@@ -1,0 +1,37 @@
+# # Hello, world!
+#
+# The canonical first example, mirroring the reference's
+# 01_getting_started/hello_world.py (cited lines per SURVEY.md §3.1): an App,
+# a function, and the three invocation modes — `.local`, `.remote`, `.map` —
+# driven from a `local_entrypoint` so `tpurun run examples/01_getting_started/
+# hello_world.py` works end to end.
+
+import sys
+
+import modal_examples_tpu as mtpu
+
+app = mtpu.App("example-hello-world")
+
+
+@app.function()
+def f(i: int) -> int:
+    if i % 2 == 0:
+        print("hello", i)
+    else:
+        print("world", i, file=sys.stderr)
+    return i * i
+
+
+@app.local_entrypoint()
+def main(n: int = 20):
+    # run the function locally, in-process
+    print("local:", f.local(1000))
+
+    # run the function remotely, in a container
+    print("remote:", f.remote(1000))
+
+    # fan out over containers, streaming ordered results back
+    total = 0
+    for ret in f.map(range(n)):
+        total += ret
+    print("map total:", total)

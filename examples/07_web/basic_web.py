@@ -1,0 +1,32 @@
+# # Basic web endpoints
+#
+# Mirrors the reference's 07_web/basic_web.py:43-46 and streaming.py:38-45:
+# a GET endpoint, a POST endpoint, and a server-sent-events stream, all
+# served by `tpurun serve examples/07_web/basic_web.py`.
+
+import time
+
+import modal_examples_tpu as mtpu
+
+app = mtpu.App("example-basic-web")
+
+
+@app.function()
+@mtpu.fastapi_endpoint(docs=True)
+def greet(user: str = "world") -> dict:
+    return {"greeting": f"Hello, {user}!"}
+
+
+@app.function()
+@mtpu.fastapi_endpoint(method="POST")
+def square(x: int) -> dict:
+    return {"x": x, "squared": x * x}
+
+
+@app.function()
+@mtpu.fastapi_endpoint()
+def stream(n: int = 3):
+    """SSE stream: one event per count, 10 Hz."""
+    for i in range(n):
+        yield {"count": i}
+        time.sleep(0.1)

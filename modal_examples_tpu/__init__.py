@@ -1,0 +1,97 @@
+"""modal_examples_tpu — a TPU-native serverless ML framework.
+
+The programming model of modal-labs/modal-examples (App/Function/Cls,
+``.remote/.map/.spawn``, images, volumes, secrets, schedules, sandboxes, web
+endpoints, clusters) re-built TPU-first: ``tpu="v5e-8"`` resource specs,
+JAX/XLA images, Pallas kernels, and ``pjit``/``shard_map`` collectives over
+ICI/DCN. See SURVEY.md for the component-by-component mapping to the
+reference.
+
+Typical use (mirrors hello_world.py / text_to_image.py in the reference):
+
+    import modal_examples_tpu as mtpu
+
+    app = mtpu.App("example")
+
+    @app.function(tpu="v5e-1")
+    def f(x):
+        ...
+
+    @app.cls(tpu="v5e-8")
+    class Model:
+        @mtpu.enter()
+        def load(self): ...
+        @mtpu.method()
+        def generate(self, prompt): ...
+"""
+
+from .core.app import App
+from .core.cls import Cls, enter, exit, method, parameter
+from .core.executor import FunctionTimeoutError, InputCancelled
+from .core.function import (
+    Function,
+    FunctionCall,
+    batched,
+    concurrent,
+    gather,
+)
+from .core.image import Image
+from .core.resources import TPUSpec, parse_tpu_spec
+from .core.retries import Retries
+from .core.schedules import Cron, Period
+from .core.serialization import RemoteError
+from .storage.dict_queue import Dict, Queue
+from .storage.secret import Secret
+from .storage.volume import CloudBucketMount, Volume
+from .web.endpoints import (
+    asgi_app,
+    fastapi_endpoint,
+    web_endpoint,
+    web_server,
+    wsgi_app,
+)
+
+__version__ = "0.1.0"
+
+__all__ = [
+    "App",
+    "Cls",
+    "CloudBucketMount",
+    "Cron",
+    "Dict",
+    "Function",
+    "FunctionCall",
+    "FunctionTimeoutError",
+    "Image",
+    "InputCancelled",
+    "Period",
+    "Queue",
+    "RemoteError",
+    "Retries",
+    "Secret",
+    "TPUSpec",
+    "Volume",
+    "asgi_app",
+    "batched",
+    "concurrent",
+    "enter",
+    "exit",
+    "fastapi_endpoint",
+    "gather",
+    "method",
+    "parameter",
+    "parse_tpu_spec",
+    "web_endpoint",
+    "web_server",
+    "wsgi_app",
+]
+
+
+class _Functions:
+    """Compat namespace: ``modal.functions.gather`` spelling."""
+
+    gather = gather
+    FunctionCall = FunctionCall
+
+
+functions = _Functions()

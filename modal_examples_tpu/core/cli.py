@@ -1,0 +1,205 @@
+"""``tpurun`` CLI — run / deploy / serve, the analog of ``modal run`` etc.
+
+Reference spec: ``modal run 01_getting_started/hello_world.py``
+(README.md:17-21); auto-generated CLI flags from the ``local_entrypoint``
+signature ("Arguments ... automatically get converted into CLI flags",
+unsloth_finetune.py:356-360, 380-441); ``modal run --detach``
+(long-training.py:168); ``modal deploy`` / ``modal serve``.
+
+Usage:
+    tpurun run path/to/script.py [::entrypoint] [--flag value ...]
+    tpurun run --detach script.py
+    tpurun deploy script.py            # register + keep scheduler alive
+    tpurun serve script.py             # host web endpoints
+    tpurun secret create NAME K=V ...
+    tpurun app list
+"""
+
+from __future__ import annotations
+
+import argparse
+import inspect
+import json
+import sys
+
+from .._internal import config as _config
+
+
+def _build_entrypoint_parser(fn, prog: str) -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(prog=prog, description=fn.__doc__)
+    sig = inspect.signature(fn)
+    for name, param in sig.parameters.items():
+        flag = "--" + name.replace("_", "-")
+        ann = param.annotation
+        required = param.default is inspect.Parameter.empty
+        default = None if required else param.default
+        if ann is bool or isinstance(default, bool):
+            p.add_argument(
+                flag,
+                default=default if default is not None else False,
+                action=argparse.BooleanOptionalAction,
+            )
+        else:
+            typ = ann if ann in (int, float, str) else (type(default) if default is not None and type(default) in (int, float, str) else str)
+            p.add_argument(flag, type=typ, default=default, required=required)
+    return p
+
+
+def _load_app(path: str):
+    from .app import App, load_module_from_path
+
+    module = load_module_from_path(path)
+    apps = [v for v in vars(module).values() if isinstance(v, App)]
+    if not apps:
+        raise SystemExit(f"no App found in {path}")
+    return module, apps[0]
+
+
+def cmd_run(argv: list[str]) -> int:
+    detach = False
+    if argv and argv[0] == "--detach":
+        detach = True
+        argv = argv[1:]
+    if not argv:
+        raise SystemExit("usage: tpurun run [--detach] script.py[::entrypoint] [flags]")
+    target, *flags = argv
+    ep_name = None
+    if "::" in target:
+        target, ep_name = target.split("::", 1)
+    module, app = _load_app(target)
+    if ep_name is None:
+        if len(app.registered_entrypoints) == 1:
+            ep_name = next(iter(app.registered_entrypoints))
+        elif "main" in app.registered_entrypoints:
+            ep_name = "main"
+        elif app.registered_entrypoints:
+            raise SystemExit(
+                f"multiple entrypoints {sorted(app.registered_entrypoints)}; "
+                f"pick one with script.py::name"
+            )
+    if ep_name is None:
+        # no local_entrypoint: if exactly one registered function, invoke it
+        if len(app.registered_functions) == 1:
+            fn = next(iter(app.registered_functions.values()))
+            with app.run(detach=detach):
+                print(fn.remote())
+            return 0
+        raise SystemExit("no local_entrypoint found")
+    ep = app.registered_entrypoints[ep_name]
+    parser = _build_entrypoint_parser(ep.raw_f, prog=f"tpurun run {target}")
+    ns = parser.parse_args(flags)
+    with app.run(detach=detach):
+        ep.raw_f(**vars(ns))
+    return 0
+
+
+def cmd_deploy(argv: list[str]) -> int:
+    keep_alive = "--no-scheduler" not in argv
+    argv = [a for a in argv if a != "--no-scheduler"]
+    if not argv:
+        raise SystemExit("usage: tpurun deploy script.py")
+    path = argv[0]
+    _module, app = _load_app(path)
+    app.deploy(source_file=path)
+    print(f"deployed app {app.name!r} "
+          f"({len(app.registered_functions)} functions, "
+          f"{len(app.registered_classes)} classes)")
+    if keep_alive and app.scheduled_functions():
+        print(f"scheduler running for {sorted(app.scheduled_functions())} (ctrl-c to stop)")
+        try:
+            app.run_scheduler()
+        except KeyboardInterrupt:
+            pass
+    return 0
+
+
+def cmd_serve(argv: list[str]) -> int:
+    if not argv:
+        raise SystemExit("usage: tpurun serve script.py [--port N] [--timeout S]")
+    path = argv[0]
+    port = 0
+    timeout = None
+    import os
+
+    if "--port" in argv:
+        port = int(argv[argv.index("--port") + 1])
+    if "--timeout" in argv:
+        timeout = float(argv[argv.index("--timeout") + 1])
+    elif os.environ.get("MTPU_SERVE_TIMEOUT"):
+        # test-harness bound, analog of MODAL_SERVE_TIMEOUT (run_example.py:28)
+        timeout = float(os.environ["MTPU_SERVE_TIMEOUT"])
+    _module, app = _load_app(path)
+    from ..web.gateway import Gateway
+
+    with app.run():
+        urls = []
+        if app.registered_web_endpoints:
+            gw = Gateway(app, port=port).start()
+            urls += [f"{gw.base_url}/{label}" for label in gw.routes]
+        for name, handle in getattr(app, "registered_servers", {}).items():
+            urls.append(handle.serve())
+        if not urls:
+            raise SystemExit("no web endpoints or servers registered")
+        for u in urls:
+            print(f"serving: {u}")
+        import time
+
+        try:
+            if timeout is None:
+                while True:
+                    time.sleep(3600)
+            else:
+                time.sleep(timeout)
+        except KeyboardInterrupt:
+            pass
+    return 0
+
+
+def cmd_secret(argv: list[str]) -> int:
+    from ..storage.secret import Secret
+
+    if len(argv) >= 2 and argv[0] == "create":
+        name = argv[1]
+        env = dict(kv.split("=", 1) for kv in argv[2:])
+        Secret.create(name, env)
+        print(f"secret {name!r} created with keys {sorted(env)}")
+        return 0
+    raise SystemExit("usage: tpurun secret create NAME KEY=VALUE ...")
+
+
+def cmd_app(argv: list[str]) -> int:
+    if argv and argv[0] == "list":
+        reg = _config.state_dir() / "apps.json"
+        try:
+            registry = json.loads(reg.read_text())
+        except (FileNotFoundError, json.JSONDecodeError):
+            registry = {}
+        for name, entry in sorted(registry.items()):
+            print(f"{name}\t{entry.get('source_file')}")
+        return 0
+    raise SystemExit("usage: tpurun app list")
+
+
+COMMANDS = {
+    "run": cmd_run,
+    "deploy": cmd_deploy,
+    "serve": cmd_serve,
+    "secret": cmd_secret,
+    "app": cmd_app,
+}
+
+
+def main(argv: list[str] | None = None) -> int:
+    argv = sys.argv[1:] if argv is None else argv
+    if not argv or argv[0] in ("-h", "--help"):
+        print(__doc__)
+        return 0
+    cmd, rest = argv[0], argv[1:]
+    handler = COMMANDS.get(cmd)
+    if handler is None:
+        raise SystemExit(f"unknown command {cmd!r}; one of {sorted(COMMANDS)}")
+    return handler(rest)
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

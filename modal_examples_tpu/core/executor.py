@@ -1,0 +1,796 @@
+"""Container executor: the local control plane for serverless functions.
+
+The reference platform schedules containers for every ``.remote/.map/.spawn``
+call — autoscaling a pool per Function, streaming logs, enforcing timeouts,
+retrying on failure, and scaling to zero after an idle window (SURVEY.md L3;
+vllm_inference.py:139-152 sets scaledown_window/target_concurrency;
+long-training.py:109-137 sets retries/timeout/single_use_containers).
+
+This module implements those semantics with supervised worker **processes**
+("containers"): spawned (never forked — forking a process that may own a TPU
+deadlocks libtpu), fed over pipes with pickled inputs, scaled between
+``min_containers`` and ``max_containers``, reaped after ``scaledown_window``
+idle seconds, and killed on per-input ``timeout`` with the input retried per
+its :class:`~modal_examples_tpu.core.retries.Retries` policy.
+
+Container model:
+- one process per container; inside it, up to ``max_concurrent_inputs``
+  (``@concurrent``, text_to_image.py:238) threads execute inputs;
+- ``@batched`` functions receive grouped inputs: the scheduler coalesces up
+  to ``max_batch_size`` queued inputs per dispatch after waiting ``wait_ms``
+  (dynamic_batching.py:29,57);
+- Cls containers instantiate the user class and run ``@enter`` hooks once
+  before serving inputs, and ``@exit`` hooks at shutdown (text_to_image.py:
+  92-137) — load-once-serve-many;
+- TPU functions serialize on a host-wide TPU lease so two containers never
+  fight over the same chips.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import itertools
+import os
+import queue as _queue
+import threading
+import time
+import traceback
+import uuid
+from collections import deque
+from typing import Any, Callable
+
+import inspect
+import subprocess
+import sys
+import tempfile
+from multiprocessing.connection import Client, Listener
+from pathlib import Path
+
+from .._internal import config as _config
+from . import serialization as ser
+from .retries import Retries
+
+
+class FunctionTimeoutError(TimeoutError):
+    pass
+
+
+class InputCancelled(Exception):
+    pass
+
+
+# --------------------------------------------------------------------------
+# Container-side (child process)
+# --------------------------------------------------------------------------
+
+
+@dataclasses.dataclass
+class ContainerConfig:
+    """Everything a container needs to boot, pickled across the spawn."""
+
+    function_tag: str
+    fn_bytes: bytes  # cloudpickled callable OR (cls, lifecycle meta) bundle
+    is_cls: bool
+    cls_params: bytes | None  # pickled dict of modal.parameter overrides
+    env: dict[str, str]
+    sys_paths: list[str]
+    max_concurrent_inputs: int
+    is_batched: bool
+    volumes: list[tuple[str, str]]  # (mount path, host path)
+
+
+def _mount_volumes(volumes: list[tuple[str, str]]) -> None:
+    """Materialize volume mounts as symlinks (local-backend bind mount)."""
+    for mount_path, host_path in volumes:
+        try:
+            if os.path.islink(mount_path):
+                if os.readlink(mount_path) == host_path:
+                    continue
+                os.unlink(mount_path)
+            elif os.path.exists(mount_path):
+                continue  # a real dir already there; leave it alone
+            os.makedirs(os.path.dirname(mount_path) or "/", exist_ok=True)
+            os.symlink(host_path, mount_path)
+        except OSError as e:
+            print(f"[mtpu] warning: cannot mount volume at {mount_path}: {e}")
+
+
+def _container_main(conn, cfg_bytes: bytes) -> None:
+    """Entry point of a container process."""
+    cfg: ContainerConfig = ser.deserialize(cfg_bytes)
+    os.environ.update(cfg.env)
+    os.environ[_config.TASK_ID_ENV] = f"ta-{uuid.uuid4().hex[:12]}"
+    import sys
+
+    for p in cfg.sys_paths:
+        if p not in sys.path:
+            sys.path.insert(0, p)
+    _mount_volumes(cfg.volumes)
+
+    send_lock = threading.Lock()
+
+    def send(msg) -> None:
+        with send_lock:
+            try:
+                conn.send(msg)
+            except (BrokenPipeError, OSError):
+                os._exit(1)
+
+    exit_hooks: list[Callable] = []
+    try:
+        target = ser.function_from_bytes(cfg.fn_bytes)
+        if cfg.is_cls:
+            cls, meta = target  # (user class, lifecycle metadata dict)
+            obj = cls()
+            if cfg.cls_params:
+                for k, v in ser.deserialize(cfg.cls_params).items():
+                    setattr(obj, k, v)
+            for name in meta.get("enter", []):
+                getattr(obj, name)()
+            exit_hooks = [getattr(obj, n) for n in meta.get("exit", [])]
+
+            def call_fn(method_name, args, kwargs):
+                return getattr(obj, method_name)(*args, **kwargs)
+
+        else:
+
+            def call_fn(method_name, args, kwargs):
+                return target(*args, **kwargs)
+
+        send(("ready",))
+    except BaseException as e:  # boot failure
+        send(("boot_error", ser.serialize_exception(e)))
+        return
+
+    inflight = threading.Semaphore(cfg.max_concurrent_inputs)
+
+    def run_one(input_id: str, method_name: str, payload: bytes) -> None:
+        try:
+            args, kwargs = ser.deserialize(payload)
+            result = call_fn(method_name, args, kwargs)
+            if inspect.isgenerator(result):
+                for item in result:
+                    send(("yield", input_id, ser.serialize(item)))
+                send(("gen_done", input_id))
+            else:
+                send(("result", input_id, True, ser.serialize(result)))
+        except BaseException as e:
+            send(("result", input_id, False, ser.serialize_exception(e)))
+        finally:
+            inflight.release()
+
+    def run_batch(input_ids: list[str], method_name: str, payloads: list[bytes]) -> None:
+        """Dynamic batching: unzip single-item args, call once with lists."""
+        try:
+            calls = [ser.deserialize(p) for p in payloads]
+            n_args = len(calls[0][0])
+            batched_args = [[c[0][i] for c in calls] for i in range(n_args)]
+            kw_keys = sorted(calls[0][1])
+            batched_kwargs = {k: [c[1][k] for c in calls] for k in kw_keys}
+            results = call_fn(method_name, batched_args, batched_kwargs)
+            results = list(results)
+            if len(results) != len(input_ids):
+                raise ValueError(
+                    f"@batched function returned {len(results)} outputs for "
+                    f"{len(input_ids)} inputs"
+                )
+            for iid, r in zip(input_ids, results):
+                send(("result", iid, True, ser.serialize(r)))
+        except BaseException as e:
+            err = ser.serialize_exception(e)
+            for iid in input_ids:
+                send(("result", iid, False, err))
+        finally:
+            inflight.release()
+
+    while True:
+        try:
+            msg = conn.recv()
+        except (EOFError, OSError):
+            break
+        if msg[0] == "shutdown":
+            break
+        elif msg[0] == "input":
+            _, input_id, method_name, payload = msg
+            inflight.acquire()
+            threading.Thread(
+                target=run_one, args=(input_id, method_name, payload), daemon=True
+            ).start()
+        elif msg[0] == "batch":
+            _, input_ids, method_name, payloads = msg
+            inflight.acquire()
+            threading.Thread(
+                target=run_batch, args=(input_ids, method_name, payloads), daemon=True
+            ).start()
+
+    for hook in exit_hooks:
+        try:
+            hook()
+        except Exception:
+            traceback.print_exc()
+    try:
+        send(("bye",))
+    except Exception:
+        pass
+
+
+# --------------------------------------------------------------------------
+# Supervisor-side (client process)
+# --------------------------------------------------------------------------
+
+
+class _Call:
+    """Client-side handle for one dispatched input (future + stream)."""
+
+    def __init__(self, input_id: str, deadline: float | None, retries: Retries | None):
+        self.input_id = input_id
+        self.deadline = deadline
+        self.retries = retries
+        self.attempt = 0
+        self.done = threading.Event()
+        self.ok: bool | None = None
+        self.value: Any = None
+        self.exc: BaseException | None = None
+        self.gen_queue: _queue.Queue = _queue.Queue()
+        self.cancelled = False
+
+    def set_result(self, value) -> None:
+        self.ok, self.value = True, value
+        self.done.set()
+
+    def set_exception(self, exc: BaseException) -> None:
+        self.ok, self.exc = False, exc
+        self.gen_queue.put(("error", exc))
+        self.done.set()
+
+    def result(self, timeout: float | None = None):
+        if not self.done.wait(timeout):
+            raise TimeoutError(f"input {self.input_id} not done after {timeout}s")
+        if self.ok:
+            return self.value
+        raise self.exc
+
+
+@dataclasses.dataclass
+class _QueuedInput:
+    call: _Call
+    method_name: str
+    payload: bytes
+    ready_at: float = 0.0  # for retry backoff
+    started_at: float | None = None
+
+
+def worker_entry() -> None:
+    """Child-process entry (``python -m modal_examples_tpu.core.container_worker``).
+
+    Containers are plain subprocesses — NOT multiprocessing spawn children —
+    so the parent's ``__main__`` is never re-executed in the child (spawn's
+    main-module fixup re-runs scripts and re-imports pytest; a real container
+    boots from its own entrypoint). The config arrives over an authenticated
+    AF_UNIX connection, the same channel used for inputs/results.
+    """
+    sock = os.environ.pop("MTPU_WORKER_SOCKET")
+    authkey = bytes.fromhex(os.environ.pop("MTPU_WORKER_AUTHKEY"))
+    conn = Client(sock, family="AF_UNIX", authkey=authkey)
+    cfg_bytes = conn.recv()
+    _container_main(conn, cfg_bytes)
+
+
+class _Container:
+    _counter = itertools.count()
+
+    def __init__(self, pool: "FunctionPool"):
+        self.pool = pool
+        self.idx = next(self._counter)
+        sock_dir = Path(tempfile.gettempdir()) / "mtpu-socks"
+        sock_dir.mkdir(exist_ok=True)
+        self._sock_path = str(sock_dir / f"c-{uuid.uuid4().hex[:12]}.sock")
+        authkey = os.urandom(16)
+        self._listener = Listener(self._sock_path, family="AF_UNIX", authkey=authkey)
+        env = dict(os.environ)
+        env["MTPU_WORKER_SOCKET"] = self._sock_path
+        env["MTPU_WORKER_AUTHKEY"] = authkey.hex()
+        pkg_root = str(Path(__file__).resolve().parents[2])
+        py_paths = [pkg_root] + [
+            p for p in env.get("PYTHONPATH", "").split(os.pathsep) if p
+        ]
+        if not pool.spec.tpu:
+            # CPU container: don't attach the TPU. The TPU plugin's
+            # sitecustomize costs seconds of boot and would contend for the
+            # chip; only containers whose Function requests tpu= pay that.
+            py_paths = [p for p in py_paths if "axon" not in p]
+            env["JAX_PLATFORMS"] = env.get("JAX_PLATFORMS_CPU_OVERRIDE", "cpu")
+        env["PYTHONPATH"] = os.pathsep.join(py_paths)
+        self.proc = subprocess.Popen(
+            [sys.executable, "-m", "modal_examples_tpu.core.container_worker"],
+            env=env,
+        )
+        self.conn = None
+        self.kill_reason: str | None = None
+        self.ready = threading.Event()
+        self.ever_ready = False
+        self.retired = False  # single-use containers retire after one dispatch
+        self.boot_error: BaseException | None = None
+        self.active: dict[str, _QueuedInput] = {}
+        self.lock = threading.Lock()
+        self.last_active = time.monotonic()
+        self.dead = False
+        self.inputs_served = 0
+        self.reader = threading.Thread(target=self._read_loop, daemon=True)
+        self.reader.start()
+        self.watchdog = threading.Thread(target=self._watch_proc, daemon=True)
+        self.watchdog.start()
+
+    def _watch_proc(self) -> None:
+        self.proc.wait()
+        # If the child died before connecting, unblock the accept().
+        if self.conn is None:
+            try:
+                self._listener.close()
+            except Exception:
+                pass
+
+    # -- dispatch -----------------------------------------------------------
+
+    def capacity(self) -> int:
+        with self.lock:
+            if self.dead or self.retired or not self.ready.is_set():
+                return 0
+            return self.pool.spec_max_concurrent - len(self.active)
+
+    def dispatch(self, qi: _QueuedInput) -> None:
+        qi.started_at = time.monotonic()
+        # timeout= is per-attempt: the clock starts at dispatch, so a retried
+        # input gets a fresh budget rather than inheriting an expired deadline
+        if self.pool.spec.timeout:
+            qi.call.deadline = qi.started_at + self.pool.spec.timeout
+        with self.lock:
+            self.active[qi.call.input_id] = qi
+            self.last_active = time.monotonic()
+        self.conn.send(("input", qi.call.input_id, qi.method_name, qi.payload))
+
+    def dispatch_batch(self, qis: list[_QueuedInput]) -> None:
+        now = time.monotonic()
+        with self.lock:
+            for qi in qis:
+                qi.started_at = now
+                if self.pool.spec.timeout:
+                    qi.call.deadline = now + self.pool.spec.timeout
+                self.active[qi.call.input_id] = qi
+            self.last_active = now
+        self.conn.send(
+            (
+                "batch",
+                [qi.call.input_id for qi in qis],
+                qis[0].method_name,
+                [qi.payload for qi in qis],
+            )
+        )
+
+    # -- reading ------------------------------------------------------------
+
+    def _read_loop(self) -> None:
+        try:
+            try:
+                conn = self._listener.accept()
+            except (OSError, EOFError):
+                return  # child died before connecting (watchdog closed us)
+            finally:
+                try:
+                    self._listener.close()
+                    os.unlink(self._sock_path)
+                except OSError:
+                    pass
+            conn.send(ser.serialize(self.pool.container_config))
+            self.conn = conn
+            while True:
+                msg = conn.recv()
+                kind = msg[0]
+                if kind == "ready":
+                    self.ever_ready = True
+                    self.ready.set()
+                elif kind == "boot_error":
+                    exc, _tb = ser.deserialize_exception(msg[1])
+                    self.boot_error = exc
+                    self.ready.set()
+                    break
+                elif kind == "yield":
+                    _, input_id, payload = msg
+                    with self.lock:
+                        qi = self.active.get(input_id)
+                    if qi:
+                        qi.call.gen_queue.put(("item", ser.deserialize(payload)))
+                elif kind == "gen_done":
+                    _, input_id = msg
+                    with self.lock:
+                        qi = self.active.pop(input_id, None)
+                        self.last_active = time.monotonic()
+                        self.inputs_served += 1
+                    if qi is not None:
+                        qi.call.gen_queue.put(("done", None))
+                        qi.call.set_result(None)
+                elif kind == "result":
+                    _, input_id, ok, payload = msg
+                    with self.lock:
+                        qi = self.active.pop(input_id, None)
+                        self.last_active = time.monotonic()
+                        self.inputs_served += 1
+                    if qi is None:
+                        continue
+                    if ok:
+                        qi.call.set_result(ser.deserialize(payload))
+                    else:
+                        exc, _tb = ser.deserialize_exception(payload)
+                        self.pool.handle_failure(qi, exc)
+                elif kind == "bye":
+                    break
+        except (EOFError, OSError):
+            pass
+        finally:
+            self._on_death()
+
+    def _on_death(self) -> None:
+        with self.lock:
+            self.dead = True
+            orphans = list(self.active.values())
+            self.active.clear()
+        self.pool.on_container_dead(self, orphans)
+
+    # -- lifecycle ----------------------------------------------------------
+
+    def shutdown(self, graceful: bool = True) -> None:
+        with self.lock:
+            if self.dead:
+                return
+        if graceful and self.conn is not None:
+            try:
+                self.conn.send(("shutdown",))
+                return  # reader sees "bye"/EOF and finalizes
+            except (BrokenPipeError, OSError):
+                pass
+        self.kill()
+
+    def kill(self) -> None:
+        try:
+            self.proc.terminate()
+        except Exception:
+            pass
+
+
+class FunctionPool:
+    """Autoscaling container pool for one Function (the L3 scheduler unit)."""
+
+    def __init__(self, spec, runner):
+        # ``spec`` is a FunctionSpec (function.py); runner is the AppRun owner.
+        self.spec = spec
+        self.runner = runner
+        self.container_config = spec.container_config()
+        self.spec_max_concurrent = spec.max_concurrent_inputs
+        self.pending: deque[_QueuedInput] = deque()
+        self.calls: dict[str, _Call] = {}
+        self.containers: list[_Container] = []
+        self.boot_crashes = 0
+        self.lock = threading.Lock()
+        self.wake = threading.Condition(self.lock)
+        self.closed = False
+        self.scheduler = threading.Thread(target=self._schedule_loop, daemon=True)
+        self.scheduler.start()
+
+    # -- public API ---------------------------------------------------------
+
+    def submit(self, method_name: str, args: tuple, kwargs: dict) -> _Call:
+        payload = ser.serialize((args, kwargs))
+        input_id = f"in-{uuid.uuid4().hex[:16]}"
+        call = _Call(input_id, None, self.spec.retries)  # deadline set at dispatch
+        qi = _QueuedInput(call, method_name, payload, ready_at=time.monotonic())
+        with self.lock:
+            if self.closed:
+                raise RuntimeError("app run context is closed")
+            self.calls[input_id] = call
+            self.pending.append(qi)
+            self.wake.notify()
+        return call
+
+    def shutdown(self) -> None:
+        with self.lock:
+            self.closed = True
+            self.wake.notify()
+        for c in list(self.containers):
+            c.shutdown(graceful=True)
+        deadline = time.monotonic() + 5.0
+        for c in list(self.containers):
+            try:
+                c.proc.wait(max(0.05, deadline - time.monotonic()))
+            except subprocess.TimeoutExpired:
+                c.kill()
+
+    # -- failure/retry ------------------------------------------------------
+
+    def handle_failure(self, qi: _QueuedInput, exc: BaseException) -> None:
+        retries = qi.call.retries
+        qi.call.attempt += 1
+        if retries is not None and qi.call.attempt <= retries.max_retries:
+            delay = retries.delay_for_attempt(qi.call.attempt)
+            qi.started_at = None
+            qi.ready_at = time.monotonic() + delay
+            with self.lock:
+                self.pending.append(qi)
+                self.wake.notify()
+        else:
+            qi.call.set_exception(exc)
+
+    def on_container_dead(self, container: _Container, orphans: list[_QueuedInput]) -> None:
+        with self.lock:
+            if container in self.containers:
+                self.containers.remove(container)
+            self.wake.notify()
+        if not container.ever_ready and container.boot_error is None:
+            # Crashed before serving anything (e.g. segfault at import).
+            self.boot_crashes += 1
+            if self.boot_crashes >= 3:
+                err = RuntimeError(
+                    f"containers for {self.spec.tag} are crash-looping at boot "
+                    f"({self.boot_crashes} consecutive failures)"
+                )
+                with self.lock:
+                    doomed = list(self.pending)
+                    self.pending.clear()
+                for qi in doomed + orphans:
+                    qi.call.set_exception(err)
+                return
+        elif container.ever_ready:
+            self.boot_crashes = 0
+        if container.boot_error is not None:
+            # Boot failures fail every queued input — nothing will ever run.
+            with self.lock:
+                doomed = list(self.pending)
+                self.pending.clear()
+            for qi in doomed + orphans:
+                qi.call.set_exception(container.boot_error)
+            return
+        for qi in orphans:
+            timed_out = qi.call.deadline and time.monotonic() >= qi.call.deadline
+            if timed_out:
+                self.handle_failure(
+                    qi,
+                    FunctionTimeoutError(
+                        f"{self.spec.tag} input exceeded timeout={self.spec.timeout}s"
+                    ),
+                )
+            elif container.kill_reason == "timeout":
+                # Collateral victim of a timeout kill: another input on this
+                # @concurrent container blew its deadline. Requeue for free —
+                # this input did nothing wrong, so it isn't charged an attempt.
+                qi.started_at = None
+                qi.call.deadline = None
+                qi.ready_at = time.monotonic()
+                with self.lock:
+                    self.pending.append(qi)
+                    self.wake.notify()
+            else:
+                self.handle_failure(
+                    qi,
+                    RuntimeError(
+                        f"container for {self.spec.tag} died while processing input"
+                    ),
+                )
+
+    # -- scheduling loop ----------------------------------------------------
+
+    def _schedule_loop(self) -> None:
+        while True:
+            with self.lock:
+                if self.closed:
+                    return
+                self.wake.wait(timeout=0.05)
+                if self.closed:
+                    return
+            try:
+                self._tick()
+            except Exception:
+                traceback.print_exc()
+
+    def _tick(self) -> None:
+        now = time.monotonic()
+        self._enforce_timeouts(now)
+        self._dispatch_ready(now)
+        self._autoscale(now)
+
+    def _enforce_timeouts(self, now: float) -> None:
+        for c in list(self.containers):
+            with c.lock:
+                expired = [
+                    qi
+                    for qi in c.active.values()
+                    if qi.call.deadline is not None and now >= qi.call.deadline
+                ]
+            if expired:
+                # The input holds the container's thread; only a kill frees it.
+                # on_container_dead() routes actives through timeout handling.
+                c.kill_reason = "timeout"
+                c.kill()
+
+    def _ready_inputs(self, now: float) -> list[_QueuedInput]:
+        ready = []
+        with self.lock:
+            n = len(self.pending)
+            for _ in range(n):
+                qi = self.pending.popleft()
+                if qi.call.cancelled:
+                    qi.call.set_exception(InputCancelled(qi.call.input_id))
+                elif qi.ready_at <= now:
+                    ready.append(qi)
+                else:
+                    self.pending.append(qi)
+        return ready
+
+    def _dispatch_ready(self, now: float) -> None:
+        ready = self._ready_inputs(now)
+        if not ready:
+            return
+        if self.spec.batched:
+            self._dispatch_batched(ready, now)
+            return
+        for i, qi in enumerate(ready):
+            target = next((c for c in self.containers if c.capacity() > 0), None)
+            if target is None:
+                with self.lock:
+                    self.pending.extendleft(reversed(ready[i:]))
+                return
+            if self.spec.single_use_containers:
+                # one input per container: retire from rotation at dispatch
+                target.retired = True
+            target.dispatch(qi)
+
+    def _dispatch_batched(self, ready: list[_QueuedInput], now: float) -> None:
+        cfg = self.spec.batched
+        oldest_wait = max((now - qi.ready_at) for qi in ready) if ready else 0
+        full = len(ready) >= cfg.max_batch_size
+        waited = oldest_wait * 1000.0 >= cfg.wait_ms
+        if not (full or waited):
+            with self.lock:
+                self.pending.extendleft(reversed(ready))
+            return
+        while ready:
+            batch, ready = ready[: cfg.max_batch_size], ready[cfg.max_batch_size :]
+            target = next((c for c in self.containers if c.capacity() > 0), None)
+            if target is None:
+                with self.lock:
+                    self.pending.extendleft(reversed(batch + ready))
+                return
+            target.dispatch_batch(batch)
+
+    def _autoscale(self, now: float) -> None:
+        with self.lock:
+            pending_n = len(self.pending)
+        live = [c for c in self.containers if not c.dead and not c.retired]
+        booting = [c for c in live if not c.ready.is_set()]
+        free_slots = sum(c.capacity() for c in live) + len(booting) * self.spec_max_concurrent
+        # scale up
+        want = 0
+        if pending_n > free_slots:
+            want = min(
+                self.spec.max_containers - len(live),
+                (pending_n - free_slots + self.spec_max_concurrent - 1)
+                // self.spec_max_concurrent,
+            )
+        for _ in range(max(0, want)):
+            self._spawn_container()
+        # keep min_containers warm
+        while len([c for c in self.containers if not c.dead]) < self.spec.min_containers:
+            self._spawn_container()
+        # scale down
+        idle_cut = now - self.spec.scaledown_window
+        for c in list(self.containers):
+            if c.dead:
+                continue
+            with c.lock:
+                idle = not c.active and c.last_active < idle_cut
+                spent = c.retired and not c.active and c.inputs_served > 0
+            live_n = len([x for x in self.containers if not x.dead])
+            if (idle or spent) and (spent or live_n > self.spec.min_containers):
+                c.shutdown(graceful=True)
+
+    def _spawn_container(self) -> None:
+        c = _Container(self)
+        self.containers.append(c)
+
+
+# --------------------------------------------------------------------------
+# Inline backend — caller-process execution with serialization round-trip
+# --------------------------------------------------------------------------
+
+
+class InlinePool:
+    """Runs inputs in the caller process (``MTPU_BACKEND=inline``).
+
+    Preserves the serialization boundary (args/results round-trip through
+    pickle) and retry semantics, but shares the caller's interpreter — the
+    mode used for single-chip benches where the caller owns the TPU, matching
+    how the reference's ``.local()`` behaves but for every invocation kind.
+    """
+
+    def __init__(self, spec, runner):
+        self.spec = spec
+        self.runner = runner
+        self._obj = None
+        self._exit_hooks: list[Callable] = []
+        self._lock = threading.Lock()
+        self._fn = None
+
+    def _ensure_target(self):
+        with self._lock:
+            if self._fn is not None:
+                return self._fn
+            cfg = self.spec.container_config()
+            _mount_volumes(cfg.volumes)
+            os.environ.update(cfg.env)
+            target = ser.function_from_bytes(cfg.fn_bytes)
+            if cfg.is_cls:
+                cls, meta = target
+                obj = cls()
+                if cfg.cls_params:
+                    for k, v in ser.deserialize(cfg.cls_params).items():
+                        setattr(obj, k, v)
+                for name in meta.get("enter", []):
+                    getattr(obj, name)()
+                self._obj = obj
+                self._exit_hooks = [getattr(obj, n) for n in meta.get("exit", [])]
+
+                def call_fn(method_name, args, kwargs):
+                    return getattr(obj, method_name)(*args, **kwargs)
+
+            else:
+
+                def call_fn(method_name, args, kwargs):
+                    return target(*args, **kwargs)
+
+            self._fn = call_fn
+            return call_fn
+
+    def submit(self, method_name: str, args: tuple, kwargs: dict) -> _Call:
+        call = _Call(f"in-{uuid.uuid4().hex[:16]}", None, self.spec.retries)
+
+        def run():
+            payload = ser.serialize((args, kwargs))
+            attempt = 0
+            while True:
+                try:
+                    a, kw = ser.deserialize(payload)
+                    fn = self._ensure_target()
+                    result = fn(method_name, a, kw)
+                    if inspect.isgenerator(result):
+                        for item in result:
+                            call.gen_queue.put(
+                                ("item", ser.deserialize(ser.serialize(item)))
+                            )
+                        call.gen_queue.put(("done", None))
+                        call.set_result(None)
+                    else:
+                        call.set_result(ser.deserialize(ser.serialize(result)))
+                    return
+                except BaseException as e:
+                    attempt += 1
+                    r = self.spec.retries
+                    if r is not None and attempt <= r.max_retries:
+                        time.sleep(min(r.delay_for_attempt(attempt), 0.1))
+                        continue
+                    exc, _tb = ser.deserialize_exception(ser.serialize_exception(e))
+                    call.set_exception(exc)
+                    return
+
+        threading.Thread(target=run, daemon=True).start()
+        return call
+
+    def shutdown(self) -> None:
+        for hook in self._exit_hooks:
+            try:
+                hook()
+            except Exception:
+                traceback.print_exc()
+
+
+def make_pool(spec, runner):
+    if _config.backend() == "inline" or spec.force_inline:
+        return InlinePool(spec, runner)
+    return FunctionPool(spec, runner)

@@ -1,0 +1,40 @@
+"""Retry policies for serverless functions.
+
+Reference spec: ``retries=modal.Retries(initial_delay=0.0, max_retries=10)``
+plus ``timeout=`` and ``single_use_containers=True`` drive the
+interruption-tolerant training loop in 06_gpu_and_ml/long-training.py:109-137;
+a bare integer (``retries=3``) is also accepted (train.py:38-39).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+
+@dataclasses.dataclass(frozen=True)
+class Retries:
+    max_retries: int = 2
+    backoff_coefficient: float = 2.0
+    initial_delay: float = 1.0
+    max_delay: float = 60.0
+
+    def __post_init__(self):
+        if self.max_retries < 0:
+            raise ValueError("max_retries must be >= 0")
+        if self.backoff_coefficient < 1.0:
+            raise ValueError("backoff_coefficient must be >= 1.0")
+
+    def delay_for_attempt(self, attempt: int) -> float:
+        """Delay before retry number ``attempt`` (1-based)."""
+        d = self.initial_delay * (self.backoff_coefficient ** max(0, attempt - 1))
+        return min(d, self.max_delay)
+
+
+def normalize_retries(retries: "Retries | int | None") -> Retries | None:
+    if retries is None:
+        return None
+    if isinstance(retries, Retries):
+        return retries
+    if isinstance(retries, int):
+        return Retries(max_retries=retries, initial_delay=1.0)
+    raise TypeError(f"retries must be an int or Retries, got {type(retries)!r}")

@@ -1,0 +1,91 @@
+"""Serialization of args/results across the client -> container boundary.
+
+The reference SDK serializes function arguments and results when crossing the
+process/network boundary on every ``.remote/.map/.spawn`` call (SURVEY.md
+§3.1). We use pickle for plain data and fall back to cloudpickle for
+closures/lambdas/``__main__``-defined callables, which is what lets
+``tpurun run script.py`` ship entrypoint-local functions to containers.
+
+Exceptions raised in a container are wrapped in :class:`RemoteError` carrying
+the remote traceback, mirroring how the reference surfaces user exceptions
+with the container-side stack.
+"""
+
+from __future__ import annotations
+
+import io
+import pickle
+import traceback
+from typing import Any
+
+import cloudpickle
+
+
+class SerializationError(Exception):
+    pass
+
+
+class RemoteError(Exception):
+    """A user exception re-raised on the client, with the remote traceback."""
+
+    def __init__(self, message: str, remote_traceback: str = ""):
+        super().__init__(message)
+        self.remote_traceback = remote_traceback
+
+    def __str__(self) -> str:  # pragma: no cover - cosmetic
+        base = super().__str__()
+        if self.remote_traceback:
+            return f"{base}\n--- remote traceback ---\n{self.remote_traceback}"
+        return base
+
+
+def serialize(obj: Any) -> bytes:
+    """Pickle ``obj``; cloudpickle fallback for non-importable callables."""
+    try:
+        return pickle.dumps(obj, protocol=pickle.HIGHEST_PROTOCOL)
+    except Exception:
+        try:
+            return cloudpickle.dumps(obj)
+        except Exception as e:
+            raise SerializationError(
+                f"cannot serialize {type(obj).__name__!r} for the remote boundary: {e}"
+            ) from e
+
+
+def deserialize(data: bytes) -> Any:
+    return pickle.loads(data)
+
+
+def serialize_exception(exc: BaseException) -> bytes:
+    """Best-effort pickle of the exception itself; else a RemoteError shim."""
+    tb = "".join(traceback.format_exception(type(exc), exc, exc.__traceback__))
+    try:
+        payload = pickle.dumps((exc, tb), protocol=pickle.HIGHEST_PROTOCOL)
+        # Verify round-trip: some exceptions pickle but fail to unpickle.
+        pickle.loads(payload)
+        return payload
+    except Exception:
+        shim = RemoteError(f"{type(exc).__name__}: {exc}", tb)
+        return pickle.dumps((shim, tb), protocol=pickle.HIGHEST_PROTOCOL)
+
+
+def deserialize_exception(data: bytes) -> tuple[BaseException, str]:
+    exc, tb = pickle.loads(data)
+    return exc, tb
+
+
+def function_to_bytes(fn: Any) -> bytes:
+    """Serialize a callable definition for execution inside a container.
+
+    Module-level functions pickle by reference (the container re-imports the
+    defining module — matching the reference's container-imports-module
+    semantics, SURVEY.md §3.1); closures and ``__main__`` callables are
+    captured by value via cloudpickle.
+    """
+    buf = io.BytesIO()
+    cloudpickle.dump(fn, buf)
+    return buf.getvalue()
+
+
+def function_from_bytes(data: bytes) -> Any:
+    return cloudpickle.loads(data)

@@ -1,0 +1,182 @@
+"""Volume — shared durable filesystem with commit/reload semantics.
+
+Reference spec: ``modal.Volume.from_name(name, create_if_missing=True)``
+mounted at a path in the container (vllm_inference.py:77-81), with explicit
+``volume.commit()`` after writes (openai_whisper/finetuning/train/train.py:469)
+and ``volume.reload()`` to pick up other writers' commits
+(torch_profiling.py:279). Volumes back HF weight caches, checkpoints, and —
+critically on TPU — the **XLA persistent compile cache** (our analog of the
+reference's vllm-cache volume, the single biggest cold-start lever; SURVEY.md
+§7 step 3).
+
+Local control plane: each volume is a directory under the state dir. commit()
+fsyncs and bumps a version file; reload() re-reads it. A GCS-backed
+implementation can replace :class:`_DirBackend` without changing callers.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import re
+import time
+from pathlib import Path
+
+from .._internal import config as _config
+
+_NAME_RE = re.compile(r"^[a-zA-Z0-9][a-zA-Z0-9._-]*$")
+
+
+class VolumeNotFound(KeyError):
+    pass
+
+
+def _volumes_root() -> Path:
+    p = _config.state_dir() / "volumes"
+    p.mkdir(parents=True, exist_ok=True)
+    return p
+
+
+class Volume:
+    def __init__(self, name: str, path: Path):
+        self.name = name
+        self._path = path
+        self._seen_version = self.version
+
+    # -- constructors -------------------------------------------------------
+
+    @classmethod
+    def from_name(cls, name: str, create_if_missing: bool = False, environment_name: str | None = None) -> "Volume":
+        if not _NAME_RE.match(name):
+            raise ValueError(f"invalid volume name {name!r}")
+        path = _volumes_root() / name
+        if not path.exists():
+            if not create_if_missing:
+                raise VolumeNotFound(name)
+            path.mkdir(parents=True, exist_ok=True)
+            (path / ".version").write_text("0")
+        return cls(name, path)
+
+    @classmethod
+    def ephemeral(cls):
+        import contextlib
+        import tempfile
+
+        @contextlib.contextmanager
+        def _ctx():
+            with tempfile.TemporaryDirectory(prefix="mtpu-vol-") as d:
+                p = Path(d)
+                (p / ".version").write_text("0")
+                yield cls(f"ephemeral-{os.path.basename(d)}", p)
+
+        return _ctx()
+
+    @staticmethod
+    def delete(name: str) -> None:
+        import shutil
+
+        path = _volumes_root() / name
+        if path.exists():
+            shutil.rmtree(path)
+
+    # -- filesystem ---------------------------------------------------------
+
+    @property
+    def local_path(self) -> Path:
+        """Host path of the volume (containers mount this path)."""
+        return self._path
+
+    @property
+    def version(self) -> int:
+        vf = self._path / ".version"
+        try:
+            return int(vf.read_text() or "0")
+        except (FileNotFoundError, ValueError):
+            return 0
+
+    def commit(self) -> None:
+        """Flush writes; makes them visible to other readers at reload()."""
+        vf = self._path / ".version"
+        v = self.version + 1
+        tmp = self._path / f".version.tmp.{os.getpid()}"
+        tmp.write_text(str(v))
+        os.replace(tmp, vf)
+        self._seen_version = v
+
+    def reload(self) -> None:
+        """Pick up commits made by other containers since our last look."""
+        self._seen_version = self.version
+
+    # -- convenience API (modeled on modal's volume file API) ----------------
+
+    def listdir(self, path: str = "/", recursive: bool = False):
+        base = self._resolve(path)
+        if recursive:
+            for root, _dirs, files in os.walk(base):
+                for f in files:
+                    full = Path(root) / f
+                    yield str(full.relative_to(self._path))
+        else:
+            for entry in sorted(base.iterdir()):
+                if entry.name.startswith(".version"):
+                    continue
+                yield str(entry.relative_to(self._path))
+
+    def read_file(self, path: str) -> bytes:
+        return self._resolve(path).read_bytes()
+
+    def write_file(self, path: str, data: bytes) -> None:
+        p = self._resolve(path)
+        p.parent.mkdir(parents=True, exist_ok=True)
+        p.write_bytes(data)
+
+    def remove_file(self, path: str, recursive: bool = False) -> None:
+        import shutil
+
+        p = self._resolve(path)
+        if p.is_dir():
+            if not recursive:
+                raise IsADirectoryError(path)
+            shutil.rmtree(p)
+        else:
+            p.unlink()
+
+    def _resolve(self, path: str) -> Path:
+        p = (self._path / path.lstrip("/")).resolve()
+        root = self._path.resolve()
+        if p != root and root not in p.parents:
+            raise PermissionError(f"path escapes volume: {path}")
+        return p
+
+    def __repr__(self) -> str:
+        return f"Volume({self.name!r})"
+
+
+class CloudBucketMount:
+    """Mount an object-store bucket as a filesystem path.
+
+    Reference: S3/GCS mounts in 12_datasets/coco.py:26-29 and
+    10_integrations/s3_bucket_mount.py. TPU-natively this is a GCS bucket;
+    locally we model it as a (optionally read-only) host directory so dataset
+    examples run end-to-end without cloud credentials.
+    """
+
+    def __init__(
+        self,
+        bucket_name: str,
+        *,
+        bucket_endpoint_url: str | None = None,
+        key_prefix: str | None = None,
+        secret=None,
+        read_only: bool = False,
+    ):
+        self.bucket_name = bucket_name
+        self.key_prefix = key_prefix or ""
+        self.read_only = read_only
+        root = _config.state_dir() / "buckets" / bucket_name
+        root.mkdir(parents=True, exist_ok=True)
+        self.local_path = root / self.key_prefix if self.key_prefix else root
+        self.local_path.mkdir(parents=True, exist_ok=True)
+
+    def __repr__(self) -> str:
+        return f"CloudBucketMount({self.bucket_name!r}, prefix={self.key_prefix!r})"

@@ -1,0 +1,176 @@
+"""Local web gateway: hosts an app's web endpoints over HTTP.
+
+This is the local analog of the reference platform's web proxy in front of
+``@modal.fastapi_endpoint`` / ``@modal.asgi_app`` / ``@modal.wsgi_app`` /
+``@modal.web_server`` functions (07_web/*, SURVEY.md L6). fastapi/uvicorn are
+optional: the gateway is stdlib ``http.server`` and dispatches requests into
+the same container pools as ``.remote`` calls, so web traffic exercises the
+exact same scheduling path (autoscaling, @concurrent, @batched) as RPC
+traffic. Generator functions stream as ``text/event-stream`` (SSE), matching
+07_web/streaming.py:38-45.
+"""
+
+from __future__ import annotations
+
+import inspect
+import json
+import socket
+import threading
+import urllib.parse
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+from . import registry
+
+
+def _coerce_kwargs(fn, raw: dict) -> dict:
+    """Coerce string query params to the entrypoint's annotated types."""
+    sig = inspect.signature(fn)
+    out = {}
+    for name, value in raw.items():
+        param = sig.parameters.get(name)
+        if param is None:
+            out[name] = value
+            continue
+        ann = param.annotation
+        try:
+            if ann is int:
+                value = int(value)
+            elif ann is float:
+                value = float(value)
+            elif ann is bool:
+                value = str(value).lower() in ("1", "true", "yes", "on")
+        except (TypeError, ValueError):
+            pass
+        out[name] = value
+    return out
+
+
+class _Handler(BaseHTTPRequestHandler):
+    gateway: "Gateway"
+
+    def log_message(self, fmt, *args):  # quiet by default; logs go to stdout
+        pass
+
+    def _route(self):
+        path = urllib.parse.urlparse(self.path)
+        label = path.path.strip("/").split("/")[0]
+        return self.gateway.routes.get(label), path
+
+    def _respond_json(self, code: int, obj) -> None:
+        body = json.dumps(obj).encode()
+        self.send_response(code)
+        self.send_header("content-type", "application/json")
+        self.send_header("content-length", str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
+
+    def _handle(self, method: str) -> None:
+        route, parsed = self._route()
+        if route is None:
+            self._respond_json(404, {"error": f"no endpoint at {parsed.path}"})
+            return
+        fn = route["function"]
+        web = fn.spec.web
+        if web["type"] == "fastapi_endpoint" and web.get("method", "GET") != method:
+            self._respond_json(405, {"error": f"method {method} not allowed"})
+            return
+        kwargs = {
+            k: v[-1] for k, v in urllib.parse.parse_qs(parsed.query).items()
+        }
+        if method == "POST":
+            length = int(self.headers.get("content-length") or 0)
+            if length:
+                try:
+                    body = json.loads(self.rfile.read(length))
+                    if isinstance(body, dict):
+                        kwargs.update(body)
+                except json.JSONDecodeError:
+                    self._respond_json(400, {"error": "invalid JSON body"})
+                    return
+        kwargs = _coerce_kwargs(fn.raw_f, kwargs)
+        headers_sent = False
+        try:
+            if fn.spec.is_generator:
+                self.send_response(200)
+                self.send_header("content-type", "text/event-stream")
+                self.send_header("cache-control", "no-cache")
+                self.end_headers()
+                headers_sent = True
+                for item in fn.remote_gen(**kwargs):
+                    data = item if isinstance(item, str) else json.dumps(item)
+                    self.wfile.write(f"data: {data}\n\n".encode())
+                    self.wfile.flush()
+                return
+            result = fn.remote(**kwargs)
+            if isinstance(result, (bytes, bytearray)):
+                self.send_response(200)
+                self.send_header("content-type", "application/octet-stream")
+                self.send_header("content-length", str(len(result)))
+                self.end_headers()
+                headers_sent = True
+                self.wfile.write(result)
+            else:
+                self._respond_json(200, result)
+        except BrokenPipeError:
+            pass
+        except BaseException as e:
+            if headers_sent:
+                # Response already started: a second status line would corrupt
+                # the stream. Drop the connection so the client sees EOF.
+                print(f"[gateway] error mid-response: {type(e).__name__}: {e}")
+                self.close_connection = True
+            else:
+                self._respond_json(500, {"error": f"{type(e).__name__}: {e}"})
+
+    def do_GET(self):
+        self._handle("GET")
+
+    def do_POST(self):
+        self._handle("POST")
+
+
+class Gateway:
+    """One HTTP server hosting all web endpoints of an app."""
+
+    def __init__(self, app, host: str = "127.0.0.1", port: int = 0):
+        self.app = app
+        self.routes: dict[str, dict] = {}
+        for name in app.registered_web_endpoints:
+            fn = app.registered_functions[name]
+            label = (fn.spec.web or {}).get("label") or name
+            self.routes[label] = {"function": fn}
+        handler = type("BoundHandler", (_Handler,), {"gateway": self})
+        self.httpd = ThreadingHTTPServer((host, port), handler)
+        self.host, self.port = self.httpd.server_address[:2]
+        self._thread: threading.Thread | None = None
+
+    def start(self) -> "Gateway":
+        for label, route in self.routes.items():
+            url = f"http://{self.host}:{self.port}/{label}"
+            registry.publish(route["function"].spec.tag, url)
+        self._thread = threading.Thread(target=self.httpd.serve_forever, daemon=True)
+        self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        self.httpd.shutdown()
+        self.httpd.server_close()
+
+    @property
+    def base_url(self) -> str:
+        return f"http://{self.host}:{self.port}"
+
+
+def wait_for_port(host: str, port: int, timeout: float) -> bool:
+    """Poll until a TCP port accepts — the readiness gate the reference uses
+    before advertising a replica (vllm_inference.py:127-128)."""
+    import time
+
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        try:
+            with socket.create_connection((host, port), timeout=1.0):
+                return True
+        except OSError:
+            time.sleep(0.1)
+    return False

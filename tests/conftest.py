@@ -1,0 +1,51 @@
+"""Test harness configuration.
+
+Forces the CPU backend with an 8-device virtual mesh (the reference has no
+fake backend — SURVEY.md §4 calls out that we add one so multi-chip SPMD
+paths are testable without TPUs: ``xla_force_host_platform_device_count``),
+and isolates the framework's state dir per test session.
+"""
+
+import os
+import sys
+import tempfile
+from pathlib import Path
+
+_REPO_ROOT = str(Path(__file__).resolve().parent.parent)
+if _REPO_ROOT not in sys.path:
+    sys.path.insert(0, _REPO_ROOT)
+
+# Must happen before any jax import anywhere in the test process.
+os.environ["JAX_PLATFORMS"] = "cpu"
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+
+# Isolate the local control plane (volumes/dicts/queues/apps) per session.
+_state_tmp = tempfile.mkdtemp(prefix="mtpu-test-state-")
+os.environ.setdefault("MTPU_STATE_DIR", _state_tmp)
+
+import pytest  # noqa: E402
+
+
+@pytest.fixture()
+def state_dir():
+    return Path(os.environ["MTPU_STATE_DIR"])
+
+
+def force_cpu_jax():
+    """Import jax pinned to CPU even with the axon TPU plugin registered."""
+    import jax
+
+    try:
+        jax.config.update("jax_platforms", "cpu")
+    except Exception:
+        pass
+    return jax
+
+
+@pytest.fixture(scope="session")
+def jax_cpu():
+    return force_cpu_jax()

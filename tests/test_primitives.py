@@ -1,0 +1,197 @@
+"""Unit tests for jax-free primitives: resources, retries, schedules, image,
+volumes, secrets, dicts, queues. (Reference test strategy: SURVEY.md §4 —
+cheap unit tier.)"""
+
+import datetime as dt
+import threading
+
+import pytest
+
+import modal_examples_tpu as mtpu
+from modal_examples_tpu.core.resources import (
+    InvalidTPUSpec,
+    parse_tpu_request,
+    parse_tpu_spec,
+)
+from modal_examples_tpu.core.retries import Retries, normalize_retries
+from modal_examples_tpu.core.schedules import Cron, InvalidSchedule, Period
+from modal_examples_tpu.storage.dict_queue import Empty
+
+
+class TestTPUSpec:
+    def test_parse_basic(self):
+        s = parse_tpu_spec("v5e-8")
+        assert s.generation == "v5e"
+        assert s.chips == 8
+        assert s.hosts == 1
+        assert not s.multi_host
+
+    def test_parse_multi_host(self):
+        s = parse_tpu_spec("v5p-128")
+        assert s.hosts == 32  # 4 chips/host
+        assert s.multi_host
+
+    def test_bare_generation_is_one_chip(self):
+        assert parse_tpu_spec("v5e").chips == 1
+
+    def test_fallback_list(self):
+        specs = parse_tpu_request(["v5e-8", "v4-8"])
+        assert [str(s) for s in specs] == ["v5e-8", "v4-8"]
+
+    def test_invalid(self):
+        with pytest.raises(InvalidTPUSpec):
+            parse_tpu_spec("h100")
+        with pytest.raises(InvalidTPUSpec):
+            parse_tpu_spec("v5e-0")
+
+
+class TestRetries:
+    def test_backoff(self):
+        r = Retries(max_retries=5, initial_delay=1.0, backoff_coefficient=2.0)
+        assert r.delay_for_attempt(1) == 1.0
+        assert r.delay_for_attempt(3) == 4.0
+
+    def test_int_normalization(self):
+        assert normalize_retries(3).max_retries == 3
+        assert normalize_retries(None) is None
+
+
+class TestSchedules:
+    def test_period(self):
+        p = Period(minutes=5)
+        now = dt.datetime(2026, 7, 28, 12, 0, 0)
+        assert p.next_fire(now) == now + dt.timedelta(minutes=5)
+
+    def test_cron_every_minute(self):
+        c = Cron("* * * * *")
+        now = dt.datetime(2026, 7, 28, 12, 0, 30)
+        assert c.next_fire(now) == dt.datetime(2026, 7, 28, 12, 1, 0)
+
+    def test_cron_daily_9am(self):
+        c = Cron("0 9 * * *")
+        now = dt.datetime(2026, 7, 28, 10, 0)
+        assert c.next_fire(now) == dt.datetime(2026, 7, 29, 9, 0)
+
+    def test_cron_step_and_range(self):
+        c = Cron("*/15 8-17 * * 1-5")
+        fire = c.next_fire(dt.datetime(2026, 7, 25, 12, 0))  # a Saturday
+        assert fire == dt.datetime(2026, 7, 27, 8, 0)  # Monday 8:00
+
+    def test_cron_invalid(self):
+        with pytest.raises(InvalidSchedule):
+            Cron("* * *")
+        with pytest.raises(InvalidSchedule):
+            Cron("61 * * * *")
+
+
+class TestImage:
+    def test_chain_and_env(self):
+        img = (
+            mtpu.Image.debian_slim()
+            .uv_pip_install("jax[tpu]", "flax")
+            .apt_install("ffmpeg")
+            .env({"HF_HUB_CACHE": "/cache"})
+        )
+        assert img.env_vars() == {"HF_HUB_CACHE": "/cache"}
+        assert "flax" in img.python_packages()
+
+    def test_digest_stable_and_order_sensitive(self):
+        a = mtpu.Image.debian_slim().env({"A": "1"})
+        b = mtpu.Image.debian_slim().env({"A": "1"})
+        c = mtpu.Image.debian_slim().env({"A": "2"})
+        assert a.digest() == b.digest()
+        assert a.digest() != c.digest()
+
+    def test_imports_ctx_suppresses_locally(self):
+        img = mtpu.Image.debian_slim()
+        with img.imports():
+            import not_a_real_package  # noqa: F401
+
+    def test_run_function_cached(self, state_dir):
+        calls = []
+        img = mtpu.Image.debian_slim().run_function(lambda: calls.append(1))
+        img.build_local()
+        img.build_local()
+        assert calls == [1]
+
+    def test_tpu_base_has_no_cuda(self):
+        img = mtpu.Image.tpu_base()
+        assert not any("cuda" in p.lower() for p in img.python_packages())
+        assert any("jax" in p for p in img.python_packages())
+
+
+class TestVolume:
+    def test_commit_reload(self):
+        vol = mtpu.Volume.from_name("test-vol", create_if_missing=True)
+        vol.write_file("weights/model.bin", b"abc")
+        v0 = vol.version
+        vol.commit()
+        assert vol.version == v0 + 1
+        vol2 = mtpu.Volume.from_name("test-vol")
+        vol2.reload()
+        assert vol2.read_file("weights/model.bin") == b"abc"
+        assert "weights/model.bin" in list(vol2.listdir("/", recursive=True))
+
+    def test_path_escape_blocked(self):
+        vol = mtpu.Volume.from_name("test-vol2", create_if_missing=True)
+        with pytest.raises(PermissionError):
+            vol.read_file("../../etc/passwd")
+
+    def test_ephemeral(self):
+        with mtpu.Volume.ephemeral() as vol:
+            vol.write_file("x", b"1")
+            assert vol.read_file("x") == b"1"
+
+    def test_missing_raises(self):
+        from modal_examples_tpu.storage.volume import VolumeNotFound
+
+        with pytest.raises(VolumeNotFound):
+            mtpu.Volume.from_name("never-created-vol")
+
+
+class TestSecret:
+    def test_from_dict_and_name(self):
+        mtpu.Secret.create("hf-secret", {"HF_TOKEN": "tok"})
+        s = mtpu.Secret.from_name("hf-secret", required_keys=["HF_TOKEN"])
+        assert s.env_vars() == {"HF_TOKEN": "tok"}
+        with pytest.raises(KeyError):
+            mtpu.Secret.from_name("hf-secret", required_keys=["MISSING"])
+
+
+class TestDictQueue:
+    def test_dict_ops(self):
+        with mtpu.Dict.ephemeral() as d:
+            d["a"] = 1
+            d.put("b", {"x": [1, 2]})
+            assert d["a"] == 1
+            assert d.get("b") == {"x": [1, 2]}
+            assert "a" in d
+            assert len(d) == 2
+            assert d.pop("a") == 1
+            assert d.get("a", "gone") == "gone"
+
+    def test_queue_fifo_and_partitions(self):
+        with mtpu.Queue.ephemeral() as q:
+            q.put_many([1, 2, 3])
+            q.put(99, partition="other")
+            assert q.get() == 1
+            assert q.get_many(5) == [2, 3]
+            assert q.get(partition="other") == 99
+            with pytest.raises(Empty):
+                q.get(block=False)
+
+    def test_queue_blocking_get(self):
+        with mtpu.Queue.ephemeral() as q:
+            def put_later():
+                import time
+
+                time.sleep(0.1)
+                q.put("late")
+
+            threading.Thread(target=put_later).start()
+            assert q.get(timeout=2.0) == "late"
+
+    def test_queue_timeout(self):
+        with mtpu.Queue.ephemeral() as q:
+            with pytest.raises(Empty):
+                q.get(timeout=0.05)
