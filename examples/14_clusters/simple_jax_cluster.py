@@ -1,0 +1,57 @@
+# # Simple multi-host JAX cluster
+#
+# TPU-native redesign of the reference's 14_clusters/simple_torch_cluster.py
+# (cited per SURVEY.md §3.4). Where the reference co-schedules containers,
+# distributes rank-0's address via `get_cluster_info()` (:101-109), and
+# launches torchrun with one process per GPU + NCCL (:118-130), the TPU
+# version is: one process per host, `init_jax_distributed()` (coordinator =
+# rank 0), a global `Mesh` spanning every chip in the slice, and XLA
+# collectives over ICI. No torchrun, no NCCL.
+#
+# Run: `tpurun run examples/14_clusters/simple_jax_cluster.py`
+
+import modal_examples_tpu as mtpu
+
+app = mtpu.App("example-jax-cluster")
+
+N_HOSTS = 2
+CHIPS_PER_HOST = 4
+
+
+@app.function(timeout=300)
+@mtpu.experimental.clustered(size=N_HOSTS, chips_per_host=CHIPS_PER_HOST)
+def all_reduce_demo():
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from modal_examples_tpu.parallel import cluster, make_mesh
+
+    info = cluster.init_jax_distributed()
+    print(
+        f"host {info.rank}/{info.size} up: "
+        f"{jax.local_device_count()} local / {jax.device_count()} global chips"
+    )
+
+    # one global mesh across the slice; each host contributes its local shard
+    mesh = make_mesh({"data": jax.device_count()})
+    local = np.full(
+        (jax.local_device_count(), 1024), float(info.rank + 1), np.float32
+    )
+    x = jax.make_array_from_process_local_data(
+        NamedSharding(mesh, P("data")), local
+    )
+
+    # the all-reduce: XLA inserts the cross-host collective
+    total = jax.jit(jnp.sum, out_shardings=NamedSharding(mesh, P()))(x)
+    print(f"host {info.rank}: global sum = {float(total)}")
+    return float(total)
+
+
+@app.local_entrypoint()
+def main():
+    total = all_reduce_demo.remote()
+    expected = 1024 * CHIPS_PER_HOST * sum(r + 1 for r in range(N_HOSTS))
+    assert total == expected, (total, expected)
+    print(f"cluster all-reduce OK: {total}")

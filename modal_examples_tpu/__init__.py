@@ -53,6 +53,27 @@ from .web.endpoints import (
 
 __version__ = "0.1.0"
 
+
+class _Experimental:
+    """``mtpu.experimental`` — mirrors ``modal.experimental``: the clusters
+    API (simple_torch_cluster.py:97-111). Import is lazy so the jax-free
+    client layer stays jax-free."""
+
+    @staticmethod
+    def clustered(size: int, chips_per_host: int | None = None):
+        from .parallel.cluster import clustered as _clustered
+
+        return _clustered(size, chips_per_host)
+
+    @staticmethod
+    def get_cluster_info():
+        from .parallel.cluster import get_cluster_info as _gci
+
+        return _gci()
+
+
+experimental = _Experimental()
+
 __all__ = [
     "App",
     "Cls",

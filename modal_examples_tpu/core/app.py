@@ -145,6 +145,7 @@ class App:
 
         def deco(fn: Callable) -> Function:
             fn_name = name or fn.__name__
+            cluster_cfg = getattr(fn, "__mtpu_cluster__", None) or {}
             spec = FunctionSpec(
                 tag=f"{self.name}.{fn_name}",
                 app_name=self.name,
@@ -167,6 +168,8 @@ class App:
                 is_generator=inspect.isgeneratorfunction(fn),
                 web=getattr(fn, "__mtpu_web__", None),
                 region=region,
+                cluster_size=cluster_cfg.get("size", 0),
+                cluster_chips_per_host=cluster_cfg.get("chips_per_host"),
             )
             f = Function(self, fn, spec)
             self.registered_functions[fn_name] = f

@@ -55,6 +55,10 @@ class FunctionTimeoutError(TimeoutError):
     pass
 
 
+class _ContainerDead(RuntimeError):
+    """Raised by dispatch() when racing a container's death."""
+
+
 class InputCancelled(Exception):
     pass
 
@@ -279,9 +283,10 @@ def worker_entry() -> None:
 class _Container:
     _counter = itertools.count()
 
-    def __init__(self, pool: "FunctionPool"):
+    def __init__(self, pool, extra_env: dict[str, str] | None = None):
         self.pool = pool
         self.idx = next(self._counter)
+        self.extra_env = extra_env or {}
         sock_dir = Path(tempfile.gettempdir()) / "mtpu-socks"
         sock_dir.mkdir(exist_ok=True)
         self._sock_path = str(sock_dir / f"c-{uuid.uuid4().hex[:12]}.sock")
@@ -294,13 +299,14 @@ class _Container:
         py_paths = [pkg_root] + [
             p for p in env.get("PYTHONPATH", "").split(os.pathsep) if p
         ]
-        if not pool.spec.tpu:
+        if not pool.spec.tpu or self.extra_env.get("JAX_PLATFORMS") == "cpu":
             # CPU container: don't attach the TPU. The TPU plugin's
             # sitecustomize costs seconds of boot and would contend for the
             # chip; only containers whose Function requests tpu= pay that.
             py_paths = [p for p in py_paths if "axon" not in p]
             env["JAX_PLATFORMS"] = env.get("JAX_PLATFORMS_CPU_OVERRIDE", "cpu")
         env["PYTHONPATH"] = os.pathsep.join(py_paths)
+        env.update(self.extra_env)
         self.proc = subprocess.Popen(
             [sys.executable, "-m", "modal_examples_tpu.core.container_worker"],
             env=env,
@@ -345,9 +351,16 @@ class _Container:
         if self.pool.spec.timeout:
             qi.call.deadline = qi.started_at + self.pool.spec.timeout
         with self.lock:
+            if self.dead:
+                raise _ContainerDead(f"container {self.idx} is dead")
             self.active[qi.call.input_id] = qi
             self.last_active = time.monotonic()
-        self.conn.send(("input", qi.call.input_id, qi.method_name, qi.payload))
+        try:
+            self.conn.send(("input", qi.call.input_id, qi.method_name, qi.payload))
+        except (BrokenPipeError, OSError) as e:
+            with self.lock:
+                self.active.pop(qi.call.input_id, None)
+            raise _ContainerDead(str(e)) from e
 
     def dispatch_batch(self, qis: list[_QueuedInput]) -> None:
         now = time.monotonic()
@@ -640,7 +653,11 @@ class FunctionPool:
             if self.spec.single_use_containers:
                 # one input per container: retire from rotation at dispatch
                 target.retired = True
-            target.dispatch(qi)
+            try:
+                target.dispatch(qi)
+            except _ContainerDead:
+                with self.lock:
+                    self.pending.appendleft(qi)
 
     def _dispatch_batched(self, ready: list[_QueuedInput], now: float) -> None:
         cfg = self.spec.batched
@@ -658,7 +675,11 @@ class FunctionPool:
                 with self.lock:
                     self.pending.extendleft(reversed(batch + ready))
                 return
-            target.dispatch_batch(batch)
+            try:
+                target.dispatch_batch(batch)
+            except (BrokenPipeError, OSError):
+                with self.lock:
+                    self.pending.extendleft(reversed(batch))
 
     def _autoscale(self, now: float) -> None:
         with self.lock:
@@ -694,6 +715,168 @@ class FunctionPool:
     def _spawn_container(self) -> None:
         c = _Container(self)
         self.containers.append(c)
+
+
+# --------------------------------------------------------------------------
+# Cluster gang scheduler — one logical call fans to n co-scheduled hosts
+# --------------------------------------------------------------------------
+
+
+class ClusterPool:
+    """Gang scheduling for ``@clustered(size=n)`` functions (SURVEY.md §3.4).
+
+    One ``.remote()`` boots n containers (the "hosts" of the slice), injects
+    rank/coordinator env (the cluster-info analog of
+    simple_torch_cluster.py:101-111), dispatches the same input to all, and
+    resolves with rank 0's return value once every rank finishes. Any rank
+    failing fails the call and tears the slice down — a dead host kills the
+    whole slice, as on a real pod.
+
+    Local simulation: each host is a CPU-backed process whose visible device
+    count equals chips_per_host, so jax.distributed + a global Mesh run for
+    real across processes.
+    """
+
+    def __init__(self, spec, runner):
+        self.spec = spec
+        self.runner = runner
+        self.container_config = spec.container_config()
+        self.spec_max_concurrent = 1
+        self.size = spec.cluster_size
+        self.chips_per_host = spec.cluster_chips_per_host or (
+            spec.tpu[0].chips_per_host if spec.tpu else 1
+        )
+        self.closed = False
+        self._lock = threading.Lock()
+        self._active_containers: list[_Container] = []
+
+    def submit(self, method_name: str, args: tuple, kwargs: dict) -> _Call:
+        if self.closed:
+            raise RuntimeError("app run context is closed")
+        call = _Call(f"in-{uuid.uuid4().hex[:16]}", None, None)
+        threading.Thread(
+            target=self._run_gang, args=(call, method_name, args, kwargs), daemon=True
+        ).start()
+        return call
+
+    # _Container callbacks ---------------------------------------------------
+
+    def handle_failure(self, qi: _QueuedInput, exc: BaseException) -> None:
+        qi.call.set_exception(exc)
+
+    def on_container_dead(self, container, orphans: list[_QueuedInput]) -> None:
+        err = container.boot_error or RuntimeError(
+            f"cluster host rank={container.extra_env.get('MTPU_CLUSTER_RANK')} died"
+        )
+        for qi in orphans:
+            qi.call.set_exception(err)
+
+    # gang logic -------------------------------------------------------------
+
+    def _run_gang(self, call: _Call, method_name, args, kwargs) -> None:
+        import re
+        import socket
+
+        # jax-free: parallel.cluster holds only env-var names + dataclasses,
+        # and modal_examples_tpu.parallel lazy-loads its jax-importing modules
+        from ..parallel import cluster as _cluster
+
+        containers: list[_Container] = []
+        try:
+            with socket.socket() as s:
+                s.bind(("127.0.0.1", 0))
+                coord_port = s.getsockname()[1]
+            ips = ",".join(["127.0.0.1"] * self.size)
+            payload = ser.serialize((args, kwargs))
+            base_flags = re.sub(
+                r"--xla_force_host_platform_device_count=\d+",
+                "",
+                os.environ.get("XLA_FLAGS", ""),
+            ).strip()
+            for rank in range(self.size):
+                if self.closed:
+                    raise RuntimeError("app run context is closed")
+                extra = {
+                    _cluster.RANK_ENV: str(rank),
+                    _cluster.SIZE_ENV: str(self.size),
+                    _cluster.COORD_ENV: f"127.0.0.1:{coord_port}",
+                    _cluster.IPS_ENV: ips,
+                    _cluster.CHIPS_ENV: str(self.chips_per_host),
+                    # local simulation: every host is a CPU device mesh
+                    "JAX_PLATFORMS": "cpu",
+                    "XLA_FLAGS": (
+                        base_flags
+                        + f" --xla_force_host_platform_device_count={self.chips_per_host}"
+                    ).strip(),
+                }
+                c = _Container(self, extra_env=extra)
+                containers.append(c)
+                with self._lock:
+                    self._active_containers.append(c)
+
+            boot_deadline = time.monotonic() + 120.0
+            while True:
+                dead = next(
+                    (c for c in containers if c.dead or c.boot_error is not None),
+                    None,
+                )
+                if dead is not None:
+                    raise dead.boot_error or RuntimeError(
+                        "cluster host died during boot"
+                    )
+                if all(c.ready.is_set() for c in containers):
+                    break
+                if time.monotonic() > boot_deadline:
+                    raise TimeoutError("cluster hosts failed to boot within 120s")
+                time.sleep(0.05)
+
+            rank_calls = []
+            deadline = (
+                time.monotonic() + self.spec.timeout if self.spec.timeout else None
+            )
+            for rank, c in enumerate(containers):
+                sub = _Call(f"{call.input_id}-r{rank}", deadline, None)
+                if rank == 0:
+                    # rank 0's yields stream straight through to the caller,
+                    # so @clustered generator functions work like plain ones
+                    sub.gen_queue = call.gen_queue
+                qi = _QueuedInput(sub, method_name, payload)
+                c.dispatch(qi)
+                rank_calls.append(sub)
+            for rank, sub in enumerate(rank_calls):
+                budget = (
+                    None if deadline is None else max(0.1, deadline - time.monotonic())
+                )
+                sub.result(budget)  # raises on rank failure
+            call.set_result(rank_calls[0].value)
+        except BaseException as e:
+            call.set_exception(e)
+        finally:
+            for c in containers:
+                c.shutdown(graceful=True)
+            deadline = time.monotonic() + 5.0
+            for c in containers:
+                try:
+                    c.proc.wait(max(0.05, deadline - time.monotonic()))
+                except subprocess.TimeoutExpired:
+                    c.kill()
+            with self._lock:
+                for c in containers:
+                    if c in self._active_containers:
+                        self._active_containers.remove(c)
+
+    def shutdown(self) -> None:
+        self.closed = True
+        with self._lock:
+            containers = list(self._active_containers)
+        for c in containers:
+            c.kill()
+        deadline = time.monotonic() + 5.0
+        for c in containers:
+            try:
+                c.proc.wait(max(0.05, deadline - time.monotonic()))
+            except subprocess.TimeoutExpired:
+                pass
 
 
 # --------------------------------------------------------------------------
@@ -791,6 +974,8 @@ class InlinePool:
 
 
 def make_pool(spec, runner):
+    if spec.cluster_size > 0:  # any @clustered function, including size=1
+        return ClusterPool(spec, runner)
     if _config.backend() == "inline" or spec.force_inline:
         return InlinePool(spec, runner)
     return FunctionPool(spec, runner)

@@ -98,6 +98,8 @@ class FunctionSpec:
     web: dict | None = None
     region: str | None = None
     force_inline: bool = False
+    cluster_size: int = 0  # >0: gang-scheduled multi-host slice (@clustered)
+    cluster_chips_per_host: int | None = None
 
     def container_config(self) -> _exec.ContainerConfig:
         env: dict[str, str] = {}

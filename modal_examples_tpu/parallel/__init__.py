@@ -1,0 +1,49 @@
+"""Parallel layer: meshes, named-axis collectives, multi-host clusters.
+
+Lazy re-exports (PEP 562): ``cluster`` is importable without jax (the
+control-plane supervisor needs its env-var protocol), while ``mesh`` /
+``collectives`` pull in jax only when first touched.
+"""
+
+from .cluster import ClusterInfo, clustered, get_cluster_info, init_jax_distributed
+
+_LAZY = {
+    "AXIS_ORDER": "mesh",
+    "DATA": "mesh",
+    "EXPERT": "mesh",
+    "FSDP": "mesh",
+    "SEQ": "mesh",
+    "TENSOR": "mesh",
+    "make_mesh": "mesh",
+    "replicated": "mesh",
+    "sharding": "mesh",
+    "shard_pytree": "mesh",
+    "single_device_mesh": "mesh",
+    "collectives": None,
+    "mesh": None,
+    "cluster": None,
+}
+
+__all__ = [
+    "ClusterInfo",
+    "clustered",
+    "get_cluster_info",
+    "init_jax_distributed",
+    *sorted(_LAZY),
+]
+
+
+def __getattr__(name: str):
+    import importlib
+
+    if name in _LAZY:
+        target = _LAZY[name]
+        if target is None:
+            mod = importlib.import_module(f".{name}", __name__)
+            globals()[name] = mod
+            return mod
+        mod = importlib.import_module(f".{target}", __name__)
+        value = getattr(mod, name)
+        globals()[name] = value
+        return value
+    raise AttributeError(f"module {__name__!r} has no attribute {name!r}")

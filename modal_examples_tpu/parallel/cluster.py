@@ -1,0 +1,112 @@
+"""Multi-host clusters: ``@clustered(size=n)`` + ``get_cluster_info()``.
+
+Reference spec: ``@modal.experimental.clustered(size=2)`` co-schedules n
+containers with a private interconnect; ``get_cluster_info()`` exposes
+``rank`` / ``container_ips`` and rank 0 acts as coordinator
+(14_clusters/simple_torch_cluster.py:96-111). The reference then launches
+torchrun with one *process per GPU* and NCCL for collectives (:118-130).
+
+TPU-native redesign (SURVEY.md §3.4): a pod slice IS the cluster. One process
+per host drives all local chips under SPMD; ``get_cluster_info()`` feeds
+``jax.distributed.initialize`` (coordinator address = rank 0), and all
+collectives are XLA ops over ICI — there is no torchrun, no NCCL, no
+proc-per-chip fan-out.
+
+The local control plane gang-schedules n container processes per call and
+simulates each "host" with a CPU device mesh
+(``--xla_force_host_platform_device_count``), so the full multi-host path —
+distributed init, global mesh, cross-process collectives — runs and is
+tested on a single machine (the fake backend the reference lacks, SURVEY.md
+§4).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import os
+from typing import Callable
+
+RANK_ENV = "MTPU_CLUSTER_RANK"
+SIZE_ENV = "MTPU_CLUSTER_SIZE"
+COORD_ENV = "MTPU_CLUSTER_COORDINATOR"
+IPS_ENV = "MTPU_CLUSTER_IPS"
+CHIPS_ENV = "MTPU_CLUSTER_CHIPS_PER_HOST"
+
+
+@dataclasses.dataclass(frozen=True)
+class ClusterInfo:
+    rank: int
+    size: int
+    container_ips: list[str]
+    coordinator_address: str
+    chips_per_host: int
+    task_id: str | None = None
+
+
+def in_cluster() -> bool:
+    return RANK_ENV in os.environ
+
+
+def get_cluster_info() -> ClusterInfo:
+    """Inside a clustered container: this host's place in the slice."""
+    if not in_cluster():
+        raise RuntimeError(
+            "get_cluster_info() called outside a @clustered container"
+        )
+    return ClusterInfo(
+        rank=int(os.environ[RANK_ENV]),
+        size=int(os.environ[SIZE_ENV]),
+        container_ips=os.environ[IPS_ENV].split(","),
+        coordinator_address=os.environ[COORD_ENV],
+        chips_per_host=int(os.environ.get(CHIPS_ENV, "1")),
+        task_id=os.environ.get("MTPU_TASK_ID"),
+    )
+
+
+def clustered(size: int, chips_per_host: int | None = None) -> Callable:
+    """Mark a function for gang scheduling over ``size`` hosts.
+
+    Apply *under* ``@app.function`` (like the reference stacks
+    ``@app.function`` over ``@modal.experimental.clustered``,
+    simple_torch_cluster.py:96-97).
+    """
+    if size < 1:
+        raise ValueError("cluster size must be >= 1")
+
+    def deco(fn):
+        if hasattr(fn, "spec") and hasattr(fn, "raw_f"):
+            raise TypeError(
+                "@clustered must be applied UNDER @app.function (closest to "
+                "the def), like the reference stacks them "
+                "(simple_torch_cluster.py:96-97)"
+            )
+        fn.__mtpu_cluster__ = {"size": size, "chips_per_host": chips_per_host}
+        return fn
+
+    return deco
+
+
+def init_jax_distributed() -> "object":
+    """Join this host to the slice-wide JAX runtime and return the info.
+
+    The analog of the reference's torchrun rendezvous + ``dist.init_process_
+    group("nccl", ...)`` (simple_torch_cluster_script.py:85) — but one call,
+    one process per host, and afterwards ``jax.devices()`` is the *global*
+    device list so a single ``Mesh`` spans the slice.
+    """
+    import jax
+
+    info = get_cluster_info()
+    jax.distributed.initialize(
+        coordinator_address=info.coordinator_address,
+        num_processes=info.size,
+        process_id=info.rank,
+    )
+    return info
+
+
+def global_mesh(axes: dict[str, int] | None = None):
+    """Mesh over every chip in the slice (call after init_jax_distributed)."""
+    from .mesh import make_mesh
+
+    return make_mesh(axes)

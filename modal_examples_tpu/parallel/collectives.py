@@ -1,0 +1,88 @@
+"""Named-axis collectives — the NCCL replacement (SURVEY.md §2.3, §5.8).
+
+The reference's workloads never call NCCL directly; they go through
+``torch.distributed`` (``dist.send/recv/barrier``,
+simple_torch_cluster_script.py:53-90) or leave it to the engine. Our analog:
+a thin wrapper over XLA collectives with *named mesh axes*, usable inside
+``shard_map``/``pjit``-partitioned functions. Intra-slice traffic rides ICI;
+multi-slice rides DCN — chosen by XLA from the mesh, never by workload code.
+
+All functions take the axis *name* (str) or a tuple of names.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+AxisName = str | tuple[str, ...]
+
+
+def psum(x, axis: AxisName):
+    """All-reduce sum over a mesh axis (the DDP gradient sync primitive —
+    replaces torch.distributed.all_reduce / NCCL allreduce)."""
+    return lax.psum(x, axis)
+
+
+def pmean(x, axis: AxisName):
+    return lax.pmean(x, axis)
+
+
+def pmax(x, axis: AxisName):
+    return lax.pmax(x, axis)
+
+
+def pmin(x, axis: AxisName):
+    return lax.pmin(x, axis)
+
+
+def all_gather(x, axis: AxisName, *, gather_dim: int = 0, tiled: bool = True):
+    """Gather shards along ``gather_dim`` (replaces NCCL allgather)."""
+    return lax.all_gather(x, axis, axis=gather_dim, tiled=tiled)
+
+
+def reduce_scatter(x, axis: AxisName, *, scatter_dim: int = 0):
+    """Sum-reduce then scatter shards (replaces NCCL reduce_scatter; the
+    memory-efficient half of a ZeRO gradient sync)."""
+    return lax.psum_scatter(x, axis, scatter_dimension=scatter_dim, tiled=True)
+
+
+def all_to_all(x, axis: AxisName, *, split_dim: int, concat_dim: int, tiled: bool = True):
+    """Transpose shards across an axis (MoE dispatch / Ulysses seq-parallel)."""
+    return lax.all_to_all(
+        x, axis, split_axis=split_dim, concat_axis=concat_dim, tiled=tiled
+    )
+
+
+def ppermute(x, axis: AxisName, perm: list[tuple[int, int]]):
+    """Point-to-point shifts (replaces dist.send/dist.recv pairs)."""
+    return lax.ppermute(x, axis, perm)
+
+
+def ring_shift(x, axis: AxisName, shift: int = 1):
+    """Rotate shards around the axis ring — the ring-attention building block.
+    On a TPU torus this maps to neighbor ICI hops."""
+    n = lax.axis_size(axis)
+    perm = [(i, (i + shift) % n) for i in range(n)]
+    return lax.ppermute(x, axis, perm)
+
+
+def axis_index(axis: AxisName):
+    """This shard's coordinate on the axis (the 'rank')."""
+    return lax.axis_index(axis)
+
+
+def axis_size(axis: AxisName) -> int:
+    return lax.axis_size(axis)
+
+
+def barrier(axis: AxisName):
+    """Synchronization fence: a trivial psum all shards must reach
+    (replaces dist.barrier, simple_torch_cluster_script.py:88)."""
+    return lax.psum(jnp.ones((), jnp.int32), axis)
+
+
+def unreplicate(tree):
+    """First shard of every leaf (host-side convenience for logging)."""
+    return jax.tree.map(lambda x: x[0] if getattr(x, "ndim", 0) else x, tree)

@@ -1,0 +1,170 @@
+"""Tests for the parallel layer: mesh construction, named-axis collectives
+under shard_map on the 8-device CPU mesh, and gang-scheduled @clustered
+execution with real cross-process jax.distributed collectives (the multi-host
+simulation SURVEY.md §4 calls for)."""
+
+import numpy as np
+import pytest
+
+import modal_examples_tpu as mtpu
+
+
+@pytest.fixture(scope="module")
+def jax(jax_cpu):
+    return jax_cpu
+
+
+class TestMesh:
+    def test_default_data_mesh(self, jax):
+        from modal_examples_tpu.parallel import make_mesh
+
+        mesh = make_mesh()
+        assert mesh.devices.size == 8
+        assert mesh.axis_names == ("data",)
+
+    def test_two_axis_mesh_with_fill(self, jax):
+        from modal_examples_tpu.parallel import make_mesh
+
+        mesh = make_mesh({"data": -1, "tensor": 4})
+        assert dict(zip(mesh.axis_names, mesh.devices.shape)) == {
+            "data": 2,
+            "tensor": 4,
+        }
+        # canonical order: data (cross-host) before tensor (ICI)
+        assert mesh.axis_names == ("data", "tensor")
+
+    def test_axis_mismatch_raises(self, jax):
+        from modal_examples_tpu.parallel import make_mesh
+
+        with pytest.raises(ValueError):
+            make_mesh({"data": 3, "tensor": 4})
+
+    def test_spec_validation(self, jax):
+        from modal_examples_tpu.parallel import make_mesh
+
+        with pytest.raises(ValueError):
+            make_mesh(spec="v5e-4")  # 8 visible devices != 4
+
+
+class TestCollectives:
+    def test_psum_and_axis_index(self, jax):
+        import jax.numpy as jnp
+        from jax.sharding import PartitionSpec as P
+        from jax import shard_map
+
+        from modal_examples_tpu.parallel import collectives as col, make_mesh
+
+        mesh = make_mesh({"data": 8})
+
+        def f(x):
+            r = col.axis_index("data")
+            total = col.psum(x, "data")
+            return total + 0 * r
+
+        out = shard_map(
+            f, mesh=mesh, in_specs=P("data"), out_specs=P("data")
+        )(jnp.ones((8, 4)))
+        np.testing.assert_allclose(np.asarray(out), 8.0)
+
+    def test_ring_shift(self, jax):
+        import jax.numpy as jnp
+        from jax.sharding import PartitionSpec as P
+        from jax import shard_map
+
+        from modal_examples_tpu.parallel import collectives as col, make_mesh
+
+        mesh = make_mesh({"data": 8})
+        x = jnp.arange(8.0).reshape(8, 1)
+        out = shard_map(
+            lambda s: col.ring_shift(s, "data", 1),
+            mesh=mesh,
+            in_specs=P("data"),
+            out_specs=P("data"),
+        )(x)
+        # shard i's value moves to shard (i+1) % 8
+        np.testing.assert_allclose(
+            np.asarray(out).ravel(), np.roll(np.arange(8.0), 1)
+        )
+
+    def test_all_gather_and_reduce_scatter(self, jax):
+        import jax.numpy as jnp
+        from jax.sharding import PartitionSpec as P
+        from jax import shard_map
+
+        from modal_examples_tpu.parallel import collectives as col, make_mesh
+
+        mesh = make_mesh({"data": 8})
+        x = jnp.arange(16.0).reshape(8, 2)
+
+        gathered = shard_map(
+            lambda s: col.all_gather(s, "data"),
+            mesh=mesh,
+            in_specs=P("data"),
+            out_specs=P(None),
+            check_vma=False,
+        )(x)
+        np.testing.assert_allclose(np.asarray(gathered), np.asarray(x))
+
+        scattered = shard_map(
+            lambda s: col.reduce_scatter(s, "data"),
+            mesh=mesh,
+            in_specs=P(None),
+            out_specs=P("data"),
+        )(x)
+        np.testing.assert_allclose(np.asarray(scattered), np.asarray(x) * 8)
+
+
+class TestSharding:
+    def test_shard_pytree_places_leaves(self, jax):
+        import jax.numpy as jnp
+        from jax.sharding import PartitionSpec as P
+
+        from modal_examples_tpu.parallel import make_mesh, shard_pytree
+
+        mesh = make_mesh({"data": 8})
+        tree = {"w": jnp.ones((16, 4)), "b": jnp.ones((4,))}
+        placed = shard_pytree(
+            tree, mesh, lambda path, leaf: P("data") if leaf.ndim == 2 else P()
+        )
+        assert placed["w"].sharding.spec == P("data")
+        assert placed["b"].sharding.spec == P()
+
+
+class TestClustered:
+    def test_gang_scheduled_jax_distributed(self):
+        """2 hosts x 4 chips: psum over a global mesh spanning processes —
+        the simple_torch_cluster parity test, jax-flavored."""
+        app = mtpu.App("cluster-test")
+
+        @app.function(timeout=180)
+        @mtpu.experimental.clustered(size=2, chips_per_host=4)
+        def allreduce_job():
+            import jax
+            import jax.numpy as jnp
+            from jax.sharding import NamedSharding, PartitionSpec as P
+
+            from modal_examples_tpu.parallel import cluster, make_mesh
+
+            info = cluster.init_jax_distributed()
+            assert jax.process_count() == 2
+            assert jax.device_count() == 8  # global view across both hosts
+            mesh = make_mesh({"data": 8})
+            x = jax.make_array_from_process_local_data(
+                NamedSharding(mesh, P("data")),
+                np.full((4, 2), float(info.rank + 1), np.float32),
+            )
+            total = jax.jit(
+                lambda a: jnp.sum(a),
+                out_shardings=NamedSharding(mesh, P()),
+            )(x)
+            # rank0 shards contribute 1.0 * 8, rank1 shards 2.0 * 8
+            return float(total), info.rank, info.size
+
+        with app.run():
+            total, rank, size = allreduce_job.remote()
+        assert total == pytest.approx(24.0)
+        assert rank == 0 and size == 2
+
+    def test_cluster_info_outside_raises(self):
+        with pytest.raises(RuntimeError):
+            mtpu.experimental.get_cluster_info()
