@@ -365,20 +365,28 @@ class _Container:
     def dispatch_batch(self, qis: list[_QueuedInput]) -> None:
         now = time.monotonic()
         with self.lock:
+            if self.dead:
+                raise _ContainerDead(f"container {self.idx} is dead")
             for qi in qis:
                 qi.started_at = now
                 if self.pool.spec.timeout:
                     qi.call.deadline = now + self.pool.spec.timeout
                 self.active[qi.call.input_id] = qi
             self.last_active = now
-        self.conn.send(
-            (
-                "batch",
-                [qi.call.input_id for qi in qis],
-                qis[0].method_name,
-                [qi.payload for qi in qis],
+        try:
+            self.conn.send(
+                (
+                    "batch",
+                    [qi.call.input_id for qi in qis],
+                    qis[0].method_name,
+                    [qi.payload for qi in qis],
+                )
             )
-        )
+        except (BrokenPipeError, OSError) as e:
+            with self.lock:
+                for qi in qis:
+                    self.active.pop(qi.call.input_id, None)
+            raise _ContainerDead(str(e)) from e
 
     # -- reading ------------------------------------------------------------
 
@@ -677,7 +685,7 @@ class FunctionPool:
                 return
             try:
                 target.dispatch_batch(batch)
-            except (BrokenPipeError, OSError):
+            except _ContainerDead:
                 with self.lock:
                     self.pending.extendleft(reversed(batch))
 
@@ -753,7 +761,7 @@ class ClusterPool:
     def submit(self, method_name: str, args: tuple, kwargs: dict) -> _Call:
         if self.closed:
             raise RuntimeError("app run context is closed")
-        call = _Call(f"in-{uuid.uuid4().hex[:16]}", None, None)
+        call = _Call(f"in-{uuid.uuid4().hex[:16]}", None, self.spec.retries)
         threading.Thread(
             target=self._run_gang, args=(call, method_name, args, kwargs), daemon=True
         ).start()
@@ -774,6 +782,25 @@ class ClusterPool:
     # gang logic -------------------------------------------------------------
 
     def _run_gang(self, call: _Call, method_name, args, kwargs) -> None:
+        while True:
+            try:
+                self._run_gang_once(call, method_name, args, kwargs)
+                return
+            except BaseException as e:
+                call.attempt += 1
+                r = self.spec.retries
+                if (
+                    r is not None
+                    and call.attempt <= r.max_retries
+                    and not call.cancelled
+                    and not self.closed
+                ):
+                    time.sleep(r.delay_for_attempt(call.attempt))
+                    continue
+                call.set_exception(e)
+                return
+
+    def _run_gang_once(self, call: _Call, method_name, args, kwargs) -> None:
         import re
         import socket
 
@@ -816,6 +843,8 @@ class ClusterPool:
 
             boot_deadline = time.monotonic() + 120.0
             while True:
+                if call.cancelled:
+                    raise InputCancelled(call.input_id)
                 dead = next(
                     (c for c in containers if c.dead or c.boot_error is not None),
                     None,
@@ -843,14 +872,22 @@ class ClusterPool:
                 qi = _QueuedInput(sub, method_name, payload)
                 c.dispatch(qi)
                 rank_calls.append(sub)
-            for rank, sub in enumerate(rank_calls):
-                budget = (
-                    None if deadline is None else max(0.1, deadline - time.monotonic())
-                )
-                sub.result(budget)  # raises on rank failure
+            # fail fast: any rank failing (or dying) kills the whole slice —
+            # don't block on rank 0 while another rank deadlocks a collective
+            pending = set(rank_calls)
+            while pending:
+                if call.cancelled:
+                    raise InputCancelled(call.input_id)
+                if deadline is not None and time.monotonic() > deadline:
+                    raise FunctionTimeoutError(
+                        f"{self.spec.tag} slice exceeded timeout={self.spec.timeout}s"
+                    )
+                for sub in list(pending):
+                    if sub.done.wait(0.02):
+                        pending.discard(sub)
+                        if not sub.ok:
+                            raise sub.exc
             call.set_result(rank_calls[0].value)
-        except BaseException as e:
-            call.set_exception(e)
         finally:
             for c in containers:
                 c.shutdown(graceful=True)

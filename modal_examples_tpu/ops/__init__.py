@@ -1,0 +1,25 @@
+"""ops — Pallas TPU kernels + XLA references.
+
+The native-kernel surface replacing the reference's CUDA dependencies
+(SURVEY.md §2.4): flash attention (flash-attn), ragged paged decode attention
+(vLLM PagedAttention), int8 quantized matmul (bitsandbytes/unsloth), ring
+attention (sequence parallelism the reference lacks).
+"""
+
+from .flash_attention import flash_attention, flash_attention_with_lse
+from .paged_attention import paged_decode_attention
+from .quantized_matmul import dequantize_int8, quantize_int8, quantized_matmul
+from .ring_attention import ring_attention, ring_attention_sharded
+from . import reference
+
+__all__ = [
+    "dequantize_int8",
+    "flash_attention",
+    "flash_attention_with_lse",
+    "paged_decode_attention",
+    "quantize_int8",
+    "quantized_matmul",
+    "reference",
+    "ring_attention",
+    "ring_attention_sharded",
+]

@@ -1,0 +1,200 @@
+"""Ragged paged decode attention for TPU (Pallas → Mosaic).
+
+The TPU-native replacement for vLLM's PagedAttention CUDA kernels — the core
+of the reference's north-star serving path (vllm_inference.py; SURVEY.md §7
+hard part #1: "Ragged paged attention kernel + continuous batching in JAX").
+
+Memory layout (TPU-first):
+- KV cache pages live in **HBM** as ``[Hkv, n_pages, page_size, D]`` — the
+  last two dims form hardware tiles (page_size sublanes x 128 lanes), so a
+  page is a contiguous DMA unit.
+- Each sequence owns a list of physical page ids (its *page table*); pages
+  are allocated/freed by the serving engine's block allocator.
+
+Kernel design:
+- grid = (batch, kv_heads): decode attention is HBM-bandwidth-bound (every
+  live KV byte is read once per step); the job is to keep DMA saturated, not
+  the MXU.
+- page tables + context lengths arrive via **scalar prefetch** (SMEM), so the
+  kernel computes its own DMA addresses — the "ragged" part: each sequence
+  reads exactly ceil(ctx/page_size) pages, not max_pages.
+- pages stream HBM→VMEM with **double buffering** (guide pattern), overlapped
+  with the online-softmax update of the previous page.
+- GQA: the q-head group for one kv head forms the row block, sharing the
+  page traffic.
+
+Runs in interpreter mode off-TPU (CPU CI), with a dense XLA reference in
+ops.reference for ground truth.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+
+def _decode_kernel(
+    # scalar prefetch
+    page_tables_ref,  # (B * pages_per_seq,) int32, SMEM
+    ctx_lens_ref,  # (B,) int32, SMEM
+    # inputs
+    q_ref,  # (1, G, D) VMEM
+    k_hbm,  # (Hkv, n_pages, page_size, D) ANY/HBM
+    v_hbm,  # (Hkv, n_pages, page_size, D) ANY/HBM
+    # outputs
+    o_ref,  # (1, G, D) VMEM
+    # scratch
+    k_scr,  # (2, page_size, D) VMEM
+    v_scr,  # (2, page_size, D) VMEM
+    acc_scr,  # (G, D) f32
+    sems,  # DMA sems (2, 2)
+    *,
+    page_size: int,
+    pages_per_seq: int,
+    sm_scale: float,
+):
+    b = pl.program_id(0)
+    h = pl.program_id(1)
+    ctx = ctx_lens_ref[b]
+    n_pages = pl.cdiv(ctx, page_size)
+
+    def page_id(i):
+        return page_tables_ref[b * pages_per_seq + i]
+
+    def k_dma(slot, i):
+        return pltpu.make_async_copy(
+            k_hbm.at[h, page_id(i)], k_scr.at[slot], sems.at[slot, 0]
+        )
+
+    def v_dma(slot, i):
+        return pltpu.make_async_copy(
+            v_hbm.at[h, page_id(i)], v_scr.at[slot], sems.at[slot, 1]
+        )
+
+    @pl.when(n_pages > 0)
+    def _():
+        k_dma(0, 0).start()
+        v_dma(0, 0).start()
+
+    acc_scr[:] = jnp.zeros_like(acc_scr)
+    q = q_ref[0].astype(jnp.float32) * sm_scale  # (G, D)
+    G = q.shape[0]
+
+    def body(i, carry):
+        m_prev, l_prev = carry  # (G, 1) each
+        slot = jax.lax.rem(i, 2)
+
+        @pl.when(i + 1 < n_pages)
+        def _prefetch():
+            nxt = jax.lax.rem(i + 1, 2)
+            k_dma(nxt, i + 1).start()
+            v_dma(nxt, i + 1).start()
+
+        k_dma(slot, i).wait()
+        v_dma(slot, i).wait()
+        k = k_scr[slot].astype(jnp.float32)  # (page_size, D)
+        v = v_scr[slot].astype(jnp.float32)
+
+        s = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+        )  # (G, page_size)
+        token_pos = i * page_size + jax.lax.broadcasted_iota(
+            jnp.int32, (G, page_size), 1
+        )
+        s = jnp.where(token_pos < ctx, s, -jnp.inf)
+
+        m_cur = jnp.max(s, axis=-1, keepdims=True)
+        m_new = jnp.maximum(m_prev, m_cur)
+        m_safe = jnp.where(jnp.isfinite(m_new), m_new, 0.0)
+        p = jnp.where(jnp.isfinite(m_new), jnp.exp(s - m_safe), 0.0)
+        alpha = jnp.where(jnp.isfinite(m_prev), jnp.exp(m_prev - m_safe), 0.0)
+        l_new = l_prev * alpha + jnp.sum(p, axis=-1, keepdims=True)
+        pv = jax.lax.dot_general(
+            p, v, (((1,), (0,)), ((), ())), preferred_element_type=jnp.float32
+        )
+        acc_scr[:] = acc_scr[:] * alpha + pv
+        return m_new, l_new
+
+    init = (
+        jnp.full((G, 1), -jnp.inf, jnp.float32),
+        jnp.zeros((G, 1), jnp.float32),
+    )
+    _, l_final = jax.lax.fori_loop(0, n_pages, body, init)
+    l_safe = jnp.where(l_final > 0, l_final, 1.0)
+    o_ref[0] = (acc_scr[:] / l_safe).astype(o_ref.dtype)
+
+
+def paged_decode_attention(
+    q: jax.Array,  # [B, Hq, D]
+    k_pages: jax.Array,  # [Hkv, n_pages, page_size, D]
+    v_pages: jax.Array,  # [Hkv, n_pages, page_size, D]
+    page_tables: jax.Array,  # [B, pages_per_seq] int32
+    context_lens: jax.Array,  # [B] int32
+    *,
+    sm_scale: float | None = None,
+    interpret: bool | None = None,
+) -> jax.Array:  # [B, Hq, D]
+    """One decode step of attention against the paged KV cache."""
+    B, Hq, D = q.shape
+    Hkv, n_pages, page_size, _ = k_pages.shape
+    if Hq % Hkv:
+        raise ValueError(f"Hq={Hq} must be a multiple of Hkv={Hkv}")
+    G = Hq // Hkv
+    pages_per_seq = page_tables.shape[1]
+    if sm_scale is None:
+        sm_scale = D**-0.5
+    if interpret is None:
+        interpret = jax.default_backend() != "tpu"
+
+    qg = q.reshape(B * Hkv, G, D)  # block (b, h) lives at row b * Hkv + h
+
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=2,
+        grid=(B, Hkv),
+        in_specs=[
+            pl.BlockSpec(
+                (1, G, D), lambda b, h, *_refs: (b * pl.num_programs(1) + h, 0, 0),
+                memory_space=pltpu.VMEM,
+            ),
+            pl.BlockSpec(memory_space=pltpu.ANY),
+            pl.BlockSpec(memory_space=pltpu.ANY),
+        ],
+        out_specs=pl.BlockSpec(
+            (1, G, D), lambda b, h, *_refs: (b * pl.num_programs(1) + h, 0, 0),
+            memory_space=pltpu.VMEM,
+        ),
+        scratch_shapes=[
+            pltpu.VMEM((2, page_size, D), k_pages.dtype),
+            pltpu.VMEM((2, page_size, D), v_pages.dtype),
+            pltpu.VMEM((G, D), jnp.float32),
+            pltpu.SemaphoreType.DMA((2, 2)),
+        ],
+    )
+    kernel = functools.partial(
+        _decode_kernel,
+        page_size=page_size,
+        pages_per_seq=pages_per_seq,
+        sm_scale=sm_scale,
+    )
+    out = pl.pallas_call(
+        kernel,
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct((B * Hkv, G, D), q.dtype),
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("arbitrary", "arbitrary"),
+        ),
+        cost_estimate=pl.CostEstimate(
+            flops=int(4 * B * Hq * pages_per_seq * page_size * D),
+            bytes_accessed=int(
+                2 * Hkv * B * pages_per_seq * page_size * D * k_pages.dtype.itemsize
+            ),
+            transcendentals=int(B * Hq * pages_per_seq * page_size),
+        ),
+        interpret=interpret,
+    )(page_tables.reshape(-1).astype(jnp.int32), context_lens.astype(jnp.int32),
+      qg, k_pages, v_pages)
+    return out.reshape(B, Hq, D)

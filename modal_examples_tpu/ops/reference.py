@@ -1,0 +1,80 @@
+"""Pure-XLA reference implementations of the framework's custom kernels.
+
+Three jobs (SURVEY.md §4's "fake backend" tier):
+1. numerical ground truth for Pallas kernel tests;
+2. CPU fallback so every model runs (slowly) without a TPU;
+3. the recompute path for backward passes until dedicated bwd kernels land.
+
+These replace the reference repo's dependence on flash-attn / vLLM CUDA
+kernels (install_flash_attn.py:19-33, vllm_inference.py engine internals) —
+the semantics live here, the speed lives in the Pallas siblings.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+
+def attention(
+    q: jax.Array,  # [B, Hq, S, D]
+    k: jax.Array,  # [B, Hkv, S, D]
+    v: jax.Array,  # [B, Hkv, S, D]
+    *,
+    causal: bool = True,
+    sm_scale: float | None = None,
+    logit_cap: float | None = None,
+) -> jax.Array:
+    """Dense softmax attention with GQA (Hq a multiple of Hkv)."""
+    B, Hq, S, D = q.shape
+    Hkv = k.shape[1]
+    if sm_scale is None:
+        sm_scale = D**-0.5
+    group = Hq // Hkv
+    qg = q.reshape(B, Hkv, group, S, D)
+    s = jnp.einsum("bhgqd,bhkd->bhgqk", qg, k, preferred_element_type=jnp.float32)
+    s = s * sm_scale
+    if logit_cap is not None:
+        s = logit_cap * jnp.tanh(s / logit_cap)
+    if causal:
+        mask = jnp.tril(jnp.ones((S, S), bool))
+        s = jnp.where(mask, s, -jnp.inf)
+    p = jax.nn.softmax(s, axis=-1)
+    o = jnp.einsum("bhgqk,bhkd->bhgqd", p.astype(v.dtype), v)
+    return o.reshape(B, Hq, S, D)
+
+
+def paged_decode_attention(
+    q: jax.Array,  # [B, Hq, D] — one new token per sequence
+    k_pages: jax.Array,  # [Hkv, n_pages, page_size, D]
+    v_pages: jax.Array,  # [Hkv, n_pages, page_size, D]
+    page_tables: jax.Array,  # [B, pages_per_seq] int32 — physical page ids
+    context_lens: jax.Array,  # [B] int32 — tokens already in cache (incl. new)
+    *,
+    sm_scale: float | None = None,
+) -> jax.Array:
+    """Decode-step attention over a paged KV cache (vLLM-semantics ground
+    truth for the Pallas ragged kernel)."""
+    B, Hq, D = q.shape
+    Hkv, _, page_size, _ = k_pages.shape
+    group = Hq // Hkv
+    pages_per_seq = page_tables.shape[1]
+    S = pages_per_seq * page_size
+    if sm_scale is None:
+        sm_scale = D**-0.5
+
+    # gather each sequence's logical KV [B, Hkv, S, D]
+    ks = k_pages[:, page_tables]  # [Hkv, B, pages, page_size, D]
+    vs = v_pages[:, page_tables]
+    ks = ks.transpose(1, 0, 2, 3, 4).reshape(B, Hkv, S, D)
+    vs = vs.transpose(1, 0, 2, 3, 4).reshape(B, Hkv, S, D)
+
+    qg = q.reshape(B, Hkv, group, D)
+    s = jnp.einsum("bhgd,bhkd->bhgk", qg, ks, preferred_element_type=jnp.float32)
+    s = s * sm_scale
+    positions = jnp.arange(S)[None, :]  # [1, S]
+    valid = positions < context_lens[:, None]  # [B, S]
+    s = jnp.where(valid[:, None, None, :], s, -jnp.inf)
+    p = jax.nn.softmax(s, axis=-1)
+    o = jnp.einsum("bhgk,bhkd->bhgd", p.astype(vs.dtype), vs)
+    return o.reshape(B, Hq, D)

@@ -73,6 +73,12 @@ def make_mesh(
     """
     if devices is None:
         devices = jax.devices()
+        if axes:
+            # a fully-specified request smaller than the machine takes a
+            # prefix of the devices (e.g. a seq-4 mesh on an 8-chip host)
+            want = math.prod(v for v in axes.values() if v != -1)
+            if all(v != -1 for v in axes.values()) and want <= len(devices):
+                devices = devices[:want]
     if spec is not None:
         if isinstance(spec, str):
             spec = parse_tpu_spec(spec)

@@ -1,0 +1,164 @@
+"""Kernel correctness tests: every Pallas kernel against its XLA reference
+(interpret mode on the CPU backend; the same kernels compile via Mosaic on
+TPU — exercised by bench.py and __graft_entry__.py)."""
+
+import numpy as np
+import pytest
+
+
+@pytest.fixture(scope="module")
+def jax(jax_cpu):
+    return jax_cpu
+
+
+@pytest.fixture(scope="module")
+def jnp(jax):
+    import jax.numpy as jnp
+
+    return jnp
+
+
+class TestFlashAttention:
+    @pytest.mark.parametrize(
+        "B,Hq,Hkv,S,D,causal",
+        [
+            (2, 4, 4, 256, 64, True),
+            (1, 8, 2, 128, 64, False),  # GQA
+            (2, 4, 2, 256, 128, True),
+            (1, 2, 2, 384, 64, True),  # 3 blocks of 128
+        ],
+    )
+    def test_matches_reference(self, jax, jnp, B, Hq, Hkv, S, D, causal):
+        from modal_examples_tpu.ops import flash_attention, reference
+
+        ks = jax.random.split(jax.random.PRNGKey(0), 3)
+        q = jax.random.normal(ks[0], (B, Hq, S, D), jnp.float32)
+        k = jax.random.normal(ks[1], (B, Hkv, S, D), jnp.float32)
+        v = jax.random.normal(ks[2], (B, Hkv, S, D), jnp.float32)
+        out = flash_attention(q, k, v, causal)
+        want = reference.attention(q, k, v, causal=causal)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(want), atol=2e-5)
+
+    def test_gradients_match_reference(self, jax, jnp):
+        from modal_examples_tpu.ops import flash_attention, reference
+
+        ks = jax.random.split(jax.random.PRNGKey(1), 3)
+        q = jax.random.normal(ks[0], (1, 2, 128, 64))
+        k = jax.random.normal(ks[1], (1, 2, 128, 64))
+        v = jax.random.normal(ks[2], (1, 2, 128, 64))
+        g1 = jax.grad(
+            lambda q, k, v: flash_attention(q, k, v, True).sum(), argnums=(0, 1, 2)
+        )(q, k, v)
+        g2 = jax.grad(
+            lambda q, k, v: reference.attention(q, k, v, causal=True).sum(),
+            argnums=(0, 1, 2),
+        )(q, k, v)
+        for a, b in zip(g1, g2):
+            np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=2e-5)
+
+    def test_lse_is_logsumexp(self, jax, jnp):
+        from modal_examples_tpu.ops import flash_attention_with_lse
+
+        ks = jax.random.split(jax.random.PRNGKey(2), 3)
+        q = jax.random.normal(ks[0], (1, 1, 128, 64))
+        k = jax.random.normal(ks[1], (1, 1, 128, 64))
+        v = jax.random.normal(ks[2], (1, 1, 128, 64))
+        scale = 64**-0.5
+        _, lse = flash_attention_with_lse(q, k, v, causal=False)
+        s = (q[0, 0] @ k[0, 0].T) * scale
+        want = jax.scipy.special.logsumexp(s, axis=-1)
+        np.testing.assert_allclose(np.asarray(lse[0, 0]), np.asarray(want), atol=1e-4)
+
+    def test_rejects_ragged_seq(self, jax, jnp):
+        from modal_examples_tpu.ops import flash_attention
+
+        q = jnp.ones((1, 1, 200, 64))
+        with pytest.raises(ValueError, match="multiple of block"):
+            flash_attention(q, q, q, True)
+
+
+class TestPagedAttention:
+    def test_matches_reference_ragged_lens(self, jax, jnp):
+        from modal_examples_tpu.ops import paged_decode_attention, reference
+
+        B, Hq, Hkv, D = 4, 8, 2, 64
+        page_size, n_pages, pages_per_seq = 16, 32, 4
+        ks = jax.random.split(jax.random.PRNGKey(1), 4)
+        q = jax.random.normal(ks[0], (B, Hq, D), jnp.float32)
+        kp = jax.random.normal(ks[1], (Hkv, n_pages, page_size, D), jnp.float32)
+        vp = jax.random.normal(ks[2], (Hkv, n_pages, page_size, D), jnp.float32)
+        pt = (
+            jax.random.permutation(ks[3], n_pages)[: B * pages_per_seq]
+            .reshape(B, pages_per_seq)
+            .astype(jnp.int32)
+        )
+        cl = jnp.array([5, 16, 33, 64], jnp.int32)  # ragged, page-unaligned
+        out = paged_decode_attention(q, kp, vp, pt, cl)
+        want = reference.paged_decode_attention(q, kp, vp, pt, cl)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(want), atol=2e-5)
+
+    def test_mha_group_of_one(self, jax, jnp):
+        from modal_examples_tpu.ops import paged_decode_attention, reference
+
+        B, H, D = 2, 4, 64
+        page_size, n_pages, pages_per_seq = 16, 16, 2
+        ks = jax.random.split(jax.random.PRNGKey(5), 4)
+        q = jax.random.normal(ks[0], (B, H, D), jnp.float32)
+        kp = jax.random.normal(ks[1], (H, n_pages, page_size, D), jnp.float32)
+        vp = jax.random.normal(ks[2], (H, n_pages, page_size, D), jnp.float32)
+        pt = jnp.arange(B * pages_per_seq, dtype=jnp.int32).reshape(B, -1)
+        cl = jnp.array([17, 32], jnp.int32)
+        out = paged_decode_attention(q, kp, vp, pt, cl)
+        want = reference.paged_decode_attention(q, kp, vp, pt, cl)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(want), atol=2e-5)
+
+
+class TestQuantizedMatmul:
+    def test_quantize_roundtrip(self, jax, jnp):
+        from modal_examples_tpu.ops import dequantize_int8, quantize_int8
+
+        w = jax.random.normal(jax.random.PRNGKey(0), (256, 512))
+        q, s = quantize_int8(w)
+        w2 = dequantize_int8(q, s)
+        assert float(jnp.max(jnp.abs(w - w2))) < float(jnp.max(s)) * 0.51
+
+    def test_matmul_matches_dequantized(self, jax, jnp):
+        from modal_examples_tpu.ops import dequantize_int8, quantize_int8, quantized_matmul
+
+        ks = jax.random.split(jax.random.PRNGKey(3), 2)
+        x = jax.random.normal(ks[0], (256, 512), jnp.float32)
+        w = jax.random.normal(ks[1], (512, 256), jnp.float32)
+        wq, ws = quantize_int8(w)
+        out = quantized_matmul(x, wq, ws, block_m=128, block_n=128, block_k=256)
+        want = x @ dequantize_int8(wq, ws)
+        # kernel computes in bf16 on the MXU: tolerance = bf16 matmul error
+        # (measured ~0.34 max for this size), not f32 error
+        np.testing.assert_allclose(np.asarray(out), np.asarray(want), atol=0.5)
+
+    def test_fallback_on_ragged_shapes(self, jax, jnp):
+        from modal_examples_tpu.ops import quantize_int8, quantized_matmul
+
+        x = jax.random.normal(jax.random.PRNGKey(0), (100, 300), jnp.float32)
+        w = jax.random.normal(jax.random.PRNGKey(1), (300, 77), jnp.float32)
+        wq, ws = quantize_int8(w)
+        out = quantized_matmul(x, wq, ws)
+        assert out.shape == (100, 77)
+
+
+class TestRingAttention:
+    @pytest.mark.parametrize("causal", [True, False])
+    def test_matches_dense_over_seq_mesh(self, jax, jnp, causal):
+        from modal_examples_tpu.ops import reference, ring_attention_sharded
+        from modal_examples_tpu.parallel import make_mesh
+
+        mesh = make_mesh({"seq": 4})
+        B, H, S, D = 1, 2, 512, 64  # 4 shards x 128
+        ks = jax.random.split(jax.random.PRNGKey(7), 3)
+        q = jax.random.normal(ks[0], (B, H, S, D), jnp.float32)
+        k = jax.random.normal(ks[1], (B, H, S, D), jnp.float32)
+        v = jax.random.normal(ks[2], (B, H, S, D), jnp.float32)
+        out = ring_attention_sharded(q, k, v, mesh, causal=causal)
+        want = reference.attention(q, k, v, causal=causal)
+        np.testing.assert_allclose(
+            np.asarray(out), np.asarray(want), atol=3e-5, rtol=1e-4
+        )
