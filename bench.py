@@ -1,0 +1,162 @@
+#!/usr/bin/env python
+"""Headline bench: LLM decode throughput on the continuous-batching engine.
+
+North star (BASELINE.md): Llama-2-7B tokens/sec/chip on TPU, vs the A100
+class the reference's vLLM example assumes. Baseline constant below:
+~1400 output tok/s is a representative public vLLM Llama-2-7B total decode
+throughput on one A100-40GB at moderate batch. vs_baseline = value/1400.
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline", ...}.
+
+Supervisor/child structure: the supervisor tries model configs largest-first
+in subprocesses with timeouts (a wedged TPU or an OOM must degrade, not
+hang the driver); the child measures engine decode throughput after a
+compile warmup. BENCH_MODEL env forces a config; BENCH_CPU=1 forces the CPU
+backend (for local smoke tests).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import subprocess
+import sys
+import time
+
+A100_LLAMA2_7B_TOK_S = 1400.0
+
+CONFIGS = {
+    # name: (engine model preset/config kwargs, slots, max_model_len, max_tokens, timeout_s)
+    "llama2-7b": dict(slots=8, max_len=256, max_tokens=128, timeout=1500),
+    "llama-1b": dict(slots=16, max_len=512, max_tokens=128, timeout=900),
+    "tiny": dict(slots=4, max_len=128, max_tokens=16, timeout=420),
+}
+
+
+def _child(model: str) -> None:
+    import jax
+
+    if os.environ.get("BENCH_CPU"):
+        jax.config.update("jax_platforms", "cpu")
+
+    import jax.numpy as jnp
+
+    from modal_examples_tpu.models import llama
+    from modal_examples_tpu.serving import LLMEngine, SamplingParams
+
+    spec = CONFIGS[model]
+    if model == "llama2-7b":
+        cfg = llama.LlamaConfig.llama2_7b()
+    elif model == "llama-1b":
+        cfg = llama.LlamaConfig(
+            vocab_size=32000, dim=2048, n_layers=16, n_heads=16, n_kv_heads=8,
+            ffn_dim=5632, max_seq_len=2048,
+        )
+    else:
+        cfg = llama.LlamaConfig.tiny()
+
+    t0 = time.time()
+    engine = LLMEngine(
+        cfg,
+        max_slots=spec["slots"],
+        max_model_len=spec["max_len"],
+        page_size=16,
+        prefill_buckets=(64, 128, 256),
+        kv_dtype=jnp.bfloat16,
+    )
+    build_s = time.time() - t0
+    prompt = "The quick brown fox jumps over the lazy dog. " * 2
+    params = SamplingParams(max_tokens=spec["max_tokens"], temperature=1.0)
+
+    # warmup: compiles prefill bucket + decode step
+    t0 = time.time()
+    engine.start()
+    warm = [engine.submit(prompt, SamplingParams(max_tokens=8, temperature=1.0))
+            for _ in range(2)]
+    for r in warm:
+        "".join(engine.stream(r))
+    compile_s = time.time() - t0
+
+    # timed: saturate all slots
+    n_reqs = spec["slots"] * 2
+    base_tokens = engine.stats.generated_tokens
+    t0 = time.time()
+    reqs = [engine.submit(prompt, params) for _ in range(n_reqs)]
+    for r in reqs:
+        for _ in engine.stream(r):
+            pass
+    elapsed = time.time() - t0
+    generated = engine.stats.generated_tokens - base_tokens
+    engine.stop()
+
+    tok_s = generated / elapsed
+    print(
+        json.dumps(
+            {
+                "metric": f"{model} serving decode throughput (1 chip)",
+                "value": round(tok_s, 2),
+                "unit": "tok/s",
+                "vs_baseline": round(tok_s / A100_LLAMA2_7B_TOK_S, 4),
+                "model": model,
+                "params": cfg.param_count,
+                "backend": jax.default_backend(),
+                "slots": spec["slots"],
+                "generated_tokens": generated,
+                "elapsed_s": round(elapsed, 2),
+                "engine_build_s": round(build_s, 1),
+                "compile_s": round(compile_s, 1),
+            }
+        )
+    )
+
+
+def main() -> int:
+    if len(sys.argv) > 1 and sys.argv[1] == "--child":
+        _child(sys.argv[2])
+        return 0
+
+    if os.environ.get("BENCH_MODEL"):
+        order = [os.environ["BENCH_MODEL"]]
+    elif os.environ.get("BENCH_CPU"):
+        order = ["tiny"]
+    else:
+        order = ["llama2-7b", "llama-1b", "tiny"]
+
+    last_err = ""
+    for model in order:
+        spec = CONFIGS[model]
+        try:
+            proc = subprocess.run(
+                [sys.executable, os.path.abspath(__file__), "--child", model],
+                capture_output=True,
+                text=True,
+                timeout=spec["timeout"],
+                cwd=os.path.dirname(os.path.abspath(__file__)),
+            )
+        except subprocess.TimeoutExpired:
+            last_err = f"{model}: timeout after {spec['timeout']}s"
+            continue
+        for line in reversed(proc.stdout.strip().splitlines()):
+            try:
+                json.loads(line)
+                print(line)
+                return 0
+            except json.JSONDecodeError:
+                continue
+        last_err = f"{model}: exit={proc.returncode} stderr={proc.stderr[-400:]}"
+    print(
+        json.dumps(
+            {
+                "metric": "serving decode throughput",
+                "value": 0.0,
+                "unit": "tok/s",
+                "vs_baseline": 0.0,
+                "error": last_err,
+            }
+        )
+    )
+    return 1
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

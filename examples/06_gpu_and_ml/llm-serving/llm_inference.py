@@ -1,0 +1,133 @@
+# # OpenAI-compatible LLM serving on TPU
+#
+# The north-star serving example — the TPU-native counterpart of the
+# reference's 06_gpu_and_ml/llm-serving/vllm_inference.py (structure cited
+# per SURVEY.md §3.2). Where the reference subprocess-spawns `vllm serve`
+# (CUDA paged attention + CUDA graphs), this serves through our own JAX
+# engine: continuous batching over fixed decode slots, Pallas ragged paged
+# attention, sampling fused into the jitted decode step.
+#
+# Deploy:  tpurun serve examples/06_gpu_and_ml/llm-serving/llm_inference.py
+# Client:  tpurun run  examples/06_gpu_and_ml/llm-serving/llm_inference.py
+#
+# FAST_BOOT analog (vllm_inference.py:85-101): MTPU_MODEL=tiny serves a tiny
+# random-weight model (the dummy-weights dev mode, very_large_models.py:2-3);
+# point MTPU_MODEL_DIR at an HF llama checkout for real weights.
+
+import json
+import os
+import time
+import urllib.request
+
+import modal_examples_tpu as mtpu
+
+MODEL = os.environ.get("MTPU_MODEL", "tiny")
+MODEL_DIR = os.environ.get("MTPU_MODEL_DIR")  # HF safetensors dir on a Volume
+PORT = int(os.environ.get("MTPU_PORT", "8000"))
+# resource spec; MTPU_TPU="" runs the server container on CPU (dev mode)
+TPU = os.environ.get("MTPU_TPU", "v5e-1") or None
+MINUTES = 60
+
+app = mtpu.App("example-llm-inference")
+
+# HF weights + XLA compile cache live on Volumes, like the reference's
+# huggingface-cache + vllm-cache volumes (vllm_inference.py:77-81)
+hf_cache_vol = mtpu.Volume.from_name("huggingface-cache", create_if_missing=True)
+compile_cache_vol = mtpu.Volume.from_name("xla-compile-cache", create_if_missing=True)
+
+image = (
+    mtpu.Image.tpu_base()
+    .env({"JAX_COMPILATION_CACHE_DIR": "/root/.cache/xla"})
+)
+
+
+@app.server(
+    port=PORT,
+    tpu=TPU,
+    image=image,
+    volumes={
+        "/root/.cache/huggingface": hf_cache_vol,
+        "/root/.cache/xla": compile_cache_vol,
+    },
+    startup_timeout=20 * MINUTES,
+    scaledown_window=15 * MINUTES,
+    target_concurrency=100,
+    unauthenticated=True,
+)
+class LLMServer:
+    @mtpu.enter()
+    def start(self):
+        import jax
+
+        # persistent compile cache: the single biggest cold-start lever on
+        # TPU (the trtllm "engine build" / vllm-cache analog)
+        try:
+            jax.config.update(
+                "jax_compilation_cache_dir",
+                os.environ.get("JAX_COMPILATION_CACHE_DIR", "/tmp/xla-cache"),
+            )
+        except Exception:
+            pass
+        from modal_examples_tpu.serving import OpenAIServer, build_engine
+
+        engine = build_engine(
+            MODEL,
+            model_dir=MODEL_DIR,
+            max_slots=8 if MODEL != "tiny" else 4,
+            max_model_len=1024 if MODEL != "tiny" else 128,
+        )
+        self.server = OpenAIServer(engine, model_name=MODEL, port=PORT)
+        self.server.start()  # replica advertised once the port accepts
+
+    @mtpu.exit()
+    def shutdown(self):
+        self.server.stop()
+
+
+# ## Client — health-check then a real request, like the reference's
+# local_entrypoint smoke test (vllm_inference.py:243-345)
+
+
+@app.local_entrypoint()
+def main(prompt: str = "A neutron star is", max_tokens: int = 32, stream: bool = False):
+    url = LLMServer.serve()
+    deadline = time.time() + 120
+    while time.time() < deadline:
+        try:
+            with urllib.request.urlopen(f"{url}/health", timeout=2) as r:
+                if json.load(r).get("status") == "ok":
+                    break
+        except Exception:
+            time.sleep(1)
+    else:
+        raise TimeoutError("server never became healthy")
+    print(f"server healthy at {url}")
+
+    body = json.dumps(
+        {
+            "messages": [{"role": "user", "content": prompt}],
+            "max_tokens": max_tokens,
+            "temperature": 0.8,
+            "stream": stream,
+        }
+    ).encode()
+    req = urllib.request.Request(
+        f"{url}/v1/chat/completions",
+        data=body,
+        headers={"content-type": "application/json"},
+    )
+    t0 = time.time()
+    with urllib.request.urlopen(req) as r:
+        if stream:
+            for line in r:
+                line = line.decode().strip()
+                if line.startswith("data: ") and line != "data: [DONE]":
+                    delta = json.loads(line[6:])["choices"][0]["delta"]
+                    print(delta.get("content", ""), end="", flush=True)
+            print()
+        else:
+            out = json.load(r)
+            print("completion:", repr(out["choices"][0]["message"]["content"]))
+            print("usage:", out["usage"])
+    print(f"round-trip: {time.time() - t0:.2f}s")
+    LLMServer.stop()

@@ -56,7 +56,17 @@ class FunctionTimeoutError(TimeoutError):
 
 
 class _ContainerDead(RuntimeError):
-    """Raised by dispatch() when racing a container's death."""
+    """Raised by dispatch() when racing a container's death.
+
+    ``still_owned`` lists the inputs the dispatcher removed from the
+    container's active set itself — only those may be requeued by the caller
+    (anything already taken by the reader thread's death path is the death
+    path's responsibility; requeueing it too would run the input twice).
+    """
+
+    def __init__(self, msg: str, still_owned: list | None = None):
+        super().__init__(msg)
+        self.still_owned = still_owned or []
 
 
 class InputCancelled(Exception):
@@ -359,8 +369,8 @@ class _Container:
             self.conn.send(("input", qi.call.input_id, qi.method_name, qi.payload))
         except (BrokenPipeError, OSError) as e:
             with self.lock:
-                self.active.pop(qi.call.input_id, None)
-            raise _ContainerDead(str(e)) from e
+                owned = self.active.pop(qi.call.input_id, None)
+            raise _ContainerDead(str(e), [qi] if owned else []) from e
 
     def dispatch_batch(self, qis: list[_QueuedInput]) -> None:
         now = time.monotonic()
@@ -384,9 +394,11 @@ class _Container:
             )
         except (BrokenPipeError, OSError) as e:
             with self.lock:
-                for qi in qis:
-                    self.active.pop(qi.call.input_id, None)
-            raise _ContainerDead(str(e)) from e
+                owned = [
+                    qi for qi in qis
+                    if self.active.pop(qi.call.input_id, None) is not None
+                ]
+            raise _ContainerDead(str(e), owned) from e
 
     # -- reading ------------------------------------------------------------
 
@@ -663,9 +675,9 @@ class FunctionPool:
                 target.retired = True
             try:
                 target.dispatch(qi)
-            except _ContainerDead:
+            except _ContainerDead as e:
                 with self.lock:
-                    self.pending.appendleft(qi)
+                    self.pending.extendleft(reversed(e.still_owned))
 
     def _dispatch_batched(self, ready: list[_QueuedInput], now: float) -> None:
         cfg = self.spec.batched
@@ -685,9 +697,9 @@ class FunctionPool:
                 return
             try:
                 target.dispatch_batch(batch)
-            except _ContainerDead:
+            except _ContainerDead as e:
                 with self.lock:
-                    self.pending.extendleft(reversed(batch))
+                    self.pending.extendleft(reversed(e.still_owned))
 
     def _autoscale(self, now: float) -> None:
         with self.lock:
@@ -794,6 +806,9 @@ class ClusterPool:
                     and call.attempt <= r.max_retries
                     and not call.cancelled
                     and not self.closed
+                    # generators stream through the caller's queue as they
+                    # run; a retry would duplicate already-delivered items
+                    and not self.spec.is_generator
                 ):
                     time.sleep(r.delay_for_attempt(call.attempt))
                     continue

@@ -1,0 +1,11 @@
+"""Model zoo (pure-functional JAX, pytree params, Pallas hot ops).
+
+JAX-native replacements for the model families the reference serves through
+CUDA engines (SURVEY.md §2.2): llama (LLM serving + fine-tuning), gpt
+(nanoGPT-style SLM pretraining, hp_sweep parity), bert (BGE embeddings),
+whisper (ASR).
+"""
+
+from . import layers, llama
+
+__all__ = ["layers", "llama"]

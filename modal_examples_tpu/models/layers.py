@@ -1,0 +1,139 @@
+"""Shared transformer building blocks (pure-functional JAX).
+
+Replaces the torch module zoo the reference leans on (HF transformers /
+vLLM / unsloth internals) with TPU-first primitives: parameters are plain
+pytrees (nested dicts of jax arrays) so sharding is a PartitionSpec tree and
+checkpointing is orbax-native; compute is bf16 on the MXU with f32 for norms
+and softmax; attention goes through ops.flash_attention (training/prefill)
+or ops.paged_decode_attention (serving decode).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ..ops import flash_attention
+
+
+def rms_norm(x: jax.Array, weight: jax.Array, eps: float = 1e-5) -> jax.Array:
+    """RMSNorm in f32, cast back to input dtype (llama-family norm)."""
+    xf = x.astype(jnp.float32)
+    var = jnp.mean(xf * xf, axis=-1, keepdims=True)
+    normed = xf * jax.lax.rsqrt(var + eps)
+    return (normed * weight.astype(jnp.float32)).astype(x.dtype)
+
+
+def layer_norm(
+    x: jax.Array, weight: jax.Array, bias: jax.Array, eps: float = 1e-5
+) -> jax.Array:
+    xf = x.astype(jnp.float32)
+    mu = jnp.mean(xf, axis=-1, keepdims=True)
+    var = jnp.var(xf, axis=-1, keepdims=True)
+    normed = (xf - mu) * jax.lax.rsqrt(var + eps)
+    return (normed * weight + bias).astype(x.dtype)
+
+
+def rotary_embedding(
+    positions: jax.Array,  # [..., S] int32
+    head_dim: int,
+    theta: float = 10000.0,
+    dtype=jnp.float32,
+) -> tuple[jax.Array, jax.Array]:
+    """cos/sin tables for RoPE at the given positions: [..., S, head_dim/2]."""
+    half = head_dim // 2
+    freqs = theta ** (-jnp.arange(0, half, dtype=jnp.float32) / half)
+    angles = positions[..., None].astype(jnp.float32) * freqs  # [..., S, half]
+    return jnp.cos(angles).astype(dtype), jnp.sin(angles).astype(dtype)
+
+
+def apply_rope(x: jax.Array, cos: jax.Array, sin: jax.Array) -> jax.Array:
+    """Rotate pairs (split-half convention, matching llama weights).
+
+    x: [B, H, S, D]; cos/sin: [B, S, D/2] or [S, D/2].
+    """
+    half = x.shape[-1] // 2
+    x1, x2 = x[..., :half], x[..., half:]
+    if cos.ndim == 2:  # [S, half] -> broadcast over B, H
+        cos_b = cos[None, None]
+        sin_b = sin[None, None]
+    else:  # [B, S, half] -> broadcast over H
+        cos_b = cos[:, None]
+        sin_b = sin[:, None]
+    o1 = x1 * cos_b - x2 * sin_b
+    o2 = x2 * cos_b + x1 * sin_b
+    return jnp.concatenate([o1, o2], axis=-1).astype(x.dtype)
+
+
+def swiglu_mlp(params: dict, x: jax.Array) -> jax.Array:
+    """SwiGLU feed-forward: silu(x W_gate) * (x W_up) W_down."""
+    gate = jnp.dot(x, params["gate"], preferred_element_type=jnp.float32)
+    up = jnp.dot(x, params["up"], preferred_element_type=jnp.float32)
+    h = (jax.nn.silu(gate) * up).astype(x.dtype)
+    return jnp.dot(h, params["down"], preferred_element_type=jnp.float32).astype(
+        x.dtype
+    )
+
+
+def gelu_mlp(params: dict, x: jax.Array) -> jax.Array:
+    """GELU feed-forward with biases (GPT-2/BERT style)."""
+    h = jnp.dot(x, params["fc_w"], preferred_element_type=jnp.float32) + params[
+        "fc_b"
+    ].astype(jnp.float32)
+    h = jax.nn.gelu(h).astype(x.dtype)
+    return (
+        jnp.dot(h, params["proj_w"], preferred_element_type=jnp.float32)
+        + params["proj_b"].astype(jnp.float32)
+    ).astype(x.dtype)
+
+
+def attention_op(q, k, v, causal: bool, impl: str = "flash") -> jax.Array:
+    """Dispatch between the Pallas flash kernel and XLA attention.
+
+    ``flash``: the Pallas kernel — use on a single chip or inside shard_map
+    (where operands are shard-local). ``xla``: plain einsum attention that
+    XLA auto-partitions — use under multi-device jit with sharded params,
+    where a pallas_call can't be partitioned by the compiler.
+    """
+    if impl == "flash":
+        return flash_attention(q, k, v, causal)
+    from ..ops import reference
+
+    return reference.attention(q, k, v, causal=causal)
+
+
+def causal_self_attention(
+    params: dict,
+    x: jax.Array,  # [B, S, E]
+    *,
+    n_heads: int,
+    n_kv_heads: int,
+    cos: jax.Array | None = None,
+    sin: jax.Array | None = None,
+    causal: bool = True,
+    attn_impl: str = "flash",
+) -> jax.Array:
+    """Projection + (optional RoPE) + fused attention + output projection."""
+    B, S, E = x.shape
+    D = E // n_heads
+    q = jnp.dot(x, params["wq"], preferred_element_type=jnp.float32).astype(x.dtype)
+    k = jnp.dot(x, params["wk"], preferred_element_type=jnp.float32).astype(x.dtype)
+    v = jnp.dot(x, params["wv"], preferred_element_type=jnp.float32).astype(x.dtype)
+    q = q.reshape(B, S, n_heads, D).transpose(0, 2, 1, 3)
+    k = k.reshape(B, S, n_kv_heads, D).transpose(0, 2, 1, 3)
+    v = v.reshape(B, S, n_kv_heads, D).transpose(0, 2, 1, 3)
+    if cos is not None:
+        q = apply_rope(q, cos, sin)
+        k = apply_rope(k, cos, sin)
+    o = attention_op(q, k, v, causal, attn_impl)
+    o = o.transpose(0, 2, 1, 3).reshape(B, S, E)
+    return jnp.dot(o, params["wo"], preferred_element_type=jnp.float32).astype(x.dtype)
+
+
+def init_dense(key, shape, scale: float | None = None, dtype=jnp.bfloat16):
+    fan_in = shape[0]
+    if scale is None:
+        scale = fan_in**-0.5
+    # sample directly in the target dtype: a 7B bf16 init must never
+    # materialize an f32 copy (2x HBM) on a 16GB chip
+    return jax.random.normal(key, shape, dtype) * jnp.asarray(scale, dtype)

@@ -1,0 +1,402 @@
+"""Llama-family decoder LM — the framework's flagship model.
+
+Serves the north-star config (BASELINE.md: Llama-2-7B at >= A100-class
+tok/s/chip on v5e) and the LLM workloads the reference delegates to
+vLLM/SGLang/TRT-LLM (06_gpu_and_ml/llm-serving/vllm_inference.py,
+unsloth_finetune.py). Architecture covers Llama 2/3 and friends: RMSNorm,
+RoPE, GQA, SwiGLU.
+
+TPU-first design:
+- parameters are a pytree of bf16 arrays; ``partition_specs()`` gives the
+  tensor-parallel NamedSharding layout (column-parallel wq/wk/wv/gate/up,
+  row-parallel wo/down — XLA inserts the psum over the ``tensor`` ICI axis);
+- training/prefill attention is the Pallas flash kernel; serving decode is
+  the Pallas ragged paged kernel against an HBM page cache;
+- per-layer weights are stacked along a leading axis and the layer loop is a
+  ``lax.scan`` — one compiled layer body instead of n_layers copies (compile
+  time and code size stay O(1) in depth);
+- init is sharded: each weight is created directly on its target devices via
+  jit so a 7B model never materializes on one host.
+
+HF interop: ``load_hf_weights()`` maps safetensors checkpoints (the HF cache
+volume pattern, vllm_inference.py:77) into this tree without a 2x RAM spike.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+from pathlib import Path
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import PartitionSpec as P
+
+from ..ops import flash_attention, paged_decode_attention
+from . import layers
+
+
+@dataclasses.dataclass(frozen=True)
+class LlamaConfig:
+    vocab_size: int = 32000
+    dim: int = 4096
+    n_layers: int = 32
+    n_heads: int = 32
+    n_kv_heads: int = 32
+    ffn_dim: int = 11008
+    rope_theta: float = 10000.0
+    norm_eps: float = 1e-5
+    max_seq_len: int = 4096
+    dtype: str = "bfloat16"
+    tie_embeddings: bool = False
+
+    @property
+    def head_dim(self) -> int:
+        return self.dim // self.n_heads
+
+    @property
+    def jnp_dtype(self):
+        return jnp.dtype(self.dtype)
+
+    @property
+    def param_count(self) -> int:
+        emb = self.vocab_size * self.dim * (1 if self.tie_embeddings else 2)
+        per_layer = (
+            self.dim * self.head_dim * (self.n_heads + 2 * self.n_kv_heads)  # qkv
+            + self.n_heads * self.head_dim * self.dim  # o
+            + 3 * self.dim * self.ffn_dim  # gate/up/down
+            + 2 * self.dim  # norms
+        )
+        return emb + self.n_layers * per_layer + self.dim
+
+    @staticmethod
+    def llama2_7b() -> "LlamaConfig":
+        return LlamaConfig()
+
+    @staticmethod
+    def llama3_8b() -> "LlamaConfig":
+        return LlamaConfig(
+            vocab_size=128256, dim=4096, n_layers=32, n_heads=32, n_kv_heads=8,
+            ffn_dim=14336, rope_theta=500000.0, max_seq_len=8192,
+        )
+
+    @staticmethod
+    def tiny(vocab_size: int = 512) -> "LlamaConfig":
+        """Test-tier config (the reference's cheap-mode switch, SURVEY.md §4)."""
+        return LlamaConfig(
+            vocab_size=vocab_size, dim=128, n_layers=2, n_heads=4, n_kv_heads=2,
+            ffn_dim=256, max_seq_len=256,
+        )
+
+    @staticmethod
+    def from_hf_config(path: str | Path) -> "LlamaConfig":
+        cfg = json.loads(Path(path).read_text())
+        return LlamaConfig(
+            vocab_size=cfg["vocab_size"],
+            dim=cfg["hidden_size"],
+            n_layers=cfg["num_hidden_layers"],
+            n_heads=cfg["num_attention_heads"],
+            n_kv_heads=cfg.get("num_key_value_heads", cfg["num_attention_heads"]),
+            ffn_dim=cfg["intermediate_size"],
+            rope_theta=cfg.get("rope_theta", 10000.0),
+            norm_eps=cfg.get("rms_norm_eps", 1e-5),
+            max_seq_len=cfg.get("max_position_embeddings", 4096),
+            tie_embeddings=cfg.get("tie_word_embeddings", False),
+        )
+
+
+# -- parameters -------------------------------------------------------------
+
+
+def init_params(key: jax.Array, cfg: LlamaConfig) -> dict:
+    """Random init; per-layer weights stacked on axis 0 for the scan."""
+    dt = cfg.jnp_dtype
+    D, H, KVH, hd, F, L = (
+        cfg.dim, cfg.n_heads, cfg.n_kv_heads, cfg.head_dim, cfg.ffn_dim,
+        cfg.n_layers,
+    )
+    keys = jax.random.split(key, 10)
+
+    def dense(k, *shape):
+        return layers.init_dense(k, shape, dtype=dt)
+
+    params = {
+        "embed": layers.init_dense(keys[0], (cfg.vocab_size, D), scale=0.02, dtype=dt),
+        "layers": {
+            "attn_norm": jnp.ones((L, D), dt),
+            "wq": dense(keys[1], L, D, H * hd),
+            "wk": dense(keys[2], L, D, KVH * hd),
+            "wv": dense(keys[3], L, D, KVH * hd),
+            "wo": dense(keys[4], L, H * hd, D),
+            "mlp_norm": jnp.ones((L, D), dt),
+            "gate": dense(keys[5], L, D, F),
+            "up": dense(keys[6], L, D, F),
+            "down": dense(keys[7], L, F, D),
+        },
+        "final_norm": jnp.ones((D,), dt),
+    }
+    if not cfg.tie_embeddings:
+        params["lm_head"] = dense(keys[8], D, cfg.vocab_size)
+    return params
+
+
+def partition_specs(cfg: LlamaConfig) -> dict:
+    """Tensor-parallel PartitionSpecs over the ``tensor`` mesh axis.
+
+    Column-parallel in-projections, row-parallel out-projections — the
+    Megatron layout expressed as sharding annotations; XLA inserts the
+    all-reduce over ICI (replaces the reference's engine-internal NCCL TP,
+    vllm_inference.py:179-180).
+    """
+    specs = {
+        "embed": P("tensor", None),  # vocab-sharded
+        "layers": {
+            "attn_norm": P(None, None),
+            "wq": P(None, None, "tensor"),
+            "wk": P(None, None, "tensor"),
+            "wv": P(None, None, "tensor"),
+            "wo": P(None, "tensor", None),
+            "mlp_norm": P(None, None),
+            "gate": P(None, None, "tensor"),
+            "up": P(None, None, "tensor"),
+            "down": P(None, "tensor", None),
+        },
+        "final_norm": P(None),
+    }
+    if not cfg.tie_embeddings:
+        specs["lm_head"] = P(None, "tensor")
+    return specs
+
+
+def _layer_stack(params: dict):
+    """[(leaf_name -> [L, ...])] -> per-layer pytrees for lax.scan."""
+    return params["layers"]
+
+
+# -- forward (training / prefill) ------------------------------------------
+
+
+def forward(
+    params: dict,
+    tokens: jax.Array,  # [B, S] int32
+    cfg: LlamaConfig,
+    *,
+    positions: jax.Array | None = None,  # [B, S] (defaults to arange)
+    attn_impl: str = "flash",
+) -> jax.Array:  # [B, S, vocab]
+    """Full-sequence forward with causal attention (flash or xla impl)."""
+    B, S = tokens.shape
+    if positions is None:
+        positions = jnp.broadcast_to(jnp.arange(S), (B, S))
+    x = params["embed"][tokens]  # [B, S, D]
+    cos, sin = layers.rotary_embedding(
+        positions, cfg.head_dim, cfg.rope_theta, dtype=jnp.float32
+    )  # [B, S, hd/2]
+
+    def layer_fn(x, layer):
+        h = layers.rms_norm(x, layer["attn_norm"], cfg.norm_eps)
+        attn_params = {k: layer[k] for k in ("wq", "wk", "wv", "wo")}
+        h = layers.causal_self_attention(
+            attn_params, h,
+            n_heads=cfg.n_heads, n_kv_heads=cfg.n_kv_heads,
+            cos=cos, sin=sin, causal=True, attn_impl=attn_impl,
+        )
+        x = x + h
+        h = layers.rms_norm(x, layer["mlp_norm"], cfg.norm_eps)
+        h = layers.swiglu_mlp({k: layer[k] for k in ("gate", "up", "down")}, h)
+        return x + h, None
+
+    x, _ = jax.lax.scan(layer_fn, x, _layer_stack(params))
+    x = layers.rms_norm(x, params["final_norm"], cfg.norm_eps)
+    head = params["embed"].T if cfg.tie_embeddings else params["lm_head"]
+    return jnp.dot(x, head, preferred_element_type=jnp.float32)
+
+
+# -- serving: prefill + paged decode ----------------------------------------
+
+
+def prefill(
+    params: dict,
+    tokens: jax.Array,  # [B, S] padded
+    k_pages: jax.Array,  # [L, Hkv, n_pages, page_size, hd]
+    v_pages: jax.Array,
+    page_tables: jax.Array,  # [B, pages_per_seq]
+    seq_lens: jax.Array,  # [B] true lengths
+    cfg: LlamaConfig,
+):
+    """Process prompts, filling the paged KV cache; returns (logits_last,
+    k_pages, v_pages). Padded positions write to reserved trash page 0."""
+    B, S = tokens.shape
+    page_size = k_pages.shape[3]
+    positions = jnp.broadcast_to(jnp.arange(S), (B, S))
+    valid = positions < seq_lens[:, None]
+    cos, sin = layers.rotary_embedding(
+        positions, cfg.head_dim, cfg.rope_theta, dtype=jnp.float32
+    )
+    x = params["embed"][tokens]
+
+    page_idx = jnp.take_along_axis(
+        page_tables, positions // page_size, axis=1
+    )  # [B, S]
+    page_idx = jnp.where(valid, page_idx, 0)
+    slot = jnp.where(valid, positions % page_size, 0)
+
+    def layer_fn(carry, layer):
+        x = carry
+        h = layers.rms_norm(x, layer["attn_norm"], cfg.norm_eps)
+        D = cfg.head_dim
+        q = jnp.dot(h, layer["wq"], preferred_element_type=jnp.float32).astype(x.dtype)
+        k = jnp.dot(h, layer["wk"], preferred_element_type=jnp.float32).astype(x.dtype)
+        v = jnp.dot(h, layer["wv"], preferred_element_type=jnp.float32).astype(x.dtype)
+        q = q.reshape(B, S, cfg.n_heads, D).transpose(0, 2, 1, 3)
+        k = k.reshape(B, S, cfg.n_kv_heads, D).transpose(0, 2, 1, 3)
+        v = v.reshape(B, S, cfg.n_kv_heads, D).transpose(0, 2, 1, 3)
+        q = layers.apply_rope(q, cos, sin)
+        k = layers.apply_rope(k, cos, sin)
+        o = flash_attention(q, k, v, True)
+        o = o.transpose(0, 2, 1, 3).reshape(B, S, cfg.n_heads * D)
+        x = x + jnp.dot(
+            o, layer["wo"], preferred_element_type=jnp.float32
+        ).astype(x.dtype)
+        h = layers.rms_norm(x, layer["mlp_norm"], cfg.norm_eps)
+        h = layers.swiglu_mlp({n: layer[n] for n in ("gate", "up", "down")}, h)
+        x = x + h
+        # stack KV for a single scatter outside the scan: [Hkv, B, S, D]
+        return x, (k.transpose(1, 0, 2, 3), v.transpose(1, 0, 2, 3))
+
+    x, (k_all, v_all) = jax.lax.scan(layer_fn, x, _layer_stack(params))
+    # k_all: [L, Hkv, B, S, D] -> pages at (page_idx[b,s], slot[b,s])
+    k_pages, v_pages = _scatter_pages(k_pages, v_pages, k_all, v_all, page_idx, slot)
+
+    x = layers.rms_norm(x, params["final_norm"], cfg.norm_eps)
+    last_idx = jnp.maximum(seq_lens - 1, 0)  # [B]
+    x_last = jnp.take_along_axis(x, last_idx[:, None, None].repeat(x.shape[-1], -1), 1)[
+        :, 0
+    ]  # [B, D]
+    head = params["embed"].T if cfg.tie_embeddings else params["lm_head"]
+    logits = jnp.dot(x_last, head, preferred_element_type=jnp.float32)
+    return logits, k_pages, v_pages
+
+
+def _scatter_pages(k_pages, v_pages, k_all, v_all, page_idx, slot):
+    """Write [L, Hkv, B, S, D] new KV into [L, Hkv, P, page_size, D] pages at
+    (page_idx[b,s], slot[b,s])."""
+    k_pages = k_pages.at[:, :, page_idx, slot].set(k_all)
+    v_pages = v_pages.at[:, :, page_idx, slot].set(v_all)
+    return k_pages, v_pages
+
+
+def decode_step(
+    params: dict,
+    tokens: jax.Array,  # [B] int32 — current token per slot
+    positions: jax.Array,  # [B] int32 — its position
+    k_pages: jax.Array,  # [L, Hkv, P, page_size, hd]
+    v_pages: jax.Array,
+    page_tables: jax.Array,  # [B, pages_per_seq]
+    active: jax.Array,  # [B] bool — live slots (dead slots write trash page 0)
+    cfg: LlamaConfig,
+):
+    """One token of batched decode against the paged cache.
+
+    Returns (logits [B, vocab], k_pages, v_pages). Pass donated pages for
+    in-place updates under jit.
+    """
+    B = tokens.shape[0]
+    page_size = k_pages.shape[3]
+    x = params["embed"][tokens]  # [B, D]
+    cos, sin = layers.rotary_embedding(
+        positions[:, None], cfg.head_dim, cfg.rope_theta, dtype=jnp.float32
+    )  # [B, 1, hd/2]
+
+    page_idx = jnp.take_along_axis(
+        page_tables, (positions // page_size)[:, None], axis=1
+    )[:, 0]
+    page_idx = jnp.where(active, page_idx, 0)
+    slot = jnp.where(active, positions % page_size, 0)
+    ctx_lens = jnp.where(active, positions + 1, 1).astype(jnp.int32)
+
+    def layer_fn(carry, layer_with_pages):
+        x = carry
+        layer, k_pg, v_pg = layer_with_pages
+        D = cfg.head_dim
+        h = layers.rms_norm(x, layer["attn_norm"], cfg.norm_eps)
+        q = jnp.dot(h, layer["wq"], preferred_element_type=jnp.float32).astype(x.dtype)
+        k = jnp.dot(h, layer["wk"], preferred_element_type=jnp.float32).astype(x.dtype)
+        v = jnp.dot(h, layer["wv"], preferred_element_type=jnp.float32).astype(x.dtype)
+        q = q.reshape(B, 1, cfg.n_heads, D).transpose(0, 2, 1, 3)  # [B,H,1,D]
+        k = k.reshape(B, 1, cfg.n_kv_heads, D).transpose(0, 2, 1, 3)
+        v = v.reshape(B, 1, cfg.n_kv_heads, D).transpose(0, 2, 1, 3)
+        q = layers.apply_rope(q, cos, sin)
+        k = layers.apply_rope(k, cos, sin)
+        # write this token's KV into the page cache
+        k_pg = k_pg.at[:, page_idx, slot].set(k[:, :, 0].transpose(1, 0, 2))
+        v_pg = v_pg.at[:, page_idx, slot].set(v[:, :, 0].transpose(1, 0, 2))
+        o = paged_decode_attention(
+            q[:, :, 0], k_pg, v_pg, page_tables, ctx_lens
+        )  # [B, H, D]
+        o = o.reshape(B, cfg.n_heads * D)
+        x = x + jnp.dot(
+            o, layer["wo"], preferred_element_type=jnp.float32
+        ).astype(x.dtype)
+        h = layers.rms_norm(x, layer["mlp_norm"], cfg.norm_eps)
+        h = layers.swiglu_mlp({n: layer[n] for n in ("gate", "up", "down")}, h)
+        return x + h, (k_pg, v_pg)
+
+    x, (k_pages, v_pages) = jax.lax.scan(
+        layer_fn, x, (_layer_stack(params), k_pages, v_pages)
+    )
+    x = layers.rms_norm(x, params["final_norm"], cfg.norm_eps)
+    head = params["embed"].T if cfg.tie_embeddings else params["lm_head"]
+    logits = jnp.dot(x, head, preferred_element_type=jnp.float32)
+    return logits, k_pages, v_pages
+
+
+# -- HF safetensors interop -------------------------------------------------
+
+
+def load_hf_weights(model_dir: str | Path, cfg: LlamaConfig, dtype=None) -> dict:
+    """Stream HF llama safetensors into this tree (no 2x RAM: tensors are
+    read file-by-file and stacked per layer)."""
+    import numpy as np
+    from safetensors import safe_open
+
+    model_dir = Path(model_dir)
+    dt = dtype or cfg.jnp_dtype
+    files = sorted(model_dir.glob("*.safetensors"))
+    if not files:
+        raise FileNotFoundError(f"no safetensors under {model_dir}")
+
+    raw: dict[str, np.ndarray] = {}
+    for f in files:
+        with safe_open(str(f), framework="np") as sf:
+            for name in sf.keys():
+                raw[name] = sf.get_tensor(name)
+
+    def t(name):  # HF stores [out, in]; we use [in, out]
+        return jnp.asarray(raw.pop(name).T, dtype=dt)
+
+    def stack(fmt, transpose=True):
+        mats = []
+        for li in range(cfg.n_layers):
+            arr = raw.pop(fmt.format(li))
+            mats.append(arr.T if transpose else arr)
+        return jnp.asarray(np.stack(mats), dtype=dt)
+
+    params = {
+        "embed": jnp.asarray(raw.pop("model.embed_tokens.weight"), dtype=dt),
+        "layers": {
+            "attn_norm": stack("model.layers.{}.input_layernorm.weight", False),
+            "wq": stack("model.layers.{}.self_attn.q_proj.weight"),
+            "wk": stack("model.layers.{}.self_attn.k_proj.weight"),
+            "wv": stack("model.layers.{}.self_attn.v_proj.weight"),
+            "wo": stack("model.layers.{}.self_attn.o_proj.weight"),
+            "mlp_norm": stack("model.layers.{}.post_attention_layernorm.weight", False),
+            "gate": stack("model.layers.{}.mlp.gate_proj.weight"),
+            "up": stack("model.layers.{}.mlp.up_proj.weight"),
+            "down": stack("model.layers.{}.mlp.down_proj.weight"),
+        },
+        "final_norm": jnp.asarray(raw.pop("model.norm.weight"), dtype=dt),
+    }
+    if not cfg.tie_embeddings:
+        params["lm_head"] = t("lm_head.weight")
+    return params

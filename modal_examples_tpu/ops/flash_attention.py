@@ -237,15 +237,42 @@ def _flash_bwd_rule(causal, sm_scale, block_q, block_k, res, g):
 flash_attention.defvjp(_flash_fwd_rule, _flash_bwd_rule)
 
 
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4))
+def _flash_with_lse(q, k, v, causal, sm_scale):
+    S = q.shape[2]
+    return _flash_forward(
+        q, k, v, causal=causal, sm_scale=sm_scale,
+        block_q=min(128, S), block_k=min(128, S),
+        interpret=_use_interpret(),
+    )
+
+
+def _flash_with_lse_fwd(q, k, v, causal, sm_scale):
+    out = _flash_with_lse(q, k, v, causal, sm_scale)
+    return out, (q, k, v)
+
+
+def _flash_with_lse_bwd(causal, sm_scale, res, cots):
+    # recompute through the differentiable reference; the lse output carries
+    # real cotangents in ring attention's softmax merge, so both flow back
+    q, k, v = res
+    _, vjp = jax.vjp(
+        lambda q, k, v: reference.attention_with_lse(
+            q, k, v, causal=causal, sm_scale=sm_scale
+        ),
+        q, k, v,
+    )
+    return vjp(cots)
+
+
+_flash_with_lse.defvjp(_flash_with_lse_fwd, _flash_with_lse_bwd)
+
+
 def flash_attention_with_lse(
     q, k, v, *, causal=True, sm_scale=None, block_q=128, block_k=128
 ):
-    """Forward-only variant also returning the per-row logsumexp (used by
-    ring attention to combine partial results across shards)."""
-    scale = _resolve_scale(q, sm_scale)
-    S = q.shape[2]
-    return _flash_forward(
-        q, k, v, causal=causal, sm_scale=scale,
-        block_q=min(block_q, S), block_k=min(block_k, S),
-        interpret=_use_interpret(),
-    )
+    """Variant also returning the per-row logsumexp (used by ring attention
+    to combine partial results across shards). Differentiable: backward
+    recomputes through the XLA reference (same pattern as flash_attention)."""
+    del block_q, block_k  # fixed at 128 (clamped to S) on this path
+    return _flash_with_lse(q, k, v, causal, _resolve_scale(q, sm_scale))

@@ -44,6 +44,34 @@ def attention(
     return o.reshape(B, Hq, S, D)
 
 
+def attention_with_lse(
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    *,
+    causal: bool = True,
+    sm_scale: float | None = None,
+) -> tuple[jax.Array, jax.Array]:
+    """Dense attention also returning per-row logsumexp [B, Hq, S] — the
+    differentiable ground truth for flash_attention_with_lse (ring attention's
+    backward recomputes through this)."""
+    B, Hq, S, D = q.shape
+    Hkv = k.shape[1]
+    if sm_scale is None:
+        sm_scale = D**-0.5
+    group = Hq // Hkv
+    qg = q.reshape(B, Hkv, group, S, D)
+    s = jnp.einsum("bhgqd,bhkd->bhgqk", qg, k, preferred_element_type=jnp.float32)
+    s = s * sm_scale
+    if causal:
+        mask = jnp.tril(jnp.ones((S, S), bool))
+        s = jnp.where(mask, s, -jnp.inf)
+    lse = jax.scipy.special.logsumexp(s, axis=-1)  # [B,Hkv,g,S]
+    p = jnp.exp(s - lse[..., None])
+    o = jnp.einsum("bhgqk,bhkd->bhgqd", p.astype(v.dtype), v)
+    return o.reshape(B, Hq, S, D), lse.reshape(B, Hq, S)
+
+
 def paged_decode_attention(
     q: jax.Array,  # [B, Hq, D] — one new token per sequence
     k_pages: jax.Array,  # [Hkv, n_pages, page_size, D]

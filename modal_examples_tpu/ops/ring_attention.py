@@ -69,7 +69,7 @@ def ring_attention(
     o_acc = jnp.zeros_like(q)
     lse_acc = jnp.full((B, H, S), -jnp.inf, jnp.float32)
 
-    def hop(step, carry):
+    def hop(carry, step):
         o_acc, lse_acc, k_cur, v_cur = carry
         kv_index = (my_idx - step) % n  # whose K/V we hold this hop
 
@@ -99,9 +99,13 @@ def ring_attention(
         perm = [(i, (i + 1) % n) for i in range(n)]
         k_nxt = lax.ppermute(k_cur, axis_name, perm)
         v_nxt = lax.ppermute(v_cur, axis_name, perm)
-        return o_new, lse_new, k_nxt, v_nxt
+        return (o_new, lse_new, k_nxt, v_nxt), None
 
-    o_acc, lse_acc, _, _ = lax.fori_loop(0, n, hop, (o_acc, lse_acc, k, v))
+    # scan (not fori_loop) so the ring is differentiable end to end:
+    # ppermute transposes to the reverse ring in the backward pass
+    (o_acc, lse_acc, _, _), _ = lax.scan(
+        hop, (o_acc, lse_acc, k, v), jnp.arange(n)
+    )
     return o_acc
 
 

@@ -1,0 +1,387 @@
+"""Continuous-batching LLM engine — the vLLM-engine replacement, TPU-first.
+
+Implements the serving core behind the reference's north-star example
+(vllm_inference.py: an OpenAI-compatible server wrapping an engine with
+continuous batching, paged KV, streaming; SURVEY.md §3.2's HOT LOOP).
+
+TPU-first architecture (vs vLLM's CUDA design):
+- **static shapes everywhere**: the decode step is ONE jitted program over a
+  fixed slot count; requests come and go by flipping an ``active`` mask and
+  rewriting page tables — XLA never recompiles as batch composition changes.
+- **prefill buckets**: prompts pad to the next bucket (128/256/.../max) so
+  prefill compiles once per bucket, not per length (the retrace-thrash
+  killer; SURVEY.md §7 hard part #5).
+- **sampling fused into the decode program**: only the sampled token ids
+  (max_slots x int32) cross the device->host boundary per step.
+- **page cache donated** through the step so XLA updates KV in place.
+- host side: admission (claim slot + pages), stop handling, incremental
+  detokenization, per-request output queues. The scheduler favors admitting
+  prefills as slots free up — the same continuous-batching policy vLLM's
+  scheduler applies.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import itertools
+import queue
+import threading
+import time
+import uuid
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..models import llama
+from .kv_cache import OutOfPages, PagedKVCache
+from .sampling import SamplingParams, sample
+from ..utils.tokenizer import load_tokenizer
+
+
+@dataclasses.dataclass
+class Request:
+    prompt: str
+    params: SamplingParams
+    request_id: str = dataclasses.field(
+        default_factory=lambda: f"req-{uuid.uuid4().hex[:12]}"
+    )
+    prompt_tokens: list[int] | None = None
+    out_queue: queue.Queue = dataclasses.field(default_factory=queue.Queue)
+    created: float = dataclasses.field(default_factory=time.monotonic)
+
+
+@dataclasses.dataclass
+class _Slot:
+    request: Request | None = None
+    pages: list[int] = dataclasses.field(default_factory=list)
+    position: int = 0  # position of the NEXT token to decode
+    last_token: int = 0
+    generated: list[int] = dataclasses.field(default_factory=list)
+    emitted_text_len: int = 0
+
+    @property
+    def free(self) -> bool:
+        return self.request is None
+
+
+@dataclasses.dataclass
+class EngineStats:
+    prompt_tokens: int = 0
+    generated_tokens: int = 0
+    steps: int = 0
+    started: float = dataclasses.field(default_factory=time.monotonic)
+
+    def tokens_per_second(self) -> float:
+        dt = time.monotonic() - self.started
+        return self.generated_tokens / dt if dt > 0 else 0.0
+
+
+_FINISH = object()
+
+
+class LLMEngine:
+    def __init__(
+        self,
+        cfg: llama.LlamaConfig,
+        params=None,
+        *,
+        model_dir: str | None = None,
+        max_slots: int = 16,
+        page_size: int = 16,
+        max_model_len: int = 1024,
+        n_pages: int | None = None,
+        prefill_buckets: tuple[int, ...] = (128, 256, 512, 1024, 2048),
+        seed: int = 0,
+        kv_dtype=jnp.bfloat16,
+    ):
+        self.cfg = cfg
+        self.tokenizer = load_tokenizer(model_dir)
+        if params is None:
+            if model_dir is not None:
+                params = llama.load_hf_weights(model_dir, cfg)
+            else:
+                params = llama.init_params(jax.random.PRNGKey(seed), cfg)
+        self.params = params
+        self.max_slots = max_slots
+        self.max_model_len = max_model_len
+        self.pages_per_slot = (max_model_len + page_size - 1) // page_size
+        if n_pages is None:
+            n_pages = 1 + max_slots * self.pages_per_slot
+        self.cache = PagedKVCache.create(
+            n_layers=cfg.n_layers,
+            n_kv_heads=cfg.n_kv_heads,
+            head_dim=cfg.head_dim,
+            n_pages=n_pages,
+            page_size=page_size,
+            dtype=kv_dtype,
+        )
+        self.prefill_buckets = tuple(
+            b for b in sorted(prefill_buckets) if b <= max_model_len
+        ) or (max_model_len,)
+
+        self.slots = [_Slot() for _ in range(max_slots)]
+        self.waiting: queue.Queue[Request] = queue.Queue()
+        self.stats = EngineStats()
+        self._key = jax.random.PRNGKey(seed)
+        self._lock = threading.Lock()
+        self._running = False
+        self._thread: threading.Thread | None = None
+
+        # host mirrors of device slot state
+        self._page_tables = np.zeros((max_slots, self.pages_per_slot), np.int32)
+        self._positions = np.zeros((max_slots,), np.int32)
+        self._active = np.zeros((max_slots,), bool)
+        self._tokens = np.zeros((max_slots,), np.int32)
+        self._temps = np.ones((max_slots,), np.float32)
+        self._top_ps = np.ones((max_slots,), np.float32)
+        self._top_ks = np.zeros((max_slots,), np.int32)
+
+        self._decode_jit = jax.jit(self._decode_and_sample, donate_argnums=(1, 2))
+        self._prefill_jits: dict[int, object] = {}
+
+    # -- jitted programs ----------------------------------------------------
+
+    def _decode_and_sample(
+        self, params, k_pages, v_pages, tokens, positions, page_tables, active,
+        key, temps, top_ps, top_ks,
+    ):
+        logits, k_pages, v_pages = llama.decode_step(
+            params, tokens, positions, k_pages, v_pages, page_tables, active,
+            self.cfg,
+        )
+        next_tokens = sample(logits, key, temps, top_ps, top_ks)
+        return next_tokens, k_pages, v_pages
+
+    def _prefill_and_sample(
+        self, params, k_pages, v_pages, tokens, page_tables, seq_lens, key,
+        temps, top_ps, top_ks,
+    ):
+        logits, k_pages, v_pages = llama.prefill(
+            params, tokens, k_pages, v_pages, page_tables, seq_lens, self.cfg
+        )
+        next_tokens = sample(logits, key, temps, top_ps, top_ks)
+        return next_tokens, k_pages, v_pages
+
+    def _prefill_jit(self, bucket: int):
+        fn = self._prefill_jits.get(bucket)
+        if fn is None:
+            fn = jax.jit(self._prefill_and_sample, donate_argnums=(1, 2))
+            self._prefill_jits[bucket] = fn
+        return fn
+
+    def _bucket_for(self, n: int) -> int:
+        for b in self.prefill_buckets:
+            if n <= b:
+                return b
+        return self.prefill_buckets[-1]
+
+    def _next_key(self):
+        self._key, sub = jax.random.split(self._key)
+        return sub
+
+    # -- public API ---------------------------------------------------------
+
+    def submit(self, prompt: str, params: SamplingParams | None = None) -> Request:
+        req = Request(prompt=prompt, params=params or SamplingParams())
+        req.prompt_tokens = self.tokenizer.encode(prompt)[
+            : self.max_model_len - 1
+        ]
+        self.waiting.put(req)
+        return req
+
+    def generate(self, prompt: str, params: SamplingParams | None = None) -> str:
+        """Blocking convenience: submit and collect the full completion."""
+        req = self.submit(prompt, params)
+        out = []
+        for piece in self.stream(req):
+            out.append(piece)
+        return "".join(out)
+
+    def stream(self, req: Request):
+        """Yield text pieces as they decode (SSE-shaped; streaming.py:38-45)."""
+        if not self._running:
+            self.start()
+        while True:
+            item = req.out_queue.get()
+            if item is _FINISH:
+                return
+            yield item
+
+    def start(self) -> "LLMEngine":
+        with self._lock:
+            if self._running:
+                return self
+            self._running = True
+            self._thread = threading.Thread(target=self._loop, daemon=True)
+            self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        self._running = False
+        if self._thread:
+            self._thread.join(timeout=10)
+
+    # -- scheduler loop ------------------------------------------------------
+
+    def _loop(self) -> None:
+        while self._running:
+            worked = self.step()
+            if not worked:
+                time.sleep(0.002)
+
+    def step(self) -> bool:
+        """One scheduler tick: admit -> decode -> emit. Returns True if any
+        work happened."""
+        admitted = self._admit()
+        decoded = self._decode_tick()
+        return admitted or decoded
+
+    def _admit(self) -> bool:
+        admitted = False
+        while True:
+            free_slot = next((i for i, s in enumerate(self.slots) if s.free), None)
+            if free_slot is None or self.waiting.empty():
+                break
+            try:
+                req = self.waiting.get_nowait()
+            except queue.Empty:
+                break
+            n_prompt = len(req.prompt_tokens)
+            max_total = min(
+                n_prompt + req.params.max_tokens, self.max_model_len
+            )
+            n_pages = self.cache.pages_for(max_total)
+            try:
+                pages = self.cache.allocator.alloc(n_pages)
+            except OutOfPages:
+                # no KV room: requeue and wait for a completion
+                self.waiting.put(req)
+                break
+            self._start_request(free_slot, req, pages, n_prompt)
+            admitted = True
+        return admitted
+
+    def _start_request(self, slot_idx: int, req: Request, pages: list[int], n_prompt: int):
+        slot = self.slots[slot_idx]
+        slot.request = req
+        slot.pages = pages
+        slot.generated = []
+        slot.emitted_text_len = 0
+
+        table = np.zeros((self.pages_per_slot,), np.int32)
+        table[: len(pages)] = pages
+        self._page_tables[slot_idx] = table
+
+        bucket = self._bucket_for(n_prompt)
+        tokens = np.full((1, bucket), self.tokenizer.pad_id % self.cfg.vocab_size, np.int32)
+        tokens[0, :n_prompt] = req.prompt_tokens
+        p = req.params
+        next_tok, self.cache.k_pages, self.cache.v_pages = self._prefill_jit(bucket)(
+            self.params,
+            self.cache.k_pages,
+            self.cache.v_pages,
+            jnp.asarray(tokens),
+            jnp.asarray(table[None, :]),
+            jnp.asarray([n_prompt], np.int32),
+            self._next_key(),
+            jnp.asarray([p.temperature], np.float32),
+            jnp.asarray([p.top_p], np.float32),
+            jnp.asarray([p.top_k], np.int32),
+        )
+        first = int(next_tok[0])
+        self.stats.prompt_tokens += n_prompt
+        slot.position = n_prompt
+        slot.last_token = first
+        self._accept_token(slot_idx, first)
+
+    def _decode_tick(self) -> bool:
+        active_idx = [i for i, s in enumerate(self.slots) if not s.free]
+        if not active_idx:
+            return False
+        self._active[:] = False
+        for i in active_idx:
+            s = self.slots[i]
+            self._active[i] = True
+            self._tokens[i] = s.last_token
+            self._positions[i] = s.position
+            p = s.request.params
+            self._temps[i] = p.temperature
+            self._top_ps[i] = p.top_p
+            self._top_ks[i] = p.top_k
+
+        next_tokens, self.cache.k_pages, self.cache.v_pages = self._decode_jit(
+            self.params,
+            self.cache.k_pages,
+            self.cache.v_pages,
+            jnp.asarray(self._tokens),
+            jnp.asarray(self._positions),
+            jnp.asarray(self._page_tables),
+            jnp.asarray(self._active),
+            self._next_key(),
+            jnp.asarray(self._temps),
+            jnp.asarray(self._top_ps),
+            jnp.asarray(self._top_ks),
+        )
+        next_np = np.asarray(next_tokens)
+        self.stats.steps += 1
+        for i in active_idx:
+            s = self.slots[i]
+            s.position += 1
+            s.last_token = int(next_np[i])
+            self._accept_token(i, s.last_token)
+        return True
+
+    def _accept_token(self, slot_idx: int, token: int) -> None:
+        slot = self.slots[slot_idx]
+        req = slot.request
+        self.stats.generated_tokens += 1
+        finished = False
+        reason = None
+        if token == self.tokenizer.eos_id:
+            finished, reason = True, "stop"
+        else:
+            slot.generated.append(token)
+            if len(slot.generated) >= req.params.max_tokens:
+                finished, reason = True, "length"
+            elif slot.position + 1 >= self.max_model_len:
+                finished, reason = True, "length"
+
+        # incremental detokenization: emit the stable new suffix
+        text = self.tokenizer.decode(slot.generated)
+        if req.params.stop:
+            for stop_s in req.params.stop:
+                idx = text.find(stop_s)
+                if idx >= 0:
+                    text = text[:idx]
+                    finished, reason = True, "stop"
+                    break
+        new = text[slot.emitted_text_len :]
+        if new and (finished or not new.endswith("�")):
+            req.out_queue.put(new)
+            slot.emitted_text_len = len(text)
+        if finished:
+            req.out_queue.put(_FINISH)
+            self.cache.allocator.free(slot.pages)
+            slot.request = None
+            slot.pages = []
+            self._active[slot_idx] = False
+
+
+def build_engine(
+    model: str = "llama2-7b",
+    model_dir: str | None = None,
+    **engine_kw,
+) -> LLMEngine:
+    """Factory mirroring the reference's MODEL_NAME/engine-flags surface
+    (vllm_inference.py:54-58,168-209)."""
+    presets = {
+        "llama2-7b": llama.LlamaConfig.llama2_7b,
+        "llama3-8b": llama.LlamaConfig.llama3_8b,
+        "tiny": llama.LlamaConfig.tiny,
+    }
+    if model_dir is not None:
+        cfg = llama.LlamaConfig.from_hf_config(f"{model_dir}/config.json")
+    else:
+        cfg = presets[model]()
+    return LLMEngine(cfg, model_dir=model_dir, **engine_kw)

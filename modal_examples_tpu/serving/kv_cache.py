@@ -1,0 +1,85 @@
+"""Paged KV cache: device pages + host-side block allocator.
+
+The TPU analog of vLLM's PagedAttention block manager (the engine inside the
+reference's vllm_inference.py). Device side: two arrays
+``[n_layers, n_kv_heads, n_pages, page_size, head_dim]`` living in HBM, page
+0 reserved as the trash page (padded/dead slots write there). Host side: a
+free-list allocator — intentionally simple; each sequence claims
+``ceil(max_tokens/page_size)`` pages at admission so decode can never fail
+mid-flight (no preemption/swap in v1, documented trade-off vs vLLM's
+best-effort allocation + preemption).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import threading
+
+import jax.numpy as jnp
+
+
+class OutOfPages(RuntimeError):
+    pass
+
+
+class PageAllocator:
+    """Thread-safe free-list over physical page ids (page 0 is reserved)."""
+
+    def __init__(self, n_pages: int):
+        self.n_pages = n_pages
+        self._free = list(range(n_pages - 1, 0, -1))  # pop() yields low ids first
+        self._lock = threading.Lock()
+
+    def alloc(self, n: int) -> list[int]:
+        with self._lock:
+            if n > len(self._free):
+                raise OutOfPages(f"need {n} pages, {len(self._free)} free")
+            return [self._free.pop() for _ in range(n)]
+
+    def free(self, pages: list[int]) -> None:
+        with self._lock:
+            for p in pages:
+                if p != 0:
+                    self._free.append(p)
+
+    @property
+    def available(self) -> int:
+        with self._lock:
+            return len(self._free)
+
+
+@dataclasses.dataclass
+class PagedKVCache:
+    k_pages: object  # [L, Hkv, P, page_size, hd]
+    v_pages: object
+    page_size: int
+    allocator: PageAllocator
+
+    @classmethod
+    def create(
+        cls,
+        *,
+        n_layers: int,
+        n_kv_heads: int,
+        head_dim: int,
+        n_pages: int,
+        page_size: int = 16,
+        dtype=jnp.bfloat16,
+    ) -> "PagedKVCache":
+        shape = (n_layers, n_kv_heads, n_pages, page_size, head_dim)
+        return cls(
+            k_pages=jnp.zeros(shape, dtype),
+            v_pages=jnp.zeros(shape, dtype),
+            page_size=page_size,
+            allocator=PageAllocator(n_pages),
+        )
+
+    @property
+    def n_pages(self) -> int:
+        return self.k_pages.shape[2]
+
+    def bytes(self) -> int:
+        return 2 * self.k_pages.size * self.k_pages.dtype.itemsize
+
+    def pages_for(self, n_tokens: int) -> int:
+        return (n_tokens + self.page_size - 1) // self.page_size

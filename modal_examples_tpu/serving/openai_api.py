@@ -1,0 +1,186 @@
+"""OpenAI-compatible HTTP server over the LLM engine.
+
+Mirrors the API surface the reference's north-star example serves and its
+client exercises (vllm_inference.py:243-345: /health, /v1/models,
+/v1/chat/completions with SSE streaming; openai_compatible/client.py).
+Stdlib HTTP (fastapi/uvicorn are optional in this image); threads per
+connection; the engine's continuous batching does the multiplexing.
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+import time
+import uuid
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+from .engine import LLMEngine
+from .sampling import SamplingParams
+
+
+def _params_from_body(body: dict) -> SamplingParams:
+    return SamplingParams(
+        temperature=float(body.get("temperature", 1.0)),
+        top_p=float(body.get("top_p", 1.0)),
+        top_k=int(body.get("top_k", 0)),
+        max_tokens=int(body.get("max_tokens", 128)),
+        stop=tuple(
+            [body["stop"]] if isinstance(body.get("stop"), str)
+            else body.get("stop") or []
+        ),
+    )
+
+
+class _Handler(BaseHTTPRequestHandler):
+    server_ref: "OpenAIServer"
+
+    def log_message(self, fmt, *args):
+        pass
+
+    def _json(self, code: int, obj) -> None:
+        data = json.dumps(obj).encode()
+        self.send_response(code)
+        self.send_header("content-type", "application/json")
+        self.send_header("content-length", str(len(data)))
+        self.end_headers()
+        self.wfile.write(data)
+
+    def do_GET(self):
+        srv = self.server_ref
+        if self.path == "/health":
+            self._json(200, {"status": "ok"})
+        elif self.path == "/v1/models":
+            self._json(
+                200,
+                {
+                    "object": "list",
+                    "data": [
+                        {
+                            "id": srv.model_name,
+                            "object": "model",
+                            "owned_by": "modal-examples-tpu",
+                        }
+                    ],
+                },
+            )
+        elif self.path == "/metrics":
+            s = srv.engine.stats
+            body = (
+                f"mtpu_generated_tokens_total {s.generated_tokens}\n"
+                f"mtpu_prompt_tokens_total {s.prompt_tokens}\n"
+                f"mtpu_decode_steps_total {s.steps}\n"
+                f"mtpu_tokens_per_second {s.tokens_per_second():.3f}\n"
+            ).encode()
+            self.send_response(200)
+            self.send_header("content-type", "text/plain")
+            self.send_header("content-length", str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
+        else:
+            self._json(404, {"error": "not found"})
+
+    def do_POST(self):
+        length = int(self.headers.get("content-length") or 0)
+        try:
+            body = json.loads(self.rfile.read(length)) if length else {}
+        except json.JSONDecodeError:
+            self._json(400, {"error": "invalid JSON"})
+            return
+        if self.path == "/v1/chat/completions":
+            self._completions(body, chat=True)
+        elif self.path == "/v1/completions":
+            self._completions(body, chat=False)
+        else:
+            self._json(404, {"error": "not found"})
+
+    def _completions(self, body: dict, chat: bool) -> None:
+        srv = self.server_ref
+        if chat:
+            messages = body.get("messages") or []
+            prompt = srv.engine.tokenizer.apply_chat_template(messages)
+        else:
+            prompt = body.get("prompt") or ""
+        params = _params_from_body(body)
+        stream = bool(body.get("stream", False))
+        req = srv.engine.submit(prompt, params)
+        rid = f"chatcmpl-{uuid.uuid4().hex[:12]}"
+        created = int(time.time())
+        kind = "chat.completion" if chat else "text_completion"
+
+        if stream:
+            self.send_response(200)
+            self.send_header("content-type", "text/event-stream")
+            self.send_header("cache-control", "no-cache")
+            self.end_headers()
+            try:
+                for piece in srv.engine.stream(req):
+                    delta = (
+                        {"delta": {"content": piece}} if chat else {"text": piece}
+                    )
+                    chunk = {
+                        "id": rid,
+                        "object": kind + ".chunk",
+                        "created": created,
+                        "model": srv.model_name,
+                        "choices": [{"index": 0, **delta, "finish_reason": None}],
+                    }
+                    self.wfile.write(f"data: {json.dumps(chunk)}\n\n".encode())
+                    self.wfile.flush()
+                self.wfile.write(b"data: [DONE]\n\n")
+                self.wfile.flush()
+            except BrokenPipeError:
+                pass
+            return
+
+        text = "".join(srv.engine.stream(req))
+        n_prompt = len(req.prompt_tokens or [])
+        n_out = len(srv.engine.tokenizer.encode(text, add_bos=False))
+        content = (
+            {"message": {"role": "assistant", "content": text}}
+            if chat
+            else {"text": text}
+        )
+        self._json(
+            200,
+            {
+                "id": rid,
+                "object": kind,
+                "created": created,
+                "model": srv.model_name,
+                "choices": [{"index": 0, **content, "finish_reason": "stop"}],
+                "usage": {
+                    "prompt_tokens": n_prompt,
+                    "completion_tokens": n_out,
+                    "total_tokens": n_prompt + n_out,
+                },
+            },
+        )
+
+
+class OpenAIServer:
+    """HTTP front end; start() binds and serves in a background thread."""
+
+    def __init__(self, engine: LLMEngine, model_name: str = "mtpu-llm",
+                 host: str = "0.0.0.0", port: int = 8000):
+        self.engine = engine
+        self.model_name = model_name
+        handler = type("BoundHandler", (_Handler,), {"server_ref": self})
+        self.httpd = ThreadingHTTPServer((host, port), handler)
+        self.host, self.port = self.httpd.server_address[:2]
+        self._thread: threading.Thread | None = None
+
+    def start(self) -> "OpenAIServer":
+        self.engine.start()
+        self._thread = threading.Thread(target=self.httpd.serve_forever, daemon=True)
+        self._thread.start()
+        return self
+
+    def serve_forever(self) -> None:
+        self.engine.start()
+        self.httpd.serve_forever()
+
+    def stop(self) -> None:
+        self.httpd.shutdown()
+        self.httpd.server_close()
+        self.engine.stop()

@@ -1,0 +1,58 @@
+"""Token sampling (jittable, static-shaped — runs inside the decode step).
+
+Covers the sampling surface the reference's served engines expose via the
+OpenAI API (temperature / top_p / top_k / greedy; vllm_inference.py client
+:309-345 and openai_compatible/client.py)."""
+
+from __future__ import annotations
+
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+
+
+@dataclasses.dataclass(frozen=True)
+class SamplingParams:
+    temperature: float = 1.0
+    top_p: float = 1.0
+    top_k: int = 0  # 0 = disabled
+    max_tokens: int = 128
+    stop: tuple[str, ...] = ()
+
+
+def sample(
+    logits: jax.Array,  # [B, V] f32
+    key: jax.Array,
+    temperature: jax.Array,  # [B]
+    top_p: jax.Array,  # [B]
+    top_k: jax.Array,  # [B] int32 (0 = off)
+) -> jax.Array:  # [B] int32
+    """Vectorized per-slot sampling; temperature 0 means greedy."""
+    V = logits.shape[-1]
+    greedy = jnp.argmax(logits, axis=-1)
+
+    t = jnp.maximum(temperature, 1e-6)[:, None]
+    scaled = logits / t
+
+    # top-k: mask everything below the k-th logit
+    sorted_logits = jnp.sort(scaled, axis=-1)[:, ::-1]  # descending
+    k_idx = jnp.clip(jnp.where(top_k > 0, top_k, V) - 1, 0, V - 1)
+    kth = jnp.take_along_axis(sorted_logits, k_idx[:, None], axis=-1)
+    scaled = jnp.where(scaled >= kth, scaled, -jnp.inf)
+
+    # top-p (nucleus): keep the smallest prefix of the sorted distribution
+    # with cumulative prob >= top_p
+    sort_idx = jnp.argsort(scaled, axis=-1)[:, ::-1]
+    sorted_scaled = jnp.take_along_axis(scaled, sort_idx, axis=-1)
+    probs_sorted = jax.nn.softmax(sorted_scaled, axis=-1)
+    cum = jnp.cumsum(probs_sorted, axis=-1)
+    keep_sorted = cum - probs_sorted < top_p[:, None]
+    keep_sorted = keep_sorted.at[:, 0].set(True)
+    keep = jnp.zeros_like(keep_sorted).at[
+        jnp.arange(keep_sorted.shape[0])[:, None], sort_idx
+    ].set(keep_sorted)
+    scaled = jnp.where(keep, scaled, -jnp.inf)
+
+    sampled = jax.random.categorical(key, scaled, axis=-1)
+    return jnp.where(temperature <= 0.0, greedy, sampled).astype(jnp.int32)

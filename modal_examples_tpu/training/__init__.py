@@ -1,0 +1,19 @@
+"""Training: mesh-sharded train steps, optimizers, checkpoint/resume."""
+
+from .checkpoints import CheckpointManager
+from .trainer import (
+    TrainState,
+    Trainer,
+    cross_entropy_loss,
+    make_optimizer,
+    warmup_cosine,
+)
+
+__all__ = [
+    "CheckpointManager",
+    "TrainState",
+    "Trainer",
+    "cross_entropy_loss",
+    "make_optimizer",
+    "warmup_cosine",
+]

@@ -1,0 +1,86 @@
+"""Checkpoint/resume: orbax-backed sharded pytree checkpoints on a Volume.
+
+Reference semantics (SURVEY.md §5.4): every training example checkpoints to a
+Volume with an explicit commit and resumes from the latest checkpoint after
+interruption (HF get_last_checkpoint train.py:175-194, TRL checkpoint-* glob
+unsloth_finetune.py:589-607, Lightning last.ckpt long-training.py:40-54).
+This module is the one implementation behind all of those patterns:
+step-numbered directories, a ``latest`` scan, keep-N pruning, and
+``volume.commit()`` after save when a Volume is attached.
+"""
+
+from __future__ import annotations
+
+import re
+import shutil
+from pathlib import Path
+from typing import Any
+
+import orbax.checkpoint as ocp
+
+_STEP_RE = re.compile(r"^step_(\d+)$")
+
+
+class CheckpointManager:
+    def __init__(
+        self,
+        directory: str | Path,
+        *,
+        keep_n: int = 3,
+        volume=None,  # modal_examples_tpu Volume: committed after save
+    ):
+        self.directory = Path(directory)
+        self.directory.mkdir(parents=True, exist_ok=True)
+        self.keep_n = keep_n
+        self.volume = volume
+        self._ckptr = ocp.StandardCheckpointer()
+
+    def _step_dir(self, step: int) -> Path:
+        return self.directory / f"step_{step:08d}"
+
+    def steps(self) -> list[int]:
+        out = []
+        for p in self.directory.iterdir():
+            m = _STEP_RE.match(p.name)
+            if m:
+                out.append(int(m.group(1)))
+        return sorted(out)
+
+    def latest_step(self) -> int | None:
+        steps = self.steps()
+        return steps[-1] if steps else None
+
+    def save(self, step: int, state: Any, wait: bool = True) -> Path:
+        path = self._step_dir(step)
+        if path.exists():
+            shutil.rmtree(path)
+        self._ckptr.save(path.resolve(), state)
+        if wait:
+            self._ckptr.wait_until_finished()
+        self._prune()
+        if self.volume is not None:
+            self.volume.commit()
+        return path
+
+    def restore(self, target: Any, step: int | None = None) -> Any:
+        """Restore into the structure/shardings of ``target`` (an abstract or
+        concrete pytree); defaults to the latest step."""
+        if step is None:
+            step = self.latest_step()
+        if step is None:
+            raise FileNotFoundError(f"no checkpoints under {self.directory}")
+        import jax
+
+        def to_abstract(x):
+            if hasattr(x, "shape") and hasattr(x, "dtype"):
+                sharding = getattr(x, "sharding", None)
+                return jax.ShapeDtypeStruct(x.shape, x.dtype, sharding=sharding)
+            return x
+
+        abstract = jax.tree.map(to_abstract, target)
+        return self._ckptr.restore(self._step_dir(step).resolve(), abstract)
+
+    def _prune(self) -> None:
+        steps = self.steps()
+        for old in steps[: -self.keep_n] if self.keep_n > 0 else []:
+            shutil.rmtree(self._step_dir(old), ignore_errors=True)

@@ -1,0 +1,67 @@
+"""Tokenizer access: HF tokenizers when a model dir is available, byte-level
+fallback otherwise.
+
+Per SURVEY.md §2.4, HF's Rust tokenizers are kept as a host-CPU dependency
+(no CUDA involved, porting out of scope). The byte fallback keeps every test
+and bench runnable with random weights in a zero-egress environment (the
+analog of the reference's dummy-weights dev mode, very_large_models.py:2-3).
+"""
+
+from __future__ import annotations
+
+
+class ByteTokenizer:
+    """Reversible byte-level tokenizer: vocab = 256 bytes + BOS/EOS/PAD."""
+
+    def __init__(self):
+        self.bos_id = 256
+        self.eos_id = 257
+        self.pad_id = 258
+        self.vocab_size = 259
+
+    def encode(self, text: str, add_bos: bool = True) -> list[int]:
+        ids = list(text.encode("utf-8", errors="replace"))
+        return ([self.bos_id] + ids) if add_bos else ids
+
+    def decode(self, ids: list[int]) -> str:
+        data = bytes(i for i in ids if i < 256)
+        return data.decode("utf-8", errors="replace")
+
+    def apply_chat_template(self, messages: list[dict], **_) -> str:
+        return (
+            "\n".join(f"{m['role']}: {m['content']}" for m in messages)
+            + "\nassistant:"
+        )
+
+
+class HFTokenizer:
+    """Thin adapter over transformers.AutoTokenizer (local files only)."""
+
+    def __init__(self, model_dir: str):
+        from transformers import AutoTokenizer
+
+        self._tok = AutoTokenizer.from_pretrained(model_dir, local_files_only=True)
+        self.bos_id = self._tok.bos_token_id
+        self.eos_id = self._tok.eos_token_id
+        self.pad_id = self._tok.pad_token_id or self.eos_id
+        self.vocab_size = len(self._tok)
+
+    def encode(self, text: str, add_bos: bool = True) -> list[int]:
+        return self._tok.encode(text, add_special_tokens=add_bos)
+
+    def decode(self, ids: list[int]) -> str:
+        return self._tok.decode(ids, skip_special_tokens=True)
+
+    def apply_chat_template(self, messages: list[dict], **kw) -> str:
+        return self._tok.apply_chat_template(
+            messages, tokenize=False, add_generation_prompt=True, **kw
+        )
+
+
+def load_tokenizer(model_dir: str | None):
+    if model_dir is None:
+        return ByteTokenizer()
+    try:
+        return HFTokenizer(model_dir)
+    except Exception:
+        return ByteTokenizer()

@@ -146,6 +146,28 @@ class TestQuantizedMatmul:
 
 
 class TestRingAttention:
+    def test_gradients_match_dense(self, jax, jnp):
+        from modal_examples_tpu.ops import reference, ring_attention_sharded
+        from modal_examples_tpu.parallel import make_mesh
+
+        mesh = make_mesh({"seq": 2})
+        ks = jax.random.split(jax.random.PRNGKey(9), 3)
+        q = jax.random.normal(ks[0], (1, 2, 256, 64))
+        k = jax.random.normal(ks[1], (1, 2, 256, 64))
+        v = jax.random.normal(ks[2], (1, 2, 256, 64))
+        g1 = jax.grad(
+            lambda q, k, v: ring_attention_sharded(q, k, v, mesh, causal=True).sum(),
+            argnums=(0, 1, 2),
+        )(q, k, v)
+        g2 = jax.grad(
+            lambda q, k, v: reference.attention(q, k, v, causal=True).sum(),
+            argnums=(0, 1, 2),
+        )(q, k, v)
+        for a, b in zip(g1, g2):
+            np.testing.assert_allclose(
+                np.asarray(a), np.asarray(b), atol=5e-5, rtol=1e-4
+            )
+
     @pytest.mark.parametrize("causal", [True, False])
     def test_matches_dense_over_seq_mesh(self, jax, jnp, causal):
         from modal_examples_tpu.ops import reference, ring_attention_sharded
