@@ -220,6 +220,7 @@ class App:
                 min_containers=min_containers,
                 scaledown_window=scaledown_window,
                 max_concurrent_inputs=getattr(user_cls, "__mtpu_concurrent__", 1),
+                methods_meta=meta["methods"],
                 region=region,
             )
             c = Cls(self, user_cls, spec, meta)

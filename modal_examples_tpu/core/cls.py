@@ -81,6 +81,8 @@ def _collect_lifecycle(user_cls: type) -> dict:
             meta["exit"].append(name)
         if hasattr(member, "__mtpu_method__"):
             meta["methods"][name] = dict(member.__mtpu_method__)
+            if hasattr(member, "__mtpu_batched__"):
+                meta["methods"][name]["batched"] = member.__mtpu_batched__
         if getattr(member, "__mtpu_web__", None):
             meta["methods"].setdefault(name, {"is_generator": False})
     for name, val in list(vars(user_cls).items()):

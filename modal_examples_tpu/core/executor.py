@@ -89,7 +89,6 @@ class ContainerConfig:
     env: dict[str, str]
     sys_paths: list[str]
     max_concurrent_inputs: int
-    is_batched: bool
     volumes: list[tuple[str, str]]  # (mount path, host path)
 
 
@@ -658,12 +657,19 @@ class FunctionPool:
         return ready
 
     def _dispatch_ready(self, now: float) -> None:
-        ready = self._ready_inputs(now)
-        if not ready:
+        all_ready = self._ready_inputs(now)
+        if not all_ready:
             return
-        if self.spec.batched:
-            self._dispatch_batched(ready, now)
-            return
+        # split by dispatch target: @batched methods coalesce, others go solo
+        batch_groups: dict[str, list[_QueuedInput]] = {}
+        ready = []
+        for qi in all_ready:
+            if self.spec.batched_for(qi.method_name) is not None:
+                batch_groups.setdefault(qi.method_name, []).append(qi)
+            else:
+                ready.append(qi)
+        for method_name, group in batch_groups.items():
+            self._dispatch_batched(group, now, self.spec.batched_for(method_name))
         for i, qi in enumerate(ready):
             target = next((c for c in self.containers if c.capacity() > 0), None)
             if target is None:
@@ -679,8 +685,8 @@ class FunctionPool:
                 with self.lock:
                     self.pending.extendleft(reversed(e.still_owned))
 
-    def _dispatch_batched(self, ready: list[_QueuedInput], now: float) -> None:
-        cfg = self.spec.batched
+    def _dispatch_batched(self, ready: list[_QueuedInput], now: float, cfg=None) -> None:
+        cfg = cfg or self.spec.batched
         oldest_wait = max((now - qi.ready_at) for qi in ready) if ready else 0
         full = len(ready) >= cfg.max_batch_size
         waited = oldest_wait * 1000.0 >= cfg.wait_ms

@@ -94,6 +94,7 @@ class FunctionSpec:
     max_concurrent_inputs: int = 1
     batched: BatchedConfig | None = None
     schedule: Schedule | None = None
+    methods_meta: dict | None = None  # Cls: per-method {batched, is_generator}
     is_generator: bool = False
     web: dict | None = None
     region: str | None = None
@@ -111,17 +112,47 @@ class FunctionSpec:
         volumes = []
         for mount_path, vol in self.volumes.items():
             volumes.append((mount_path, str(vol.local_path)))
+        sys_paths = self.image.sys_path_additions() + self._source_dirs()
         return _exec.ContainerConfig(
             function_tag=self.tag,
             fn_bytes=ser.function_to_bytes(self.raw_target),
             is_cls=self.is_cls_method,
             cls_params=self.cls_params_bytes,
             env=env,
-            sys_paths=self.image.sys_path_additions(),
+            sys_paths=sys_paths,
             max_concurrent_inputs=self.max_concurrent_inputs,
-            is_batched=self.batched is not None,
             volumes=volumes,
         )
+
+    def batched_for(self, method_name: str) -> "BatchedConfig | None":
+        """Batching config for one dispatch target (per-method on a Cls)."""
+        if self.is_cls_method and self.methods_meta is not None:
+            return (self.methods_meta.get(method_name) or {}).get("batched")
+        return self.batched
+
+    def _source_dirs(self) -> list[str]:
+        """Dir of the module defining the function/class, so by-reference
+        pickles (module-level helpers the remote code calls) resolve in the
+        container — the local analog of the platform mounting the user's
+        source into the container (SURVEY.md §3.1: container imports module).
+        """
+        target = self.raw_target[0] if self.is_cls_method else self.raw_target
+        try:
+            src = inspect.getsourcefile(target)
+        except TypeError:
+            src = None
+        if not src:
+            return []
+        # walk up past package __init__.py files so 'import pkg.sub' resolves
+        # (and so we never put a package's own dir on sys.path, which would
+        # let sibling modules shadow stdlib names)
+        d = os.path.dirname(os.path.abspath(src))
+        while os.path.exists(os.path.join(d, "__init__.py")):
+            parent = os.path.dirname(d)
+            if parent == d:
+                break
+            d = parent
+        return [d]
 
     def pool_key(self) -> str:
         import hashlib

@@ -1,0 +1,217 @@
+"""BERT-family encoder — text embeddings (BGE) on TPU.
+
+The model behind the reference's embeddings north-star config: bge-small-en
+(gpu_snapshot.py:52, text_embeddings_inference.py:18 serves bge-base via the
+TEI Rust/CUDA server; amazon_embeddings.py drives it at fleet scale). Here
+the encoder is JAX: bidirectional attention with an additive padding mask
+(XLA fuses this fine at BERT sizes — the flash kernel is reserved for the
+causal LMs), CLS or mean pooling, L2 normalization.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from pathlib import Path
+
+import jax
+import jax.numpy as jnp
+
+from . import layers
+
+
+@dataclasses.dataclass(frozen=True)
+class BertConfig:
+    vocab_size: int = 30522
+    dim: int = 384
+    n_layers: int = 12
+    n_heads: int = 12
+    ffn_dim: int = 1536
+    max_position: int = 512
+    type_vocab_size: int = 2
+    norm_eps: float = 1e-12
+    dtype: str = "float32"
+    pooling: str = "cls"  # bge uses CLS pooling
+
+    @property
+    def head_dim(self) -> int:
+        return self.dim // self.n_heads
+
+    @property
+    def jnp_dtype(self):
+        return jnp.dtype(self.dtype)
+
+    @staticmethod
+    def bge_small_en() -> "BertConfig":
+        return BertConfig()  # bge-small-en-v1.5 == BERT-small geometry
+
+    @staticmethod
+    def bge_base_en() -> "BertConfig":
+        return BertConfig(dim=768, n_layers=12, n_heads=12, ffn_dim=3072)
+
+    @staticmethod
+    def tiny() -> "BertConfig":
+        return BertConfig(vocab_size=512, dim=64, n_layers=2, n_heads=2, ffn_dim=128)
+
+
+def init_params(key: jax.Array, cfg: BertConfig) -> dict:
+    dt = cfg.jnp_dtype
+    D, F, L = cfg.dim, cfg.ffn_dim, cfg.n_layers
+    ks = jax.random.split(key, 12)
+
+    def dense(k, *shape, scale=0.02):
+        return layers.init_dense(k, shape, scale=scale, dtype=dt)
+
+    return {
+        "word_emb": dense(ks[0], cfg.vocab_size, D),
+        "pos_emb": dense(ks[1], cfg.max_position, D),
+        "type_emb": dense(ks[2], cfg.type_vocab_size, D),
+        "emb_norm_w": jnp.ones((D,), dt),
+        "emb_norm_b": jnp.zeros((D,), dt),
+        "layers": {
+            "wq": dense(ks[3], L, D, D),
+            "bq": jnp.zeros((L, D), dt),
+            "wk": dense(ks[4], L, D, D),
+            "bk": jnp.zeros((L, D), dt),
+            "wv": dense(ks[5], L, D, D),
+            "bv": jnp.zeros((L, D), dt),
+            "wo": dense(ks[6], L, D, D),
+            "bo": jnp.zeros((L, D), dt),
+            "attn_norm_w": jnp.ones((L, D), dt),
+            "attn_norm_b": jnp.zeros((L, D), dt),
+            "fc_w": dense(ks[7], L, D, F),
+            "fc_b": jnp.zeros((L, F), dt),
+            "proj_w": dense(ks[8], L, F, D),
+            "proj_b": jnp.zeros((L, D), dt),
+            "mlp_norm_w": jnp.ones((L, D), dt),
+            "mlp_norm_b": jnp.zeros((L, D), dt),
+        },
+    }
+
+
+def forward(
+    params: dict,
+    tokens: jax.Array,  # [B, S] int32
+    attention_mask: jax.Array | None = None,  # [B, S] 1=real, 0=pad
+    cfg: BertConfig = BertConfig(),
+) -> jax.Array:  # [B, S, D] final hidden states
+    B, S = tokens.shape
+    if attention_mask is None:
+        attention_mask = jnp.ones((B, S), jnp.int32)
+    pos = jnp.arange(S)
+    x = (
+        params["word_emb"][tokens]
+        + params["pos_emb"][pos][None, :, :]
+        + params["type_emb"][jnp.zeros_like(tokens)]
+    )
+    x = layers.layer_norm(x, params["emb_norm_w"], params["emb_norm_b"], cfg.norm_eps)
+
+    # additive mask: [B, 1, 1, S]
+    bias = jnp.where(attention_mask[:, None, None, :] > 0, 0.0, -1e9).astype(
+        jnp.float32
+    )
+    scale = cfg.head_dim**-0.5
+
+    def layer_fn(x, layer):
+        # post-LN transformer (BERT convention)
+        q = jnp.dot(x, layer["wq"]) + layer["bq"]
+        k = jnp.dot(x, layer["wk"]) + layer["bk"]
+        v = jnp.dot(x, layer["wv"]) + layer["bv"]
+        q = q.reshape(B, S, cfg.n_heads, cfg.head_dim).transpose(0, 2, 1, 3)
+        k = k.reshape(B, S, cfg.n_heads, cfg.head_dim).transpose(0, 2, 1, 3)
+        v = v.reshape(B, S, cfg.n_heads, cfg.head_dim).transpose(0, 2, 1, 3)
+        s = (
+            jnp.einsum("bhqd,bhkd->bhqk", q, k, preferred_element_type=jnp.float32)
+            * scale
+            + bias
+        )
+        p = jax.nn.softmax(s, axis=-1).astype(v.dtype)
+        o = jnp.einsum("bhqk,bhkd->bhqd", p, v)
+        o = o.transpose(0, 2, 1, 3).reshape(B, S, cfg.dim)
+        o = jnp.dot(o, layer["wo"]) + layer["bo"]
+        x = layers.layer_norm(
+            x + o, layer["attn_norm_w"], layer["attn_norm_b"], cfg.norm_eps
+        )
+        h = layers.gelu_mlp(
+            {n: layer[n] for n in ("fc_w", "fc_b", "proj_w", "proj_b")}, x
+        )
+        return layers.layer_norm(
+            x + h, layer["mlp_norm_w"], layer["mlp_norm_b"], cfg.norm_eps
+        ), None
+
+    x, _ = jax.lax.scan(layer_fn, x, params["layers"])
+    return x
+
+
+def embed(
+    params: dict,
+    tokens: jax.Array,
+    attention_mask: jax.Array | None = None,
+    cfg: BertConfig = BertConfig(),
+) -> jax.Array:  # [B, D] L2-normalized sentence embeddings
+    B, S = tokens.shape
+    if attention_mask is None:
+        attention_mask = jnp.ones((B, S), jnp.int32)
+    h = forward(params, tokens, attention_mask, cfg)
+    if cfg.pooling == "cls":
+        pooled = h[:, 0]
+    else:  # mean over real tokens
+        m = attention_mask[..., None].astype(h.dtype)
+        pooled = (h * m).sum(1) / jnp.maximum(m.sum(1), 1.0)
+    norm = jnp.linalg.norm(pooled.astype(jnp.float32), axis=-1, keepdims=True)
+    return (pooled / jnp.maximum(norm, 1e-9)).astype(jnp.float32)
+
+
+def load_hf_weights(model_dir: str | Path, cfg: BertConfig, dtype=None) -> dict:
+    """Map an HF BERT checkpoint (bge-*) into this tree."""
+    import numpy as np
+    from safetensors import safe_open
+
+    dt = dtype or cfg.jnp_dtype
+    files = sorted(Path(model_dir).glob("*.safetensors"))
+    raw: dict[str, np.ndarray] = {}
+    for f in files:
+        with safe_open(str(f), framework="np") as sf:
+            for name in sf.keys():
+                raw[name.removeprefix("bert.")] = sf.get_tensor(name)
+
+    def g(name, transpose=False):
+        arr = raw[name]
+        return jnp.asarray(arr.T if transpose else arr, dtype=dt)
+
+    def stack(fmt, transpose=False):
+        return jnp.asarray(
+            np.stack(
+                [
+                    raw[fmt.format(i)].T if transpose else raw[fmt.format(i)]
+                    for i in range(cfg.n_layers)
+                ]
+            ),
+            dtype=dt,
+        )
+
+    pre = "encoder.layer.{}."
+    return {
+        "word_emb": g("embeddings.word_embeddings.weight"),
+        "pos_emb": g("embeddings.position_embeddings.weight"),
+        "type_emb": g("embeddings.token_type_embeddings.weight"),
+        "emb_norm_w": g("embeddings.LayerNorm.weight"),
+        "emb_norm_b": g("embeddings.LayerNorm.bias"),
+        "layers": {
+            "wq": stack(pre + "attention.self.query.weight", True),
+            "bq": stack(pre + "attention.self.query.bias"),
+            "wk": stack(pre + "attention.self.key.weight", True),
+            "bk": stack(pre + "attention.self.key.bias"),
+            "wv": stack(pre + "attention.self.value.weight", True),
+            "bv": stack(pre + "attention.self.value.bias"),
+            "wo": stack(pre + "attention.output.dense.weight", True),
+            "bo": stack(pre + "attention.output.dense.bias"),
+            "attn_norm_w": stack(pre + "attention.output.LayerNorm.weight"),
+            "attn_norm_b": stack(pre + "attention.output.LayerNorm.bias"),
+            "fc_w": stack(pre + "intermediate.dense.weight", True),
+            "fc_b": stack(pre + "intermediate.dense.bias"),
+            "proj_w": stack(pre + "output.dense.weight", True),
+            "proj_b": stack(pre + "output.dense.bias"),
+            "mlp_norm_w": stack(pre + "output.LayerNorm.weight"),
+            "mlp_norm_b": stack(pre + "output.LayerNorm.bias"),
+        },
+    }

@@ -1,0 +1,144 @@
+"""nanoGPT-style small LM — the SLM pretraining workload.
+
+Parity target: the reference's from-scratch GPT in
+06_gpu_and_ml/hyperparameter-sweep/src/model.py (MultiHeadFast with SDPA
+:14-30) trained by hp_sweep_gpt.py ("recognizable Shakespeare SLM in ~15
+min", :65-67). Same shape of model — learned positional embeddings, pre-LN,
+GELU MLP, tied output head — but JAX: scan over layers, flash-attention
+kernel, hyperparameters as a frozen config swept via ``.starmap``
+(hp_sweep_gpt.py:320).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+
+from . import layers
+
+
+@dataclasses.dataclass(frozen=True)
+class GPTConfig:
+    vocab_size: int = 96  # char-level
+    block_size: int = 256
+    n_layers: int = 6
+    n_heads: int = 6
+    dim: int = 384
+    dropout: float = 0.0  # handled by caller via rng if nonzero
+    dtype: str = "float32"
+
+    @property
+    def head_dim(self) -> int:
+        return self.dim // self.n_heads
+
+    @property
+    def jnp_dtype(self):
+        return jnp.dtype(self.dtype)
+
+    @staticmethod
+    def tiny() -> "GPTConfig":
+        return GPTConfig(vocab_size=96, block_size=64, n_layers=2, n_heads=2, dim=64)
+
+
+def init_params(key: jax.Array, cfg: GPTConfig) -> dict:
+    dt = cfg.jnp_dtype
+    D, F, L = cfg.dim, 4 * cfg.dim, cfg.n_layers
+    ks = jax.random.split(key, 8)
+
+    def dense(k, *shape, scale=0.02):
+        return layers.init_dense(k, shape, scale=scale, dtype=dt)
+
+    return {
+        "tok_emb": dense(ks[0], cfg.vocab_size, D),
+        "pos_emb": dense(ks[1], cfg.block_size, D),
+        "layers": {
+            "ln1_w": jnp.ones((L, D), dt),
+            "ln1_b": jnp.zeros((L, D), dt),
+            "wq": dense(ks[2], L, D, D),
+            "wk": dense(ks[3], L, D, D),
+            "wv": dense(ks[4], L, D, D),
+            "wo": dense(ks[5], L, D, D, scale=0.02 / (2 * L) ** 0.5),
+            "ln2_w": jnp.ones((L, D), dt),
+            "ln2_b": jnp.zeros((L, D), dt),
+            "fc_w": dense(ks[6], L, D, F),
+            "fc_b": jnp.zeros((L, F), dt),
+            "proj_w": dense(ks[7], L, F, D, scale=0.02 / (2 * L) ** 0.5),
+            "proj_b": jnp.zeros((L, D), dt),
+        },
+        "final_ln_w": jnp.ones((D,), dt),
+        "final_ln_b": jnp.zeros((D,), dt),
+    }
+
+
+def forward(
+    params: dict, tokens: jax.Array, cfg: GPTConfig, *, attn_impl: str = "flash"
+) -> jax.Array:  # [B, S, vocab]
+    B, S = tokens.shape
+    x = params["tok_emb"][tokens] + params["pos_emb"][jnp.arange(S)][None]
+
+    def layer_fn(x, layer):
+        h = layers.layer_norm(x, layer["ln1_w"], layer["ln1_b"])
+        h = layers.causal_self_attention(
+            {k: layer[k] for k in ("wq", "wk", "wv", "wo")},
+            h,
+            n_heads=cfg.n_heads,
+            n_kv_heads=cfg.n_heads,
+            causal=True,
+            attn_impl=attn_impl,
+        )
+        x = x + h
+        h = layers.layer_norm(x, layer["ln2_w"], layer["ln2_b"])
+        h = layers.gelu_mlp(
+            {k: layer[k] for k in ("fc_w", "fc_b", "proj_w", "proj_b")}, h
+        )
+        return x + h, None
+
+    x, _ = jax.lax.scan(layer_fn, x, params["layers"])
+    x = layers.layer_norm(x, params["final_ln_w"], params["final_ln_b"])
+    return jnp.dot(x, params["tok_emb"].T, preferred_element_type=jnp.float32)
+
+
+def generate(
+    params: dict,
+    cfg: GPTConfig,
+    prompt: jax.Array,  # [S0] int32
+    n_tokens: int,
+    key: jax.Array,
+    temperature: float = 1.0,
+) -> jax.Array:
+    """Autoregressive sampling via a fixed-window scan (kv-cache-free — at
+    SLM scale recompute is cheaper than cache bookkeeping)."""
+    S = cfg.block_size
+    buf = jnp.zeros((S,), jnp.int32).at[: prompt.shape[0]].set(prompt)
+
+    def step(carry, k):
+        buf, pos = carry
+        logits = forward(params, buf[None], cfg, attn_impl="xla")[0]
+        logits_last = logits[jnp.clip(pos - 1, 0, S - 1)]
+        nxt = jax.random.categorical(k, logits_last / max(temperature, 1e-6))
+        buf = buf.at[jnp.clip(pos, 0, S - 1)].set(nxt.astype(jnp.int32))
+        return (buf, jnp.minimum(pos + 1, S)), nxt
+
+    (buf, _), toks = jax.lax.scan(
+        step, (buf, prompt.shape[0]), jax.random.split(key, n_tokens)
+    )
+    return toks
+
+
+class CharTokenizer:
+    """Char-level tokenizer for the Shakespeare-style corpus (hp_sweep's
+    src/tokenizer.py analog)."""
+
+    def __init__(self, text: str):
+        chars = sorted(set(text))
+        self.stoi = {c: i for i, c in enumerate(chars)}
+        self.itos = {i: c for i, c in enumerate(chars)}
+        self.vocab_size = len(chars)
+
+    def encode(self, s: str) -> list[int]:
+        return [self.stoi[c] for c in s if c in self.stoi]
+
+    def decode(self, ids) -> str:
+        return "".join(self.itos.get(int(i), "") for i in ids)

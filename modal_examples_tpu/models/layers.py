@@ -65,14 +65,33 @@ def apply_rope(x: jax.Array, cos: jax.Array, sin: jax.Array) -> jax.Array:
     return jnp.concatenate([o1, o2], axis=-1).astype(x.dtype)
 
 
-def swiglu_mlp(params: dict, x: jax.Array) -> jax.Array:
-    """SwiGLU feed-forward: silu(x W_gate) * (x W_up) W_down."""
-    gate = jnp.dot(x, params["gate"], preferred_element_type=jnp.float32)
-    up = jnp.dot(x, params["up"], preferred_element_type=jnp.float32)
+def _proj_f32(x, w, name, lora, lora_scale):
+    """x @ w in f32 accumulation, plus the LoRA low-rank delta when an
+    adapter targets ``name``. Returns f32 (caller decides when to round)."""
+    out = jnp.dot(x, w, preferred_element_type=jnp.float32)
+    if lora is not None and f"{name}_a" in lora:
+        from .lora import delta
+
+        out = out + delta(x, lora[f"{name}_a"], lora[f"{name}_b"], lora_scale)
+    return out
+
+
+def _proj(x, w, name, lora, lora_scale):
+    return _proj_f32(x, w, name, lora, lora_scale).astype(x.dtype)
+
+
+def swiglu_mlp(
+    params: dict, x: jax.Array, lora: dict | None = None, lora_scale: float = 1.0
+) -> jax.Array:
+    """SwiGLU feed-forward: silu(x W_gate) * (x W_up) W_down.
+
+    gate/up stay f32 through the silu product (one rounding at the end),
+    matching f32-accumulated MXU semantics.
+    """
+    gate = _proj_f32(x, params["gate"], "gate", lora, lora_scale)
+    up = _proj_f32(x, params["up"], "up", lora, lora_scale)
     h = (jax.nn.silu(gate) * up).astype(x.dtype)
-    return jnp.dot(h, params["down"], preferred_element_type=jnp.float32).astype(
-        x.dtype
-    )
+    return _proj(h, params["down"], "down", lora, lora_scale)
 
 
 def gelu_mlp(params: dict, x: jax.Array) -> jax.Array:
@@ -112,13 +131,15 @@ def causal_self_attention(
     sin: jax.Array | None = None,
     causal: bool = True,
     attn_impl: str = "flash",
+    lora: dict | None = None,
+    lora_scale: float = 1.0,
 ) -> jax.Array:
     """Projection + (optional RoPE) + fused attention + output projection."""
     B, S, E = x.shape
     D = E // n_heads
-    q = jnp.dot(x, params["wq"], preferred_element_type=jnp.float32).astype(x.dtype)
-    k = jnp.dot(x, params["wk"], preferred_element_type=jnp.float32).astype(x.dtype)
-    v = jnp.dot(x, params["wv"], preferred_element_type=jnp.float32).astype(x.dtype)
+    q = _proj(x, params["wq"], "wq", lora, lora_scale)
+    k = _proj(x, params["wk"], "wk", lora, lora_scale)
+    v = _proj(x, params["wv"], "wv", lora, lora_scale)
     q = q.reshape(B, S, n_heads, D).transpose(0, 2, 1, 3)
     k = k.reshape(B, S, n_kv_heads, D).transpose(0, 2, 1, 3)
     v = v.reshape(B, S, n_kv_heads, D).transpose(0, 2, 1, 3)
@@ -127,7 +148,7 @@ def causal_self_attention(
         k = apply_rope(k, cos, sin)
     o = attention_op(q, k, v, causal, attn_impl)
     o = o.transpose(0, 2, 1, 3).reshape(B, S, E)
-    return jnp.dot(o, params["wo"], preferred_element_type=jnp.float32).astype(x.dtype)
+    return _proj(o, params["wo"], "wo", lora, lora_scale)
 
 
 def init_dense(key, shape, scale: float | None = None, dtype=jnp.bfloat16):

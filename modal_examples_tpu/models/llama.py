@@ -183,6 +183,8 @@ def forward(
     *,
     positions: jax.Array | None = None,  # [B, S] (defaults to arange)
     attn_impl: str = "flash",
+    lora: dict | None = None,  # adapter pytree (models.lora), applied on the fly
+    lora_scale: float = 1.0,
 ) -> jax.Array:  # [B, S, vocab]
     """Full-sequence forward with causal attention (flash or xla impl)."""
     B, S = tokens.shape
@@ -193,20 +195,30 @@ def forward(
         positions, cfg.head_dim, cfg.rope_theta, dtype=jnp.float32
     )  # [B, S, hd/2]
 
-    def layer_fn(x, layer):
+    def layer_fn(x, scanned):
+        layer = scanned[0] if lora is not None else scanned
+        llayer = scanned[1] if lora is not None else None
         h = layers.rms_norm(x, layer["attn_norm"], cfg.norm_eps)
         attn_params = {k: layer[k] for k in ("wq", "wk", "wv", "wo")}
         h = layers.causal_self_attention(
             attn_params, h,
             n_heads=cfg.n_heads, n_kv_heads=cfg.n_kv_heads,
             cos=cos, sin=sin, causal=True, attn_impl=attn_impl,
+            lora=llayer, lora_scale=lora_scale,
         )
         x = x + h
         h = layers.rms_norm(x, layer["mlp_norm"], cfg.norm_eps)
-        h = layers.swiglu_mlp({k: layer[k] for k in ("gate", "up", "down")}, h)
+        h = layers.swiglu_mlp(
+            {k: layer[k] for k in ("gate", "up", "down")}, h,
+            lora=llayer, lora_scale=lora_scale,
+        )
         return x + h, None
 
-    x, _ = jax.lax.scan(layer_fn, x, _layer_stack(params))
+    xs = (
+        (_layer_stack(params), lora["layers"]) if lora is not None
+        else _layer_stack(params)
+    )
+    x, _ = jax.lax.scan(layer_fn, x, xs)
     x = layers.rms_norm(x, params["final_norm"], cfg.norm_eps)
     head = params["embed"].T if cfg.tie_embeddings else params["lm_head"]
     return jnp.dot(x, head, preferred_element_type=jnp.float32)

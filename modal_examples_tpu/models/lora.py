@@ -1,0 +1,72 @@
+"""LoRA: low-rank adapters as a separate pytree.
+
+Replaces the reference's unsloth/TRL LoRA stack (unsloth_finetune.py:205-213
+targets q/k/v/o/gate/up/down; dreambooth/diffusers_lora_finetune.py). The
+TPU-native shape: adapters are their OWN pytree — the frozen base params are
+never touched, the optimizer state covers only the adapters (rank*d instead
+of d^2), and inference either merges (``merge``) or applies the low-rank
+delta on the fly inside the jitted forward (``llama.forward(lora=...)``:
+x@(W + aXb) computed as x@W + (x@a)@b, never materializing W + delta).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+
+DEFAULT_TARGETS = ("wq", "wk", "wv", "wo", "gate", "up", "down")
+
+
+@dataclasses.dataclass(frozen=True)
+class LoRAConfig:
+    rank: int = 8
+    alpha: float = 16.0
+    targets: tuple[str, ...] = DEFAULT_TARGETS
+
+    @property
+    def scale(self) -> float:
+        return self.alpha / self.rank
+
+
+def init_lora(key: jax.Array, params: dict, lcfg: LoRAConfig) -> dict:
+    """Adapters for the stacked layer weights: a ~ N(0, 1/r), b = 0 (so the
+    model starts exactly at the base)."""
+    lora_layers = {}
+    keys = jax.random.split(key, len(lcfg.targets))
+    for k, name in zip(keys, lcfg.targets):
+        w = params["layers"][name]  # [L, din, dout]
+        L, din, dout = w.shape
+        lora_layers[f"{name}_a"] = (
+            jax.random.normal(k, (L, din, lcfg.rank), jnp.float32) / lcfg.rank
+        ).astype(w.dtype)
+        lora_layers[f"{name}_b"] = jnp.zeros((L, lcfg.rank, dout), w.dtype)
+    return {"layers": lora_layers}
+
+
+def delta(x: jax.Array, a: jax.Array, b: jax.Array, scale: float) -> jax.Array:
+    """(x @ a) @ b * scale in f32 — the on-the-fly low-rank path."""
+    xa = jnp.dot(x, a, preferred_element_type=jnp.float32).astype(x.dtype)
+    return jnp.dot(xa, b, preferred_element_type=jnp.float32) * scale
+
+
+def merge(params: dict, lora_params: dict, lcfg: LoRAConfig) -> dict:
+    """Fold adapters into a copy of the base weights (for serving)."""
+    merged_layers = dict(params["layers"])
+    for name in lcfg.targets:
+        a = lora_params["layers"][f"{name}_a"]
+        b = lora_params["layers"][f"{name}_b"]
+        w = params["layers"][name]
+        merged_layers[name] = (
+            w.astype(jnp.float32)
+            + jnp.einsum("lir,lro->lio", a.astype(jnp.float32), b.astype(jnp.float32))
+            * lcfg.scale
+        ).astype(w.dtype)
+    out = dict(params)
+    out["layers"] = merged_layers
+    return out
+
+
+def param_count(lora_params: dict) -> int:
+    return sum(x.size for x in jax.tree.leaves(lora_params))

@@ -1,0 +1,172 @@
+"""Tests for bert/gpt/lora: embeddings semantics, SLM training step, LoRA
+delta == merged-weight equivalence (the property that licenses on-the-fly
+application during training and merged weights for serving)."""
+
+import numpy as np
+import pytest
+
+
+@pytest.fixture(scope="module")
+def jax(jax_cpu):
+    return jax_cpu
+
+
+class TestBert:
+    def test_embed_normalized_and_mask_sensitive(self, jax):
+        import jax.numpy as jnp
+
+        from modal_examples_tpu.models import bert
+
+        cfg = bert.BertConfig.tiny()
+        params = bert.init_params(jax.random.PRNGKey(0), cfg)
+        tokens = jax.random.randint(jax.random.PRNGKey(1), (2, 16), 0, 500)
+        mask = jnp.ones((2, 16), jnp.int32).at[1, 8:].set(0)
+        emb = bert.embed(params, tokens, mask, cfg)
+        assert emb.shape == (2, cfg.dim)
+        np.testing.assert_allclose(
+            np.linalg.norm(np.asarray(emb), axis=-1), 1.0, atol=1e-5
+        )
+        # padding must not affect the embedding: same row, garbage in pad area
+        tokens2 = tokens.at[1, 8:].set(7)
+        emb2 = bert.embed(params, tokens2, mask, cfg)
+        np.testing.assert_allclose(
+            np.asarray(emb[1]), np.asarray(emb2[1]), atol=1e-5
+        )
+
+    def test_mean_pooling(self, jax):
+        import dataclasses
+
+        from modal_examples_tpu.models import bert
+
+        cfg = dataclasses.replace(bert.BertConfig.tiny(), pooling="mean")
+        params = bert.init_params(jax.random.PRNGKey(0), cfg)
+        tokens = jax.random.randint(jax.random.PRNGKey(1), (1, 8), 0, 500)
+        emb = bert.embed(params, tokens, None, cfg)
+        assert emb.shape == (1, cfg.dim)
+
+
+class TestGPT:
+    def test_forward_and_train_step(self, jax):
+        import jax.numpy as jnp
+
+        from modal_examples_tpu.models import gpt
+        from modal_examples_tpu.training import (
+            Trainer, cross_entropy_loss, make_optimizer,
+        )
+
+        cfg = gpt.GPTConfig.tiny()
+        params = gpt.init_params(jax.random.PRNGKey(0), cfg)
+        tokens = jax.random.randint(jax.random.PRNGKey(1), (2, 64), 0, cfg.vocab_size)
+        logits = gpt.forward(params, tokens, cfg)
+        assert logits.shape == (2, 64, cfg.vocab_size)
+
+        def loss_fn(p, batch):
+            lg = gpt.forward(p, batch["tokens"], cfg, attn_impl="xla")
+            return cross_entropy_loss(lg[:, :-1], batch["tokens"][:, 1:])
+
+        t = Trainer(loss_fn, make_optimizer(1e-2))
+        state = t.init_state(params)
+        first = None
+        for _ in range(10):
+            state, m = t.train_step(state, {"tokens": tokens})
+            first = first or float(m["loss"])
+        assert float(m["loss"]) < first
+
+    def test_generate_shape(self, jax):
+        from modal_examples_tpu.models import gpt
+
+        cfg = gpt.GPTConfig.tiny()
+        params = gpt.init_params(jax.random.PRNGKey(0), cfg)
+        import jax.numpy as jnp
+
+        out = gpt.generate(
+            params, cfg, jnp.array([1, 2, 3]), 8, jax.random.PRNGKey(2)
+        )
+        assert out.shape == (8,)
+
+    def test_char_tokenizer_roundtrip(self):
+        from modal_examples_tpu.models.gpt import CharTokenizer
+
+        tok = CharTokenizer("hello world")
+        assert tok.decode(tok.encode("hello")) == "hello"
+
+
+class TestLoRA:
+    def test_zero_init_is_identity(self, jax):
+        from modal_examples_tpu.models import llama, lora
+
+        cfg = llama.LlamaConfig(
+            vocab_size=128, dim=64, n_layers=2, n_heads=2, n_kv_heads=2,
+            ffn_dim=128, dtype="float32",
+        )
+        params = llama.init_params(jax.random.PRNGKey(0), cfg)
+        lcfg = lora.LoRAConfig(rank=4)
+        adapters = lora.init_lora(jax.random.PRNGKey(1), params, lcfg)
+        tokens = jax.random.randint(jax.random.PRNGKey(2), (1, 64), 0, 128)
+        base = llama.forward(params, tokens, cfg)
+        with_lora = llama.forward(
+            params, tokens, cfg, lora=adapters, lora_scale=lcfg.scale
+        )
+        np.testing.assert_allclose(np.asarray(base), np.asarray(with_lora), atol=1e-5)
+
+    def test_on_the_fly_equals_merged(self, jax):
+        import jax.numpy as jnp
+
+        from modal_examples_tpu.models import llama, lora
+
+        cfg = llama.LlamaConfig(
+            vocab_size=128, dim=64, n_layers=2, n_heads=2, n_kv_heads=2,
+            ffn_dim=128, dtype="float32",
+        )
+        params = llama.init_params(jax.random.PRNGKey(0), cfg)
+        lcfg = lora.LoRAConfig(rank=4)
+        adapters = lora.init_lora(jax.random.PRNGKey(1), params, lcfg)
+        # give b nonzero values so the delta is real
+        adapters = jax.tree.map(
+            lambda x: x + 0.01 if x.ndim == 3 else x, adapters
+        )
+        tokens = jax.random.randint(jax.random.PRNGKey(2), (1, 64), 0, 128)
+        on_fly = llama.forward(
+            params, tokens, cfg, lora=adapters, lora_scale=lcfg.scale
+        )
+        merged = lora.merge(params, adapters, lcfg)
+        merged_out = llama.forward(merged, tokens, cfg)
+        np.testing.assert_allclose(
+            np.asarray(on_fly), np.asarray(merged_out), atol=2e-4
+        )
+
+    def test_lora_training_only_touches_adapters(self, jax):
+        import jax.numpy as jnp
+
+        from modal_examples_tpu.models import llama, lora
+        from modal_examples_tpu.training import (
+            Trainer, cross_entropy_loss, make_optimizer,
+        )
+
+        cfg = llama.LlamaConfig(
+            vocab_size=128, dim=64, n_layers=2, n_heads=2, n_kv_heads=2,
+            ffn_dim=128, dtype="float32",
+        )
+        base = llama.init_params(jax.random.PRNGKey(0), cfg)
+        lcfg = lora.LoRAConfig(rank=4)
+        adapters = lora.init_lora(jax.random.PRNGKey(1), base, lcfg)
+        tokens = jax.random.randint(jax.random.PRNGKey(2), (2, 64), 0, 128)
+
+        def loss_fn(adapters, batch):
+            lg = llama.forward(
+                base, batch["tokens"], cfg, attn_impl="xla",
+                lora=adapters, lora_scale=lcfg.scale,
+            )
+            return cross_entropy_loss(lg[:, :-1], batch["tokens"][:, 1:])
+
+        t = Trainer(loss_fn, make_optimizer(1e-2))
+        state = t.init_state(adapters)
+        first = None
+        for _ in range(8):
+            state, m = t.train_step(state, {"tokens": tokens})
+            first = first or float(m["loss"])
+        assert float(m["loss"]) < first
+        # trainable params are tiny vs base
+        assert lora.param_count(state.params) < 0.2 * sum(
+            x.size for x in jax.tree.leaves(base)
+        )
