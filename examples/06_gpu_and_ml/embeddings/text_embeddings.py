@@ -60,10 +60,10 @@ class Embedder:
         self._embed = jax.jit(
             lambda p, t, m: bert.embed(p, t, m, self.cfg)
         )
-        # warmup compile at the fixed batch shape
+        # warmup compile at the one fixed batch shape (32 = max_batch_size)
         import numpy as np
 
-        t = np.zeros((8, MAX_SEQ), np.int32)
+        t = np.zeros((32, MAX_SEQ), np.int32)
         self._embed(self.params, t, np.ones_like(t)).block_until_ready()
 
     def _encode_batch(self, texts: list[str]):
@@ -75,8 +75,8 @@ class Embedder:
             ids = self.tokenizer.encode(s)[:MAX_SEQ]
             toks[i, : len(ids)] = ids
             mask[i, : len(ids)] = 1
-        # pad the batch dim to the compiled shape (8) to avoid retraces
-        pad_to = 8 * ((len(texts) + 7) // 8)
+        # always pad to the single compiled shape (32): no serve-time retraces
+        pad_to = 32
         if pad_to != len(texts):
             toks = np.pad(toks, ((0, pad_to - len(texts)), (0, 0)))
             mask = np.pad(mask, ((0, pad_to - len(texts)), (0, 0)))

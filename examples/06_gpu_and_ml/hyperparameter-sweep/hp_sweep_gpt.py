@@ -132,10 +132,15 @@ def sample_from(run_name: str, dim: int, prompt: str = "To be") -> str:
 
 @app.local_entrypoint()
 def main(n_steps: int = 100):
+    import time
+
+    # unique sweep id: run dirs never collide with a previous invocation's
+    # checkpoints on the persistent volume
+    sweep = time.strftime("%Y%m%d-%H%M%S")
     # the sweep grid: 4 configurations fanned out via .starmap
     # (hp_sweep_gpt.py:320)
     grid = [
-        (f"run-lr{lr}-d{dim}", lr, dim, n_steps)
+        (f"{sweep}/run-lr{lr}-d{dim}", lr, dim, n_steps)
         for lr in (3e-3, 1e-3)
         for dim in (64, 128)
     ]

@@ -94,12 +94,14 @@ def finetune(max_steps: int = 30, lora_rank: int = 8, resume: bool = True) -> di
 
     trainer = Trainer(loss_fn, make_optimizer(1e-3))
     state = trainer.init_state(adapters)
+    # reload FIRST: a fresh retry container must see commits from the dead
+    # attempt before scanning for checkpoints (volume.reload contract)
+    ckpt_vol.reload()
     ckpts = CheckpointManager("/ckpts/lora-run", keep_n=2, volume=ckpt_vol)
 
     # resume from the latest checkpoint (unsloth_finetune.py:549-567)
     start_step = 0
     if resume and ckpts.latest_step() is not None:
-        ckpt_vol.reload()
         template = {"adapters": state.params, "opt": state.opt_state}
         restored = ckpts.restore(template)
         state = state.__class__(
@@ -108,6 +110,14 @@ def finetune(max_steps: int = 30, lora_rank: int = 8, resume: bool = True) -> di
         )
         start_step = ckpts.latest_step()
         print(f"resumed from step {start_step}")
+
+    if start_step >= max_steps:
+        print(f"nothing to do: checkpoint at {start_step} >= max_steps {max_steps}")
+        return {
+            "trained_steps": 0, "resumed_from": start_step,
+            "first_loss": None, "final_loss": None,
+            "adapter_params": lora.param_count(state.params),
+        }
 
     key = jax.random.PRNGKey(2)
     losses = []

@@ -685,8 +685,7 @@ class FunctionPool:
                 with self.lock:
                     self.pending.extendleft(reversed(e.still_owned))
 
-    def _dispatch_batched(self, ready: list[_QueuedInput], now: float, cfg=None) -> None:
-        cfg = cfg or self.spec.batched
+    def _dispatch_batched(self, ready: list[_QueuedInput], now: float, cfg) -> None:
         oldest_wait = max((now - qi.ready_at) for qi in ready) if ready else 0
         full = len(ready) >= cfg.max_batch_size
         waited = oldest_wait * 1000.0 >= cfg.wait_ms

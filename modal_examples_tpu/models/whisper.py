@@ -1,0 +1,231 @@
+"""Whisper-family encoder-decoder ASR.
+
+The model behind the reference's Whisper north-star config
+(06_gpu_and_ml/openai_whisper/fine_tune_asr.py, finetuning/train/train.py —
+HF Seq2SeqTrainer fine-tuning; speech-to-text/batched_whisper.py — dynamic
+batched inference). Architecture (whisper geometry): audio encoder = two
+GELU convs (stride 1, 2) over log-mel + sinusoidal positions + pre-LN
+transformer; text decoder = learned positions + causal self-attention +
+cross-attention + tied output head.
+
+JAX-first: per-layer weights stacked for lax.scan, greedy decode as a
+fixed-length scan (static shapes; no dynamic host loop), fine-tuning via the
+same Trainer as every other model.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+
+from . import layers
+
+
+@dataclasses.dataclass(frozen=True)
+class WhisperConfig:
+    n_mels: int = 80
+    n_audio_ctx: int = 1500  # encoder frames after stride-2 conv
+    n_text_ctx: int = 448
+    vocab_size: int = 51865
+    dim: int = 512
+    n_heads: int = 8
+    n_audio_layers: int = 6
+    n_text_layers: int = 6
+    norm_eps: float = 1e-5
+    dtype: str = "float32"
+
+    @property
+    def head_dim(self) -> int:
+        return self.dim // self.n_heads
+
+    @property
+    def jnp_dtype(self):
+        return jnp.dtype(self.dtype)
+
+    @staticmethod
+    def base() -> "WhisperConfig":
+        return WhisperConfig()
+
+    @staticmethod
+    def tiny_en() -> "WhisperConfig":
+        return WhisperConfig(dim=384, n_heads=6, n_audio_layers=4, n_text_layers=4)
+
+    @staticmethod
+    def test_tiny() -> "WhisperConfig":
+        """Cheap-mode config (SURVEY.md §4 tiny-workload switches)."""
+        return WhisperConfig(
+            n_mels=80, n_audio_ctx=100, n_text_ctx=32, vocab_size=300,
+            dim=64, n_heads=2, n_audio_layers=2, n_text_layers=2,
+        )
+
+
+def _sinusoids(length: int, channels: int) -> jnp.ndarray:
+    """Fixed sinusoidal position table (whisper encoder convention)."""
+    log_timescale = jnp.log(10000.0) / (channels // 2 - 1)
+    inv = jnp.exp(-log_timescale * jnp.arange(channels // 2))
+    scaled = jnp.arange(length)[:, None] * inv[None, :]
+    return jnp.concatenate([jnp.sin(scaled), jnp.cos(scaled)], axis=1)
+
+
+def init_params(key: jax.Array, cfg: WhisperConfig) -> dict:
+    dt = cfg.jnp_dtype
+    D, F = cfg.dim, 4 * cfg.dim
+    ks = iter(jax.random.split(key, 24))
+
+    def dense(*shape, scale=None):
+        return layers.init_dense(next(ks), shape, scale=scale, dtype=dt)
+
+    def enc_dec_layers(L, cross: bool):
+        p = {
+            "ln1_w": jnp.ones((L, D), dt), "ln1_b": jnp.zeros((L, D), dt),
+            "wq": dense(L, D, D), "bq": jnp.zeros((L, D), dt),
+            "wk": dense(L, D, D),
+            "wv": dense(L, D, D), "bv": jnp.zeros((L, D), dt),
+            "wo": dense(L, D, D), "bo": jnp.zeros((L, D), dt),
+            "ln2_w": jnp.ones((L, D), dt), "ln2_b": jnp.zeros((L, D), dt),
+            "fc_w": dense(L, D, F), "fc_b": jnp.zeros((L, F), dt),
+            "proj_w": dense(L, F, D), "proj_b": jnp.zeros((L, D), dt),
+        }
+        if cross:
+            p.update({
+                "xln_w": jnp.ones((L, D), dt), "xln_b": jnp.zeros((L, D), dt),
+                "xwq": dense(L, D, D), "xbq": jnp.zeros((L, D), dt),
+                "xwk": dense(L, D, D),
+                "xwv": dense(L, D, D), "xbv": jnp.zeros((L, D), dt),
+                "xwo": dense(L, D, D), "xbo": jnp.zeros((L, D), dt),
+            })
+        return p
+
+    return {
+        "conv1_w": dense(3, cfg.n_mels, D, scale=0.02),  # [k, in, out]
+        "conv1_b": jnp.zeros((D,), dt),
+        "conv2_w": dense(3, D, D, scale=0.02),
+        "conv2_b": jnp.zeros((D,), dt),
+        "enc": enc_dec_layers(cfg.n_audio_layers, cross=False),
+        "enc_ln_w": jnp.ones((D,), dt),
+        "enc_ln_b": jnp.zeros((D,), dt),
+        "tok_emb": dense(cfg.vocab_size, D, scale=0.02),
+        "pos_emb": dense(cfg.n_text_ctx, D, scale=0.02),
+        "dec": enc_dec_layers(cfg.n_text_layers, cross=True),
+        "dec_ln_w": jnp.ones((D,), dt),
+        "dec_ln_b": jnp.zeros((D,), dt),
+    }
+
+
+def _mha(q, k, v, n_heads, causal: bool) -> jax.Array:
+    B, Sq, D = q.shape
+    Sk = k.shape[1]
+    hd = D // n_heads
+    q = q.reshape(B, Sq, n_heads, hd).transpose(0, 2, 1, 3)
+    k = k.reshape(B, Sk, n_heads, hd).transpose(0, 2, 1, 3)
+    v = v.reshape(B, Sk, n_heads, hd).transpose(0, 2, 1, 3)
+    s = jnp.einsum("bhqd,bhkd->bhqk", q, k, preferred_element_type=jnp.float32)
+    s = s * hd**-0.5
+    if causal:
+        mask = jnp.tril(jnp.ones((Sq, Sk), bool))
+        s = jnp.where(mask, s, -jnp.inf)
+    p = jax.nn.softmax(s, axis=-1).astype(v.dtype)
+    o = jnp.einsum("bhqk,bhkd->bhqd", p, v)
+    return o.transpose(0, 2, 1, 3).reshape(B, Sq, D)
+
+
+def encode(params: dict, mel: jax.Array, cfg: WhisperConfig) -> jax.Array:
+    """log-mel [B, T, n_mels] -> audio states [B, T//2, D]."""
+    dn = ("NWC", "WIO", "NWC")
+    x = jax.lax.conv_general_dilated(
+        mel, params["conv1_w"], (1,), "SAME", dimension_numbers=dn
+    ) + params["conv1_b"]
+    x = jax.nn.gelu(x)
+    x = jax.lax.conv_general_dilated(
+        x, params["conv2_w"], (2,), "SAME", dimension_numbers=dn
+    ) + params["conv2_b"]
+    x = jax.nn.gelu(x)
+    x = x + _sinusoids(x.shape[1], cfg.dim).astype(x.dtype)[None]
+
+    def layer_fn(x, l):
+        h = layers.layer_norm(x, l["ln1_w"], l["ln1_b"], cfg.norm_eps)
+        q = jnp.dot(h, l["wq"]) + l["bq"]
+        k = jnp.dot(h, l["wk"])  # whisper: no bias on key
+        v = jnp.dot(h, l["wv"]) + l["bv"]
+        o = _mha(q, k, v, cfg.n_heads, causal=False)
+        x = x + jnp.dot(o, l["wo"]) + l["bo"]
+        h = layers.layer_norm(x, l["ln2_w"], l["ln2_b"], cfg.norm_eps)
+        h = layers.gelu_mlp(
+            {n: l[n] for n in ("fc_w", "fc_b", "proj_w", "proj_b")}, h
+        )
+        return x + h, None
+
+    x, _ = jax.lax.scan(layer_fn, x, params["enc"])
+    return layers.layer_norm(x, params["enc_ln_w"], params["enc_ln_b"], cfg.norm_eps)
+
+
+def decode(
+    params: dict,
+    tokens: jax.Array,  # [B, S] int32
+    audio_states: jax.Array,  # [B, Ta, D]
+    cfg: WhisperConfig,
+) -> jax.Array:  # [B, S, vocab]
+    B, S = tokens.shape
+    x = params["tok_emb"][tokens] + params["pos_emb"][:S][None]
+
+    def layer_fn(x, l):
+        h = layers.layer_norm(x, l["ln1_w"], l["ln1_b"], cfg.norm_eps)
+        q = jnp.dot(h, l["wq"]) + l["bq"]
+        k = jnp.dot(h, l["wk"])
+        v = jnp.dot(h, l["wv"]) + l["bv"]
+        x = x + jnp.dot(
+            _mha(q, k, v, cfg.n_heads, causal=True), l["wo"]
+        ) + l["bo"]
+        h = layers.layer_norm(x, l["xln_w"], l["xln_b"], cfg.norm_eps)
+        xq = jnp.dot(h, l["xwq"]) + l["xbq"]
+        xk = jnp.dot(audio_states, l["xwk"])
+        xv = jnp.dot(audio_states, l["xwv"]) + l["xbv"]
+        x = x + jnp.dot(
+            _mha(xq, xk, xv, cfg.n_heads, causal=False), l["xwo"]
+        ) + l["xbo"]
+        h = layers.layer_norm(x, l["ln2_w"], l["ln2_b"], cfg.norm_eps)
+        h = layers.gelu_mlp(
+            {n: l[n] for n in ("fc_w", "fc_b", "proj_w", "proj_b")}, h
+        )
+        return x + h, None
+
+    x, _ = jax.lax.scan(layer_fn, x, params["dec"])
+    x = layers.layer_norm(x, params["dec_ln_w"], params["dec_ln_b"], cfg.norm_eps)
+    return jnp.dot(x, params["tok_emb"].T, preferred_element_type=jnp.float32)
+
+
+def forward(params, mel, tokens, cfg: WhisperConfig) -> jax.Array:
+    """Teacher-forced forward (the fine-tuning loss path)."""
+    return decode(params, tokens, encode(params, mel, cfg), cfg)
+
+
+def greedy_transcribe(
+    params: dict,
+    mel: jax.Array,  # [B, T, n_mels]
+    cfg: WhisperConfig,
+    *,
+    bos_id: int,
+    eos_id: int,
+    max_tokens: int | None = None,
+) -> jax.Array:  # [B, max_tokens] (eos-padded)
+    """Greedy decode as a fixed-length scan — static shapes end to end."""
+    B = mel.shape[0]
+    S = max_tokens or cfg.n_text_ctx
+    audio_states = encode(params, mel, cfg)
+    buf = jnp.full((B, S), eos_id, jnp.int32).at[:, 0].set(bos_id)
+
+    def step(carry, pos):
+        buf, done = carry
+        logits = decode(params, buf, audio_states, cfg)  # [B, S, V]
+        nxt = jnp.argmax(logits[:, pos - 1], axis=-1).astype(jnp.int32)
+        nxt = jnp.where(done, eos_id, nxt)
+        buf = buf.at[:, pos].set(nxt)
+        done = done | (nxt == eos_id)
+        return (buf, done), None
+
+    (buf, _), _ = jax.lax.scan(
+        step, (buf, jnp.zeros((B,), bool)), jnp.arange(1, S)
+    )
+    return buf[:, 1:]

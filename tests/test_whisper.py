@@ -1,0 +1,100 @@
+"""Whisper model + audio frontend tests."""
+
+import numpy as np
+import pytest
+
+
+@pytest.fixture(scope="module")
+def jax(jax_cpu):
+    return jax_cpu
+
+
+class TestAudio:
+    def test_log_mel_shape_and_range(self):
+        from modal_examples_tpu.utils.audio import (
+            log_mel_spectrogram, synth_tone_audio,
+        )
+
+        audio = synth_tone_audio([440.0], 1.0)
+        mel = log_mel_spectrogram(audio, pad_to_chunk=False)
+        assert mel.shape[1] == 80
+        assert 95 <= mel.shape[0] <= 100  # ~1s at 10ms hop
+        assert np.isfinite(mel).all()
+
+    def test_distinct_tones_distinct_mels(self):
+        from modal_examples_tpu.utils.audio import (
+            log_mel_spectrogram, synth_tone_audio,
+        )
+
+        a = log_mel_spectrogram(synth_tone_audio([440.0]), pad_to_chunk=False)
+        b = log_mel_spectrogram(synth_tone_audio([880.0]), pad_to_chunk=False)
+        assert np.abs(a - b).max() > 0.1
+
+    def test_chunk_padding(self):
+        from modal_examples_tpu.utils.audio import (
+            N_FRAMES, log_mel_spectrogram, synth_tone_audio,
+        )
+
+        mel = log_mel_spectrogram(synth_tone_audio([440.0], 1.0))
+        assert abs(mel.shape[0] - N_FRAMES) <= 2  # framing edge
+
+
+class TestMetrics:
+    def test_wer(self):
+        from modal_examples_tpu.utils.metrics import word_error_rate
+
+        assert word_error_rate(["a b c"], ["a b c"]) == 0.0
+        assert word_error_rate(["a b c"], ["a x c"]) == pytest.approx(1 / 3)
+        assert word_error_rate(["a b"], [""]) == 1.0
+
+
+class TestWhisperModel:
+    def test_forward_shapes(self, jax):
+        import jax.numpy as jnp
+
+        from modal_examples_tpu.models import whisper
+
+        cfg = whisper.WhisperConfig.test_tiny()
+        params = whisper.init_params(jax.random.PRNGKey(0), cfg)
+        mel = jax.random.normal(jax.random.PRNGKey(1), (2, 200, cfg.n_mels))
+        tokens = jax.random.randint(jax.random.PRNGKey(2), (2, 8), 0, cfg.vocab_size)
+        states = whisper.encode(params, mel, cfg)
+        assert states.shape == (2, 100, cfg.dim)  # stride-2 conv halves T
+        logits = whisper.decode(params, tokens, states, cfg)
+        assert logits.shape == (2, 8, cfg.vocab_size)
+
+    def test_greedy_transcribe_static_shape(self, jax):
+        from modal_examples_tpu.models import whisper
+
+        cfg = whisper.WhisperConfig.test_tiny()
+        params = whisper.init_params(jax.random.PRNGKey(0), cfg)
+        mel = jax.random.normal(jax.random.PRNGKey(1), (2, 200, cfg.n_mels))
+        out = whisper.greedy_transcribe(
+            params, mel, cfg, bos_id=0, eos_id=1, max_tokens=8
+        )
+        assert out.shape == (2, 7)
+
+    def test_finetune_loss_decreases(self, jax):
+        import jax.numpy as jnp
+
+        from modal_examples_tpu.models import whisper
+        from modal_examples_tpu.training import (
+            Trainer, cross_entropy_loss, make_optimizer,
+        )
+
+        cfg = whisper.WhisperConfig.test_tiny()
+        params = whisper.init_params(jax.random.PRNGKey(0), cfg)
+        mel = jax.random.normal(jax.random.PRNGKey(1), (2, 200, cfg.n_mels))
+        tokens = jax.random.randint(jax.random.PRNGKey(2), (2, 8), 0, cfg.vocab_size)
+
+        def loss_fn(p, b):
+            logits = whisper.forward(p, b["mel"], b["tokens"], cfg)
+            return cross_entropy_loss(logits[:, :-1], b["tokens"][:, 1:])
+
+        t = Trainer(loss_fn, make_optimizer(1e-3))
+        state = t.init_state(params)
+        first = None
+        for _ in range(8):
+            state, m = t.train_step(state, {"mel": mel, "tokens": tokens})
+            first = first or float(m["loss"])
+        assert float(m["loss"]) < first
