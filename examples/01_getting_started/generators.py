@@ -1,0 +1,23 @@
+# # Streaming generators
+#
+# Counterpart of the reference's 01_getting_started/generators.py:21 —
+# a generator function streams results back with `.remote_gen`.
+
+import modal_examples_tpu as mtpu
+
+app = mtpu.App("example-generators")
+
+
+@app.function()
+def f(i: int):
+    for j in range(i):
+        yield j * j
+
+
+@app.local_entrypoint()
+def main():
+    out = []
+    for r in f.remote_gen(5):
+        print("got", r)
+        out.append(r)
+    assert out == [0, 1, 4, 9, 16]

@@ -1,0 +1,48 @@
+# # Building container images
+#
+# Counterpart of 02_building_containers/*: the chainable Image DSL
+# (import_sklearn.py:25-51, install_cuda.py:40 — except our base is
+# JAX/libtpu, never CUDA), build-time `run_function` steps, env layers, and
+# the `image.imports()` guard.
+
+import modal_examples_tpu as mtpu
+
+
+def prefetch_assets():
+    """Build-time step (runs once, cached by layer digest) — the analog of
+    weight pre-download steps baked into images."""
+    print("prefetching assets into the image layer...")
+
+
+image = (
+    mtpu.Image.tpu_base()  # Python + jax[tpu] + flax: the CUDA-free base
+    .apt_install("ffmpeg")
+    .uv_pip_install("einops")
+    .env({"EXAMPLE_MODE": "builder-demo"})
+    .run_function(prefetch_assets)
+)
+
+app = mtpu.App("example-image-builder", image=image)
+
+# container-only imports are guarded on the client (import_sklearn.py:25-27)
+with image.imports():
+    import some_container_only_package  # noqa: F401
+
+
+@app.function()
+def show_env() -> dict:
+    import os
+
+    return {
+        "mode": os.environ.get("EXAMPLE_MODE"),
+        "task": os.environ.get("MTPU_TASK_ID", "")[:6],
+    }
+
+
+@app.local_entrypoint()
+def main():
+    print("image digest:", image.digest())
+    print("pip layers:", image.python_packages())
+    out = show_env.remote()
+    print("container env:", out)
+    assert out["mode"] == "builder-demo"

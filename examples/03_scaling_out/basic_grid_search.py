@@ -1,0 +1,24 @@
+# # Grid search with .map
+#
+# Counterpart of 03_scaling_out/basic_grid_search.py:48 — fan a parameter
+# grid over autoscaled containers and reduce the streamed results.
+
+import modal_examples_tpu as mtpu
+
+app = mtpu.App("example-grid-search")
+
+
+@app.function(max_containers=8)
+def score(params: tuple) -> tuple:
+    lr, width = params
+    # a synthetic objective with a known optimum at (0.1, 64)
+    value = -((lr - 0.1) ** 2) - ((width - 64) / 64) ** 2
+    return params, value
+
+
+@app.local_entrypoint()
+def main():
+    grid = [(lr, w) for lr in (0.01, 0.1, 1.0) for w in (16, 64, 256)]
+    best = max(score.map(grid), key=lambda r: r[1])
+    print("best:", best)
+    assert best[0] == (0.1, 64)

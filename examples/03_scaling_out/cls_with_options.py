@@ -1,0 +1,41 @@
+# # Runtime-parameterized services: with_options and parameters
+#
+# Counterpart of 03_scaling_out/cls_with_options.py:57 — override a Cls's
+# resources at call time with `.with_options`, and parameterize instances
+# with `mtpu.parameter` (distinct containers per parameter set).
+
+import os
+
+import modal_examples_tpu as mtpu
+
+app = mtpu.App("example-cls-options")
+
+
+@app.cls(scaledown_window=60)
+class Greeter:
+    greeting: str = mtpu.parameter(default="Hello")
+
+    @mtpu.enter()
+    def setup(self):
+        self.task_id = os.environ.get("MTPU_TASK_ID")
+
+    @mtpu.method()
+    def greet(self, name: str) -> str:
+        return f"{self.greeting}, {name}! (from {self.task_id})"
+
+
+@app.local_entrypoint()
+def main():
+    hello = Greeter()
+    hola = Greeter(greeting="Hola")
+    a = hello.greet.remote("world")
+    b = hola.greet.remote("mundo")
+    print(a)
+    print(b)
+    assert a.startswith("Hello,") and b.startswith("Hola,")
+    # parameterized instances get separate containers
+    assert a.split("from ")[1] != b.split("from ")[1]
+
+    # with_options returns a re-resourced handle without redefining the class
+    fast = Greeter.with_options(max_containers=2, scaledown_window=30)
+    assert fast._spec.max_containers == 2

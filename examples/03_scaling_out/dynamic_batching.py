@@ -1,0 +1,36 @@
+# # Dynamic batching
+#
+# Counterpart of 03_scaling_out/dynamic_batching.py:29,57 — `@mtpu.batched`
+# coalesces concurrent single inputs into server-side batches, and the async
+# variant drives it from one coroutine (08_advanced usage :81-93).
+
+import asyncio
+
+import modal_examples_tpu as mtpu
+
+app = mtpu.App("example-dynamic-batching")
+
+
+@app.function()
+@mtpu.batched(max_batch_size=4, wait_ms=100)
+def batched_multiply(xs: list[int], ys: list[int]) -> list[int]:
+    # the function sees lists; callers send scalars
+    assert isinstance(xs, list)
+    return [x * y for x, y in zip(xs, ys)]
+
+
+@app.local_entrypoint()
+def main():
+    # sync fan-out: the scheduler groups these into batches of <= 4
+    results = list(batched_multiply.map(range(8), range(8)))
+    assert results == [i * i for i in range(8)]
+    print("sync batched:", results)
+
+    async def async_path():
+        return await asyncio.gather(
+            *(batched_multiply.remote.aio(i, 10) for i in range(4))
+        )
+
+    out = asyncio.run(async_path())
+    assert out == [0, 10, 20, 30]
+    print("async batched:", out)

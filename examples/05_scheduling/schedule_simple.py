@@ -1,0 +1,33 @@
+# # Scheduled functions
+#
+# Counterpart of 05_scheduling/schedule_simple.py:27,34 — `Period` and
+# `Cron` schedules fire on deployed apps (`tpurun deploy` keeps the
+# scheduler loop alive). The entrypoint demonstrates a bounded scheduler run.
+
+import time
+
+import modal_examples_tpu as mtpu
+
+app = mtpu.App("example-schedules")
+heartbeat_log = mtpu.Dict.from_name("schedule-heartbeats")
+
+
+@app.function(schedule=mtpu.Period(seconds=2))
+def heartbeat():
+    ts = time.time()
+    heartbeat_log[f"beat-{int(ts * 1000)}"] = ts
+    print(f"heartbeat at {ts:.1f}")
+
+
+@app.function(schedule=mtpu.Cron("0 9 * * 1-5"))
+def weekday_report():
+    print("good morning — weekday 9am report")
+
+
+@app.local_entrypoint()
+def main(seconds: float = 5.0):
+    heartbeat_log.clear()
+    fired = app.run_scheduler(duration=seconds)
+    beats = len(heartbeat_log)
+    print(f"scheduler fired {fired} times; {beats} heartbeats recorded")
+    assert beats >= 1

@@ -17,6 +17,7 @@ import modal_examples_tpu as mtpu
 MODEL_DIR = os.environ.get("MTPU_MODEL_DIR")  # HF bge-small-en checkout
 TPU = os.environ.get("MTPU_TPU", "") or None
 MAX_SEQ = 128
+MAX_BATCH = 32  # the ONE compiled batch shape: warmup, padding, batcher agree
 
 app = mtpu.App("example-text-embeddings")
 
@@ -60,10 +61,10 @@ class Embedder:
         self._embed = jax.jit(
             lambda p, t, m: bert.embed(p, t, m, self.cfg)
         )
-        # warmup compile at the one fixed batch shape (32 = max_batch_size)
+        # warmup compile at the one fixed batch shape
         import numpy as np
 
-        t = np.zeros((32, MAX_SEQ), np.int32)
+        t = np.zeros((MAX_BATCH, MAX_SEQ), np.int32)
         self._embed(self.params, t, np.ones_like(t)).block_until_ready()
 
     def _encode_batch(self, texts: list[str]):
@@ -75,8 +76,9 @@ class Embedder:
             ids = self.tokenizer.encode(s)[:MAX_SEQ]
             toks[i, : len(ids)] = ids
             mask[i, : len(ids)] = 1
-        # always pad to the single compiled shape (32): no serve-time retraces
-        pad_to = 32
+        # always pad to the single compiled shape: no serve-time retraces
+        assert len(texts) <= MAX_BATCH, (len(texts), MAX_BATCH)
+        pad_to = MAX_BATCH
         if pad_to != len(texts):
             toks = np.pad(toks, ((0, pad_to - len(texts)), (0, 0)))
             mask = np.pad(mask, ((0, pad_to - len(texts)), (0, 0)))
@@ -87,7 +89,7 @@ class Embedder:
     def embed_one(self, text: str) -> list[float]:
         return self._encode_batch([text])[0]
 
-    @mtpu.batched(max_batch_size=32, wait_ms=50)
+    @mtpu.batched(max_batch_size=MAX_BATCH, wait_ms=50)
     @mtpu.method()
     def embed(self, texts: list[str]) -> list[list[float]]:
         """Dynamic batching: concurrent callers' singles coalesce into one

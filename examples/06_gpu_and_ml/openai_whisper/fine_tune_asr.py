@@ -111,19 +111,23 @@ def train(train_steps: int = 60) -> dict:
             logits[:, :-1], batch["tokens"][:, 1:], batch["mask"][:, 1:]
         )
 
+    if train_steps < 1:
+        raise ValueError("train_steps must be >= 1")
     trainer = Trainer(loss_fn, make_optimizer(3e-3))
     state = trainer.init_state(params)
     batch = {"mel": mels, "tokens": toks, "mask": mask}
-    first = None
+    first = last = None
     for step in range(train_steps):
         state, metrics = trainer.train_step(state, batch)
-        first = first or float(metrics["loss"])
+        last = float(metrics["loss"])
+        if first is None:
+            first = last
         if (step + 1) % 20 == 0:
-            print(f"step {step + 1} loss {float(metrics['loss']):.3f}")
+            print(f"step {step + 1} loss {last:.3f}")
 
     ckpts = CheckpointManager("/ckpts/whisper-tones", keep_n=1, volume=ckpt_vol)
     ckpts.save(train_steps, {"params": state.params})
-    return {"first_loss": first, "final_loss": float(metrics["loss"])}
+    return {"first_loss": first, "final_loss": last}
 
 
 @app.function(tpu=TPU, volumes={"/ckpts": ckpt_vol}, timeout=600)

@@ -1,0 +1,32 @@
+# # TPU fallback lists
+#
+# Counterpart of 06_gpu_and_ml/gpu_fallbacks.py:20-23 — request an ordered
+# preference list of accelerators; the scheduler takes the first with
+# capacity. TPU-natively the list is topology-aware: each spec carries its
+# generation, chip count, hosts, and HBM.
+
+import modal_examples_tpu as mtpu
+from modal_examples_tpu.core.resources import parse_tpu_request
+
+app = mtpu.App("example-tpu-fallbacks")
+
+
+@app.function(tpu=["v5e-8", "v4-8", "v5e"])
+def chips_info() -> dict:
+    import os
+
+    spec = os.environ.get("MTPU_TPU_SPEC", "none")
+    return {"granted_spec": spec}
+
+
+@app.local_entrypoint()
+def main():
+    specs = parse_tpu_request(["v5e-8", "v4-8", "v5e"])
+    for s in specs:
+        print(
+            f"candidate {s}: {s.chips} chips / {s.hosts} host(s), "
+            f"{s.hbm_gib_per_chip} GiB HBM/chip, "
+            f"{s.bf16_tflops_per_chip} bf16 TFLOP/s/chip"
+        )
+    assert [str(s) for s in specs] == ["v5e-8", "v4-8", "v5e-1"]
+    print("preference order preserved; scheduler tries each in turn")

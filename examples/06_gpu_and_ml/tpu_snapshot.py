@@ -1,0 +1,70 @@
+# # Fast cold starts: snapshot-eligible setup + persistent compile cache
+#
+# Counterpart of 06_gpu_and_ml/gpu_snapshot.py:41-52 (bge-small served with
+# `@modal.enter(snap=True)` + GPU memory snapshots). The TPU translation of
+# "snapshot the device state": the expensive parts of a cold start are (1)
+# weights to HBM and (2) the XLA compile — so `@mtpu.enter(snap=True)` marks
+# the stage whose effects are captured, and the **XLA persistent compile
+# cache on a Volume** makes recompiles cache hits across containers (the
+# single biggest TPU cold-start lever, SURVEY.md §7).
+
+import os
+import time
+
+import modal_examples_tpu as mtpu
+
+TPU = os.environ.get("MTPU_TPU", "") or None
+
+app = mtpu.App("example-tpu-snapshot")
+compile_cache = mtpu.Volume.from_name("xla-compile-cache", create_if_missing=True)
+
+
+@app.cls(
+    tpu=TPU,
+    volumes={"/xla-cache": compile_cache},
+    enable_memory_snapshot=True,
+    timeout=600,
+)
+class Embedder:
+    @mtpu.enter(snap=True)
+    def load(self):
+        """Everything here is snapshot-eligible: model build + compile."""
+        import jax
+
+        try:
+            jax.config.update("jax_compilation_cache_dir", "/xla-cache")
+        except Exception:
+            pass
+        from modal_examples_tpu.models import bert
+
+        self.cfg = bert.BertConfig.tiny()
+        self.params = bert.init_params(jax.random.PRNGKey(0), self.cfg)
+        t0 = time.time()
+        self._embed = jax.jit(lambda p, t: bert.embed(p, t, None, self.cfg))
+        import numpy as np
+
+        self._embed(self.params, np.zeros((4, 32), np.int32)).block_until_ready()
+        self.compile_s = time.time() - t0
+        compile_cache.commit()  # publish cache entries for the next replica
+
+    @mtpu.method()
+    def embed(self, texts: list[str]) -> dict:
+        import numpy as np
+
+        from modal_examples_tpu.utils.tokenizer import ByteTokenizer
+
+        tok = ByteTokenizer()
+        ids = np.zeros((4, 32), np.int32)
+        for i, t in enumerate(texts[:4]):
+            enc = tok.encode(t)[:32]
+            ids[i, : len(enc)] = enc
+        out = self._embed(self.params, ids)
+        return {"dim": int(out.shape[1]), "compile_s": self.compile_s}
+
+
+@app.local_entrypoint()
+def main():
+    e = Embedder()
+    r = e.embed.remote(["snapshot me"])
+    print(f"embed dim={r['dim']}, enter-stage compile took {r['compile_s']:.2f}s")
+    print("subsequent replicas hit the persistent compile cache on the volume")

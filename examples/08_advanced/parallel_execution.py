@@ -1,0 +1,35 @@
+# # Spawn, gather, and cross-process polling
+#
+# Counterpart of 08_advanced/parallel_execution.py:33-48 (spawn + gather)
+# and poll_delayed_result.py (`FunctionCall.from_id` from another process).
+
+import time
+
+import modal_examples_tpu as mtpu
+
+app = mtpu.App("example-parallel-execution")
+
+
+@app.function(timeout=120)
+def slow_square(x: int) -> int:
+    time.sleep(0.5)
+    return x * x
+
+
+@app.local_entrypoint()
+def main():
+    t0 = time.monotonic()
+    calls = [slow_square.spawn(i) for i in range(6)]
+    # fire-and-forget: all six run concurrently across containers
+    results = mtpu.gather(*calls)
+    elapsed = time.monotonic() - t0
+    print(f"gathered {results} in {elapsed:.2f}s")
+    assert results == [i * i for i in range(6)]
+    assert elapsed < 6 * 0.5  # genuinely parallel
+
+    # poll a call by id, as a separate client process would
+    # (poll_delayed_result.py pattern)
+    call = slow_square.spawn(9)
+    call_id = call.object_id
+    print("polling call id:", call_id)
+    assert mtpu.FunctionCall.from_id(call_id).get(timeout=30) == 81

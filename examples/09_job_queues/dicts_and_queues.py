@@ -1,0 +1,53 @@
+# # Distributed coordination with Dicts and Queues
+#
+# Counterpart of 09_job_queues/dicts_and_queues.py:53-80 — a crawler-shaped
+# workload: a shared Queue feeds worker containers, a shared Dict collects
+# results and carries the termination signal.
+
+import modal_examples_tpu as mtpu
+
+app = mtpu.App("example-dicts-queues")
+
+# a tiny synthetic "site graph" standing in for the web (zero-egress)
+SITE = {
+    "root": ["a", "b"],
+    "a": ["c", "d"],
+    "b": ["d", "e"],
+    "c": [], "d": ["f"], "e": [], "f": [],
+}
+
+
+@app.function(timeout=120, max_containers=4)
+def crawler_worker(worker_id: int, queue_name: str, dict_name: str) -> int:
+    frontier = mtpu.Queue.from_name(queue_name)
+    seen = mtpu.Dict.from_name(dict_name)
+    crawled = 0
+    while True:
+        try:
+            url = frontier.get(timeout=1.0)
+        except Exception:
+            break  # drained
+        if url == "__stop__":
+            break
+        if not seen.put_if_absent(url, worker_id):
+            continue  # another worker claimed it
+        crawled += 1
+        for link in SITE.get(url, []):
+            if link not in seen:
+                frontier.put(link)
+    return crawled
+
+
+@app.local_entrypoint()
+def main(n_workers: int = 3):
+    with mtpu.Queue.ephemeral() as frontier, mtpu.Dict.ephemeral() as seen:
+        frontier.put("root")
+        counts = list(
+            crawler_worker.starmap(
+                [(i, frontier.name, seen.name) for i in range(n_workers)]
+            )
+        )
+        crawled = set(seen.keys())
+    print(f"workers crawled {counts} -> {sorted(crawled)}")
+    assert crawled == set(SITE)
+    assert sum(counts) == len(SITE)

@@ -1,0 +1,53 @@
+# # Async job queue: web frontend spawns TPU jobs
+#
+# Counterpart of 09_job_queues/doc_ocr_jobs.py + doc_ocr_webapp.py — a web
+# endpoint accepts work, `.spawn`s it onto accelerator containers, returns a
+# call id immediately, and a second endpoint polls for the result
+# (the 1M-queued-inputs pattern, amazon_embeddings.py:18).
+
+import modal_examples_tpu as mtpu
+
+app = mtpu.App("example-doc-jobs")
+
+
+@app.function(timeout=300)
+def process_document(text: str) -> dict:
+    """The 'OCR' stage — here a cheap summarizer standing in for the model."""
+    words = text.split()
+    return {
+        "words": len(words),
+        "summary": " ".join(words[:8]) + ("..." if len(words) > 8 else ""),
+    }
+
+
+@app.function()
+@mtpu.fastapi_endpoint(method="POST")
+def submit(text: str) -> dict:
+    call = process_document.spawn(text)
+    return {"call_id": call.object_id}
+
+
+@app.function()
+@mtpu.fastapi_endpoint()
+def result(call_id: str) -> dict:
+    try:
+        return {"status": "done", "result": mtpu.FunctionCall.from_id(call_id).get(timeout=0.1)}
+    except TimeoutError:
+        return {"status": "pending"}
+
+
+@app.local_entrypoint()
+def main():
+    import time
+
+    call = process_document.spawn("the quick brown fox jumps over the lazy dog " * 4)
+    print("submitted:", call.object_id)
+    while True:
+        try:
+            out = mtpu.FunctionCall.from_id(call.object_id).get(timeout=0.2)
+            break
+        except TimeoutError:
+            print("pending...")
+            time.sleep(0.2)
+    print("result:", out)
+    assert out["words"] == 36

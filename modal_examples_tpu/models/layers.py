@@ -152,7 +152,9 @@ def causal_self_attention(
 
 
 def init_dense(key, shape, scale: float | None = None, dtype=jnp.bfloat16):
-    fan_in = shape[0]
+    # fan-in is the contraction dim: shape[-2] for (possibly layer-stacked)
+    # [..., in, out] weights, not shape[0] (which is n_layers when stacked)
+    fan_in = shape[-2] if len(shape) >= 2 else shape[0]
     if scale is None:
         scale = fan_in**-0.5
     # sample directly in the target dtype: a 7B bf16 init must never

@@ -123,6 +123,17 @@ class Dict:
             self._store.save(d)
             return val
 
+    def put_if_absent(self, key, value) -> bool:
+        """Atomically claim ``key``; True iff this caller won (the primitive
+        behind exactly-once work claiming in the crawler pattern)."""
+        with self._store.locked():
+            d = self._store.load()
+            if key in d:
+                return False
+            d[key] = value
+            self._store.save(d)
+            return True
+
     def update(self, **kwargs) -> None:
         with self._store.locked():
             d = self._store.load()

@@ -65,8 +65,10 @@ def log_mel_spectrogram(
     if pad_to_chunk:
         target = CHUNK_SECONDS * SAMPLE_RATE
         audio = np.pad(audio[:target], (0, max(0, target - len(audio))))
+    elif len(audio) < N_FFT:  # guarantee at least one frame
+        audio = np.pad(audio, (0, N_FFT - len(audio)))
     window = np.hanning(N_FFT + 1)[:-1].astype(np.float32)
-    n_frames = 1 + (len(audio) - N_FFT) // HOP if len(audio) >= N_FFT else 0
+    n_frames = 1 + (len(audio) - N_FFT) // HOP
     frames = np.lib.stride_tricks.as_strided(
         audio,
         shape=(n_frames, N_FFT),

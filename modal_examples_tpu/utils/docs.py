@@ -1,0 +1,81 @@
+"""Literate-example tooling: discovery + markdown rendering.
+
+Reference parity (SURVEY.md §4): examples ARE the docs — `# `-prefixed
+comment blocks render to markdown with code in fences
+(internal/utils.py:46-84 render_example_md); discovery walks the numbered
+example dirs (internal/utils.py:153-161).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import re
+from pathlib import Path
+
+_SKIP_DIRS = {"internal", "misc", "__pycache__"}
+
+
+@dataclasses.dataclass
+class Example:
+    path: Path
+    module_name: str
+    category: str  # e.g. "01_getting_started"
+
+    @property
+    def repo_relative(self) -> str:
+        return str(self.path)
+
+
+def repo_root() -> Path:
+    return Path(__file__).resolve().parents[2]
+
+
+def get_examples(root: Path | None = None) -> list[Example]:
+    """Walk the numbered example dirs, skipping internal/ and misc/."""
+    root = root or (repo_root() / "examples")
+    out: list[Example] = []
+    if not root.exists():
+        return out
+    for cat_dir in sorted(root.iterdir()):
+        if not cat_dir.is_dir() or cat_dir.name in _SKIP_DIRS:
+            continue
+        for py in sorted(cat_dir.rglob("*.py")):
+            if py.name.startswith("_") or "__pycache__" in py.parts:
+                continue
+            out.append(
+                Example(
+                    path=py.relative_to(root.parent),
+                    module_name=py.stem,
+                    category=cat_dir.name,
+                )
+            )
+    return out
+
+
+def render_example_md(source: str) -> str:
+    """Render a literate example: `# ` comment blocks become prose, code
+    becomes fenced blocks. The `# # Title` convention maps to headings."""
+    lines = source.splitlines()
+    out: list[str] = []
+    code_buf: list[str] = []
+
+    def flush_code():
+        while code_buf and not code_buf[0].strip():
+            code_buf.pop(0)
+        while code_buf and not code_buf[-1].strip():
+            code_buf.pop()
+        if code_buf:
+            out.append("```python")
+            out.extend(code_buf)
+            out.append("```")
+            code_buf.clear()
+
+    for line in lines:
+        m = re.match(r"^# ?(.*)$", line)
+        if m and not line.startswith("#!"):
+            flush_code()
+            out.append(m.group(1))
+        else:
+            code_buf.append(line)
+    flush_code()
+    return "\n".join(out).strip() + "\n"

@@ -1,0 +1,55 @@
+"""Example-corpus tests — the reference's internal/examples_test.py shape
+(SURVEY.md §4): parametrize over every discovered example; each must have a
+sane path, import cleanly, register an App, and render to non-empty docs."""
+
+import importlib.util
+import re
+import sys
+
+import pytest
+
+from modal_examples_tpu.utils.docs import get_examples, render_example_md, repo_root
+
+EXAMPLES = get_examples()
+IDS = [str(e.path) for e in EXAMPLES]
+
+
+def _import_example(example):
+    path = repo_root() / example.path
+    parent = str(path.parent)
+    if parent not in sys.path:
+        sys.path.insert(0, parent)
+    spec = importlib.util.spec_from_file_location(
+        f"example_{example.module_name}", path
+    )
+    module = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(module)
+    return module
+
+
+def test_examples_discovered():
+    assert len(EXAMPLES) >= 10
+    assert any(e.category == "01_getting_started" for e in EXAMPLES)
+
+
+@pytest.mark.parametrize("example", EXAMPLES, ids=IDS)
+def test_filename(example):
+    assert re.match(r"^[a-z0-9_\-]+\.py$", example.path.name), example.path
+
+
+@pytest.mark.parametrize("example", EXAMPLES, ids=IDS)
+def test_import_and_app(example):
+    import modal_examples_tpu as mtpu
+
+    module = _import_example(example)
+    apps = [v for v in vars(module).values() if isinstance(v, mtpu.App)]
+    assert apps, f"{example.path} defines no App"
+    assert apps[0].name.startswith("example-"), apps[0].name
+
+
+@pytest.mark.parametrize("example", EXAMPLES, ids=IDS)
+def test_render_docs(example):
+    src = (repo_root() / example.path).read_text()
+    md = render_example_md(src)
+    assert len(md) > 100
+    assert md.splitlines()[0].startswith("#"), "first line should be a heading"
