@@ -1,0 +1,53 @@
+# # Profiling TPU workloads
+#
+# Counterpart of 06_gpu_and_ml/torch_profiling.py — a generic `profile`
+# Function that wraps any registered Function by name (:131-135), runs it
+# under the profiler with warmup/active scheduling (:141-161), writes
+# TensorBoard-compatible traces to a Volume (:116), and prints a summary
+# (:164-167). TPU flavor: jax.profiler XPlane traces + HBM stats instead of
+# torch.profiler + nvidia-smi.
+#
+# Run: tpurun run examples/06_gpu_and_ml/tpu_profiling.py
+
+import os
+
+import modal_examples_tpu as mtpu
+from modal_examples_tpu.utils.profiling import make_profile_function
+
+TPU = os.environ.get("MTPU_TPU", "") or None
+
+app = mtpu.App("example-tpu-profiling")
+traces_vol = mtpu.Volume.from_name("profiler-traces", create_if_missing=True)
+
+
+@app.function(tpu=TPU, timeout=600)
+def matmul_workload(n: int = 512) -> float:
+    """A candidate workload to profile."""
+    import jax
+    import jax.numpy as jnp
+
+    x = jnp.ones((n, n), jnp.bfloat16)
+    y = jax.jit(lambda a: a @ a @ a)(x)
+    return float(jnp.sum(y.astype(jnp.float32)))
+
+
+@app.function(tpu=TPU, timeout=120)
+def hbm_stats() -> dict:
+    from modal_examples_tpu.utils.profiling import device_memory_stats
+
+    return device_memory_stats()
+
+
+profile = make_profile_function(app, trace_volume=traces_vol)
+
+
+@app.local_entrypoint()
+def main():
+    result = profile.remote("matmul_workload", 256, iterations=5)
+    print("profile result:", {k: result[k] for k in ("iterations", "per_iter_s")})
+    assert result["iterations"] == 5
+    traces_vol.reload()
+    traces = list(traces_vol.listdir("/", recursive=True))
+    print(f"{len(traces)} trace files on the volume (serve with TensorBoard)")
+    assert traces, "profiler wrote no trace"
+    print("HBM stats:", hbm_stats.remote())

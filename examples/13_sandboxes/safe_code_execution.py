@@ -1,0 +1,55 @@
+# # Safe code execution in sandboxes
+#
+# Counterpart of 13_sandboxes/safe_code_execution.py:21-41 — run untrusted
+# (e.g. LLM-generated) code in an isolated sandbox with an exec API and
+# streamed output, plus the warm-pool pattern from sandbox_pool.py:6-30.
+
+import sys
+
+import modal_examples_tpu as mtpu
+
+app = mtpu.App("example-safe-code-execution")
+
+UNTRUSTED_CODE = """
+import os
+print("hello from the sandbox")
+print("cwd:", os.getcwd())
+print("secret env leaked:", "MTPU_STATE_DIR" in os.environ)
+total = sum(i * i for i in range(10))
+print("computed:", total)
+"""
+
+
+@app.local_entrypoint()
+def main():
+    sb = mtpu.Sandbox.create(timeout=60)
+    try:
+        # write the code into the sandbox filesystem, then execute it
+        with sb.open("job.py", "w") as f:
+            f.write(UNTRUSTED_CODE)
+        proc = sb.exec(sys.executable, "job.py")
+        out = proc.stdout.read()
+        code = proc.wait()
+        print(out)
+        assert code == 0
+        assert "computed: 285" in out
+        assert "secret env leaked: False" in out  # env was scrubbed
+
+        # a failing command surfaces its stderr and exit code
+        bad = sb.exec(sys.executable, "-c", "raise ValueError('nope')")
+        assert bad.wait() != 0
+        assert "ValueError" in bad.stderr.read()
+
+        # warm pool: sandboxes registered in a Queue, claimed by workers
+        with mtpu.Queue.ephemeral() as pool:
+            for _ in range(2):
+                warm = mtpu.Sandbox.create(timeout=60)
+                pool.put(warm.object_id)
+            claimed = mtpu.Sandbox.from_id(pool.get())
+            p = claimed.exec(sys.executable, "-c", "print(6*7)")
+            assert p.stdout.read().strip() == "42"
+            claimed.cleanup()
+            mtpu.Sandbox.from_id(pool.get()).cleanup()
+        print("sandbox exec, isolation, and warm pool OK")
+    finally:
+        sb.cleanup()

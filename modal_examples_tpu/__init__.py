@@ -38,6 +38,7 @@ from .core.function import (
 from .core.image import Image
 from .core.resources import TPUSpec, parse_tpu_spec
 from .core.retries import Retries
+from .core.sandbox import ContainerProcess, Sandbox, forward
 from .core.schedules import Cron, Period
 from .core.serialization import RemoteError
 from .storage.dict_queue import Dict, Queue
@@ -89,6 +90,7 @@ __all__ = [
     "Queue",
     "RemoteError",
     "Retries",
+    "Sandbox",
     "Secret",
     "TPUSpec",
     "Volume",

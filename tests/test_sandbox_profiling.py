@@ -1,0 +1,109 @@
+"""Sandbox exec API + profiler wrapper tests."""
+
+import sys
+
+import pytest
+
+import modal_examples_tpu as mtpu
+
+
+class TestSandbox:
+    def test_exec_streams_and_exit_codes(self):
+        sb = mtpu.Sandbox.create(timeout=60)
+        try:
+            p = sb.exec(sys.executable, "-c", "print('out'); import sys; print('err', file=sys.stderr)")
+            assert p.wait() == 0
+            assert p.stdout.read().strip() == "out"
+            assert p.stderr.read().strip() == "err"
+            bad = sb.exec(sys.executable, "-c", "raise SystemExit(3)")
+            assert bad.wait() == 3
+        finally:
+            sb.cleanup()
+
+    def test_env_scrubbed(self):
+        import os
+
+        os.environ["SUPER_SECRET_TEST_VAR"] = "leak-me"
+        try:
+            sb = mtpu.Sandbox.create(timeout=30)
+            p = sb.exec(
+                sys.executable, "-c",
+                "import os; print('SUPER_SECRET_TEST_VAR' in os.environ)",
+            )
+            p.wait()
+            assert p.stdout.read().strip() == "False"
+            sb.cleanup()
+        finally:
+            del os.environ["SUPER_SECRET_TEST_VAR"]
+
+    def test_secrets_and_image_env_injected(self):
+        img = mtpu.Image.debian_slim().env({"FROM_IMAGE": "yes"})
+        sec = mtpu.Secret.from_dict({"FROM_SECRET": "yes"})
+        sb = mtpu.Sandbox.create(image=img, secrets=[sec], timeout=30)
+        p = sb.exec(
+            sys.executable, "-c",
+            "import os; print(os.environ['FROM_IMAGE'], os.environ['FROM_SECRET'])",
+        )
+        p.wait()
+        assert p.stdout.read().strip() == "yes yes"
+        sb.cleanup()
+
+    def test_open_confined_to_sandbox(self):
+        sb = mtpu.Sandbox.create(timeout=30)
+        with sb.open("notes/x.txt", "w") as f:
+            f.write("hi")
+        with sb.open("notes/x.txt") as f:
+            assert f.read() == "hi"
+        with pytest.raises(PermissionError):
+            sb.open("../../etc/passwd")
+        sb.cleanup()
+
+    def test_volume_mount(self):
+        vol = mtpu.Volume.from_name("sb-test-vol", create_if_missing=True)
+        vol.write_file("data.txt", b"volume-data")
+        sb = mtpu.Sandbox.create(volumes={"/data": vol}, timeout=30)
+        p = sb.exec(sys.executable, "-c", "print(open('data/data.txt').read())")
+        p.wait()
+        assert p.stdout.read().strip() == "volume-data"
+        sb.cleanup()
+
+    def test_terminate_kills_processes(self):
+        import time
+
+        sb = mtpu.Sandbox.create(timeout=60)
+        p = sb.exec(sys.executable, "-c", "import time; time.sleep(60)")
+        assert sb.poll() is None
+        sb.terminate()
+        time.sleep(0.3)
+        assert p.poll() is not None
+        sb.cleanup()
+
+    def test_from_id_and_list(self):
+        sb = mtpu.Sandbox.create(timeout=30)
+        assert mtpu.Sandbox.from_id(sb.object_id) is sb
+        assert sb in mtpu.Sandbox.list()
+        sb.cleanup()
+        assert sb not in mtpu.Sandbox.list()
+
+    def test_forward_tunnel(self):
+        with mtpu.forward(8123) as tunnel:
+            assert tunnel.url == "http://127.0.0.1:8123"
+
+
+class TestProfiling:
+    def test_profile_call(self, jax_cpu, tmp_path):
+        import jax.numpy as jnp
+
+        from modal_examples_tpu.utils.profiling import profile_call
+
+        jax = jax_cpu
+        f = jax.jit(lambda x: x @ x)
+        x = jnp.ones((64, 64))
+        out, result = profile_call(
+            f, x, warmup=1, iterations=3, trace_dir=tmp_path / "trace"
+        )
+        assert out.shape == (64, 64)
+        assert result.iterations == 3
+        assert result.per_iter_s > 0
+        assert list((tmp_path / "trace").rglob("*")), "no trace written"
+        assert "per-iteration" in result.summary()
