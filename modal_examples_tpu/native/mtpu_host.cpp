@@ -1,0 +1,138 @@
+// mtpu_host: native host-side runtime for the serving/data path.
+//
+// The reference's serving engines keep their host-side hot paths native
+// (vLLM's C++ block manager + scheduler, TEI's Rust tokenization server,
+// TRT-LLM's C++ runtime — SURVEY.md §2.4). This library is the TPU
+// framework's equivalent: the per-step host work that sits between Python
+// orchestration and the XLA device step.
+//
+//   1. KV page allocator: thread-safe free-list over physical page ids
+//      (page 0 reserved as the trash page).
+//   2. Batched byte tokenization: UTF-8 text -> padded int32 id/mask
+//      matrices in one call (the request-assembly hot path: one C call per
+//      admitted batch instead of a Python loop per token).
+//   3. Levenshtein distance over token sequences (WER/CER eval tier).
+//
+// C ABI only (loaded via ctypes — no pybind11 in the image). Every entry
+// point is exception-free and returns negative codes on error.
+
+#include <algorithm>
+#include <cstdint>
+#include <cstring>
+#include <mutex>
+#include <vector>
+
+extern "C" {
+
+// ---------------------------------------------------------------------------
+// 1. Page allocator
+// ---------------------------------------------------------------------------
+
+struct MtpuAllocator {
+  std::vector<int32_t> free_list;
+  std::mutex mu;
+  int32_t n_pages;
+};
+
+void* mtpu_alloc_create(int32_t n_pages) {
+  if (n_pages < 2) return nullptr;
+  auto* a = new (std::nothrow) MtpuAllocator();
+  if (!a) return nullptr;
+  a->n_pages = n_pages;
+  a->free_list.reserve(n_pages - 1);
+  // page 0 reserved; pop() yields low ids first (matches the Python impl)
+  for (int32_t p = n_pages - 1; p >= 1; --p) a->free_list.push_back(p);
+  return a;
+}
+
+void mtpu_alloc_destroy(void* handle) {
+  delete static_cast<MtpuAllocator*>(handle);
+}
+
+// Returns 0 on success (ids written to out), -1 if not enough pages.
+int32_t mtpu_alloc_alloc(void* handle, int32_t n, int32_t* out) {
+  auto* a = static_cast<MtpuAllocator*>(handle);
+  std::lock_guard<std::mutex> lock(a->mu);
+  if (n < 0 || static_cast<size_t>(n) > a->free_list.size()) return -1;
+  for (int32_t i = 0; i < n; ++i) {
+    out[i] = a->free_list.back();
+    a->free_list.pop_back();
+  }
+  return 0;
+}
+
+int32_t mtpu_alloc_free(void* handle, const int32_t* ids, int32_t n) {
+  auto* a = static_cast<MtpuAllocator*>(handle);
+  std::lock_guard<std::mutex> lock(a->mu);
+  for (int32_t i = 0; i < n; ++i) {
+    if (ids[i] > 0 && ids[i] < a->n_pages) a->free_list.push_back(ids[i]);
+  }
+  return 0;
+}
+
+int32_t mtpu_alloc_available(void* handle) {
+  auto* a = static_cast<MtpuAllocator*>(handle);
+  std::lock_guard<std::mutex> lock(a->mu);
+  return static_cast<int32_t>(a->free_list.size());
+}
+
+// ---------------------------------------------------------------------------
+// 2. Batched byte tokenization
+// ---------------------------------------------------------------------------
+
+// texts: n concatenated byte strings with lengths[], encoded into
+// out_ids/out_mask [n, max_len] row-major. bos_id < 0 disables BOS.
+// pad_id fills the tail. Returns the max true length (for bucket picking).
+int32_t mtpu_byte_encode_batch(const uint8_t* data, const int64_t* lengths,
+                               int32_t n, int32_t max_len, int32_t bos_id,
+                               int32_t pad_id, int32_t* out_ids,
+                               int32_t* out_mask) {
+  int32_t max_true = 0;
+  int64_t offset = 0;
+  for (int32_t i = 0; i < n; ++i) {
+    const uint8_t* s = data + offset;
+    int64_t len = lengths[i];
+    offset += len;
+    int32_t* ids = out_ids + static_cast<int64_t>(i) * max_len;
+    int32_t* mask = out_mask + static_cast<int64_t>(i) * max_len;
+    int32_t j = 0;
+    if (bos_id >= 0 && j < max_len) {
+      ids[j] = bos_id;
+      mask[j] = 1;
+      ++j;
+    }
+    for (int64_t k = 0; k < len && j < max_len; ++k, ++j) {
+      ids[j] = static_cast<int32_t>(s[k]);
+      mask[j] = 1;
+    }
+    if (j > max_true) max_true = j;
+    for (; j < max_len; ++j) {
+      ids[j] = pad_id;
+      mask[j] = 0;
+    }
+  }
+  return max_true;
+}
+
+// ---------------------------------------------------------------------------
+// 3. Levenshtein distance (token ids)
+// ---------------------------------------------------------------------------
+
+int32_t mtpu_levenshtein(const int32_t* a, int32_t la, const int32_t* b,
+                         int32_t lb) {
+  if (la == 0) return lb;
+  if (lb == 0) return la;
+  std::vector<int32_t> prev(lb + 1), cur(lb + 1);
+  for (int32_t j = 0; j <= lb; ++j) prev[j] = j;
+  for (int32_t i = 1; i <= la; ++i) {
+    cur[0] = i;
+    for (int32_t j = 1; j <= lb; ++j) {
+      int32_t sub = prev[j - 1] + (a[i - 1] != b[j - 1] ? 1 : 0);
+      cur[j] = std::min({prev[j] + 1, cur[j - 1] + 1, sub});
+    }
+    std::swap(prev, cur);
+  }
+  return prev[lb];
+}
+
+}  // extern "C"

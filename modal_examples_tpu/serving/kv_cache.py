@@ -65,13 +65,22 @@ class PagedKVCache:
         n_pages: int,
         page_size: int = 16,
         dtype=jnp.bfloat16,
+        prefer_native: bool = True,
     ) -> "PagedKVCache":
         shape = (n_layers, n_kv_heads, n_pages, page_size, head_dim)
+        allocator = None
+        if prefer_native:
+            try:  # C++ free list (native/mtpu_host.cpp); same semantics
+                from ..native import NativePageAllocator
+
+                allocator = NativePageAllocator(n_pages)
+            except Exception:
+                allocator = None
         return cls(
             k_pages=jnp.zeros(shape, dtype),
             v_pages=jnp.zeros(shape, dtype),
             page_size=page_size,
-            allocator=PageAllocator(n_pages),
+            allocator=allocator or PageAllocator(n_pages),
         )
 
     @property

@@ -1,0 +1,90 @@
+"""Native (C++) host library tests: build, semantics parity with the Python
+fallbacks, and thread safety under contention."""
+
+import threading
+
+import numpy as np
+import pytest
+
+from modal_examples_tpu import native
+
+
+@pytest.fixture(scope="module")
+def lib():
+    lib = native.load()
+    if lib is None:
+        pytest.skip("g++ unavailable; native library not built")
+    return lib
+
+
+class TestNativeAllocator:
+    def test_semantics_match_python(self, lib):
+        from modal_examples_tpu.serving.kv_cache import OutOfPages, PageAllocator
+
+        n = native.NativePageAllocator(16)
+        p = PageAllocator(16)
+        assert n.available == p.available == 15
+        na, pa = n.alloc(5), p.alloc(5)
+        assert na == pa  # same low-ids-first order
+        assert 0 not in na
+        n.free(na[:2])
+        p.free(pa[:2])
+        assert n.available == p.available
+        with pytest.raises(OutOfPages):
+            n.alloc(100)
+
+    def test_thread_safety(self, lib):
+        alloc = native.NativePageAllocator(1025)
+        got, lock = [], threading.Lock()
+
+        def worker():
+            mine = []
+            for _ in range(16):
+                mine.extend(alloc.alloc(4))
+            with lock:
+                got.extend(mine)
+
+        threads = [threading.Thread(target=worker) for _ in range(8)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        assert len(got) == 8 * 64
+        assert len(set(got)) == len(got)  # no page double-allocated
+        assert alloc.available == 1024 - len(got)
+
+    def test_engine_uses_native_allocator(self, lib, jax_cpu):
+        from modal_examples_tpu.serving.kv_cache import PagedKVCache
+
+        cache = PagedKVCache.create(
+            n_layers=1, n_kv_heads=1, head_dim=8, n_pages=8, page_size=4
+        )
+        assert type(cache.allocator).__name__ == "NativePageAllocator"
+
+
+class TestNativeEncode:
+    def test_matches_fallback(self, lib):
+        texts = ["hello", "", "tpu systolic array", "ünïcødé"]
+        ids_n, mask_n, mt_n = native.byte_encode_batch(texts, 16)
+        # force the fallback path
+        orig, native._lib = native._lib, None
+        try:
+            ids_p, mask_p, mt_p = native.byte_encode_batch(texts, 16)
+        finally:
+            native._lib = orig
+        np.testing.assert_array_equal(ids_n, ids_p)
+        np.testing.assert_array_equal(mask_n, mask_p)
+        assert mt_n == mt_p
+
+    def test_truncation(self, lib):
+        ids, mask, mt = native.byte_encode_batch(["x" * 100], 8)
+        assert mask[0].sum() == 8
+        assert mt == 8
+
+
+class TestNativeLevenshtein:
+    def test_known_distances(self, lib):
+        assert native.levenshtein_ids([1, 2, 3], [1, 2, 3]) == 0
+        assert native.levenshtein_ids([1, 2, 3], [1, 9, 3]) == 1
+        assert native.levenshtein_ids([], [1, 2]) == 2
+        assert native.levenshtein_ids([1, 2, 3, 4], [2, 3]) == 2

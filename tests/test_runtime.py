@@ -233,8 +233,9 @@ class TestConcurrency:
             out = list(slow_echo.map(range(4)))
             elapsed = time.monotonic() - t0
         assert sorted(out) == [0, 1, 2, 3]
-        # 4 overlapping 0.4s sleeps in one container beat 4 serial ones
-        assert elapsed < 1.4
+        # 4 overlapping 0.4s sleeps beat 4 serial ones (1.6s+); generous
+        # headroom for loaded CI machines
+        assert elapsed < 1.55, elapsed
 
     def test_autoscale_fan_out(self):
         sapp = mtpu.App("scale-test")
