@@ -70,12 +70,17 @@ class Embedder:
     def _encode_batch(self, texts: list[str]):
         import numpy as np
 
-        toks = np.full((len(texts), MAX_SEQ), 0, np.int32)
-        mask = np.zeros((len(texts), MAX_SEQ), np.int32)
-        for i, s in enumerate(texts):
-            ids = self.tokenizer.encode(s)[:MAX_SEQ]
-            toks[i, : len(ids)] = ids
-            mask[i, : len(ids)] = 1
+        if hasattr(self.tokenizer, "encode_batch"):
+            # one native call builds the padded id/mask matrices
+            toks, mask = self.tokenizer.encode_batch(texts, MAX_SEQ)
+            toks = toks % self.cfg.vocab_size
+        else:
+            toks = np.full((len(texts), MAX_SEQ), 0, np.int32)
+            mask = np.zeros((len(texts), MAX_SEQ), np.int32)
+            for i, s in enumerate(texts):
+                ids = self.tokenizer.encode(s)[:MAX_SEQ]
+                toks[i, : len(ids)] = ids
+                mask[i, : len(ids)] = 1
         # always pad to the single compiled shape: no serve-time retraces
         assert len(texts) <= MAX_BATCH, (len(texts), MAX_BATCH)
         pad_to = MAX_BATCH

@@ -1,0 +1,90 @@
+# # Hosting WSGI and ASGI apps
+#
+# Counterpart of the reference's `@modal.wsgi_app` (torch_profiling.py:301
+# hosts TensorBoard) and `@modal.asgi_app` (text_to_image.py:239 hosts a
+# FastAPI UI): the decorated function RETURNS the app object, and the web
+# layer serves it. Works with any WSGI/ASGI framework; shown here with
+# dependency-free apps.
+#
+# Serve: tpurun serve examples/07_web/wsgi_asgi_apps.py
+
+import json
+
+import modal_examples_tpu as mtpu
+
+app = mtpu.App("example-wsgi-asgi")
+
+
+@app.function()
+@mtpu.wsgi_app()
+def wsgi_echo():
+    """A minimal WSGI app (Flask & friends drop in the same way)."""
+
+    def application(environ, start_response):
+        body = json.dumps(
+            {
+                "framework": "wsgi",
+                "path": environ["PATH_INFO"],
+                "method": environ["REQUEST_METHOD"],
+            }
+        ).encode()
+        start_response(
+            "200 OK",
+            [("content-type", "application/json")],
+        )
+        return [body]
+
+    return application
+
+
+@app.function()
+@mtpu.asgi_app()
+def asgi_echo():
+    """A minimal ASGI app (FastAPI/Starlette drop in the same way)."""
+
+    async def application(scope, receive, send):
+        assert scope["type"] == "http"
+        message = await receive()
+        body = json.dumps(
+            {
+                "framework": "asgi",
+                "path": scope["path"],
+                "method": scope["method"],
+                "received_bytes": len(message.get("body", b"")),
+            }
+        ).encode()
+        await send(
+            {
+                "type": "http.response.start",
+                "status": 200,
+                "headers": [(b"content-type", b"application/json")],
+            }
+        )
+        await send({"type": "http.response.body", "body": body})
+
+    return application
+
+
+@app.local_entrypoint()
+def main():
+    import urllib.request
+
+    from modal_examples_tpu.web.gateway import Gateway
+
+    with app.run():
+        gw = Gateway(app).start()
+        with urllib.request.urlopen(f"{gw.base_url}/wsgi_echo/hello") as r:
+            out = json.load(r)
+        print("wsgi:", out)
+        assert out == {"framework": "wsgi", "path": "/hello", "method": "GET"}
+
+        req = urllib.request.Request(
+            f"{gw.base_url}/asgi_echo/items", data=b'{"x": 1}',
+            headers={"content-type": "application/json"},
+        )
+        with urllib.request.urlopen(req) as r:
+            out = json.load(r)
+        print("asgi:", out)
+        assert out["framework"] == "asgi" and out["received_bytes"] == 8
+        gw.stop()
+        print("wsgi + asgi hosting OK")

@@ -1,0 +1,50 @@
+# # Dataset ingest to a cloud bucket mount
+#
+# Counterpart of 12_datasets/coco.py:26-54 and s3_bucket_mount.py — ingest
+# shards into a CloudBucketMount-backed path from parallel workers, with the
+# disk-space watchdog pattern (coco.py:38-54).
+
+import json
+
+import modal_examples_tpu as mtpu
+
+app = mtpu.App("example-dataset-ingest")
+bucket = mtpu.CloudBucketMount("example-datasets", key_prefix="tone-corpus")
+
+
+@app.function(timeout=600, max_containers=4)
+def ingest_shard(shard_id: int, n_items: int) -> dict:
+    """Generate one shard of (audio-features, transcript) records."""
+    import shutil
+
+    import numpy as np
+
+    from modal_examples_tpu.utils.audio import log_mel_spectrogram, synth_tone_audio
+
+    # disk-space watchdog (coco.py:38-54): bail before filling the disk
+    free_gb = shutil.disk_usage(bucket.local_path).free / 1e9
+    if free_gb < 1.0:
+        raise RuntimeError(f"only {free_gb:.1f}GB free; aborting ingest")
+
+    shard_dir = bucket.local_path / f"shard-{shard_id:04d}"
+    shard_dir.mkdir(parents=True, exist_ok=True)
+    rng = np.random.default_rng(shard_id)
+    for i in range(n_items):
+        freq = float(rng.uniform(200, 2000))
+        mel = log_mel_spectrogram(synth_tone_audio([freq], 0.5), pad_to_chunk=False)
+        np.save(shard_dir / f"mel-{i:05d}.npy", mel)
+    (shard_dir / "manifest.json").write_text(
+        json.dumps({"shard": shard_id, "items": n_items})
+    )
+    return {"shard": shard_id, "items": n_items}
+
+
+@app.local_entrypoint()
+def main(n_shards: int = 4, items_per_shard: int = 8):
+    results = list(
+        ingest_shard.starmap((i, items_per_shard) for i in range(n_shards))
+    )
+    total = sum(r["items"] for r in results)
+    manifests = sorted(bucket.local_path.glob("shard-*/manifest.json"))
+    print(f"ingested {total} items into {len(manifests)} shards at {bucket}")
+    assert len(manifests) == n_shards

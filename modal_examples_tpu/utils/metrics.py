@@ -23,13 +23,27 @@ def _levenshtein(a: list[str], b: list[str]) -> int:
     return prev[-1]
 
 
+def _edit_distance(a: list[str], b: list[str]) -> int:
+    """Native levenshtein over interned symbol ids when available."""
+    try:
+        from ..native import levenshtein_ids, load
+
+        if load() is not None:
+            vocab: dict[str, int] = {}
+            ids = lambda seq: [vocab.setdefault(w, len(vocab)) for w in seq]
+            return levenshtein_ids(ids(a), ids(b))
+    except Exception:
+        pass
+    return _levenshtein(a, b)
+
+
 def word_error_rate(references: list[str], hypotheses: list[str]) -> float:
     """Corpus-level WER: total edits / total reference words."""
     edits = 0
     words = 0
     for ref, hyp in zip(references, hypotheses):
         r, h = ref.split(), hyp.split()
-        edits += _levenshtein(r, h)
+        edits += _edit_distance(r, h)
         words += len(r)
     return edits / max(words, 1)
 

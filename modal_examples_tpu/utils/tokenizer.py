@@ -27,6 +27,18 @@ class ByteTokenizer:
         data = bytes(i for i in ids if i < 256)
         return data.decode("utf-8", errors="replace")
 
+    def encode_batch(self, texts: list[str], max_len: int, add_bos: bool = True):
+        """Batched encode -> padded (ids, mask) int32 matrices in one native
+        call (native/mtpu_host.cpp; numpy fallback inside)."""
+        from ..native import byte_encode_batch
+
+        ids, mask, _ = byte_encode_batch(
+            texts, max_len,
+            bos_id=self.bos_id if add_bos else -1,
+            pad_id=self.pad_id,
+        )
+        return ids, mask
+
     def apply_chat_template(self, messages: list[dict], **_) -> str:
         return (
             "\n".join(f"{m['role']}: {m['content']}" for m in messages)

@@ -56,6 +56,106 @@ class _Handler(BaseHTTPRequestHandler):
         label = path.path.strip("/").split("/")[0]
         return self.gateway.routes.get(label), path
 
+    # -- WSGI/ASGI hosting (the function RETURNS the app; we serve it) ------
+
+    def _read_request(self, parsed) -> tuple[bytes, str]:
+        """(body, decoded subpath below the route label)."""
+        length = int(self.headers.get("content-length") or 0)
+        body = self.rfile.read(length) if length else b""
+        raw = "/" + "/".join(parsed.path.strip("/").split("/")[1:])
+        return body, urllib.parse.unquote(raw)
+
+    def _send_payload(self, status: int, headers, payload: bytes) -> None:
+        self._started_response = True
+        self.send_response(status)
+        for k, v in headers:
+            k = k.decode() if isinstance(k, bytes) else k
+            v = v.decode() if isinstance(v, bytes) else v
+            if k.lower() != "content-length":
+                self.send_header(k, v)
+        self.send_header("content-length", str(len(payload)))
+        self.end_headers()
+        self.wfile.write(payload)
+
+    def _serve_wsgi(self, wsgi_app, parsed, method: str) -> None:
+        import io
+
+        body, subpath = self._read_request(parsed)
+        environ = {
+            "REQUEST_METHOD": method,
+            "PATH_INFO": subpath,
+            "QUERY_STRING": parsed.query or "",
+            "CONTENT_LENGTH": str(len(body)),
+            "CONTENT_TYPE": self.headers.get("content-type", ""),
+            "SERVER_NAME": self.gateway.host,
+            "SERVER_PORT": str(self.gateway.port),
+            "SERVER_PROTOCOL": "HTTP/1.1",
+            "wsgi.version": (1, 0),
+            "wsgi.url_scheme": "http",
+            "wsgi.input": io.BytesIO(body),
+            "wsgi.errors": io.StringIO(),
+            "wsgi.multithread": True,
+            "wsgi.multiprocess": False,
+            "wsgi.run_once": False,
+        }
+        for k, v in self.headers.items():
+            environ["HTTP_" + k.upper().replace("-", "_")] = v
+        status_headers = {}
+
+        def start_response(status, headers, exc_info=None):
+            status_headers["status"] = status
+            status_headers["headers"] = headers
+
+        result = wsgi_app(environ, start_response)
+        try:
+            payload = b"".join(result)
+        finally:
+            if hasattr(result, "close"):  # PEP 3333: server must call close()
+                result.close()
+        code = int(status_headers["status"].split()[0])
+        self._send_payload(code, status_headers["headers"], payload)
+
+    def _serve_asgi(self, asgi_app, parsed, method: str) -> None:
+        import asyncio
+
+        body, subpath = self._read_request(parsed)
+        scope = {
+            "type": "http",
+            "asgi": {"version": "3.0"},
+            "http_version": "1.1",
+            "method": method,
+            "path": subpath,
+            "raw_path": subpath.encode(),
+            "query_string": (parsed.query or "").encode(),
+            "headers": [
+                (k.lower().encode(), v.encode()) for k, v in self.headers.items()
+            ],
+            "server": (self.gateway.host, self.gateway.port),
+            "client": self.client_address,
+        }
+        received = {"sent": False}
+
+        async def receive():
+            if received["sent"]:
+                await asyncio.sleep(3600)
+            received["sent"] = True
+            return {"type": "http.request", "body": body, "more_body": False}
+
+        messages: list[dict] = []
+
+        async def send(message):
+            messages.append(message)
+
+        asyncio.run(asgi_app(scope, receive, send))
+        status = next(
+            (m for m in messages if m["type"] == "http.response.start"),
+            {"status": 500, "headers": []},
+        )
+        payload = b"".join(
+            m.get("body", b"") for m in messages if m["type"] == "http.response.body"
+        )
+        self._send_payload(status["status"], status.get("headers", []), payload)
+
     def _respond_json(self, code: int, obj) -> None:
         body = json.dumps(obj).encode()
         self.send_response(code)
@@ -71,6 +171,27 @@ class _Handler(BaseHTTPRequestHandler):
             return
         fn = route["function"]
         web = fn.spec.web
+        if web["type"] in ("wsgi_app", "asgi_app"):
+            # the function returns an app object, built once (under the
+            # route lock: concurrent first requests must not double-build)
+            with self.gateway.app_build_lock:
+                if "app_instance" not in route:
+                    route["app_instance"] = fn.raw_f()
+            self._started_response = False
+            try:
+                if web["type"] == "wsgi_app":
+                    self._serve_wsgi(route["app_instance"], parsed, method)
+                else:
+                    self._serve_asgi(route["app_instance"], parsed, method)
+            except (BrokenPipeError, ConnectionResetError):
+                self.close_connection = True
+            except BaseException as e:
+                if getattr(self, "_started_response", False):
+                    # response underway: a second status line would corrupt it
+                    self.close_connection = True
+                else:
+                    self._respond_json(500, {"error": f"{type(e).__name__}: {e}"})
+            return
         if web["type"] == "fastapi_endpoint" and web.get("method", "GET") != method:
             self._respond_json(405, {"error": f"method {method} not allowed"})
             return
@@ -134,6 +255,7 @@ class Gateway:
 
     def __init__(self, app, host: str = "127.0.0.1", port: int = 0):
         self.app = app
+        self.app_build_lock = threading.Lock()
         self.routes: dict[str, dict] = {}
         for name in app.registered_web_endpoints:
             fn = app.registered_functions[name]

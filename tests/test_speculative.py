@@ -1,0 +1,83 @@
+"""Speculative decoding correctness: greedy mode must reproduce the target
+model's greedy decode token-for-token; self-drafting must accept everything;
+stochastic mode must produce a full-length sample."""
+
+import numpy as np
+import pytest
+
+
+@pytest.fixture(scope="module")
+def jax(jax_cpu):
+    return jax_cpu
+
+
+@pytest.fixture(scope="module")
+def models(jax):
+    import jax.numpy as jnp
+
+    from modal_examples_tpu.models import llama
+
+    tcfg = llama.LlamaConfig(
+        vocab_size=64, dim=64, n_layers=2, n_heads=2, n_kv_heads=2,
+        ffn_dim=128, max_seq_len=128, dtype="float32",
+    )
+    dcfg = llama.LlamaConfig(
+        vocab_size=64, dim=32, n_layers=1, n_heads=2, n_kv_heads=2,
+        ffn_dim=64, max_seq_len=128, dtype="float32",
+    )
+    tp = llama.init_params(jax.random.PRNGKey(0), tcfg)
+    dp = llama.init_params(jax.random.PRNGKey(1), dcfg)
+    prompt = jnp.array([1, 2, 3, 4, 5, 6, 7, 8], jnp.int32)
+    return tcfg, dcfg, tp, dp, prompt
+
+
+class TestSpeculative:
+    def test_greedy_reproduces_target(self, jax, models):
+        from modal_examples_tpu.serving import speculative as spec
+
+        tcfg, dcfg, tp, dp, prompt = models
+        want = spec.greedy_generate(tp, tcfg, prompt, 8, 16)
+        buf, n = spec.speculative_generate(
+            tp, dp, tcfg, dcfg, prompt, 8, jax.random.PRNGKey(2),
+            max_new=16, gamma=4, greedy=True,
+        )
+        assert int(n) == 16
+        np.testing.assert_array_equal(np.asarray(buf), np.asarray(want))
+
+    def test_budget_truncation_exact(self, jax, models):
+        """gamma does NOT divide max_new: the final round's accepted run is
+        truncated by the budget and must still match target greedy exactly
+        (regression: duplicate-index scatter clobbered the last token)."""
+        from modal_examples_tpu.serving import speculative as spec
+
+        tcfg, _, tp, _, prompt = models
+        want = spec.greedy_generate(tp, tcfg, prompt, 8, 14)
+        buf, n = spec.speculative_generate(
+            tp, tp, tcfg, tcfg, prompt, 8, jax.random.PRNGKey(2),
+            max_new=14, gamma=4, greedy=True,
+        )
+        assert int(n) == 14
+        np.testing.assert_array_equal(np.asarray(buf), np.asarray(want))
+
+    def test_self_draft_accepts_everything(self, jax, models):
+        from modal_examples_tpu.serving import speculative as spec
+
+        tcfg, _, tp, _, prompt = models
+        want = spec.greedy_generate(tp, tcfg, prompt, 8, 16)
+        buf, n = spec.speculative_generate(
+            tp, tp, tcfg, tcfg, prompt, 8, jax.random.PRNGKey(2),
+            max_new=16, gamma=4, greedy=True,
+        )
+        np.testing.assert_array_equal(np.asarray(buf), np.asarray(want))
+
+    def test_stochastic_generates_full_length(self, jax, models):
+        from modal_examples_tpu.serving import speculative as spec
+
+        tcfg, dcfg, tp, dp, prompt = models
+        buf, n = spec.speculative_generate(
+            tp, dp, tcfg, dcfg, prompt, 8, jax.random.PRNGKey(3),
+            max_new=16, gamma=4, greedy=False, temperature=1.0,
+        )
+        assert int(n) == 16
+        out = np.asarray(buf[8:])
+        assert (out >= 0).all() and (out < tcfg.vocab_size).all()
