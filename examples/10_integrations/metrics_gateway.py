@@ -1,0 +1,52 @@
+# # Metrics for ephemeral containers (pushgateway pattern)
+#
+# Counterpart of 10_integrations/pushgateway.py:8-12,62-69 — scrape-based
+# Prometheus can't see short-lived containers, so workers PUSH metrics and a
+# gateway endpoint exposes the merged view. Here the registry, text
+# exposition, and aggregation are framework-native (no Go binary), with a
+# shared Dict as the push sink and a web endpoint as /metrics.
+#
+# Run: tpurun run examples/10_integrations/metrics_gateway.py
+
+import modal_examples_tpu as mtpu
+
+app = mtpu.App("example-metrics-gateway")
+metrics_store = mtpu.Dict.from_name("pushed-metrics")
+
+
+@app.function(max_containers=4)
+def worker(job_id: int, n_items: int) -> int:
+    """An ephemeral batch worker pushing its counters before exit."""
+    import time
+
+    from modal_examples_tpu.utils.prometheus import Registry, push_to_dict
+
+    reg = Registry()
+    for i in range(n_items):
+        time.sleep(0.01)
+        reg.counter_inc("items_processed_total", labels={"job": str(job_id)},
+                        help="items processed by batch workers")
+    reg.gauge_set("last_batch_size", n_items, labels={"job": str(job_id)})
+    push_to_dict(metrics_store, f"worker-{job_id}", reg)
+    return n_items
+
+
+@app.function()
+@mtpu.fastapi_endpoint()
+def metrics() -> str:
+    """The aggregated /metrics endpoint a Prometheus server would scrape."""
+    from modal_examples_tpu.utils.prometheus import aggregate_exposition
+
+    return aggregate_exposition(metrics_store)
+
+
+@app.local_entrypoint()
+def main():
+    metrics_store.clear()
+    totals = list(worker.starmap([(i, 5 + i) for i in range(3)]))
+    print("workers processed:", totals)
+    text = metrics.local()
+    print(text)
+    assert "items_processed_total" in text
+    assert all(f'job="{i}"' in text for i in range(3))
+    print("metrics aggregation OK")

@@ -1,0 +1,166 @@
+"""Tensor-parallel serving: sharded decode over a device mesh.
+
+The reference enables TP with engine flags (``--tensor-parallel-size``,
+vllm_inference.py:179-180; ``--tp-size`` very_large_models.py:247) and lets
+vLLM/SGLang drive NCCL. TPU-natively, TP serving is: params placed with the
+model's Megatron-layout partition specs over the ``tensor`` ICI axis, a
+dense KV cache sharded over the kv-head dimension, and ONE jitted decode
+step — XLA inserts the all-reduces. No engine subprocess, no NCCL, no
+per-rank code.
+
+The dense cache ([L, B, Hkv, S, D], in-place dynamic-update-slice writes)
+is the multi-chip counterpart of the single-chip paged cache: kv-head
+sharding keeps every cache byte and its attention math on the chip that owns
+the head. (Paged attention stays the single-chip fast path; a TP paged
+kernel via shard_map is a later-round item.)
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from ..models import llama, layers
+
+
+@dataclasses.dataclass
+class DenseKVCache:
+    k: jax.Array  # [L, B, Hkv, S, D]
+    v: jax.Array
+    _pytree = None
+
+    @classmethod
+    def create(cls, cfg: llama.LlamaConfig, batch: int, max_len: int, mesh=None, dtype=jnp.bfloat16):
+        shape = (cfg.n_layers, batch, cfg.n_kv_heads, max_len, cfg.head_dim)
+        k = jnp.zeros(shape, dtype)
+        v = jnp.zeros(shape, dtype)
+        if mesh is not None:
+            sh = NamedSharding(mesh, P(None, None, "tensor", None, None))
+            k, v = jax.device_put(k, sh), jax.device_put(v, sh)
+        return cls(k, v)
+
+
+jax.tree_util.register_dataclass(
+    DenseKVCache, data_fields=("k", "v"), meta_fields=()
+)
+
+
+def shard_params_tp(params: dict, cfg: llama.LlamaConfig, mesh: Mesh) -> dict:
+    """Place weights with the Megatron TP layout over the ``tensor`` axis."""
+    specs = llama.partition_specs(cfg)
+    return jax.tree.map(
+        lambda p, s: jax.device_put(p, NamedSharding(mesh, s)),
+        params,
+        specs,
+        is_leaf=lambda x: isinstance(x, P),
+    )
+
+
+@functools.partial(jax.jit, static_argnames=("cfg",), donate_argnums=(2,))
+def decode_step_dense(
+    params: dict,
+    tokens: jax.Array,  # [B] int32
+    cache: DenseKVCache,
+    positions: jax.Array,  # [B] int32
+    cfg: llama.LlamaConfig,
+):
+    """One decode token against the dense cache; auto-partitioned under jit.
+
+    Returns (logits [B, vocab], cache). Works on 1 chip or a tensor mesh —
+    the partitioning comes entirely from the operands' shardings.
+    """
+    B = tokens.shape[0]
+    S = cache.k.shape[3]
+    x = params["embed"][tokens]  # [B, D]
+    cos, sin = layers.rotary_embedding(
+        positions[:, None], cfg.head_dim, cfg.rope_theta, dtype=jnp.float32
+    )
+    pos_mask = jnp.arange(S)[None, :] <= positions[:, None]  # [B, S]
+
+    def layer_fn(carry, layer_with_cache):
+        x = carry
+        layer, k_c, v_c = layer_with_cache  # k_c: [B, Hkv, S, D]
+        D = cfg.head_dim
+        h = layers.rms_norm(x, layer["attn_norm"], cfg.norm_eps)
+        q = jnp.dot(h, layer["wq"], preferred_element_type=jnp.float32).astype(x.dtype)
+        k = jnp.dot(h, layer["wk"], preferred_element_type=jnp.float32).astype(x.dtype)
+        v = jnp.dot(h, layer["wv"], preferred_element_type=jnp.float32).astype(x.dtype)
+        q = q.reshape(B, 1, cfg.n_heads, D).transpose(0, 2, 1, 3)
+        k = k.reshape(B, 1, cfg.n_kv_heads, D).transpose(0, 2, 1, 3)
+        v = v.reshape(B, 1, cfg.n_kv_heads, D).transpose(0, 2, 1, 3)
+        q = layers.apply_rope(q, cos, sin)
+        k = layers.apply_rope(k, cos, sin)
+
+        # write this token's K/V at its position (scatter over batch)
+        b_idx = jnp.arange(B)
+        k_c = k_c.at[b_idx, :, positions].set(k[:, :, 0])
+        v_c = v_c.at[b_idx, :, positions].set(v[:, :, 0])
+
+        # GQA attention over the cache, masked to live positions
+        G = cfg.n_heads // cfg.n_kv_heads
+        qg = q.reshape(B, cfg.n_kv_heads, G, D)
+        s = jnp.einsum(
+            "bhgd,bhsd->bhgs", qg.astype(jnp.float32), k_c.astype(jnp.float32)
+        ) * (D**-0.5)
+        s = jnp.where(pos_mask[:, None, None, :], s, -jnp.inf)
+        p = jax.nn.softmax(s, axis=-1)
+        o = jnp.einsum("bhgs,bhsd->bhgd", p.astype(v_c.dtype), v_c)
+        o = o.reshape(B, cfg.n_heads * D)
+        x = x + jnp.dot(
+            o, layer["wo"], preferred_element_type=jnp.float32
+        ).astype(x.dtype)
+        h = layers.rms_norm(x, layer["mlp_norm"], cfg.norm_eps)
+        h = layers.swiglu_mlp({n: layer[n] for n in ("gate", "up", "down")}, h)
+        return x + h, (k_c, v_c)
+
+    x, (k_new, v_new) = jax.lax.scan(
+        layer_fn, x, (params["layers"], cache.k, cache.v)
+    )
+    x = layers.rms_norm(x, params["final_norm"], cfg.norm_eps)
+    head = params["embed"].T if cfg.tie_embeddings else params["lm_head"]
+    logits = jnp.dot(x, head, preferred_element_type=jnp.float32)
+    return logits, DenseKVCache(k_new, v_new)
+
+
+def generate_tp(
+    params: dict,
+    cfg: llama.LlamaConfig,
+    prompts: jax.Array,  # [B, S0] int32 (right-padded)
+    prompt_lens: jax.Array,  # [B]
+    max_new: int,
+    *,
+    mesh: Mesh | None = None,
+    max_len: int = 256,
+    key: jax.Array | None = None,
+    temperature: float = 0.0,
+) -> jax.Array:
+    """Greedy/temperature generation with the dense TP cache: prefill token
+    by token (simple, compile-once), then decode max_new tokens."""
+    B, S0 = prompts.shape
+    if mesh is not None:
+        params = shard_params_tp(params, cfg, mesh)
+    cache = DenseKVCache.create(cfg, B, max_len, mesh, dtype=params["embed"].dtype)
+    key = key if key is not None else jax.random.PRNGKey(0)
+
+    out = jnp.zeros((B, S0 + max_new), jnp.int32)
+    out = out.at[:, :S0].set(prompts)
+    tokens = prompts[:, 0]
+    last_logits = None
+    for pos in range(S0 + max_new - 1):
+        positions = jnp.full((B,), pos, jnp.int32)
+        logits, cache = decode_step_dense(params, tokens, cache, positions, cfg)
+        nxt_pos = pos + 1
+        if temperature <= 0:
+            nxt = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+        else:
+            key, sub = jax.random.split(key)
+            nxt = jax.random.categorical(sub, logits / temperature).astype(jnp.int32)
+        in_prompt = nxt_pos < prompt_lens
+        teacher = out[:, min(nxt_pos, S0 + max_new - 1)]
+        tokens = jnp.where(in_prompt, teacher, nxt)
+        out = out.at[:, nxt_pos].set(tokens)
+    return out

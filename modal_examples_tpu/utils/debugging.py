@@ -1,0 +1,66 @@
+"""Numerics & correctness debugging — the sanitizer tier the reference lacks
+(SURVEY.md §5.2 calls for jax transfer-guard / NaN-check / disable-jit modes
+as our addition over the reference's warnings-as-errors + mypy).
+"""
+
+from __future__ import annotations
+
+import contextlib
+
+import jax
+
+
+@contextlib.contextmanager
+def debug_nans(enable: bool = True):
+    """Raise at the first NaN-producing op (jax_debug_nans)."""
+    with jax.debug_nans(enable):
+        yield
+
+
+@contextlib.contextmanager
+def no_implicit_transfers(level: str = "disallow"):
+    """Fail on implicit host<->device transfers — catches accidental device
+    syncs in the hot loop (the TPU analog of catching hidden .cpu() calls)."""
+    with jax.transfer_guard(level):
+        yield
+
+
+@contextlib.contextmanager
+def eager_mode():
+    """Run without jit for step-through debugging (--no-enforce-eager analog,
+    vllm_inference.py:175-177 — but as a scoped context, not a server flag)."""
+    with jax.disable_jit():
+        yield
+
+
+def check_numerics(tree, name: str = "pytree") -> None:
+    """Assert every leaf is finite; names the offending path."""
+    import jax.numpy as jnp
+
+    def check(path, leaf):
+        if hasattr(leaf, "dtype") and jnp.issubdtype(leaf.dtype, jnp.floating):
+            bad = int(jnp.sum(~jnp.isfinite(leaf)))
+            if bad:
+                raise FloatingPointError(
+                    f"{name}{jax.tree_util.keystr(path)}: {bad} non-finite values"
+                )
+
+    jax.tree_util.tree_map_with_path(check, tree)
+
+
+def tree_summary(tree) -> str:
+    """One line per leaf: path, shape, dtype, norm — quick divergence triage."""
+    import jax.numpy as jnp
+
+    lines = []
+
+    def add(path, leaf):
+        if hasattr(leaf, "shape"):
+            norm = float(jnp.linalg.norm(leaf.astype(jnp.float32)))
+            lines.append(
+                f"{jax.tree_util.keystr(path):40s} {str(leaf.shape):18s} "
+                f"{str(leaf.dtype):10s} |x|={norm:.3e}"
+            )
+
+    jax.tree_util.tree_map_with_path(add, tree)
+    return "\n".join(lines)

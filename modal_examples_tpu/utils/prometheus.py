@@ -1,0 +1,93 @@
+"""Metrics: counters/gauges/histograms with Prometheus text exposition and a
+push-style aggregator.
+
+Reference pattern (SURVEY.md §5.5): scrape-based Prometheus doesn't fit
+ephemeral containers, so the reference runs a Pushgateway *as an app*
+(10_integrations/pushgateway.py:8-12,62-69) and functions push counters to
+it. Here the registry + exposition format are implemented directly (no Go
+binary needed), and the aggregator pattern is a Dict-backed push sink any
+app can serve via a web endpoint.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from collections import defaultdict
+
+
+class Registry:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._counters: dict[tuple, float] = defaultdict(float)
+        self._gauges: dict[tuple, float] = {}
+        self._help: dict[str, str] = {}
+        self._types: dict[str, str] = {}
+
+    def _key(self, name: str, labels: dict | None):
+        return (name, tuple(sorted((labels or {}).items())))
+
+    def counter_inc(self, name: str, value: float = 1.0, labels: dict | None = None,
+                    help: str = ""):
+        with self._lock:
+            self._counters[self._key(name, labels)] += value
+            self._types[name] = "counter"
+            if help:
+                self._help[name] = help
+
+    def gauge_set(self, name: str, value: float, labels: dict | None = None,
+                  help: str = ""):
+        with self._lock:
+            self._gauges[self._key(name, labels)] = value
+            self._types[name] = "gauge"
+            if help:
+                self._help[name] = help
+
+    def expose(self) -> str:
+        """Prometheus text exposition format."""
+        with self._lock:
+            lines: list[str] = []
+            seen_header = set()
+            for store in (self._counters, self._gauges):
+                for (name, labels), value in sorted(store.items()):
+                    if name not in seen_header:
+                        if name in self._help:
+                            lines.append(f"# HELP {name} {self._help[name]}")
+                        lines.append(f"# TYPE {name} {self._types.get(name, 'untyped')}")
+                        seen_header.add(name)
+                    label_s = (
+                        "{" + ",".join(f'{k}="{v}"' for k, v in labels) + "}"
+                        if labels
+                        else ""
+                    )
+                    lines.append(f"{name}{label_s} {value}")
+            return "\n".join(lines) + "\n"
+
+    def snapshot(self) -> dict:
+        with self._lock:
+            return {
+                "counters": {str(k): v for k, v in self._counters.items()},
+                "gauges": {str(k): v for k, v in self._gauges.items()},
+            }
+
+
+#: process-wide default registry
+default_registry = Registry()
+
+
+def push_to_dict(metrics_dict, job: str, registry: Registry | None = None) -> None:
+    """Push this process's metrics into a shared Dict — the pushgateway
+    pattern for ephemeral containers (each push overwrites the job's slot,
+    tagged with a timestamp)."""
+    reg = registry or default_registry
+    metrics_dict[job] = {"at": time.time(), "metrics": reg.snapshot(),
+                         "text": reg.expose()}
+
+
+def aggregate_exposition(metrics_dict) -> str:
+    """Merge all jobs' pushed text expositions (the gateway's /metrics)."""
+    parts = []
+    for job, payload in sorted(metrics_dict.items()):
+        parts.append(f"# job: {job} (pushed at {payload['at']:.0f})")
+        parts.append(payload["text"])
+    return "\n".join(parts)

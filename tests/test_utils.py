@@ -1,0 +1,87 @@
+"""Utils tier: prometheus registry/exposition, debugging contexts, docs
+renderer details."""
+
+import pytest
+
+
+class TestPrometheus:
+    def test_counter_and_gauge_exposition(self):
+        from modal_examples_tpu.utils.prometheus import Registry
+
+        reg = Registry()
+        reg.counter_inc("reqs_total", labels={"route": "a"}, help="requests")
+        reg.counter_inc("reqs_total", labels={"route": "a"})
+        reg.counter_inc("reqs_total", labels={"route": "b"})
+        reg.gauge_set("active_slots", 7)
+        text = reg.expose()
+        assert '# TYPE reqs_total counter' in text
+        assert 'reqs_total{route="a"} 2.0' in text
+        assert 'reqs_total{route="b"} 1.0' in text
+        assert "active_slots 7" in text
+
+    def test_push_and_aggregate(self):
+        import modal_examples_tpu as mtpu
+        from modal_examples_tpu.utils.prometheus import (
+            Registry, aggregate_exposition, push_to_dict,
+        )
+
+        with mtpu.Dict.ephemeral() as store:
+            r1, r2 = Registry(), Registry()
+            r1.counter_inc("x_total", 3)
+            r2.counter_inc("x_total", 4)
+            push_to_dict(store, "job1", r1)
+            push_to_dict(store, "job2", r2)
+            merged = aggregate_exposition(store)
+        assert "# job: job1" in merged and "# job: job2" in merged
+        assert "x_total 3.0" in merged and "x_total 4.0" in merged
+
+
+class TestDebugging:
+    def test_check_numerics_names_bad_leaf(self, jax_cpu):
+        import jax.numpy as jnp
+
+        from modal_examples_tpu.utils.debugging import check_numerics
+
+        good = {"a": jnp.ones(3), "b": {"c": jnp.zeros(2)}}
+        check_numerics(good)
+        bad = {"a": jnp.ones(3), "b": {"c": jnp.array([1.0, jnp.nan])}}
+        with pytest.raises(FloatingPointError, match="'c'"):
+            check_numerics(bad, "params")
+
+    def test_debug_nans_context(self, jax_cpu):
+        import jax
+        import jax.numpy as jnp
+
+        from modal_examples_tpu.utils.debugging import debug_nans
+
+        with debug_nans():
+            with pytest.raises(FloatingPointError):
+                jax.jit(lambda x: 0.0 / x)(jnp.zeros(())).block_until_ready()
+        # restored afterwards: same op silently yields nan
+        out = jax.jit(lambda x: 0.0 / x)(jnp.zeros(()))
+        assert bool(jnp.isnan(out))
+
+    def test_eager_mode(self, jax_cpu):
+        import jax
+
+        from modal_examples_tpu.utils.debugging import eager_mode
+
+        with eager_mode():
+            # inside disable_jit, tracing doesn't happen; python side effects run
+            seen = []
+
+            def f(x):
+                seen.append(1)
+                return x + 1
+
+            jax.jit(f)(1)
+            jax.jit(f)(2)
+        assert len(seen) == 2
+
+    def test_tree_summary(self, jax_cpu):
+        import jax.numpy as jnp
+
+        from modal_examples_tpu.utils.debugging import tree_summary
+
+        s = tree_summary({"w": jnp.ones((2, 3))})
+        assert "(2, 3)" in s and "|x|=" in s
