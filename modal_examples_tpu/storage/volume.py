@@ -141,6 +141,16 @@ class Volume:
         else:
             p.unlink()
 
+    def restricted(self, subpath: str) -> "Volume":
+        """A view of this volume rooted at ``subpath`` — per-user restricted
+        mounts (08_advanced/restricted_volumes.py:8-35): mount
+        ``vol.restricted(f"users/{user_id}")`` and the container can only
+        see/write that subtree."""
+        root = self._resolve(subpath)
+        root.mkdir(parents=True, exist_ok=True)
+        view = Volume(f"{self.name}/{subpath.strip('/')}", root)
+        return view
+
     def _resolve(self, path: str) -> Path:
         p = (self._path / path.lstrip("/")).resolve()
         root = self._path.resolve()

@@ -36,6 +36,48 @@ class TestPrometheus:
         assert "x_total 3.0" in merged and "x_total 4.0" in merged
 
 
+class TestRouting:
+    def test_rendezvous_stable_and_balanced(self):
+        from modal_examples_tpu.web.routing import rendezvous_pick, rendezvous_rank
+
+        nodes = [f"replica-{i}" for i in range(4)]
+        picks = {f"session-{k}": rendezvous_pick(f"session-{k}", nodes) for k in range(200)}
+        # deterministic
+        assert all(
+            rendezvous_pick(k, nodes) == v for k, v in picks.items()
+        )
+        # reasonably balanced
+        from collections import Counter
+
+        counts = Counter(picks.values())
+        assert all(20 <= c <= 80 for c in counts.values()), counts
+        # minimal disruption: removing one node only moves its keys
+        survivors = nodes[:-1]
+        moved = sum(
+            1
+            for k, v in picks.items()
+            if v != "replica-3" and rendezvous_pick(k, survivors) != v
+        )
+        assert moved == 0
+        # failover order starts with the primary
+        assert rendezvous_rank("session-1", nodes)[0] == picks["session-1"]
+
+
+class TestRestrictedVolume:
+    def test_view_confined_to_subtree(self, state_dir):
+        import modal_examples_tpu as mtpu
+
+        vol = mtpu.Volume.from_name("acl-vol", create_if_missing=True)
+        vol.write_file("users/alice/doc.txt", b"alice data")
+        vol.write_file("users/bob/doc.txt", b"bob data")
+        alice = vol.restricted("users/alice")
+        assert alice.read_file("doc.txt") == b"alice data"
+        alice.write_file("new.txt", b"x")
+        assert vol.read_file("users/alice/new.txt") == b"x"
+        with pytest.raises(PermissionError):
+            alice.read_file("../bob/doc.txt")
+
+
 class TestDebugging:
     def test_check_numerics_names_bad_leaf(self, jax_cpu):
         import jax.numpy as jnp
