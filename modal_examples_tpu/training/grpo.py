@@ -1,0 +1,192 @@
+"""GRPO: group-relative policy optimization for LLM fine-tuning.
+
+The reference's RL workloads delegate to verl/TRL with vLLM rollouts and
+FSDP (06_gpu_and_ml/reinforcement-learning per SURVEY §2.2: learn_math.py,
+grpo_trl.py, grpo_verl.py:153-202). JAX-native redesign:
+
+- rollouts: batched stochastic sampling from the policy as a fixed-length
+  scan (static shapes; the serving engine can stand in at scale);
+- advantages: rewards normalized within each prompt's group of G
+  completions (the GRPO trick — no value network);
+- loss: PPO-style clipped importance ratio against the behavior logprobs,
+  plus a k3 KL penalty to a frozen reference policy;
+- one jitted update step via the same optax machinery as everything else.
+
+Rewards are arbitrary Python (the reference scores sandboxed code execution,
+learn_math.py:7-9 — our Sandbox API slots in the same way).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import functools
+from typing import Callable
+
+import jax
+import jax.numpy as jnp
+import optax
+
+from ..models import llama
+
+
+@dataclasses.dataclass(frozen=True)
+class GRPOConfig:
+    group_size: int = 8
+    clip_eps: float = 0.2
+    kl_coef: float = 0.02
+    temperature: float = 1.0
+    max_new: int = 8
+
+
+@functools.partial(jax.jit, static_argnames=("cfg", "max_new", "temperature"))
+def sample_group(
+    params,
+    cfg: llama.LlamaConfig,
+    prompts: jax.Array,  # [G, S0] int32 (the same prompt tiled, or varied)
+    prompt_len: int | jax.Array,
+    key: jax.Array,
+    *,
+    max_new: int,
+    temperature: float,
+):
+    """Stochastic rollouts: returns (tokens [G, S0+max_new], logprobs [G,
+    max_new]) where logprobs are the behavior policy's per-token logprobs."""
+    G, S0 = prompts.shape
+    S = S0 + max_new
+    buf = jnp.zeros((G, S), jnp.int32).at[:, :S0].set(prompts)
+
+    def step(carry, k):
+        buf, pos = carry
+        logits = llama.forward(params, buf, cfg, attn_impl="xla")  # [G, S, V]
+        lp = jax.nn.log_softmax(
+            logits[:, pos - 1] / max(temperature, 1e-6), axis=-1
+        )
+        tok = jax.random.categorical(k, lp, axis=-1).astype(jnp.int32)
+        tok_lp = jnp.take_along_axis(lp, tok[:, None], 1)[:, 0]
+        buf = buf.at[:, pos].set(tok)
+        return (buf, pos + 1), (tok, tok_lp)
+
+    (buf, _), (toks, lps) = jax.lax.scan(
+        step, (buf, jnp.asarray(prompt_len)), jax.random.split(key, max_new)
+    )
+    return buf, lps.T  # [G, max_new]
+
+
+def _completion_logprobs(
+    params, cfg, tokens, prompt_len: int, max_new: int, temperature: float = 1.0
+):
+    """Per-token logprobs of the completion region under ``params``, at the
+    SAME temperature as the behavior policy (the importance ratio is only
+    meaningful when both sides use one distribution)."""
+    logits = llama.forward(params, tokens, cfg, attn_impl="xla")
+    lp = jax.nn.log_softmax(logits / max(temperature, 1e-6), axis=-1)
+    idx = prompt_len - 1 + jnp.arange(max_new)  # predicts positions idx+1
+    targets = tokens[:, prompt_len : prompt_len + max_new]
+    sel = jnp.take_along_axis(
+        lp[:, idx], targets[..., None], axis=-1
+    )[..., 0]
+    return sel  # [G, max_new]
+
+
+def grpo_advantages(rewards: jax.Array) -> jax.Array:
+    """Group-normalized advantages: (r - mean) / (std + eps), one group."""
+    mu = rewards.mean()
+    sd = rewards.std()
+    return (rewards - mu) / (sd + 1e-6)
+
+
+@functools.partial(
+    jax.jit,
+    static_argnames=(
+        "cfg", "prompt_len", "max_new", "clip_eps", "kl_coef", "temperature",
+    ),
+)
+def grpo_loss(
+    policy_params,
+    ref_params,
+    cfg: llama.LlamaConfig,
+    tokens: jax.Array,  # [G, S]
+    behavior_lps: jax.Array,  # [G, max_new]
+    advantages: jax.Array,  # [G]
+    *,
+    prompt_len: int,
+    max_new: int,
+    clip_eps: float,
+    kl_coef: float,
+    temperature: float = 1.0,
+):
+    new_lps = _completion_logprobs(
+        policy_params, cfg, tokens, prompt_len, max_new, temperature
+    )
+    ratio = jnp.exp(new_lps - behavior_lps)  # [G, max_new]
+    adv = advantages[:, None]
+    unclipped = ratio * adv
+    clipped = jnp.clip(ratio, 1 - clip_eps, 1 + clip_eps) * adv
+    pg = -jnp.mean(jnp.minimum(unclipped, clipped))
+    # k3 KL estimator vs the frozen reference (grpo convention)
+    ref_lps = _completion_logprobs(
+        ref_params, cfg, tokens, prompt_len, max_new, temperature
+    )
+    log_r = ref_lps - new_lps
+    kl = jnp.mean(jnp.exp(log_r) - log_r - 1.0)
+    return pg + kl_coef * kl, {"pg_loss": pg, "kl": kl}
+
+
+class GRPOTrainer:
+    """Rollout -> reward -> advantage -> clipped update, one prompt group at
+    a time (the verl config's essential loop, without verl)."""
+
+    def __init__(
+        self,
+        cfg: llama.LlamaConfig,
+        params,
+        reward_fn: Callable[[jax.Array], list[float]],  # tokens [G, S] -> rewards
+        grpo: GRPOConfig = GRPOConfig(),
+        learning_rate: float = 1e-4,
+    ):
+        self.cfg = cfg
+        self.grpo = grpo
+        self.reward_fn = reward_fn
+        self.policy = params
+        self.ref = jax.tree.map(lambda x: x, params)  # frozen snapshot
+        self.opt = optax.adamw(learning_rate)
+        self.opt_state = self.opt.init(self.policy)
+        self._grad_fn = jax.grad(
+            lambda p, *a, **k: grpo_loss(p, *a, **k)[0], argnums=0
+        )
+
+    def step(
+        self,
+        prompt: jax.Array,
+        prompt_len: int,
+        key: jax.Array,
+        reward_fn: Callable | None = None,  # per-prompt override
+    ) -> dict:
+        g = self.grpo
+        reward_fn = reward_fn or self.reward_fn
+        prompts = jnp.tile(prompt[None], (g.group_size, 1))
+        tokens, behavior_lps = sample_group(
+            self.policy, self.cfg, prompts, prompt_len, key,
+            max_new=g.max_new, temperature=g.temperature,
+        )
+        rewards = jnp.asarray(reward_fn(tokens), jnp.float32)
+        if rewards.shape != (g.group_size,):
+            raise ValueError(
+                f"reward_fn returned shape {rewards.shape}, expected "
+                f"({g.group_size},)"
+            )
+        adv = grpo_advantages(rewards)
+        grads = self._grad_fn(
+            self.policy, self.ref, self.cfg, tokens, behavior_lps, adv,
+            prompt_len=prompt_len, max_new=g.max_new,
+            clip_eps=g.clip_eps, kl_coef=g.kl_coef, temperature=g.temperature,
+        )
+        updates, self.opt_state = self.opt.update(
+            grads, self.opt_state, self.policy
+        )
+        self.policy = optax.apply_updates(self.policy, updates)
+        return {
+            "mean_reward": float(rewards.mean()),
+            "max_reward": float(rewards.max()),
+            "adv_std": float(adv.std()),
+        }
