@@ -1,0 +1,100 @@
+# # Load-testing the OpenAI-compatible server
+#
+# Counterpart of the reference's openai_compatible/load_test.py +
+# locustfile.py (locust workers driving the served API) and
+# trtllm_latency.py's round-trip target (:10-22): concurrent client threads
+# hit /v1/chat/completions over HTTP and report throughput + latency
+# percentiles. No locust dependency — threads and a shared histogram.
+#
+# Run: tpurun run examples/06_gpu_and_ml/llm-serving/load_test.py
+
+import json
+import os
+import threading
+import time
+import urllib.request
+
+import modal_examples_tpu as mtpu
+
+TPU = os.environ.get("MTPU_TPU", "") or None
+
+app = mtpu.App("example-llm-load-test")
+
+
+@app.function(tpu=TPU, timeout=1800)
+def run_load_test(
+    users: int = 4, requests_per_user: int = 3, max_tokens: int = 8
+) -> dict:
+    import urllib.request  # submodule import must happen in THIS process
+
+    from modal_examples_tpu.models import llama
+    from modal_examples_tpu.serving import LLMEngine, OpenAIServer
+
+    engine = LLMEngine(
+        llama.LlamaConfig.tiny(), max_slots=4, max_model_len=128,
+        prefill_buckets=(32, 64),
+    )
+    server = OpenAIServer(engine, model_name="load-test", host="127.0.0.1", port=0)
+    server.start()
+    url = f"http://127.0.0.1:{server.port}/v1/chat/completions"
+
+    latencies: list[float] = []
+    errors: list[str] = []
+    lock = threading.Lock()
+
+    def user(uid: int):
+        for i in range(requests_per_user):
+            body = json.dumps(
+                {
+                    "messages": [{"role": "user", "content": f"u{uid} r{i}"}],
+                    "max_tokens": max_tokens,
+                    "temperature": 1.0,
+                }
+            ).encode()
+            req = urllib.request.Request(
+                url, data=body, headers={"content-type": "application/json"}
+            )
+            t0 = time.monotonic()
+            try:
+                with urllib.request.urlopen(req, timeout=120) as r:
+                    json.load(r)
+                with lock:
+                    latencies.append(time.monotonic() - t0)
+            except Exception as e:
+                with lock:
+                    errors.append(f"{type(e).__name__}: {e}")
+
+    # warmup (compile)
+    user(-1)
+    latencies.clear()
+
+    t0 = time.monotonic()
+    threads = [threading.Thread(target=user, args=(u,)) for u in range(users)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    wall = time.monotonic() - t0
+    server.stop()
+
+    latencies.sort()
+    n = len(latencies)
+    pct = lambda p: round(latencies[min(int(p * n), n - 1)], 3) if n else None
+    return {
+        "completed": n,
+        "errors": errors[:5],
+        "rps": round(n / wall, 2),
+        "p50_s": pct(0.50),
+        "p95_s": pct(0.95),
+        "tokens_per_s": round(engine.stats.tokens_per_second(), 1),
+    }
+
+
+@app.local_entrypoint()
+def main(users: int = 4):
+    out = run_load_test.remote(users)
+    print(
+        f"{out['completed']} requests, {out['rps']} req/s, "
+        f"p50={out['p50_s']}s p95={out['p95_s']}s, errors={len(out['errors'])}"
+    )
+    assert out["completed"] == users * 3 and not out["errors"], out

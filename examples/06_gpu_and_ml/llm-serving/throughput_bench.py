@@ -1,0 +1,69 @@
+# # Max-throughput batch inference
+#
+# Counterpart of the reference's llm-serving/vllm_throughput.py (batch
+# pipeline with throughput claims :26-37) and trtllm_throughput.py's
+# measured tok/s print (:379): saturate the continuous-batching engine with
+# a backlog of prompts and report aggregate input/output tokens per second.
+#
+# MTPU_MODEL=llama2-7b (+ a TPU) benches the real thing; the default tiny
+# model exercises the measurement path anywhere.
+#
+# Run: tpurun run examples/06_gpu_and_ml/llm-serving/throughput_bench.py
+
+import os
+import time
+
+import modal_examples_tpu as mtpu
+
+TPU = os.environ.get("MTPU_TPU", "") or None
+MODEL = os.environ.get("MTPU_MODEL", "tiny")
+
+app = mtpu.App("example-llm-throughput")
+
+
+@app.function(tpu=TPU, timeout=3600)
+def bench(n_requests: int = 16, max_tokens: int = 32) -> dict:
+    from modal_examples_tpu.serving import SamplingParams, build_engine
+
+    engine = build_engine(
+        MODEL,
+        max_slots=8 if MODEL != "tiny" else 4,
+        max_model_len=512 if MODEL != "tiny" else 128,
+        prefill_buckets=(64, 128, 256),
+    ).start()
+    prompt = "Summarize the following filing: revenue grew due to " * 3
+    params = SamplingParams(max_tokens=max_tokens, temperature=1.0)
+
+    # warmup compiles
+    for _ in engine.stream(engine.submit(prompt, SamplingParams(max_tokens=4))):
+        pass
+
+    base_out = engine.stats.generated_tokens
+    base_in = engine.stats.prompt_tokens
+    t0 = time.monotonic()
+    reqs = [engine.submit(prompt, params) for _ in range(n_requests)]
+    for r in reqs:
+        for _ in engine.stream(r):
+            pass
+    dt = time.monotonic() - t0
+    out_toks = engine.stats.generated_tokens - base_out
+    in_toks = engine.stats.prompt_tokens - base_in
+    engine.stop()
+    return {
+        "model": MODEL,
+        "requests": n_requests,
+        "input_tok_s": round(in_toks / dt, 1),
+        "output_tok_s": round(out_toks / dt, 1),
+        "wall_s": round(dt, 2),
+    }
+
+
+@app.local_entrypoint()
+def main(n_requests: int = 16, max_tokens: int = 32):
+    out = bench.remote(n_requests, max_tokens)
+    print(
+        f"{out['model']}: {out['input_tok_s']} input tok/s, "
+        f"{out['output_tok_s']} output tok/s over {out['requests']} requests "
+        f"({out['wall_s']}s)"
+    )
+    assert out["output_tok_s"] > 0
