@@ -20,6 +20,7 @@ from __future__ import annotations
 import argparse
 import inspect
 import json
+import os
 import sys
 
 from .._internal import config as _config
@@ -129,7 +130,7 @@ def cmd_serve(argv: list[str]) -> int:
         # test-harness bound, analog of MODAL_SERVE_TIMEOUT (run_example.py:28)
         timeout = float(os.environ["MTPU_SERVE_TIMEOUT"])
     _module, app = _load_app(path)
-    from ..web.gateway import Gateway
+    from ..web.gateway import Gateway, wait_for_port
 
     with app.run():
         urls = []
@@ -138,6 +139,15 @@ def cmd_serve(argv: list[str]) -> int:
             urls += [f"{gw.base_url}/{label}" for label in gw.routes]
         for name, handle in getattr(app, "registered_servers", {}).items():
             urls.append(handle.serve())
+        # @web_server(port) functions start their own server when invoked
+        for name, fn in app.registered_functions.items():
+            web = fn.spec.web or {}
+            if web.get("type") == "web_server":
+                fn.raw_f()  # user code binds the port (thread/subprocess)
+                if wait_for_port("127.0.0.1", web["port"], web.get("startup_timeout", 30)):
+                    urls.append(f"http://127.0.0.1:{web['port']}")
+                else:
+                    print(f"warning: {name} never opened port {web['port']}")
         if not urls:
             raise SystemExit("no web endpoints or servers registered")
         for u in urls:
@@ -167,6 +177,53 @@ def cmd_secret(argv: list[str]) -> int:
     raise SystemExit("usage: tpurun secret create NAME KEY=VALUE ...")
 
 
+def cmd_examples(argv: list[str]) -> int:
+    """List or run the example corpus (internal/run_example.py parity:
+    subprocess per example with a timeout bound)."""
+    from ..utils.docs import get_examples, repo_root
+
+    examples = get_examples()
+    if not argv or argv[0] == "list":
+        for e in examples:
+            print(e.path)
+        return 0
+    if argv[0] == "run":
+        import subprocess
+        import tempfile
+
+        timeout = 600.0
+        if "--timeout" in argv:
+            timeout = float(argv[argv.index("--timeout") + 1])
+        pattern = argv[1] if len(argv) > 1 and not argv[1].startswith("-") else ""
+        targets = [e for e in examples if pattern in str(e.path)]
+        if not targets:
+            raise SystemExit(f"no examples match {pattern!r}")
+        failures = []
+        for e in targets:
+            env = dict(os.environ)
+            env.setdefault("MTPU_STATE_DIR", tempfile.mkdtemp(prefix="mtpu-ex-"))
+            print(f"=== {e.path} ===", flush=True)
+            try:
+                proc = subprocess.run(
+                    [sys.executable, "-m", "modal_examples_tpu", "run",
+                     str(repo_root() / e.path)],
+                    timeout=timeout,
+                    env=env,
+                )
+                if proc.returncode != 0:
+                    failures.append(str(e.path))
+            except subprocess.TimeoutExpired:
+                failures.append(f"{e.path} (timeout {timeout}s)")
+        if failures:
+            print(f"FAILED ({len(failures)}/{len(targets)}):")
+            for f in failures:
+                print(" ", f)
+            return 1
+        print(f"all {len(targets)} example(s) passed")
+        return 0
+    raise SystemExit("usage: tpurun examples [list | run [pattern] [--timeout S]]")
+
+
 def cmd_app(argv: list[str]) -> int:
     if argv and argv[0] == "list":
         reg = _config.state_dir() / "apps.json"
@@ -186,6 +243,7 @@ COMMANDS = {
     "serve": cmd_serve,
     "secret": cmd_secret,
     "app": cmd_app,
+    "examples": cmd_examples,
 }
 
 

@@ -92,6 +92,7 @@ class LLMEngine:
         max_model_len: int = 1024,
         n_pages: int | None = None,
         prefill_buckets: tuple[int, ...] = (128, 256, 512, 1024, 2048),
+        prefill_batch: int = 4,  # the one compiled prefill batch shape
         seed: int = 0,
         kv_dtype=jnp.bfloat16,
     ):
@@ -119,6 +120,7 @@ class LLMEngine:
         self.prefill_buckets = tuple(
             b for b in sorted(prefill_buckets) if b <= max_model_len
         ) or (max_model_len,)
+        self.prefill_batch = max(1, min(prefill_batch, max_slots))
 
         self.slots = [_Slot() for _ in range(max_slots)]
         self.waiting: queue.Queue[Request] = queue.Queue()
@@ -238,9 +240,19 @@ class LLMEngine:
         return admitted or decoded
 
     def _admit(self) -> bool:
-        admitted = False
+        """Claim slots+pages for waiting requests, then prefill each bucket's
+        admissions as ONE batched jitted call (compile shapes: bucket x
+        pow2-padded batch — continuous batching on the prefill side too)."""
+        assignments: list[tuple[int, Request, list[int], int]] = []
         while True:
-            free_slot = next((i for i, s in enumerate(self.slots) if s.free), None)
+            free_slot = next(
+                (
+                    i
+                    for i, s in enumerate(self.slots)
+                    if s.free and i not in {a[0] for a in assignments}
+                ),
+                None,
+            )
             if free_slot is None or self.waiting.empty():
                 break
             try:
@@ -248,52 +260,69 @@ class LLMEngine:
             except queue.Empty:
                 break
             n_prompt = len(req.prompt_tokens)
-            max_total = min(
-                n_prompt + req.params.max_tokens, self.max_model_len
-            )
+            max_total = min(n_prompt + req.params.max_tokens, self.max_model_len)
             n_pages = self.cache.pages_for(max_total)
             try:
                 pages = self.cache.allocator.alloc(n_pages)
             except OutOfPages:
-                # no KV room: requeue and wait for a completion
-                self.waiting.put(req)
+                self.waiting.put(req)  # no KV room: wait for a completion
                 break
-            self._start_request(free_slot, req, pages, n_prompt)
-            admitted = True
-        return admitted
+            assignments.append((free_slot, req, pages, n_prompt))
 
-    def _start_request(self, slot_idx: int, req: Request, pages: list[int], n_prompt: int):
-        slot = self.slots[slot_idx]
-        slot.request = req
-        slot.pages = pages
-        slot.generated = []
-        slot.emitted_text_len = 0
+        by_bucket: dict[int, list] = {}
+        for a in assignments:
+            by_bucket.setdefault(self._bucket_for(a[3]), []).append(a)
+        for bucket, group in by_bucket.items():
+            # chunk to the ONE compiled batch shape per bucket
+            for i in range(0, len(group), self.prefill_batch):
+                self._prefill_group(bucket, group[i : i + self.prefill_batch])
+        return bool(assignments)
 
-        table = np.zeros((self.pages_per_slot,), np.int32)
-        table[: len(pages)] = pages
-        self._page_tables[slot_idx] = table
+    def _prefill_group(self, bucket: int, group: list) -> None:
+        B = self.prefill_batch  # fixed compile shape; short groups pad
+        pad_tok = self.tokenizer.pad_id % self.cfg.vocab_size
+        tokens = np.full((B, bucket), pad_tok, np.int32)
+        tables = np.zeros((B, self.pages_per_slot), np.int32)  # pad rows: trash
+        seq_lens = np.ones((B,), np.int32)
+        temps = np.ones((B,), np.float32)
+        top_ps = np.ones((B,), np.float32)
+        top_ks = np.zeros((B,), np.int32)
+        for i, (slot_idx, req, pages, n_prompt) in enumerate(group):
+            slot = self.slots[slot_idx]
+            slot.request = req
+            slot.pages = pages
+            slot.generated = []
+            slot.emitted_text_len = 0
+            table = np.zeros((self.pages_per_slot,), np.int32)
+            table[: len(pages)] = pages
+            self._page_tables[slot_idx] = table
+            tokens[i, :n_prompt] = req.prompt_tokens
+            tables[i] = table
+            seq_lens[i] = n_prompt
+            p = req.params
+            temps[i], top_ps[i], top_ks[i] = p.temperature, p.top_p, p.top_k
 
-        bucket = self._bucket_for(n_prompt)
-        tokens = np.full((1, bucket), self.tokenizer.pad_id % self.cfg.vocab_size, np.int32)
-        tokens[0, :n_prompt] = req.prompt_tokens
-        p = req.params
-        next_tok, self.cache.k_pages, self.cache.v_pages = self._prefill_jit(bucket)(
+        next_tok, self.cache.k_pages, self.cache.v_pages = self._prefill_jit(
+            (bucket, B)
+        )(
             self.params,
             self.cache.k_pages,
             self.cache.v_pages,
             jnp.asarray(tokens),
-            jnp.asarray(table[None, :]),
-            jnp.asarray([n_prompt], np.int32),
+            jnp.asarray(tables),
+            jnp.asarray(seq_lens),
             self._next_key(),
-            jnp.asarray([p.temperature], np.float32),
-            jnp.asarray([p.top_p], np.float32),
-            jnp.asarray([p.top_k], np.int32),
+            jnp.asarray(temps),
+            jnp.asarray(top_ps),
+            jnp.asarray(top_ks),
         )
-        first = int(next_tok[0])
-        self.stats.prompt_tokens += n_prompt
-        slot.position = n_prompt
-        slot.last_token = first
-        self._accept_token(slot_idx, first)
+        next_np = np.asarray(next_tok)
+        for i, (slot_idx, req, _pages, n_prompt) in enumerate(group):
+            slot = self.slots[slot_idx]
+            self.stats.prompt_tokens += n_prompt
+            slot.position = n_prompt
+            slot.last_token = int(next_np[i])
+            self._accept_token(slot_idx, slot.last_token)
 
     def _decode_tick(self) -> bool:
         active_idx = [i for i, s in enumerate(self.slots) if not s.free]
