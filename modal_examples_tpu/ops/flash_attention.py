@@ -189,6 +189,183 @@ def _use_interpret() -> bool:
     return jax.default_backend() != "tpu"
 
 
+# ---------------------------------------------------------------------------
+# Backward kernels. With S_scaled = scale*Q@K^T, P = exp(S_scaled - lse):
+#   dV = P^T dO
+#   dP = dO V^T
+#   dS = P * (dP - D),  D_i = rowsum(dO_i * O_i)
+#   dQ = scale * dS K          (accumulated over k blocks)
+#   dK = scale * dS^T Q        (accumulated over q blocks)
+# Two kernels: dq (grid bh, qi, ki — ki sequential into scratch) and dkv
+# (grid bh, ki, qi — qi sequential into scratch). lse/delta ride along as
+# per-row statistics; causal blocks above the diagonal are skipped.
+# ---------------------------------------------------------------------------
+
+
+def _bwd_block_ds(q, k, lse_row, delta_row, do, v, *, sm_scale, causal,
+                  q_start, k_start):
+    """Shared per-block math: returns (p, ds) both (block_q, block_k) f32."""
+    s = jax.lax.dot_general(
+        q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+    ) * sm_scale
+    if causal:
+        rows = jax.lax.broadcasted_iota(jnp.int32, s.shape, 0) + q_start
+        cols = jax.lax.broadcasted_iota(jnp.int32, s.shape, 1) + k_start
+        s = jnp.where(rows >= cols, s, -jnp.inf)
+    finite = jnp.isfinite(lse_row)
+    p = jnp.where(finite, jnp.exp(s - jnp.where(finite, lse_row, 0.0)), 0.0)
+    dp = jax.lax.dot_general(
+        do, v, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+    )
+    ds = p * (dp - delta_row)
+    return p, ds
+
+
+def _dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
+               dq_scr, *, sm_scale, causal, block_q):
+    qi, ki, nk = pl.program_id(1), pl.program_id(2), pl.num_programs(2)
+    block_k = k_ref.shape[1]
+    q_start, k_start = qi * block_q, ki * block_k
+
+    @pl.when(ki == 0)
+    def _():
+        dq_scr[:] = jnp.zeros_like(dq_scr)
+
+    run = jnp.logical_or(not causal, k_start <= q_start + block_q - 1)
+
+    @pl.when(run)
+    def _():
+        q = q_ref[0].astype(jnp.float32)
+        k = k_ref[0].astype(jnp.float32)
+        v = v_ref[0].astype(jnp.float32)
+        do = do_ref[0].astype(jnp.float32)
+        lse_row = lse_ref[0][:, None]
+        delta_row = delta_ref[0][:, None]
+        _, ds = _bwd_block_ds(
+            q, k, lse_row, delta_row, do, v, sm_scale=sm_scale, causal=causal,
+            q_start=q_start, k_start=k_start,
+        )
+        dq_scr[:] += sm_scale * jax.lax.dot_general(
+            ds, k, (((1,), (0,)), ((), ())), preferred_element_type=jnp.float32
+        )
+
+    @pl.when(ki == nk - 1)
+    def _():
+        dq_ref[0] = dq_scr[:].astype(dq_ref.dtype)
+
+
+def _dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+                dk_ref, dv_ref, dk_scr, dv_scr, *, sm_scale, causal, block_k):
+    ki, qi, nq = pl.program_id(1), pl.program_id(2), pl.num_programs(2)
+    block_q = q_ref.shape[1]
+    q_start, k_start = qi * block_q, ki * block_k
+
+    @pl.when(qi == 0)
+    def _():
+        dk_scr[:] = jnp.zeros_like(dk_scr)
+        dv_scr[:] = jnp.zeros_like(dv_scr)
+
+    run = jnp.logical_or(not causal, q_start + block_q - 1 >= k_start)
+
+    @pl.when(run)
+    def _():
+        q = q_ref[0].astype(jnp.float32)
+        k = k_ref[0].astype(jnp.float32)
+        v = v_ref[0].astype(jnp.float32)
+        do = do_ref[0].astype(jnp.float32)
+        lse_row = lse_ref[0][:, None]
+        delta_row = delta_ref[0][:, None]
+        p, ds = _bwd_block_ds(
+            q, k, lse_row, delta_row, do, v, sm_scale=sm_scale, causal=causal,
+            q_start=q_start, k_start=k_start,
+        )
+        dv_scr[:] += jax.lax.dot_general(
+            p, do, (((0,), (0,)), ((), ())), preferred_element_type=jnp.float32
+        )
+        dk_scr[:] += sm_scale * jax.lax.dot_general(
+            ds, q, (((0,), (0,)), ((), ())), preferred_element_type=jnp.float32
+        )
+
+    @pl.when(qi == nq - 1)
+    def _():
+        dk_ref[0] = dk_scr[:].astype(dk_ref.dtype)
+        dv_ref[0] = dv_scr[:].astype(dv_ref.dtype)
+
+
+def _flash_backward(q, k, v, o, lse, g, *, causal, sm_scale, block_q, block_k,
+                    interpret):
+    """Pallas backward: returns (dq, dk, dv) with GQA group reduction."""
+    B, Hq, S, D = q.shape
+    Hkv = k.shape[1]
+    group = Hq // Hkv
+    BHq = B * Hq
+    qf = q.reshape(BHq, S, D)
+    kf = k.reshape(B * Hkv, S, D)
+    vf = v.reshape(B * Hkv, S, D)
+    dof = g.reshape(BHq, S, D)
+    lsef = lse.reshape(BHq, S)
+    delta = jnp.sum(
+        g.astype(jnp.float32) * o.astype(jnp.float32), axis=-1
+    ).reshape(BHq, S)
+
+    kv_index = lambda bh, g=group: bh // g
+
+    dq = pl.pallas_call(
+        functools.partial(
+            _dq_kernel, sm_scale=sm_scale, causal=causal, block_q=block_q
+        ),
+        grid=(BHq, pl.cdiv(S, block_q), pl.cdiv(S, block_k)),
+        in_specs=[
+            pl.BlockSpec((1, block_q, D), lambda bh, qi, ki: (bh, qi, 0)),
+            pl.BlockSpec((1, block_k, D), lambda bh, qi, ki: (kv_index(bh), ki, 0)),
+            pl.BlockSpec((1, block_k, D), lambda bh, qi, ki: (kv_index(bh), ki, 0)),
+            pl.BlockSpec((1, block_q, D), lambda bh, qi, ki: (bh, qi, 0)),
+            pl.BlockSpec((1, block_q), lambda bh, qi, ki: (bh, qi)),
+            pl.BlockSpec((1, block_q), lambda bh, qi, ki: (bh, qi)),
+        ],
+        out_specs=pl.BlockSpec((1, block_q, D), lambda bh, qi, ki: (bh, qi, 0)),
+        out_shape=jax.ShapeDtypeStruct(qf.shape, q.dtype),
+        scratch_shapes=[pltpu.VMEM((block_q, D), jnp.float32)],
+        interpret=interpret,
+    )(qf, kf, vf, dof, lsef, delta)
+
+    # dk/dv per QUERY head (kv blocks replicated across the group), then
+    # group-summed outside the kernel
+    dkv_grid = (BHq, pl.cdiv(S, block_k), pl.cdiv(S, block_q))
+    dk_h, dv_h = pl.pallas_call(
+        functools.partial(
+            _dkv_kernel, sm_scale=sm_scale, causal=causal, block_k=block_k
+        ),
+        grid=dkv_grid,
+        in_specs=[
+            pl.BlockSpec((1, block_q, D), lambda bh, ki, qi: (bh, qi, 0)),
+            pl.BlockSpec((1, block_k, D), lambda bh, ki, qi: (kv_index(bh), ki, 0)),
+            pl.BlockSpec((1, block_k, D), lambda bh, ki, qi: (kv_index(bh), ki, 0)),
+            pl.BlockSpec((1, block_q, D), lambda bh, ki, qi: (bh, qi, 0)),
+            pl.BlockSpec((1, block_q), lambda bh, ki, qi: (bh, qi)),
+            pl.BlockSpec((1, block_q), lambda bh, ki, qi: (bh, qi)),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, block_k, D), lambda bh, ki, qi: (bh, ki, 0)),
+            pl.BlockSpec((1, block_k, D), lambda bh, ki, qi: (bh, ki, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((BHq, S, D), k.dtype),
+            jax.ShapeDtypeStruct((BHq, S, D), v.dtype),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((block_k, D), jnp.float32),
+            pltpu.VMEM((block_k, D), jnp.float32),
+        ],
+        interpret=interpret,
+    )(qf, kf, vf, dof, lsef, delta)
+
+    dq = dq.reshape(B, Hq, S, D)
+    dk = dk_h.reshape(B, Hkv, group, S, D).sum(axis=2).astype(k.dtype)
+    dv = dv_h.reshape(B, Hkv, group, S, D).sum(axis=2).astype(v.dtype)
+    return dq, dk, dv
+
+
 @functools.partial(
     jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6)
 )
@@ -214,24 +391,31 @@ def _flash_fwd_rule(q, k, v, causal, sm_scale, block_q, block_k):
     scale = _resolve_scale(q, sm_scale)
     S = q.shape[2]
     bq, bk = min(block_q, S), min(block_k, S)
-    o, _lse = _flash_forward(
+    o, lse = _flash_forward(
         q, k, v, causal=causal, sm_scale=scale,
         block_q=bq, block_k=bk, interpret=_use_interpret(),
     )
-    return o, (q, k, v)
+    return o, (q, k, v, o, lse)
 
 
 def _flash_bwd_rule(causal, sm_scale, block_q, block_k, res, g):
-    # Recompute-based backward (flash = recomputation). The reference impl is
-    # numerically identical; swap in a Pallas bwd kernel here when profiled.
-    q, k, v = res
+    q, k, v, o, lse = res
     scale = _resolve_scale(q, sm_scale)
+    S = q.shape[2]
+    bq, bk = min(block_q, S), min(block_k, S)
+    import os as _os
 
-    def ref(q, k, v):
-        return reference.attention(q, k, v, causal=causal, sm_scale=scale)
+    if _os.environ.get("MTPU_FLASH_BWD", "kernel") == "recompute":
+        # XLA-recompute fallback (numerically identical; debugging aid)
+        def ref(q, k, v):
+            return reference.attention(q, k, v, causal=causal, sm_scale=scale)
 
-    _, vjp = jax.vjp(ref, q, k, v)
-    return vjp(g)
+        _, vjp = jax.vjp(ref, q, k, v)
+        return vjp(g)
+    return _flash_backward(
+        q, k, v, o, lse, g, causal=causal, sm_scale=scale,
+        block_q=bq, block_k=bk, interpret=_use_interpret(),
+    )
 
 
 flash_attention.defvjp(_flash_fwd_rule, _flash_bwd_rule)

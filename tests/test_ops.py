@@ -56,6 +56,31 @@ class TestFlashAttention:
         for a, b in zip(g1, g2):
             np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=2e-5)
 
+    @pytest.mark.parametrize("causal", [True, False])
+    def test_bwd_kernel_gqa_multiblock(self, jax, jnp, causal):
+        """Pallas backward kernels (dq/dkv) vs reference grads: GQA group
+        reduction + multiple q/k blocks + causal block skipping."""
+        from modal_examples_tpu.ops import flash_attention, reference
+
+        ks = jax.random.split(jax.random.PRNGKey(11), 3)
+        q = jax.random.normal(ks[0], (2, 4, 256, 64))
+        k = jax.random.normal(ks[1], (2, 2, 256, 64))
+        v = jax.random.normal(ks[2], (2, 2, 256, 64))
+        gq, gk, gv = jax.grad(
+            lambda q, k, v: (flash_attention(q, k, v, causal) ** 2).sum(),
+            argnums=(0, 1, 2),
+        )(q, k, v)
+        rq, rk, rv = jax.grad(
+            lambda q, k, v: (
+                reference.attention(q, k, v, causal=causal) ** 2
+            ).sum(),
+            argnums=(0, 1, 2),
+        )(q, k, v)
+        for a, b in zip((gq, gk, gv), (rq, rk, rv)):
+            np.testing.assert_allclose(
+                np.asarray(a), np.asarray(b), atol=1e-4, rtol=1e-4
+            )
+
     def test_lse_is_logsumexp(self, jax, jnp):
         from modal_examples_tpu.ops import flash_attention_with_lse
 
