@@ -6,7 +6,11 @@ The native-kernel surface replacing the reference's CUDA dependencies
 attention (sequence parallelism the reference lacks).
 """
 
-from .flash_attention import flash_attention, flash_attention_with_lse
+from .flash_attention import (
+    flash_attention,
+    flash_attention_chunked,
+    flash_attention_with_lse,
+)
 from .paged_attention import paged_decode_attention
 from .quantized_matmul import dequantize_int8, quantize_int8, quantized_matmul
 from .ring_attention import ring_attention, ring_attention_sharded
@@ -15,6 +19,7 @@ from . import reference
 __all__ = [
     "dequantize_int8",
     "flash_attention",
+    "flash_attention_chunked",
     "flash_attention_with_lse",
     "paged_decode_attention",
     "quantize_int8",

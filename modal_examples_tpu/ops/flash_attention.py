@@ -50,6 +50,7 @@ def _fwd_kernel(
     causal: bool,
     block_q: int,
     block_k: int,
+    q_offset: int = 0,
 ):
     del block_k  # derivable from refs; kept for signature symmetry
     qi = pl.program_id(1)
@@ -62,9 +63,11 @@ def _fwd_kernel(
         l_scr[:] = jnp.zeros_like(l_scr)
         acc_scr[:] = jnp.zeros_like(acc_scr)
 
-    # causal: k blocks strictly above the diagonal contribute nothing
+    # causal: k blocks strictly above the diagonal contribute nothing.
+    # q_offset shifts query GLOBAL positions (chunked prefill: this q chunk
+    # starts at q_offset within the full sequence the K/V cover).
     block_k = k_ref.shape[1]
-    q_start = qi * block_q
+    q_start = qi * block_q + q_offset
     k_start = ki * block_k
     run = jnp.logical_or(not causal, k_start <= q_start + block_q - 1)
 
@@ -115,30 +118,35 @@ def _fwd_kernel(
 
 def _flash_forward(
     q, k, v, *, causal: bool, sm_scale: float, block_q: int, block_k: int,
-    interpret: bool,
+    interpret: bool, q_offset: int = 0,
 ):
-    B, Hq, S, D = q.shape
-    Hkv = k.shape[1]
-    if S % block_q or S % block_k:
+    B, Hq, S, D = q.shape  # S = query length
+    Hkv, Skv = k.shape[1], k.shape[2]
+    if S % block_q or Skv % block_k:
         raise ValueError(
-            f"sequence length {S} must be a multiple of block sizes "
+            f"lengths (q={S}, kv={Skv}) must be multiples of block sizes "
             f"({block_q}, {block_k}); pad sequences at the model layer"
         )
     if Hq % Hkv:
         raise ValueError(f"query heads {Hq} not a multiple of kv heads {Hkv}")
+    if causal and q_offset + S > Skv:
+        raise ValueError(
+            f"q_offset {q_offset} + q len {S} exceeds kv len {Skv}"
+        )
     group = Hq // Hkv
     # fold (B, Hkv, group) into one leading grid axis; kv index drops `group`
     qf = q.reshape(B * Hkv * group, S, D)
-    kf = k.reshape(B * Hkv, S, D)
-    vf = v.reshape(B * Hkv, S, D)
+    kf = k.reshape(B * Hkv, Skv, D)
+    vf = v.reshape(B * Hkv, Skv, D)
 
-    grid = (B * Hkv * group, pl.cdiv(S, block_q), pl.cdiv(S, block_k))
+    grid = (B * Hkv * group, pl.cdiv(S, block_q), pl.cdiv(Skv, block_k))
     kernel = functools.partial(
         _fwd_kernel,
         sm_scale=sm_scale,
         causal=causal,
         block_q=block_q,
         block_k=block_k,
+        q_offset=q_offset,
     )
     o, lse = pl.pallas_call(
         kernel,
@@ -460,3 +468,27 @@ def flash_attention_with_lse(
     recomputes through the XLA reference (same pattern as flash_attention)."""
     del block_q, block_k  # fixed at 128 (clamped to S) on this path
     return _flash_with_lse(q, k, v, causal, _resolve_scale(q, sm_scale))
+
+
+def flash_attention_chunked(
+    q: jax.Array,  # [B, Hq, S_chunk, D] — queries at positions
+                   # [q_offset, q_offset + S_chunk) of the full sequence
+    k: jax.Array,  # [B, Hkv, S_kv, D] — the full (or so-far) K
+    v: jax.Array,
+    *,
+    q_offset: int,
+    causal: bool = True,
+    sm_scale: float | None = None,
+) -> jax.Array:
+    """Rectangular attention for chunked prefill: one query chunk against a
+    longer K/V prefix (the engine processes long prompts chunk by chunk with
+    bounded VMEM; also the building block for prefix-cache reuse). Forward
+    only — prefill needs no gradients."""
+    scale = _resolve_scale(q, sm_scale)
+    Sq, Skv = q.shape[2], k.shape[2]
+    o, _ = _flash_forward(
+        q, k, v, causal=causal, sm_scale=scale,
+        block_q=min(128, Sq), block_k=min(128, Skv),
+        interpret=_use_interpret(), q_offset=q_offset,
+    )
+    return o

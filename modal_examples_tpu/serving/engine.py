@@ -49,6 +49,7 @@ class Request:
     prompt_tokens: list[int] | None = None
     out_queue: queue.Queue = dataclasses.field(default_factory=queue.Queue)
     created: float = dataclasses.field(default_factory=time.monotonic)
+    aborted: bool = False
 
 
 @dataclasses.dataclass
@@ -210,6 +211,12 @@ class LLMEngine:
                 return
             yield item
 
+    def abort(self, request: Request) -> None:
+        """Cancel a request: waiting ones are dropped at admission; active
+        ones finish at the next scheduler tick and free their slot/pages
+        (the engine-abort surface vLLM exposes for client disconnects)."""
+        request.aborted = True
+
     def start(self) -> "LLMEngine":
         with self._lock:
             if self._running:
@@ -259,6 +266,9 @@ class LLMEngine:
                 req = self.waiting.get_nowait()
             except queue.Empty:
                 break
+            if req.aborted:
+                req.out_queue.put(_FINISH)
+                continue
             n_prompt = len(req.prompt_tokens)
             max_total = min(n_prompt + req.params.max_tokens, self.max_model_len)
             n_pages = self.cache.pages_for(max_total)
@@ -325,6 +335,14 @@ class LLMEngine:
             self._accept_token(slot_idx, slot.last_token)
 
     def _decode_tick(self) -> bool:
+        # reap aborted slots before spending a step on them
+        for i, s in enumerate(self.slots):
+            if not s.free and s.request.aborted:
+                s.request.out_queue.put(_FINISH)
+                self.cache.allocator.free(s.pages)
+                s.request = None
+                s.pages = []
+                self._active[i] = False
         active_idx = [i for i, s in enumerate(self.slots) if not s.free]
         if not active_idx:
             return False

@@ -94,11 +94,32 @@ class TestFlashAttention:
         want = jax.scipy.special.logsumexp(s, axis=-1)
         np.testing.assert_allclose(np.asarray(lse[0, 0]), np.asarray(want), atol=1e-4)
 
+    @pytest.mark.parametrize("q_offset", [0, 128, 256])
+    def test_chunked_prefill_matches_full_rows(self, jax, jnp, q_offset):
+        """A query chunk at offset o against the full K/V must equal rows
+        [o, o+chunk) of dense causal attention over the whole sequence."""
+        from modal_examples_tpu.ops import flash_attention_chunked, reference
+
+        B, H, Skv, D, chunk = 1, 2, 384, 64, 128
+        ks = jax.random.split(jax.random.PRNGKey(3), 3)
+        q = jax.random.normal(ks[0], (B, H, Skv, D), jnp.float32)
+        k = jax.random.normal(ks[1], (B, H, Skv, D), jnp.float32)
+        v = jax.random.normal(ks[2], (B, H, Skv, D), jnp.float32)
+        full = reference.attention(q, k, v, causal=True)
+        out = flash_attention_chunked(
+            q[:, :, q_offset : q_offset + chunk], k, v, q_offset=q_offset
+        )
+        np.testing.assert_allclose(
+            np.asarray(out),
+            np.asarray(full[:, :, q_offset : q_offset + chunk]),
+            atol=2e-5,
+        )
+
     def test_rejects_ragged_seq(self, jax, jnp):
         from modal_examples_tpu.ops import flash_attention
 
         q = jnp.ones((1, 1, 200, 64))
-        with pytest.raises(ValueError, match="multiple of block"):
+        with pytest.raises(ValueError, match="multiples? of block"):
             flash_attention(q, q, q, True)
 
 

@@ -118,6 +118,23 @@ class TestEngine:
         outs = ["".join(engine.stream(r)) for r in reqs]
         assert len(outs) == 8
 
+    def test_abort_frees_slot(self, engine):
+        from modal_examples_tpu.serving import SamplingParams
+
+        req = engine.submit("abort me", SamplingParams(max_tokens=64, temperature=1.0))
+        engine.start()
+        engine.abort(req)
+        out = "".join(engine.stream(req))  # must terminate promptly
+        # all slots eventually free again
+        import time
+
+        deadline = time.monotonic() + 10
+        while time.monotonic() < deadline:
+            if all(s.free for s in engine.slots):
+                break
+            time.sleep(0.05)
+        assert all(s.free for s in engine.slots)
+
     def test_stats_accumulate(self, engine):
         assert engine.stats.generated_tokens > 0
         assert engine.stats.steps > 0
