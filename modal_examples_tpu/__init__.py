@@ -27,7 +27,7 @@ Typical use (mirrors hello_world.py / text_to_image.py in the reference):
 
 from .core.app import App
 from .core.cls import Cls, enter, exit, method, parameter
-from .core.executor import FunctionTimeoutError, InputCancelled
+from .core.executor import FunctionTimeoutError, InputCancelled, current_input_id
 from .core.function import (
     Function,
     FunctionCall,

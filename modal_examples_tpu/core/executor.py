@@ -51,6 +51,19 @@ from . import serialization as ser
 from .retries import Retries
 
 
+import contextvars
+
+#: the input id being processed by the current container thread
+#: (modal.current_input_id parity)
+_current_input_id: contextvars.ContextVar[str | None] = contextvars.ContextVar(
+    "mtpu_input_id", default=None
+)
+
+
+def current_input_id() -> str | None:
+    return _current_input_id.get()
+
+
 class FunctionTimeoutError(TimeoutError):
     pass
 
@@ -158,6 +171,7 @@ def _container_main(conn, cfg_bytes: bytes) -> None:
     inflight = threading.Semaphore(cfg.max_concurrent_inputs)
 
     def run_one(input_id: str, method_name: str, payload: bytes) -> None:
+        _current_input_id.set(input_id)
         try:
             args, kwargs = ser.deserialize(payload)
             result = call_fn(method_name, args, kwargs)

@@ -1,0 +1,64 @@
+"""Static tier: every module in the package byte-compiles and imports, and
+the jax-free layering invariant holds (the reference's typecheck/lint CI
+analog, SURVEY.md §4 — mypy isn't in this image, so the checks are
+compileall + import + an architectural rule)."""
+
+import compileall
+import importlib
+import pkgutil
+import subprocess
+import sys
+from pathlib import Path
+
+import modal_examples_tpu
+
+PKG_ROOT = Path(modal_examples_tpu.__file__).parent
+REPO_ROOT = PKG_ROOT.parent
+
+
+def test_package_bytecompiles():
+    assert compileall.compile_dir(
+        str(PKG_ROOT), quiet=2, force=True
+    ), "syntax errors in package"
+
+
+def test_examples_bytecompile():
+    assert compileall.compile_dir(
+        str(REPO_ROOT / "examples"), quiet=2, force=True
+    ), "syntax errors in examples"
+
+
+def test_every_module_imports():
+    failures = []
+    for mod in pkgutil.walk_packages([str(PKG_ROOT)], "modal_examples_tpu."):
+        if mod.name.endswith("__main__"):
+            continue  # executes the CLI on import by design
+        if "libmtpu_host" in mod.name:
+            continue  # the raw .so is a ctypes library, not a Python module
+        try:
+            importlib.import_module(mod.name)
+        except Exception as e:
+            failures.append(f"{mod.name}: {type(e).__name__}: {e}")
+    assert not failures, failures
+
+
+def test_core_layer_is_jax_free():
+    """The client/control-plane layer must never import jax (chip attach +
+    multi-second import would leak into every CLI invocation)."""
+    code = (
+        "import sys\n"
+        "import modal_examples_tpu\n"
+        "import modal_examples_tpu.core.cli\n"
+        "import modal_examples_tpu.core.executor\n"
+        "import modal_examples_tpu.storage.volume\n"
+        "assert 'jax' not in sys.modules, 'core layer imported jax'\n"
+        "print('jax-free')\n"
+    )
+    out = subprocess.run(
+        [sys.executable, "-c", code],
+        capture_output=True,
+        text=True,
+        timeout=60,
+        env={"PATH": "/usr/bin:/bin:/usr/local/bin", "PYTHONPATH": str(REPO_ROOT)},
+    )
+    assert out.returncode == 0 and "jax-free" in out.stdout, out.stderr
