@@ -56,6 +56,8 @@ class Request:
 class _Slot:
     request: Request | None = None
     pages: list[int] = dataclasses.field(default_factory=list)
+    trie_pages: list[int] = dataclasses.field(default_factory=list)  # release()
+    private_pages: list[int] = dataclasses.field(default_factory=list)  # free()
     position: int = 0  # position of the NEXT token to decode
     last_token: int = 0
     generated: list[int] = dataclasses.field(default_factory=list)
@@ -94,6 +96,7 @@ class LLMEngine:
         n_pages: int | None = None,
         prefill_buckets: tuple[int, ...] = (128, 256, 512, 1024, 2048),
         prefill_batch: int = 4,  # the one compiled prefill batch shape
+        enable_prefix_cache: bool = True,
         seed: int = 0,
         kv_dtype=jnp.bfloat16,
     ):
@@ -122,6 +125,13 @@ class LLMEngine:
             b for b in sorted(prefill_buckets) if b <= max_model_len
         ) or (max_model_len,)
         self.prefill_batch = max(1, min(prefill_batch, max_slots))
+        from .prefix_cache import PrefixCache
+
+        self.prefix_cache = (
+            PrefixCache(self.cache.allocator, page_size)
+            if enable_prefix_cache
+            else None
+        )
 
         self.slots = [_Slot() for _ in range(max_slots)]
         self.waiting: queue.Queue[Request] = queue.Queue()
@@ -187,9 +197,9 @@ class LLMEngine:
 
     def submit(self, prompt: str, params: SamplingParams | None = None) -> Request:
         req = Request(prompt=prompt, params=params or SamplingParams())
-        req.prompt_tokens = self.tokenizer.encode(prompt)[
-            : self.max_model_len - 1
-        ]
+        # prompts clamp to the largest prefill shape (and leave >=1 decode slot)
+        limit = min(self.max_model_len - 1, self.prefill_buckets[-1])
+        req.prompt_tokens = self.tokenizer.encode(prompt)[:limit]
         self.waiting.put(req)
         return req
 
@@ -234,8 +244,15 @@ class LLMEngine:
     # -- scheduler loop ------------------------------------------------------
 
     def _loop(self) -> None:
+        import traceback
+
         while self._running:
-            worked = self.step()
+            try:
+                worked = self.step()
+            except Exception:
+                # a poisoned request must not kill the serving loop
+                traceback.print_exc()
+                worked = False
             if not worked:
                 time.sleep(0.002)
 
@@ -250,7 +267,7 @@ class LLMEngine:
         """Claim slots+pages for waiting requests, then prefill each bucket's
         admissions as ONE batched jitted call (compile shapes: bucket x
         pow2-padded batch — continuous batching on the prefill side too)."""
-        assignments: list[tuple[int, Request, list[int], int]] = []
+        assignments: list[tuple[int, "Request", dict]] = []  # (slot, req, claim)
         while True:
             free_slot = next(
                 (
@@ -269,24 +286,95 @@ class LLMEngine:
             if req.aborted:
                 req.out_queue.put(_FINISH)
                 continue
-            n_prompt = len(req.prompt_tokens)
-            max_total = min(n_prompt + req.params.max_tokens, self.max_model_len)
-            n_pages = self.cache.pages_for(max_total)
-            try:
-                pages = self.cache.allocator.alloc(n_pages)
-            except OutOfPages:
+            claim = self._claim_pages(req)
+            if claim is None:
                 self.waiting.put(req)  # no KV room: wait for a completion
                 break
-            assignments.append((free_slot, req, pages, n_prompt))
+            assignments.append((free_slot, req, claim))
 
         by_bucket: dict[int, list] = {}
         for a in assignments:
-            by_bucket.setdefault(self._bucket_for(a[3]), []).append(a)
+            by_bucket.setdefault(self._bucket_for(a[2]["n_prompt"]), []).append(a)
         for bucket, group in by_bucket.items():
             # chunk to the ONE compiled batch shape per bucket
             for i in range(0, len(group), self.prefill_batch):
-                self._prefill_group(bucket, group[i : i + self.prefill_batch])
+                chunk = group[i : i + self.prefill_batch]
+                try:
+                    self._prefill_group(bucket, chunk)
+                except Exception:
+                    # a failed prefill must not leak claims, hang callers, or
+                    # leave never-written KV pages in the prefix trie
+                    import traceback
+
+                    traceback.print_exc()
+                    for slot_idx, req, claim in chunk:
+                        if self.prefix_cache is not None:
+                            self.prefix_cache.invalidate(claim["trie_pages"])
+                        # trie pages another request still holds stay theirs;
+                        # free everything this claim exclusively owns
+                        owned = [
+                            p for p in claim["private_pages"]
+                        ] + [
+                            p for p in claim["trie_pages"]
+                            if self.prefix_cache is None
+                            or p not in self.prefix_cache._by_page
+                        ]
+                        self.cache.allocator.free(owned)
+                        slot = self.slots[slot_idx]
+                        slot.request = None
+                        slot.pages = slot.trie_pages = slot.private_pages = []
+                        req.out_queue.put(_FINISH)
         return bool(assignments)
+
+    def _claim_pages(self, req: Request) -> dict | None:
+        """Slot page claim with prefix-cache sharing + eviction pressure."""
+        n_prompt = len(req.prompt_tokens)
+        max_total = min(n_prompt + req.params.max_tokens, self.max_model_len)
+        n_pages = self.cache.pages_for(max_total)
+        pc = self.prefix_cache
+        shared: list[int] = []
+        if pc is not None:
+            shared, _ = pc.acquire(req.prompt_tokens)
+        need = n_pages - len(shared)
+        try:
+            fresh = self.cache.allocator.alloc(need)
+        except OutOfPages:
+            if pc is not None:
+                pc.evict(need)  # reclaim zero-ref cached pages and retry
+                try:
+                    fresh = self.cache.allocator.alloc(need)
+                except OutOfPages:
+                    pc.release(shared)
+                    return None
+            else:
+                return None
+        pages = shared + fresh
+        trie_pages, private_pages = list(shared), list(fresh)
+        if pc is not None:
+            pc.hits += bool(shared)
+            pc.misses += not shared
+            n_full = n_prompt // self.cache.page_size
+            final, displaced = pc.insert(
+                req.prompt_tokens, pages[:n_full], len(shared)
+            )
+            self.cache.allocator.free(displaced)
+            trie_pages = list(final)
+            private_pages = pages[n_full:]  # everything past the full-prompt
+            pages = final + private_pages   # pages is trie-tracked
+        return {
+            "pages": pages,
+            "trie_pages": trie_pages,
+            "private_pages": private_pages,
+            "n_prompt": n_prompt,
+        }
+
+    def _release_slot_pages(self, slot: _Slot) -> None:
+        if self.prefix_cache is not None:
+            self.prefix_cache.release(slot.trie_pages)
+            self.cache.allocator.free(slot.private_pages)
+        else:
+            self.cache.allocator.free(slot.pages)
+        slot.pages, slot.trie_pages, slot.private_pages = [], [], []
 
     def _prefill_group(self, bucket: int, group: list) -> None:
         B = self.prefill_batch  # fixed compile shape; short groups pad
@@ -297,10 +385,13 @@ class LLMEngine:
         temps = np.ones((B,), np.float32)
         top_ps = np.ones((B,), np.float32)
         top_ks = np.zeros((B,), np.int32)
-        for i, (slot_idx, req, pages, n_prompt) in enumerate(group):
+        for i, (slot_idx, req, claim) in enumerate(group):
+            pages, n_prompt = claim["pages"], claim["n_prompt"]
             slot = self.slots[slot_idx]
             slot.request = req
             slot.pages = pages
+            slot.trie_pages = claim["trie_pages"]
+            slot.private_pages = claim["private_pages"]
             slot.generated = []
             slot.emitted_text_len = 0
             table = np.zeros((self.pages_per_slot,), np.int32)
@@ -327,10 +418,10 @@ class LLMEngine:
             jnp.asarray(top_ks),
         )
         next_np = np.asarray(next_tok)
-        for i, (slot_idx, req, _pages, n_prompt) in enumerate(group):
+        for i, (slot_idx, req, claim) in enumerate(group):
             slot = self.slots[slot_idx]
-            self.stats.prompt_tokens += n_prompt
-            slot.position = n_prompt
+            self.stats.prompt_tokens += claim["n_prompt"]
+            slot.position = claim["n_prompt"]
             slot.last_token = int(next_np[i])
             self._accept_token(slot_idx, slot.last_token)
 
@@ -339,9 +430,8 @@ class LLMEngine:
         for i, s in enumerate(self.slots):
             if not s.free and s.request.aborted:
                 s.request.out_queue.put(_FINISH)
-                self.cache.allocator.free(s.pages)
+                self._release_slot_pages(s)
                 s.request = None
-                s.pages = []
                 self._active[i] = False
         active_idx = [i for i, s in enumerate(self.slots) if not s.free]
         if not active_idx:
@@ -409,9 +499,8 @@ class LLMEngine:
             slot.emitted_text_len = len(text)
         if finished:
             req.out_queue.put(_FINISH)
-            self.cache.allocator.free(slot.pages)
+            self._release_slot_pages(slot)
             slot.request = None
-            slot.pages = []
             self._active[slot_idx] = False
 
 
