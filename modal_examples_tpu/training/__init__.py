@@ -1,6 +1,7 @@
 """Training: mesh-sharded train steps, optimizers, checkpoint/resume."""
 
 from .checkpoints import CheckpointManager
+from .resilience import PreemptionGuard, device_health, run_resilient
 from .trainer import (
     TrainState,
     Trainer,
@@ -11,9 +12,12 @@ from .trainer import (
 
 __all__ = [
     "CheckpointManager",
+    "PreemptionGuard",
     "TrainState",
     "Trainer",
     "cross_entropy_loss",
+    "device_health",
     "make_optimizer",
+    "run_resilient",
     "warmup_cosine",
 ]

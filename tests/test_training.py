@@ -147,6 +147,64 @@ class TestCheckpoints:
         assert vol.version == v0 + 1
 
 
+class TestResilience:
+    def _tiny_setup(self, jax, lr=1e-2):
+        import jax.numpy as jnp
+
+        from modal_examples_tpu.training import Trainer, make_optimizer
+
+        def loss_fn(params, batch):
+            return jnp.mean((batch["x"] @ params["w"] - batch["y"]) ** 2)
+
+        t = Trainer(loss_fn, make_optimizer(lr, grad_clip=1e9))
+        state = t.init_state({"w": jnp.ones((4, 1))})
+        batch = {
+            "x": jax.random.normal(jax.random.PRNGKey(0), (8, 4)),
+            "y": jax.random.normal(jax.random.PRNGKey(1), (8, 1)),
+        }
+        return t, state, batch
+
+    def test_preemption_triggers_emergency_checkpoint(self, jax_cpu, tmp_path):
+        import itertools
+        import os
+        import signal
+
+        from modal_examples_tpu.training import CheckpointManager, run_resilient
+
+        t, state, batch = self._tiny_setup(jax_cpu)
+        mgr = CheckpointManager(tmp_path / "resil", keep_n=3)
+
+        def batches():
+            for i in itertools.count():
+                if i == 3:  # the "preemption notice" arrives mid-training
+                    os.kill(os.getpid(), signal.SIGTERM)
+                yield batch
+
+        state, step, preempted = run_resilient(
+            t, state, batches(), mgr, total_steps=100, save_every=50
+        )
+        assert preempted
+        assert step < 100
+        assert mgr.latest_step() == step  # emergency checkpoint landed
+
+    def test_clean_run_periodic_saves(self, jax_cpu, tmp_path):
+        from modal_examples_tpu.training import CheckpointManager, run_resilient
+
+        t, state, batch = self._tiny_setup(jax_cpu)
+        mgr = CheckpointManager(tmp_path / "clean", keep_n=5)
+        state, step, preempted = run_resilient(
+            t, state, iter([batch] * 10), mgr, total_steps=10, save_every=4
+        )
+        assert not preempted and step == 10
+        assert mgr.steps() == [4, 8, 10]
+
+    def test_device_health(self, jax_cpu):
+        from modal_examples_tpu.training import device_health
+
+        report = device_health()
+        assert all(v == "ok" for v in report.values())
+
+
 class TestGraftEntry:
     def test_dryrun_multichip(self, jax):
         import __graft_entry__ as g
