@@ -1,0 +1,169 @@
+"""Mixture-of-Experts: top-k routing + expert parallelism over a mesh axis.
+
+The reference serves MoE models (Gemma-4-26B-A4B via vllm_inference.py:54-58,
+Qwen MoE, DeepSeek configs) but leaves expert parallelism inside the CUDA
+engines (SURVEY.md §2.3: "MoE routing + expert sharding on mesh axis;
+all_to_all over ICI" is ours to build). This module implements the GShard
+dispatch TPU-natively:
+
+- top-k softmax routing with per-(group, expert) capacity and position-in-
+  expert assignment (static shapes: dropped tokens are zeroed, not ragged);
+- ``moe_mlp``: the single-device ground truth (groups = what shards will
+  see, so the EP result is bit-identical);
+- ``moe_mlp_ep``: the same math under shard_map with experts sharded over an
+  ``expert`` mesh axis — dispatch/return ride two ``all_to_all``s (ICI on a
+  real slice);
+- the standard load-balancing auxiliary loss.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import functools
+
+import jax
+import jax.numpy as jnp
+
+
+@dataclasses.dataclass(frozen=True)
+class MoEConfig:
+    n_experts: int = 8
+    top_k: int = 2
+    capacity_factor: float = 1.25
+    d_model: int = 64
+    d_ff: int = 128
+
+    def capacity(self, tokens_per_group: int) -> int:
+        c = int(self.capacity_factor * self.top_k * tokens_per_group / self.n_experts)
+        return max(c, 1)
+
+
+def init_params(key: jax.Array, cfg: MoEConfig, dtype=jnp.float32) -> dict:
+    k1, k2, k3 = jax.random.split(key, 3)
+    E, D, F = cfg.n_experts, cfg.d_model, cfg.d_ff
+    s_in, s_out = D**-0.5, F**-0.5
+    return {
+        "router": jax.random.normal(k1, (D, E), dtype) * s_in,
+        "w_in": jax.random.normal(k2, (E, D, F), dtype) * s_in,
+        "w_out": jax.random.normal(k3, (E, F, D), dtype) * s_out,
+    }
+
+
+def _route(x: jax.Array, router: jax.Array, cfg: MoEConfig, capacity: int):
+    """Per-group dispatch/combine tensors.
+
+    x: [T, D] (one group). Returns (dispatch [T, E, C] bool-ish f32,
+    combine [T, E, C] f32 weights, aux_loss scalar).
+    """
+    T = x.shape[0]
+    E = cfg.n_experts
+    logits = jnp.dot(x, router, preferred_element_type=jnp.float32)  # [T, E]
+    probs = jax.nn.softmax(logits, axis=-1)
+
+    # aux load-balance loss (Switch): mean prob mass * mean assignment frac
+    top1 = jnp.argmax(probs, axis=-1)
+    frac_tokens = jnp.mean(jax.nn.one_hot(top1, E), axis=0)
+    frac_probs = jnp.mean(probs, axis=0)
+    aux = E * jnp.sum(frac_tokens * frac_probs)
+
+    topk_p, topk_idx = jax.lax.top_k(probs, cfg.top_k)  # [T, k]
+    topk_p = topk_p / jnp.sum(topk_p, axis=-1, keepdims=True)  # renormalize
+
+    dispatch = jnp.zeros((T, E, capacity), jnp.float32)
+    combine = jnp.zeros((T, E, capacity), jnp.float32)
+    counts = jnp.zeros((E,), jnp.int32)  # slots used per expert so far
+    for k in range(cfg.top_k):
+        e_k = topk_idx[:, k]  # [T]
+        onehot = jax.nn.one_hot(e_k, E, dtype=jnp.int32)  # [T, E]
+        # position of each token within its expert (prior ks first)
+        pos_in_e = jnp.cumsum(onehot, axis=0) - 1 + counts[None, :]  # [T, E]
+        pos = jnp.take_along_axis(pos_in_e, e_k[:, None], 1)[:, 0]  # [T]
+        keep = pos < capacity
+        slot = jnp.clip(pos, 0, capacity - 1)
+        d_k = (
+            jax.nn.one_hot(e_k, E)[:, :, None]
+            * jax.nn.one_hot(slot, capacity)[:, None, :]
+            * keep[:, None, None]
+        )
+        dispatch = dispatch + d_k
+        combine = combine + d_k * topk_p[:, k][:, None, None]
+        counts = counts + jnp.sum(onehot * keep[:, None].astype(jnp.int32), axis=0)
+    return dispatch, combine, aux
+
+
+def _expert_ffn(w_in, w_out, h):
+    """h: [..., C, D] per expert; gelu MLP with that expert's weights."""
+    return jnp.einsum(
+        "...cf,fd->...cd",
+        jax.nn.gelu(jnp.einsum("...cd,df->...cf", h, w_in)),
+        w_out,
+    )
+
+
+def moe_mlp(
+    params: dict, x: jax.Array, cfg: MoEConfig, *, groups: int = 1
+) -> tuple[jax.Array, jax.Array]:
+    """Ground-truth MoE layer. x: [T, D]; ``groups`` partitions tokens the
+    way EP shards would (so capacities — and therefore drops — match the
+    sharded version exactly). Returns (out [T, D], aux_loss)."""
+    T, D = x.shape
+    assert T % groups == 0
+    tg = T // groups
+    cap = cfg.capacity(tg)
+    xg = x.reshape(groups, tg, D)
+
+    def per_group(xg_i):
+        dispatch, combine, aux = _route(xg_i, params["router"], cfg, cap)
+        expert_in = jnp.einsum("tec,td->ecd", dispatch, xg_i)  # [E, C, D]
+        expert_out = jax.vmap(_expert_ffn)(
+            params["w_in"], params["w_out"], expert_in
+        )  # [E, C, D]
+        out = jnp.einsum("tec,ecd->td", combine, expert_out)
+        return out, aux
+
+    out, aux = jax.vmap(per_group)(xg)
+    return out.reshape(T, D), jnp.mean(aux)
+
+
+def moe_mlp_ep(
+    params: dict, x: jax.Array, cfg: MoEConfig, mesh, *, axis: str = "expert"
+) -> tuple[jax.Array, jax.Array]:
+    """Expert-parallel MoE: tokens AND experts sharded over ``axis``; the
+    dispatched activations cross shards via all_to_all (ICI), compute runs
+    on each shard's local experts, results ride all_to_all back."""
+    from jax.sharding import PartitionSpec as P
+
+    n_shards = mesh.shape[axis]
+    E_loc = cfg.n_experts // n_shards
+    T = x.shape[0]
+    cap = cfg.capacity(T // n_shards)
+
+    def shard_fn(router, w_in, w_out, x_loc):
+        D = x_loc.shape[-1]
+        dispatch, combine, aux = _route(x_loc, router, cfg, cap)  # [t, E, C]
+        expert_in = jnp.einsum("tec,td->ecd", dispatch, x_loc)  # [E, C, D]
+        # global expert e = owner_shard * E_loc + e_loc (blocked layout):
+        # send each owner its slice, receive every shard's tokens for OUR
+        # local experts. untiled all_to_all on dim 0: consumed, and the
+        # received blocks stack as a new leading dim of size S.
+        send = expert_in.reshape(n_shards, E_loc, cap, D)
+        recv = jax.lax.all_to_all(send, axis, 0, 0, tiled=False)  # [S, E_loc, C, D]
+        h = recv.transpose(1, 0, 2, 3).reshape(E_loc, n_shards * cap, D)
+        out_loc = jax.vmap(_expert_ffn)(w_in, w_out, h)  # [E_loc, S*C, D]
+        # return every shard's results to it, then reassemble global E order
+        back = jax.lax.all_to_all(
+            out_loc.reshape(E_loc, n_shards, cap, D).transpose(1, 0, 2, 3),
+            axis, 0, 0, tiled=False,
+        )  # [S, E_loc, C, D] — block j = my tokens through shard j's experts
+        expert_out = back.reshape(cfg.n_experts, cap, D)
+        out = jnp.einsum("tec,ecd->td", combine, expert_out)
+        return out, aux[None]  # rank-1 so shards concatenate over the axis
+
+    out, aux = jax.shard_map(
+        shard_fn,
+        mesh=mesh,
+        in_specs=(P(), P(axis), P(axis), P(axis)),
+        out_specs=(P(axis), P(axis)),
+        check_vma=False,
+    )(params["router"], params["w_in"], params["w_out"], x)
+    return out, jnp.mean(aux)
