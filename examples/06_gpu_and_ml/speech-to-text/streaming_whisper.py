@@ -1,0 +1,80 @@
+# # Streaming transcription
+#
+# Counterpart of the reference's speech-to-text streaming tier
+# (streaming_whisper.py, streaming_parakeet.py — websocket streaming ASR):
+# long audio is windowed into chunks, each chunk transcribes as it arrives,
+# and partial transcripts stream back — as a `.remote_gen` generator and as
+# an SSE web endpoint (07_web/streaming.py:38-45 transport).
+#
+# Run:   tpurun run examples/06_gpu_and_ml/speech-to-text/streaming_whisper.py
+# Serve: tpurun serve ... then curl -N '<url>/transcribe_stream'
+
+import os
+
+import modal_examples_tpu as mtpu
+
+TPU = os.environ.get("MTPU_TPU", "") or None
+CHUNK_SECONDS = 1.0
+MEL_FRAMES = 200
+
+app = mtpu.App("example-streaming-whisper")
+
+
+def _model():
+    import dataclasses
+
+    import jax
+
+    from modal_examples_tpu.models import whisper
+
+    cfg = dataclasses.replace(
+        whisper.WhisperConfig.test_tiny(), vocab_size=16, n_text_ctx=8
+    )
+    params = whisper.init_params(jax.random.PRNGKey(0), cfg)
+    return cfg, params
+
+
+@app.function(tpu=TPU, timeout=900)
+def transcribe_stream(seconds: float = 4.0):
+    """Generator: one partial transcript per audio window as it 'arrives'."""
+    import numpy as np
+
+    from modal_examples_tpu.models import whisper
+    from modal_examples_tpu.utils.audio import (
+        SAMPLE_RATE, log_mel_spectrogram, synth_tone_audio,
+    )
+
+    cfg, params = _model()
+    # the "microphone": a long synthetic tone sweep
+    audio = np.concatenate(
+        [synth_tone_audio([440.0 * (1 + i)], CHUNK_SECONDS) for i in range(int(seconds))]
+    )
+    window = int(CHUNK_SECONDS * SAMPLE_RATE)
+    for i in range(0, len(audio), window):
+        chunk = audio[i : i + window]
+        mel = log_mel_spectrogram(chunk, pad_to_chunk=False)
+        mel = np.pad(
+            mel[:MEL_FRAMES], ((0, MEL_FRAMES - min(len(mel), MEL_FRAMES)), (0, 0))
+        )
+        toks = whisper.greedy_transcribe(
+            params, mel[None], cfg, bos_id=0, eos_id=1
+        )
+        text = " ".join(str(t) for t in np.asarray(toks[0]) if t != 1)
+        yield {"t": round(i / SAMPLE_RATE, 1), "partial": f"[{text}]"}
+
+
+@app.function()
+@mtpu.fastapi_endpoint()
+def transcribe_sse(seconds: float = 3.0):
+    """The same stream over SSE (curl -N)."""
+    yield from transcribe_stream.local(seconds)
+
+
+@app.local_entrypoint()
+def main(seconds: float = 3.0):
+    n = 0
+    for update in transcribe_stream.remote_gen(seconds):
+        print(f"t={update['t']}s partial={update['partial']}")
+        n += 1
+    assert n == int(seconds)
+    print(f"streamed {n} partial transcripts")

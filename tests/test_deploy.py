@@ -1,0 +1,56 @@
+"""Deployment registry: apps deployed from one process are resolvable and
+invocable from a DIFFERENT process via App.lookup / Function.from_name."""
+
+import json
+import os
+import subprocess
+import sys
+import textwrap
+from pathlib import Path
+
+
+def test_deploy_then_lookup_cross_process(tmp_path, state_dir):
+    app_file = tmp_path / "deployable_app.py"
+    app_file.write_text(
+        textwrap.dedent(
+            """
+            import modal_examples_tpu as mtpu
+
+            app = mtpu.App("deployed-cross-process")
+
+            @app.function(timeout=60)
+            def triple(x: int) -> int:
+                return x * 3
+            """
+        )
+    )
+    env = {
+        **os.environ,
+        "MTPU_STATE_DIR": str(state_dir),
+        "PYTHONPATH": str(Path(__file__).resolve().parents[1]),
+    }
+    # process 1: deploy
+    out = subprocess.run(
+        [sys.executable, "-m", "modal_examples_tpu", "deploy", "--no-scheduler",
+         str(app_file)],
+        capture_output=True, text=True, timeout=120, env=env,
+    )
+    assert out.returncode == 0, out.stderr
+    registry = json.loads((state_dir / "apps.json").read_text())
+    assert "deployed-cross-process" in registry
+
+    # process 2: lookup + invoke (imports the module from the registry path)
+    code = textwrap.dedent(
+        """
+        import modal_examples_tpu as mtpu
+
+        f = mtpu.Function.from_name("deployed-cross-process", "triple")
+        print("RESULT", f.remote(14))
+        """
+    )
+    out2 = subprocess.run(
+        [sys.executable, "-c", code],
+        capture_output=True, text=True, timeout=180, env=env,
+    )
+    assert out2.returncode == 0, out2.stderr
+    assert "RESULT 42" in out2.stdout
