@@ -49,6 +49,12 @@ class LlamaConfig:
     max_seq_len: int = 4096
     dtype: str = "bfloat16"
     tie_embeddings: bool = False
+    # MoE (Mixtral-style): n_experts > 0 replaces the dense SwiGLU MLP with
+    # a routed expert MLP (models.moe); serving decode for MoE is a
+    # round-2 item — training/forward support here.
+    n_experts: int = 0
+    top_k_experts: int = 2
+    expert_capacity_factor: float = 1.5
 
     @property
     def head_dim(self) -> int:
@@ -120,6 +126,19 @@ def init_params(key: jax.Array, cfg: LlamaConfig) -> dict:
     def dense(k, *shape):
         return layers.init_dense(k, shape, dtype=dt)
 
+    if cfg.n_experts > 0:
+        E = cfg.n_experts
+        mlp = {
+            "router": dense(keys[5], L, D, E),
+            "moe_w_in": dense(keys[6], L, E, D, F),
+            "moe_w_out": dense(keys[7], L, E, F, D),
+        }
+    else:
+        mlp = {
+            "gate": dense(keys[5], L, D, F),
+            "up": dense(keys[6], L, D, F),
+            "down": dense(keys[7], L, F, D),
+        }
     params = {
         "embed": layers.init_dense(keys[0], (cfg.vocab_size, D), scale=0.02, dtype=dt),
         "layers": {
@@ -129,9 +148,7 @@ def init_params(key: jax.Array, cfg: LlamaConfig) -> dict:
             "wv": dense(keys[3], L, D, KVH * hd),
             "wo": dense(keys[4], L, H * hd, D),
             "mlp_norm": jnp.ones((L, D), dt),
-            "gate": dense(keys[5], L, D, F),
-            "up": dense(keys[6], L, D, F),
-            "down": dense(keys[7], L, F, D),
+            **mlp,
         },
         "final_norm": jnp.ones((D,), dt),
     }
@@ -148,6 +165,20 @@ def partition_specs(cfg: LlamaConfig) -> dict:
     all-reduce over ICI (replaces the reference's engine-internal NCCL TP,
     vllm_inference.py:179-180).
     """
+    if cfg.n_experts > 0:
+        # MoE: shard the ffn dim over tensor (expert-axis sharding goes
+        # through moe.moe_mlp_ep / shard_map, not these specs)
+        mlp_specs = {
+            "router": P(None, None, None),
+            "moe_w_in": P(None, None, None, "tensor"),
+            "moe_w_out": P(None, None, "tensor", None),
+        }
+    else:
+        mlp_specs = {
+            "gate": P(None, None, "tensor"),
+            "up": P(None, None, "tensor"),
+            "down": P(None, "tensor", None),
+        }
     specs = {
         "embed": P("tensor", None),  # vocab-sharded
         "layers": {
@@ -157,9 +188,7 @@ def partition_specs(cfg: LlamaConfig) -> dict:
             "wv": P(None, None, "tensor"),
             "wo": P(None, "tensor", None),
             "mlp_norm": P(None, None),
-            "gate": P(None, None, "tensor"),
-            "up": P(None, None, "tensor"),
-            "down": P(None, "tensor", None),
+            **mlp_specs,
         },
         "final_norm": P(None),
     }
@@ -185,7 +214,8 @@ def forward(
     attn_impl: str = "flash",
     lora: dict | None = None,  # adapter pytree (models.lora), applied on the fly
     lora_scale: float = 1.0,
-) -> jax.Array:  # [B, S, vocab]
+    return_aux: bool = False,  # MoE: also return the mean load-balance loss
+):  # [B, S, vocab] (, aux)
     """Full-sequence forward with causal attention (flash or xla impl)."""
     B, S = tokens.shape
     if positions is None:
@@ -208,20 +238,42 @@ def forward(
         )
         x = x + h
         h = layers.rms_norm(x, layer["mlp_norm"], cfg.norm_eps)
-        h = layers.swiglu_mlp(
-            {k: layer[k] for k in ("gate", "up", "down")}, h,
-            lora=llayer, lora_scale=lora_scale,
-        )
-        return x + h, None
+        if cfg.n_experts > 0:
+            from . import moe as _moe
+
+            mcfg = _moe.MoEConfig(
+                n_experts=cfg.n_experts, top_k=cfg.top_k_experts,
+                capacity_factor=cfg.expert_capacity_factor,
+                d_model=cfg.dim, d_ff=cfg.ffn_dim,
+            )
+            mparams = {
+                "router": layer["router"],
+                "w_in": layer["moe_w_in"],
+                "w_out": layer["moe_w_out"],
+            }
+            flat, aux = _moe.moe_mlp(
+                mparams, h.reshape(-1, cfg.dim).astype(jnp.float32), mcfg
+            )
+            h = flat.reshape(h.shape).astype(h.dtype)
+        else:
+            aux = jnp.zeros((), jnp.float32)
+            h = layers.swiglu_mlp(
+                {k: layer[k] for k in ("gate", "up", "down")}, h,
+                lora=llayer, lora_scale=lora_scale,
+            )
+        return x + h, aux
 
     xs = (
         (_layer_stack(params), lora["layers"]) if lora is not None
         else _layer_stack(params)
     )
-    x, _ = jax.lax.scan(layer_fn, x, xs)
+    x, aux_per_layer = jax.lax.scan(layer_fn, x, xs)
     x = layers.rms_norm(x, params["final_norm"], cfg.norm_eps)
     head = params["embed"].T if cfg.tie_embeddings else params["lm_head"]
-    return jnp.dot(x, head, preferred_element_type=jnp.float32)
+    logits = jnp.dot(x, head, preferred_element_type=jnp.float32)
+    if return_aux:
+        return logits, jnp.mean(aux_per_layer)
+    return logits
 
 
 # -- serving: prefill + paged decode ----------------------------------------
