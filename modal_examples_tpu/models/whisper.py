@@ -201,6 +201,95 @@ def forward(params, mel, tokens, cfg: WhisperConfig) -> jax.Array:
     return decode(params, tokens, encode(params, mel, cfg), cfg)
 
 
+def load_hf_weights(model_dir, cfg: WhisperConfig, dtype=None) -> dict:
+    """Map an HF openai/whisper-* safetensors checkpoint into this tree.
+
+    HF layout: model.encoder.conv{1,2} (torch conv1d [out,in,k]),
+    encoder/decoder.layers.{i}.self_attn (no k bias), decoder encoder_attn,
+    fc1/fc2 MLPs, learned decoder positions, tied proj_out.
+    """
+    from pathlib import Path
+
+    import numpy as np
+    from safetensors import safe_open
+
+    dt = dtype or cfg.jnp_dtype
+    raw: dict[str, np.ndarray] = {}
+    for f in sorted(Path(model_dir).glob("*.safetensors")):
+        with safe_open(str(f), framework="np") as sf:
+            for name in sf.keys():
+                raw[name.removeprefix("model.")] = sf.get_tensor(name)
+
+    def g(name, transpose=False):
+        arr = raw[name]
+        return jnp.asarray(arr.T if transpose else arr, dtype=dt)
+
+    def stack(side: str, fmt: str, L: int, transpose=False):
+        return jnp.asarray(
+            np.stack(
+                [
+                    raw[f"{side}.layers.{i}.{fmt}"].T
+                    if transpose
+                    else raw[f"{side}.layers.{i}.{fmt}"]
+                    for i in range(L)
+                ]
+            ),
+            dtype=dt,
+        )
+
+    def block(side: str, L: int, cross: bool) -> dict:
+        p = {
+            "ln1_w": stack(side, "self_attn_layer_norm.weight", L),
+            "ln1_b": stack(side, "self_attn_layer_norm.bias", L),
+            "wq": stack(side, "self_attn.q_proj.weight", L, True),
+            "bq": stack(side, "self_attn.q_proj.bias", L),
+            "wk": stack(side, "self_attn.k_proj.weight", L, True),
+            "wv": stack(side, "self_attn.v_proj.weight", L, True),
+            "bv": stack(side, "self_attn.v_proj.bias", L),
+            "wo": stack(side, "self_attn.out_proj.weight", L, True),
+            "bo": stack(side, "self_attn.out_proj.bias", L),
+            "ln2_w": stack(side, "final_layer_norm.weight", L),
+            "ln2_b": stack(side, "final_layer_norm.bias", L),
+            "fc_w": stack(side, "fc1.weight", L, True),
+            "fc_b": stack(side, "fc1.bias", L),
+            "proj_w": stack(side, "fc2.weight", L, True),
+            "proj_b": stack(side, "fc2.bias", L),
+        }
+        if cross:
+            p.update({
+                "xln_w": stack(side, "encoder_attn_layer_norm.weight", L),
+                "xln_b": stack(side, "encoder_attn_layer_norm.bias", L),
+                "xwq": stack(side, "encoder_attn.q_proj.weight", L, True),
+                "xbq": stack(side, "encoder_attn.q_proj.bias", L),
+                "xwk": stack(side, "encoder_attn.k_proj.weight", L, True),
+                "xwv": stack(side, "encoder_attn.v_proj.weight", L, True),
+                "xbv": stack(side, "encoder_attn.v_proj.bias", L),
+                "xwo": stack(side, "encoder_attn.out_proj.weight", L, True),
+                "xbo": stack(side, "encoder_attn.out_proj.bias", L),
+            })
+        return p
+
+    return {
+        # torch conv1d [out, in, k] -> ours [k, in, out]
+        "conv1_w": jnp.asarray(
+            raw["encoder.conv1.weight"].transpose(2, 1, 0), dtype=dt
+        ),
+        "conv1_b": g("encoder.conv1.bias"),
+        "conv2_w": jnp.asarray(
+            raw["encoder.conv2.weight"].transpose(2, 1, 0), dtype=dt
+        ),
+        "conv2_b": g("encoder.conv2.bias"),
+        "enc": block("encoder", cfg.n_audio_layers, cross=False),
+        "enc_ln_w": g("encoder.layer_norm.weight"),
+        "enc_ln_b": g("encoder.layer_norm.bias"),
+        "tok_emb": g("decoder.embed_tokens.weight"),
+        "pos_emb": g("decoder.embed_positions.weight"),
+        "dec": block("decoder", cfg.n_text_layers, cross=True),
+        "dec_ln_w": g("decoder.layer_norm.weight"),
+        "dec_ln_b": g("decoder.layer_norm.bias"),
+    }
+
+
 def greedy_transcribe(
     params: dict,
     mel: jax.Array,  # [B, T, n_mels]

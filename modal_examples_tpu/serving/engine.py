@@ -221,6 +221,44 @@ class LLMEngine:
                 return
             yield item
 
+    def warmup(self, buckets: tuple[int, ...] | None = None) -> float:
+        """Pre-compile the decode step and prefill buckets against trash
+        pages (no allocator state touched) — the FAST_BOOT-style cold-start
+        control (vllm_inference.py:85-101): pay compiles at boot, not on the
+        first user request. Returns seconds spent."""
+        t0 = time.monotonic()
+        for bucket in buckets or self.prefill_buckets:
+            B = self.prefill_batch
+            _tok, self.cache.k_pages, self.cache.v_pages = self._prefill_jit(
+                (bucket, B)
+            )(
+                self.params,
+                self.cache.k_pages,
+                self.cache.v_pages,
+                jnp.zeros((B, bucket), jnp.int32),
+                jnp.zeros((B, self.pages_per_slot), jnp.int32),
+                jnp.ones((B,), jnp.int32),
+                self._next_key(),
+                jnp.ones((B,), jnp.float32),
+                jnp.ones((B,), jnp.float32),
+                jnp.zeros((B,), jnp.int32),
+            )
+        _tok, self.cache.k_pages, self.cache.v_pages = self._decode_jit(
+            self.params,
+            self.cache.k_pages,
+            self.cache.v_pages,
+            jnp.zeros((self.max_slots,), jnp.int32),
+            jnp.zeros((self.max_slots,), jnp.int32),
+            jnp.zeros((self.max_slots, self.pages_per_slot), jnp.int32),
+            jnp.zeros((self.max_slots,), bool),
+            self._next_key(),
+            jnp.ones((self.max_slots,), jnp.float32),
+            jnp.ones((self.max_slots,), jnp.float32),
+            jnp.zeros((self.max_slots,), jnp.int32),
+        )
+        jax.block_until_ready(self.cache.k_pages)
+        return time.monotonic() - t0
+
     def abort(self, request: Request) -> None:
         """Cancel a request: waiting ones are dropped at admission; active
         ones finish at the next scheduler tick and free their slot/pages

@@ -74,6 +74,83 @@ class TestWhisperModel:
         )
         assert out.shape == (2, 7)
 
+    def test_hf_weight_roundtrip(self, jax, tmp_path):
+        """Export our random params under HF names, load them back through
+        load_hf_weights, and verify the tree is bit-identical — proves the
+        name/transpose mapping."""
+        import numpy as np
+        from safetensors.numpy import save_file
+
+        from modal_examples_tpu.models import whisper
+
+        cfg = whisper.WhisperConfig.test_tiny()
+        params = whisper.init_params(jax.random.PRNGKey(0), cfg)
+
+        raw: dict[str, np.ndarray] = {}
+        raw["model.encoder.conv1.weight"] = np.ascontiguousarray(
+            np.asarray(params["conv1_w"]).transpose(2, 1, 0)
+        )
+        raw["model.encoder.conv1.bias"] = np.asarray(params["conv1_b"])
+        raw["model.encoder.conv2.weight"] = np.ascontiguousarray(
+            np.asarray(params["conv2_w"]).transpose(2, 1, 0)
+        )
+        raw["model.encoder.conv2.bias"] = np.asarray(params["conv2_b"])
+        raw["model.encoder.layer_norm.weight"] = np.asarray(params["enc_ln_w"])
+        raw["model.encoder.layer_norm.bias"] = np.asarray(params["enc_ln_b"])
+        raw["model.decoder.embed_tokens.weight"] = np.asarray(params["tok_emb"])
+        raw["model.decoder.embed_positions.weight"] = np.asarray(params["pos_emb"])
+        raw["model.decoder.layer_norm.weight"] = np.asarray(params["dec_ln_w"])
+        raw["model.decoder.layer_norm.bias"] = np.asarray(params["dec_ln_b"])
+
+        hf_names = {
+            "ln1_w": ("self_attn_layer_norm.weight", False),
+            "ln1_b": ("self_attn_layer_norm.bias", False),
+            "wq": ("self_attn.q_proj.weight", True),
+            "bq": ("self_attn.q_proj.bias", False),
+            "wk": ("self_attn.k_proj.weight", True),
+            "wv": ("self_attn.v_proj.weight", True),
+            "bv": ("self_attn.v_proj.bias", False),
+            "wo": ("self_attn.out_proj.weight", True),
+            "bo": ("self_attn.out_proj.bias", False),
+            "ln2_w": ("final_layer_norm.weight", False),
+            "ln2_b": ("final_layer_norm.bias", False),
+            "fc_w": ("fc1.weight", True),
+            "fc_b": ("fc1.bias", False),
+            "proj_w": ("fc2.weight", True),
+            "proj_b": ("fc2.bias", False),
+            "xln_w": ("encoder_attn_layer_norm.weight", False),
+            "xln_b": ("encoder_attn_layer_norm.bias", False),
+            "xwq": ("encoder_attn.q_proj.weight", True),
+            "xbq": ("encoder_attn.q_proj.bias", False),
+            "xwk": ("encoder_attn.k_proj.weight", True),
+            "xwv": ("encoder_attn.v_proj.weight", True),
+            "xbv": ("encoder_attn.v_proj.bias", False),
+            "xwo": ("encoder_attn.out_proj.weight", True),
+            "xbo": ("encoder_attn.out_proj.bias", False),
+        }
+        for side, L in (("encoder", cfg.n_audio_layers), ("decoder", cfg.n_text_layers)):
+            tree = params["enc" if side == "encoder" else "dec"]
+            for ours, (hf, transpose) in hf_names.items():
+                if ours not in tree:
+                    continue
+                for i in range(L):
+                    arr = np.asarray(tree[ours][i])
+                    raw[f"model.{side}.layers.{i}.{hf}"] = np.ascontiguousarray(
+                        arr.T if transpose else arr
+                    )
+        save_file(raw, str(tmp_path / "model.safetensors"))
+
+        loaded = whisper.load_hf_weights(tmp_path, cfg)
+        import jax as jx
+
+        for path, (a, b) in zip(
+            jx.tree_util.tree_leaves_with_path(params),
+            zip(jx.tree.leaves(params), jx.tree.leaves(loaded)),
+        ):
+            np.testing.assert_array_equal(
+                np.asarray(a), np.asarray(b), err_msg=str(path[0])
+            )
+
     def test_finetune_loss_decreases(self, jax):
         import jax.numpy as jnp
 
