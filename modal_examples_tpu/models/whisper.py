@@ -214,28 +214,25 @@ def load_hf_weights(model_dir, cfg: WhisperConfig, dtype=None) -> dict:
     from safetensors import safe_open
 
     dt = dtype or cfg.jnp_dtype
+    files = sorted(Path(model_dir).glob("*.safetensors"))
+    if not files:
+        raise FileNotFoundError(f"no safetensors under {model_dir}")
     raw: dict[str, np.ndarray] = {}
-    for f in sorted(Path(model_dir).glob("*.safetensors")):
+    for f in files:
         with safe_open(str(f), framework="np") as sf:
             for name in sf.keys():
                 raw[name.removeprefix("model.")] = sf.get_tensor(name)
 
+    # pop as we convert: never hold checkpoint + converted copies at once
     def g(name, transpose=False):
-        arr = raw[name]
+        arr = raw.pop(name)
         return jnp.asarray(arr.T if transpose else arr, dtype=dt)
 
     def stack(side: str, fmt: str, L: int, transpose=False):
-        return jnp.asarray(
-            np.stack(
-                [
-                    raw[f"{side}.layers.{i}.{fmt}"].T
-                    if transpose
-                    else raw[f"{side}.layers.{i}.{fmt}"]
-                    for i in range(L)
-                ]
-            ),
-            dtype=dt,
-        )
+        mats = [raw.pop(f"{side}.layers.{i}.{fmt}") for i in range(L)]
+        if transpose:
+            mats = [m.T for m in mats]
+        return jnp.asarray(np.stack(mats), dtype=dt)
 
     def block(side: str, L: int, cross: bool) -> dict:
         p = {
@@ -272,11 +269,11 @@ def load_hf_weights(model_dir, cfg: WhisperConfig, dtype=None) -> dict:
     return {
         # torch conv1d [out, in, k] -> ours [k, in, out]
         "conv1_w": jnp.asarray(
-            raw["encoder.conv1.weight"].transpose(2, 1, 0), dtype=dt
+            raw.pop("encoder.conv1.weight").transpose(2, 1, 0), dtype=dt
         ),
         "conv1_b": g("encoder.conv1.bias"),
         "conv2_w": jnp.asarray(
-            raw["encoder.conv2.weight"].transpose(2, 1, 0), dtype=dt
+            raw.pop("encoder.conv2.weight").transpose(2, 1, 0), dtype=dt
         ),
         "conv2_b": g("encoder.conv2.bias"),
         "enc": block("encoder", cfg.n_audio_layers, cross=False),

@@ -226,6 +226,10 @@ class LLMEngine:
         pages (no allocator state touched) — the FAST_BOOT-style cold-start
         control (vllm_inference.py:85-101): pay compiles at boot, not on the
         first user request. Returns seconds spent."""
+        if self._running:
+            # the scheduler thread donates the same cache buffers; racing it
+            # would pass deleted arrays. Warmup is a boot-time API.
+            raise RuntimeError("call warmup() before start()")
         t0 = time.monotonic()
         for bucket in buckets or self.prefill_buckets:
             B = self.prefill_batch

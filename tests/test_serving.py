@@ -118,6 +118,36 @@ class TestEngine:
         outs = ["".join(engine.stream(r)) for r in reqs]
         assert len(outs) == 8
 
+    def test_warmup_precompiles_all_shapes(self, jax):
+        from modal_examples_tpu.models import llama
+        from modal_examples_tpu.serving import LLMEngine, SamplingParams
+
+        eng = LLMEngine(
+            llama.LlamaConfig.tiny(), max_slots=2, max_model_len=64,
+            prefill_buckets=(32,), seed=1,
+        )
+        try:
+            t = eng.warmup()
+            assert t > 0
+            sizes = {
+                b: fn._cache_size() for b, fn in eng._prefill_jits.items()
+            }
+            assert all(s >= 1 for s in sizes.values())
+            decode_size = eng._decode_jit._cache_size()
+            assert decode_size >= 1
+            # serving a request must NOT trigger new compiles
+            eng.generate("warm", SamplingParams(max_tokens=2, temperature=0.0))
+            assert eng._decode_jit._cache_size() == decode_size
+            assert all(
+                fn._cache_size() == sizes[b]
+                for b, fn in eng._prefill_jits.items()
+            )
+            # and warmup after start() is refused (donation race guard)
+            with pytest.raises(RuntimeError, match="before start"):
+                eng.warmup()
+        finally:
+            eng.stop()
+
     def test_abort_frees_slot(self, engine):
         from modal_examples_tpu.serving import SamplingParams
 

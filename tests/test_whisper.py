@@ -143,13 +143,14 @@ class TestWhisperModel:
         loaded = whisper.load_hf_weights(tmp_path, cfg)
         import jax as jx
 
-        for path, (a, b) in zip(
-            jx.tree_util.tree_leaves_with_path(params),
-            zip(jx.tree.leaves(params), jx.tree.leaves(loaded)),
-        ):
-            np.testing.assert_array_equal(
-                np.asarray(a), np.asarray(b), err_msg=str(path[0])
-            )
+        # tree_map checks STRUCTURE (missing/extra keys fail) and values
+        jx.tree.map(
+            lambda a, b: np.testing.assert_array_equal(
+                np.asarray(a), np.asarray(b)
+            ),
+            params,
+            loaded,
+        )
 
     def test_finetune_loss_decreases(self, jax):
         import jax.numpy as jnp
