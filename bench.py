@@ -28,6 +28,10 @@ A100_LLAMA2_7B_TOK_S = 1400.0
 CONFIGS = {
     # name: (engine model preset/config kwargs, slots, max_model_len, max_tokens, timeout_s)
     "llama2-7b": dict(slots=8, max_len=256, max_tokens=128, timeout=1500),
+    # int8 weights: ~7GB on HBM, leaves room for a bigger batch/KV on 16GB
+    "llama2-7b-int8": dict(
+        slots=16, max_len=384, max_tokens=128, timeout=1500, quant="int8"
+    ),
     "llama-1b": dict(slots=16, max_len=512, max_tokens=128, timeout=900),
     "tiny": dict(slots=4, max_len=128, max_tokens=16, timeout=420),
 }
@@ -45,7 +49,7 @@ def _child(model: str) -> None:
     from modal_examples_tpu.serving import LLMEngine, SamplingParams
 
     spec = CONFIGS[model]
-    if model == "llama2-7b":
+    if model.startswith("llama2-7b"):
         cfg = llama.LlamaConfig.llama2_7b()
     elif model == "llama-1b":
         cfg = llama.LlamaConfig(
@@ -63,13 +67,15 @@ def _child(model: str) -> None:
         page_size=16,
         prefill_buckets=(64, 128, 256),
         kv_dtype=jnp.bfloat16,
+        quantization=spec.get("quant"),
     )
     build_s = time.time() - t0
     prompt = "The quick brown fox jumps over the lazy dog. " * 2
     params = SamplingParams(max_tokens=spec["max_tokens"], temperature=1.0)
 
-    # warmup: compiles prefill bucket + decode step
+    # boot-time compiles, then a live warmup round through the scheduler
     t0 = time.time()
+    engine.warmup()
     engine.start()
     warm = [engine.submit(prompt, SamplingParams(max_tokens=8, temperature=1.0))
             for _ in range(2)]
@@ -120,7 +126,7 @@ def main() -> int:
     elif os.environ.get("BENCH_CPU"):
         order = ["tiny"]
     else:
-        order = ["llama2-7b", "llama-1b", "tiny"]
+        order = ["llama2-7b", "llama2-7b-int8", "llama-1b", "tiny"]
 
     last_err = ""
     for model in order:

@@ -65,10 +65,21 @@ def apply_rope(x: jax.Array, cos: jax.Array, sin: jax.Array) -> jax.Array:
     return jnp.concatenate([o1, o2], axis=-1).astype(x.dtype)
 
 
+def mm(x: jax.Array, w) -> jax.Array:
+    """x @ w with f32 accumulation; ``w`` may be an int8 QuantizedWeight
+    (weights upcast tile-wise into the MXU, then per-channel rescale)."""
+    from .quantize import QuantizedWeight
+
+    if isinstance(w, QuantizedWeight):
+        y = jnp.dot(x, w.q.astype(x.dtype), preferred_element_type=jnp.float32)
+        return y * w.scale
+    return jnp.dot(x, w, preferred_element_type=jnp.float32)
+
+
 def _proj_f32(x, w, name, lora, lora_scale):
     """x @ w in f32 accumulation, plus the LoRA low-rank delta when an
     adapter targets ``name``. Returns f32 (caller decides when to round)."""
-    out = jnp.dot(x, w, preferred_element_type=jnp.float32)
+    out = mm(x, w)
     if lora is not None and f"{name}_a" in lora:
         from .lora import delta
 

@@ -270,7 +270,7 @@ def forward(
     x, aux_per_layer = jax.lax.scan(layer_fn, x, xs)
     x = layers.rms_norm(x, params["final_norm"], cfg.norm_eps)
     head = params["embed"].T if cfg.tie_embeddings else params["lm_head"]
-    logits = jnp.dot(x, head, preferred_element_type=jnp.float32)
+    logits = layers.mm(x, head)
     if return_aux:
         return logits, jnp.mean(aux_per_layer)
     return logits
@@ -309,9 +309,9 @@ def prefill(
         x = carry
         h = layers.rms_norm(x, layer["attn_norm"], cfg.norm_eps)
         D = cfg.head_dim
-        q = jnp.dot(h, layer["wq"], preferred_element_type=jnp.float32).astype(x.dtype)
-        k = jnp.dot(h, layer["wk"], preferred_element_type=jnp.float32).astype(x.dtype)
-        v = jnp.dot(h, layer["wv"], preferred_element_type=jnp.float32).astype(x.dtype)
+        q = layers.mm(h, layer["wq"]).astype(x.dtype)
+        k = layers.mm(h, layer["wk"]).astype(x.dtype)
+        v = layers.mm(h, layer["wv"]).astype(x.dtype)
         q = q.reshape(B, S, cfg.n_heads, D).transpose(0, 2, 1, 3)
         k = k.reshape(B, S, cfg.n_kv_heads, D).transpose(0, 2, 1, 3)
         v = v.reshape(B, S, cfg.n_kv_heads, D).transpose(0, 2, 1, 3)
@@ -319,9 +319,7 @@ def prefill(
         k = layers.apply_rope(k, cos, sin)
         o = flash_attention(q, k, v, True)
         o = o.transpose(0, 2, 1, 3).reshape(B, S, cfg.n_heads * D)
-        x = x + jnp.dot(
-            o, layer["wo"], preferred_element_type=jnp.float32
-        ).astype(x.dtype)
+        x = x + layers.mm(o, layer["wo"]).astype(x.dtype)
         h = layers.rms_norm(x, layer["mlp_norm"], cfg.norm_eps)
         h = layers.swiglu_mlp({n: layer[n] for n in ("gate", "up", "down")}, h)
         x = x + h
@@ -338,7 +336,7 @@ def prefill(
         :, 0
     ]  # [B, D]
     head = params["embed"].T if cfg.tie_embeddings else params["lm_head"]
-    logits = jnp.dot(x_last, head, preferred_element_type=jnp.float32)
+    logits = layers.mm(x_last, head)
     return logits, k_pages, v_pages
 
 
@@ -384,9 +382,9 @@ def decode_step(
         layer, k_pg, v_pg = layer_with_pages
         D = cfg.head_dim
         h = layers.rms_norm(x, layer["attn_norm"], cfg.norm_eps)
-        q = jnp.dot(h, layer["wq"], preferred_element_type=jnp.float32).astype(x.dtype)
-        k = jnp.dot(h, layer["wk"], preferred_element_type=jnp.float32).astype(x.dtype)
-        v = jnp.dot(h, layer["wv"], preferred_element_type=jnp.float32).astype(x.dtype)
+        q = layers.mm(h, layer["wq"]).astype(x.dtype)
+        k = layers.mm(h, layer["wk"]).astype(x.dtype)
+        v = layers.mm(h, layer["wv"]).astype(x.dtype)
         q = q.reshape(B, 1, cfg.n_heads, D).transpose(0, 2, 1, 3)  # [B,H,1,D]
         k = k.reshape(B, 1, cfg.n_kv_heads, D).transpose(0, 2, 1, 3)
         v = v.reshape(B, 1, cfg.n_kv_heads, D).transpose(0, 2, 1, 3)
@@ -399,9 +397,7 @@ def decode_step(
             q[:, :, 0], k_pg, v_pg, page_tables, ctx_lens
         )  # [B, H, D]
         o = o.reshape(B, cfg.n_heads * D)
-        x = x + jnp.dot(
-            o, layer["wo"], preferred_element_type=jnp.float32
-        ).astype(x.dtype)
+        x = x + layers.mm(o, layer["wo"]).astype(x.dtype)
         h = layers.rms_norm(x, layer["mlp_norm"], cfg.norm_eps)
         h = layers.swiglu_mlp({n: layer[n] for n in ("gate", "up", "down")}, h)
         return x + h, (k_pg, v_pg)
@@ -411,7 +407,7 @@ def decode_step(
     )
     x = layers.rms_norm(x, params["final_norm"], cfg.norm_eps)
     head = params["embed"].T if cfg.tie_embeddings else params["lm_head"]
-    logits = jnp.dot(x, head, preferred_element_type=jnp.float32)
+    logits = layers.mm(x, head)
     return logits, k_pages, v_pages
 
 

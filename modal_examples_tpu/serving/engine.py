@@ -97,6 +97,7 @@ class LLMEngine:
         prefill_buckets: tuple[int, ...] = (128, 256, 512, 1024, 2048),
         prefill_batch: int = 4,  # the one compiled prefill batch shape
         enable_prefix_cache: bool = True,
+        quantization: str | None = None,  # "int8": weight-only quant serving
         seed: int = 0,
         kv_dtype=jnp.bfloat16,
     ):
@@ -107,6 +108,12 @@ class LLMEngine:
                 params = llama.load_hf_weights(model_dir, cfg)
             else:
                 params = llama.init_params(jax.random.PRNGKey(seed), cfg)
+        if quantization == "int8":
+            from ..models.quantize import quantize_llama
+
+            params = quantize_llama(params)
+        elif quantization is not None:
+            raise ValueError(f"unknown quantization {quantization!r}")
         self.params = params
         self.max_slots = max_slots
         self.max_model_len = max_model_len

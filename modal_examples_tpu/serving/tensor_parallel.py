@@ -86,9 +86,9 @@ def decode_step_dense(
         layer, k_c, v_c = layer_with_cache  # k_c: [B, Hkv, S, D]
         D = cfg.head_dim
         h = layers.rms_norm(x, layer["attn_norm"], cfg.norm_eps)
-        q = jnp.dot(h, layer["wq"], preferred_element_type=jnp.float32).astype(x.dtype)
-        k = jnp.dot(h, layer["wk"], preferred_element_type=jnp.float32).astype(x.dtype)
-        v = jnp.dot(h, layer["wv"], preferred_element_type=jnp.float32).astype(x.dtype)
+        q = layers.mm(h, layer["wq"]).astype(x.dtype)
+        k = layers.mm(h, layer["wk"]).astype(x.dtype)
+        v = layers.mm(h, layer["wv"]).astype(x.dtype)
         q = q.reshape(B, 1, cfg.n_heads, D).transpose(0, 2, 1, 3)
         k = k.reshape(B, 1, cfg.n_kv_heads, D).transpose(0, 2, 1, 3)
         v = v.reshape(B, 1, cfg.n_kv_heads, D).transpose(0, 2, 1, 3)
@@ -110,9 +110,7 @@ def decode_step_dense(
         p = jax.nn.softmax(s, axis=-1)
         o = jnp.einsum("bhgs,bhsd->bhgd", p.astype(v_c.dtype), v_c)
         o = o.reshape(B, cfg.n_heads * D)
-        x = x + jnp.dot(
-            o, layer["wo"], preferred_element_type=jnp.float32
-        ).astype(x.dtype)
+        x = x + layers.mm(o, layer["wo"]).astype(x.dtype)
         h = layers.rms_norm(x, layer["mlp_norm"], cfg.norm_eps)
         h = layers.swiglu_mlp({n: layer[n] for n in ("gate", "up", "down")}, h)
         return x + h, (k_c, v_c)
@@ -122,7 +120,7 @@ def decode_step_dense(
     )
     x = layers.rms_norm(x, params["final_norm"], cfg.norm_eps)
     head = params["embed"].T if cfg.tie_embeddings else params["lm_head"]
-    logits = jnp.dot(x, head, preferred_element_type=jnp.float32)
+    logits = layers.mm(x, head)
     return logits, DenseKVCache(k_new, v_new)
 
 
