@@ -348,6 +348,91 @@ def _scatter_pages(k_pages, v_pages, k_all, v_all, page_idx, slot):
     return k_pages, v_pages
 
 
+def prefill_chunk(
+    params: dict,
+    tokens: jax.Array,  # [B, C] — one chunk of the prompt
+    k_pages: jax.Array,
+    v_pages: jax.Array,
+    page_tables: jax.Array,  # [B, pages_per_seq]
+    chunk_lens: jax.Array,  # [B] valid tokens in THIS chunk
+    cfg: LlamaConfig,
+    *,
+    q_offset: int,  # global position of the chunk's first token (static)
+):
+    """One chunk of a long prompt: attends to the already-cached prefix (via
+    page gather) + itself (rectangular flash kernel with q_offset), writes
+    its K/V into the pages. Bounded VMEM for arbitrarily long prompts —
+    the chunked-prefill half of the serving engine (vLLM chunked prefill
+    analog). Returns (last_logits [B, vocab], k_pages, v_pages)."""
+    B, C = tokens.shape
+    page_size = k_pages.shape[3]
+    positions = q_offset + jnp.broadcast_to(jnp.arange(C), (B, C))
+    valid = jnp.arange(C)[None, :] < chunk_lens[:, None]
+    cos, sin = layers.rotary_embedding(
+        positions, cfg.head_dim, cfg.rope_theta, dtype=jnp.float32
+    )
+    x = params["embed"][tokens]
+
+    page_idx = jnp.take_along_axis(page_tables, positions // page_size, axis=1)
+    page_idx = jnp.where(valid, page_idx, 0)
+    slot = jnp.where(valid, positions % page_size, 0)
+
+    # dense gather of the cached prefix (page-aligned: q_offset % page_size
+    # == 0 by construction — chunks are bucket-sized)
+    n_prefix_pages = q_offset // page_size
+    prefix_tables = page_tables[:, :n_prefix_pages] if n_prefix_pages else None
+
+    def layer_fn(carry, layer_with_pages):
+        x = carry
+        layer, k_pg, v_pg = layer_with_pages  # [Hkv, P, ps, D]
+        D = cfg.head_dim
+        h = layers.rms_norm(x, layer["attn_norm"], cfg.norm_eps)
+        q = layers.mm(h, layer["wq"]).astype(x.dtype)
+        k = layers.mm(h, layer["wk"]).astype(x.dtype)
+        v = layers.mm(h, layer["wv"]).astype(x.dtype)
+        q = q.reshape(B, C, cfg.n_heads, D).transpose(0, 2, 1, 3)
+        k = k.reshape(B, C, cfg.n_kv_heads, D).transpose(0, 2, 1, 3)
+        v = v.reshape(B, C, cfg.n_kv_heads, D).transpose(0, 2, 1, 3)
+        q = layers.apply_rope(q, cos, sin)
+        k = layers.apply_rope(k, cos, sin)
+
+        if n_prefix_pages:
+            # [Hkv, B, n_pp, ps, D] -> [B, Hkv, prefix, D]
+            pk = k_pg[:, prefix_tables].transpose(1, 0, 2, 3, 4).reshape(
+                B, cfg.n_kv_heads, n_prefix_pages * page_size, D
+            )
+            pv = v_pg[:, prefix_tables].transpose(1, 0, 2, 3, 4).reshape(
+                B, cfg.n_kv_heads, n_prefix_pages * page_size, D
+            )
+            k_full = jnp.concatenate([pk, k], axis=2)
+            v_full = jnp.concatenate([pv, v], axis=2)
+        else:
+            k_full, v_full = k, v
+        from ..ops import flash_attention_chunked
+
+        o = flash_attention_chunked(q, k_full, v_full, q_offset=q_offset)
+        o = o.transpose(0, 2, 1, 3).reshape(B, C, cfg.n_heads * D)
+        x = x + layers.mm(o, layer["wo"]).astype(x.dtype)
+        h = layers.rms_norm(x, layer["mlp_norm"], cfg.norm_eps)
+        h = layers.swiglu_mlp({n: layer[n] for n in ("gate", "up", "down")}, h)
+        x = x + h
+        return x, (k.transpose(1, 0, 2, 3), v.transpose(1, 0, 2, 3))
+
+    x, (k_all, v_all) = jax.lax.scan(
+        layer_fn, x, (_layer_stack(params), k_pages, v_pages)
+    )
+    k_pages, v_pages = _scatter_pages(k_pages, v_pages, k_all, v_all, page_idx, slot)
+
+    x = layers.rms_norm(x, params["final_norm"], cfg.norm_eps)
+    last_idx = jnp.maximum(chunk_lens - 1, 0)
+    x_last = jnp.take_along_axis(
+        x, last_idx[:, None, None].repeat(x.shape[-1], -1), 1
+    )[:, 0]
+    head = params["embed"].T if cfg.tie_embeddings else params["lm_head"]
+    logits = layers.mm(x_last, head)
+    return logits, k_pages, v_pages
+
+
 def decode_step(
     params: dict,
     tokens: jax.Array,  # [B] int32 — current token per slot

@@ -486,9 +486,16 @@ def flash_attention_chunked(
     only — prefill needs no gradients."""
     scale = _resolve_scale(q, sm_scale)
     Sq, Skv = q.shape[2], k.shape[2]
+
+    def pick_block(S: int) -> int:
+        for b in (128, 64, 32, 16, 8):
+            if S % b == 0:
+                return b
+        return S
+
     o, _ = _flash_forward(
         q, k, v, causal=causal, sm_scale=scale,
-        block_q=min(128, Sq), block_k=min(128, Skv),
+        block_q=pick_block(Sq), block_k=pick_block(Skv),
         interpret=_use_interpret(), q_offset=q_offset,
     )
     return o

@@ -159,6 +159,7 @@ class LLMEngine:
 
         self._decode_jit = jax.jit(self._decode_and_sample, donate_argnums=(1, 2))
         self._prefill_jits: dict[int, object] = {}
+        self._chunk_jits: dict[int, object] = {}  # keyed by chunk q_offset
 
     # -- jitted programs ----------------------------------------------------
 
@@ -204,9 +205,9 @@ class LLMEngine:
 
     def submit(self, prompt: str, params: SamplingParams | None = None) -> Request:
         req = Request(prompt=prompt, params=params or SamplingParams())
-        # prompts clamp to the largest prefill shape (and leave >=1 decode slot)
-        limit = min(self.max_model_len - 1, self.prefill_buckets[-1])
-        req.prompt_tokens = self.tokenizer.encode(prompt)[:limit]
+        # prompts longer than the largest bucket prefill in chunks; the hard
+        # cap is the model length (minus >=1 decode slot)
+        req.prompt_tokens = self.tokenizer.encode(prompt)[: self.max_model_len - 1]
         self.waiting.put(req)
         return req
 
@@ -341,6 +342,12 @@ class LLMEngine:
                 break
             assignments.append((free_slot, req, claim))
 
+        long_ones = [
+            a for a in assignments if a[2]["n_prompt"] > self.prefill_buckets[-1]
+        ]
+        assignments = [a for a in assignments if a not in long_ones]
+        for a in long_ones:
+            self._prefill_long(*a)
         by_bucket: dict[int, list] = {}
         for a in assignments:
             by_bucket.setdefault(self._bucket_for(a[2]["n_prompt"]), []).append(a)
@@ -424,6 +431,61 @@ class LLMEngine:
         else:
             self.cache.allocator.free(slot.pages)
         slot.pages, slot.trie_pages, slot.private_pages = [], [], []
+
+    def _prefill_long(self, slot_idx: int, req: Request, claim: dict) -> None:
+        """Chunked prefill for prompts beyond the largest bucket: bucket-
+        sized chunks attend to the cached prefix via the rectangular flash
+        kernel (llama.prefill_chunk) — bounded VMEM at any prompt length."""
+        import functools
+
+        pages, n_prompt = claim["pages"], claim["n_prompt"]
+        slot = self.slots[slot_idx]
+        slot.request = req
+        slot.pages = pages
+        slot.trie_pages = claim["trie_pages"]
+        slot.private_pages = claim["private_pages"]
+        slot.generated = []
+        slot.emitted_text_len = 0
+        table = np.zeros((self.pages_per_slot,), np.int32)
+        table[: len(pages)] = pages
+        self._page_tables[slot_idx] = table
+
+        C = self.prefill_buckets[-1]
+        pad_tok = self.tokenizer.pad_id % self.cfg.vocab_size
+        logits = None
+        for offset in range(0, n_prompt, C):
+            chunk = req.prompt_tokens[offset : offset + C]
+            toks = np.full((1, C), pad_tok, np.int32)
+            toks[0, : len(chunk)] = chunk
+            fn = self._chunk_jits.get(offset)
+            if fn is None:
+                fn = jax.jit(
+                    functools.partial(llama.prefill_chunk, q_offset=offset),
+                    static_argnames=("cfg",),
+                    donate_argnums=(2, 3),
+                )
+                self._chunk_jits[offset] = fn
+            logits, self.cache.k_pages, self.cache.v_pages = fn(
+                self.params,
+                jnp.asarray(toks),
+                self.cache.k_pages,
+                self.cache.v_pages,
+                jnp.asarray(table[None, :]),
+                jnp.asarray([len(chunk)], np.int32),
+                cfg=self.cfg,
+            )
+        p = req.params
+        first = sample(
+            logits,
+            self._next_key(),
+            jnp.asarray([p.temperature], np.float32),
+            jnp.asarray([p.top_p], np.float32),
+            jnp.asarray([p.top_k], np.int32),
+        )
+        self.stats.prompt_tokens += n_prompt
+        slot.position = n_prompt
+        slot.last_token = int(first[0])
+        self._accept_token(slot_idx, slot.last_token)
 
     def _prefill_group(self, bucket: int, group: list) -> None:
         B = self.prefill_batch  # fixed compile shape; short groups pad

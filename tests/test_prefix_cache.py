@@ -90,6 +90,28 @@ class TestEnginePrefixSharing:
         assert a == b  # sharing must not change greedy output
         assert engine.prefix_cache.cached_pages > 0
 
+    def test_chunked_prefill_long_prompt(self, engine, jax_cpu):
+        """A prompt beyond the largest bucket (64) prefills in chunks and
+        must produce the same greedy completion as a single-shot prefill."""
+        import dataclasses
+
+        from modal_examples_tpu.models import llama
+        from modal_examples_tpu.serving import LLMEngine, SamplingParams
+
+        prompt = "x" * 100  # 101 tokens with bos > bucket 64
+        p = SamplingParams(max_tokens=4, temperature=0.0)
+        chunked_out = engine.generate(prompt, p)
+
+        wide = LLMEngine(
+            llama.LlamaConfig.tiny(), max_slots=2, max_model_len=256,
+            page_size=16, prefill_buckets=(128,), seed=0,
+        )
+        try:
+            single_out = wide.generate(prompt, p)
+        finally:
+            wide.stop()
+        assert chunked_out == single_out
+
     def test_allocator_balance_after_many_requests(self, engine):
         from modal_examples_tpu.serving import SamplingParams
 
