@@ -1,0 +1,52 @@
+# # Secrets in data pipelines
+#
+# Counterpart of 04_secrets/db_to_sheet.py — credentials for external
+# systems (Postgres + Google Sheets there) arrive as named Secrets that
+# materialize only inside the container's environment. The external systems
+# are stood in by a credential-checking stub (zero-egress environment); the
+# secret plumbing is the real thing.
+#
+# Run: tpurun run examples/04_secrets/secret_pipelines.py
+
+import os
+
+import modal_examples_tpu as mtpu
+
+app = mtpu.App("example-secrets")
+
+# register the named secrets the pipeline expects (in production:
+# `tpurun secret create warehouse-creds DB_PASSWORD=...`)
+mtpu.Secret.create("warehouse-creds", {"DB_USER": "analytics", "DB_PASSWORD": "s3cret"})
+mtpu.Secret.create("report-sink-creds", {"SINK_TOKEN": "tok-123"})
+
+warehouse = mtpu.Secret.from_name(
+    "warehouse-creds", required_keys=["DB_USER", "DB_PASSWORD"]
+)
+sink = mtpu.Secret.from_name("report-sink-creds", required_keys=["SINK_TOKEN"])
+
+
+@app.function(secrets=[warehouse])
+def extract_rows() -> list[dict]:
+    """'Query the warehouse' — creds come from the container env only."""
+    assert os.environ["DB_USER"] == "analytics"
+    assert os.environ["DB_PASSWORD"] == "s3cret"
+    return [{"day": d, "requests": 100 + 7 * d} for d in range(5)]
+
+
+@app.function(secrets=[sink])
+def publish_report(rows: list[dict]) -> str:
+    """'Write the sheet' — a different function gets different creds."""
+    assert os.environ["SINK_TOKEN"] == "tok-123"
+    assert "DB_PASSWORD" not in os.environ  # least privilege: no warehouse creds
+    total = sum(r["requests"] for r in rows)
+    return f"published {len(rows)} rows, {total} total requests"
+
+
+@app.local_entrypoint()
+def main():
+    rows = extract_rows.remote()
+    result = publish_report.remote(rows)
+    print(result)
+    # the client process never saw the secret values in its env
+    assert "DB_PASSWORD" not in os.environ
+    assert result.startswith("published 5 rows")

@@ -185,7 +185,10 @@ def paged_decode_attention(
         grid_spec=grid_spec,
         out_shape=jax.ShapeDtypeStruct((B * Hkv, G, D), q.dtype),
         compiler_params=pltpu.CompilerParams(
-            dimension_semantics=("arbitrary", "arbitrary"),
+            # every (b, h) cell reads shared pages but writes a distinct
+            # output block: both grid dims are safely parallel (lets Mosaic
+            # split the grid across cores where the part has them)
+            dimension_semantics=("parallel", "parallel"),
         ),
         cost_estimate=pl.CostEstimate(
             flops=int(4 * B * Hq * pages_per_seq * page_size * D),

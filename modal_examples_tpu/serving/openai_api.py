@@ -65,12 +65,25 @@ class _Handler(BaseHTTPRequestHandler):
                 },
             )
         elif self.path == "/metrics":
-            s = srv.engine.stats
+            eng = srv.engine
+            s = eng.stats
+            active = sum(1 for sl in eng.slots if not sl.free)
+            pc = eng.prefix_cache
             body = (
                 f"mtpu_generated_tokens_total {s.generated_tokens}\n"
                 f"mtpu_prompt_tokens_total {s.prompt_tokens}\n"
                 f"mtpu_decode_steps_total {s.steps}\n"
                 f"mtpu_tokens_per_second {s.tokens_per_second():.3f}\n"
+                f"mtpu_active_slots {active}\n"
+                f"mtpu_waiting_requests {eng.waiting.qsize()}\n"
+                f"mtpu_kv_pages_free {eng.cache.allocator.available}\n"
+                + (
+                    f"mtpu_prefix_cache_hits_total {pc.hits}\n"
+                    f"mtpu_prefix_cache_misses_total {pc.misses}\n"
+                    f"mtpu_prefix_cached_pages {pc.cached_pages}\n"
+                    if pc is not None
+                    else ""
+                )
             ).encode()
             self.send_response(200)
             self.send_header("content-type", "text/plain")
