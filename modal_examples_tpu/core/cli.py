@@ -224,6 +224,30 @@ def cmd_examples(argv: list[str]) -> int:
     raise SystemExit("usage: tpurun examples [list | run [pattern] [--timeout S]]")
 
 
+def cmd_docs(argv: list[str]) -> int:
+    """Render the literate examples to markdown (the examples ARE the docs —
+    internal/utils.py render_example_md parity)."""
+    from pathlib import Path
+
+    from ..utils.docs import get_examples, render_example_md, repo_root
+
+    out_dir = Path(argv[0]) if argv else repo_root() / "docs"
+    n = 0
+    for e in get_examples():
+        src = (repo_root() / e.path).read_text()
+        md = render_example_md(src)
+        target = out_dir / e.path.with_suffix(".md")
+        target.parent.mkdir(parents=True, exist_ok=True)
+        target.write_text(md)
+        n += 1
+    index = ["# Examples\n"]
+    for e in get_examples():
+        index.append(f"- [{e.module_name}]({e.path.with_suffix('.md')})")
+    (out_dir / "index.md").write_text("\n".join(index) + "\n")
+    print(f"rendered {n} example docs to {out_dir}")
+    return 0
+
+
 def cmd_app(argv: list[str]) -> int:
     if argv and argv[0] == "list":
         reg = _config.state_dir() / "apps.json"
@@ -244,6 +268,7 @@ COMMANDS = {
     "secret": cmd_secret,
     "app": cmd_app,
     "examples": cmd_examples,
+    "docs": cmd_docs,
 }
 
 
