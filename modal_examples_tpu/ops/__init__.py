@@ -13,7 +13,12 @@ from .flash_attention import (
 )
 from .paged_attention import paged_decode_attention
 from .quantized_matmul import dequantize_int8, quantize_int8, quantized_matmul
-from .ring_attention import ring_attention, ring_attention_sharded
+from .ring_attention import (
+    ring_attention,
+    ring_attention_sharded,
+    ulysses_attention,
+    ulysses_attention_sharded,
+)
 from . import reference
 
 __all__ = [
@@ -27,4 +32,6 @@ __all__ = [
     "reference",
     "ring_attention",
     "ring_attention_sharded",
+    "ulysses_attention",
+    "ulysses_attention_sharded",
 ]

@@ -124,3 +124,63 @@ def ring_attention_sharded(
         fn, mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec,
         check_vma=False,
     )(q, k, v)
+
+
+def ulysses_attention(
+    q: jax.Array,  # [B, H, S_local, D] — this shard's sequence slice
+    k: jax.Array,
+    v: jax.Array,
+    *,
+    axis_name: str,
+    causal: bool = True,
+    sm_scale: float | None = None,
+) -> jax.Array:
+    """Ulysses (DeepSpeed-style) sequence parallelism: two all_to_alls swap
+    the sharded dimension from SEQUENCE to HEADS, so each shard runs plain
+    full-sequence attention on H/n heads — exact, and a good fit when
+    H >= shards and the interconnect is all-to-all friendly. The reference
+    has no equivalent (SURVEY §2.3 row 'Ulysses: absent'); on a TPU torus
+    the ring variant is usually preferred, but both are exact — pick by
+    profile. Call inside shard_map with the seq dim sharded over
+    ``axis_name``."""
+    n = lax.axis_size(axis_name)
+    B, H, S_loc, D = q.shape
+    if H % n:
+        raise ValueError(f"heads {H} must be divisible by seq shards {n}")
+
+    def seq_to_heads(x):
+        # [B, H, S_loc, D] -> [B, H/n, S_global, D]: give away head blocks,
+        # receive every shard's tokens for our head block. concat_axis indexes
+        # the shape AFTER the split dim is removed: [B, H/n, S_loc, D] with
+        # the shard dim inserted at 2 -> [B, H/n, n, S_loc, D] (shard-major
+        # global sequence).
+        x = x.reshape(B, n, H // n, S_loc, D)
+        x = lax.all_to_all(x, axis_name, split_axis=1, concat_axis=2, tiled=False)
+        return x.reshape(B, H // n, n * S_loc, D)
+
+    def heads_to_seq(x):
+        # inverse: [B, H/n, S_global, D] -> [B, H, S_loc, D]
+        x = x.reshape(B, H // n, n, S_loc, D)
+        x = lax.all_to_all(x, axis_name, split_axis=2, concat_axis=1, tiled=False)
+        # [B, n, H/n, S_loc, D] -> [B, H, S_loc, D]
+        return x.reshape(B, H, S_loc, D)
+
+    qg, kg, vg = seq_to_heads(q), seq_to_heads(k), seq_to_heads(v)
+    og, _ = flash_attention_with_lse(qg, kg, vg, causal=causal, sm_scale=sm_scale)
+    return heads_to_seq(og)
+
+
+def ulysses_attention_sharded(
+    q, k, v, mesh, *, seq_axis: str = "seq", causal: bool = True,
+    sm_scale: float | None = None,
+):
+    from jax.sharding import PartitionSpec as P
+
+    spec = P(None, None, seq_axis, None)
+    fn = functools.partial(
+        ulysses_attention, axis_name=seq_axis, causal=causal, sm_scale=sm_scale
+    )
+    return jax.shard_map(
+        fn, mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec,
+        check_vma=False,
+    )(q, k, v)

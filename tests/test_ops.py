@@ -191,6 +191,35 @@ class TestQuantizedMatmul:
         assert out.shape == (100, 77)
 
 
+class TestUlyssesAttention:
+    @pytest.mark.parametrize("causal", [True, False])
+    def test_matches_dense(self, jax, jnp, causal):
+        from modal_examples_tpu.ops.ring_attention import ulysses_attention_sharded
+        from modal_examples_tpu.ops import reference
+        from modal_examples_tpu.parallel import make_mesh
+
+        mesh = make_mesh({"seq": 4})
+        B, H, S, D = 1, 8, 512, 64
+        ks = jax.random.split(jax.random.PRNGKey(13), 3)
+        q = jax.random.normal(ks[0], (B, H, S, D), jnp.float32)
+        k = jax.random.normal(ks[1], (B, H, S, D), jnp.float32)
+        v = jax.random.normal(ks[2], (B, H, S, D), jnp.float32)
+        out = ulysses_attention_sharded(q, k, v, mesh, causal=causal)
+        want = reference.attention(q, k, v, causal=causal)
+        np.testing.assert_allclose(
+            np.asarray(out), np.asarray(want), atol=3e-5, rtol=1e-4
+        )
+
+    def test_rejects_indivisible_heads(self, jax, jnp):
+        from modal_examples_tpu.ops.ring_attention import ulysses_attention_sharded
+        from modal_examples_tpu.parallel import make_mesh
+
+        mesh = make_mesh({"seq": 4})
+        x = jnp.ones((1, 6, 128, 64))  # 6 heads not divisible by 4 shards
+        with pytest.raises(ValueError, match="divisible"):
+            ulysses_attention_sharded(x, x, x, mesh)
+
+
 class TestRingAttention:
     def test_gradients_match_dense(self, jax, jnp):
         from modal_examples_tpu.ops import reference, ring_attention_sharded

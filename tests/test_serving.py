@@ -118,6 +118,37 @@ class TestEngine:
         outs = ["".join(engine.stream(r)) for r in reqs]
         assert len(outs) == 8
 
+    def test_concurrent_client_threads(self, engine):
+        """Many client threads submit/stream at once: the single scheduler
+        thread must serve all without loss, duplication, or deadlock."""
+        import threading
+
+        from modal_examples_tpu.serving import SamplingParams
+
+        engine.start()
+        results: dict[int, str] = {}
+        errors: list[BaseException] = []
+        lock = threading.Lock()
+
+        def client(i: int):
+            try:
+                out = engine.generate(
+                    f"thread {i}", SamplingParams(max_tokens=3, temperature=1.0)
+                )
+                with lock:
+                    results[i] = out
+            except BaseException as e:
+                with lock:
+                    errors.append(e)
+
+        threads = [threading.Thread(target=client, args=(i,)) for i in range(12)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=120)
+        assert not errors, errors
+        assert len(results) == 12
+
     def test_warmup_precompiles_all_shapes(self, jax):
         from modal_examples_tpu.models import llama
         from modal_examples_tpu.serving import LLMEngine, SamplingParams
