@@ -15,9 +15,10 @@ Design (TPU-first, not a CUDA translation):
   elementwise triangle mask only on the one diagonal block.
 - GQA folds the query-head group into the batch dimension; K/V blocks are
   indexed by kv head so grouped queries share the same K/V traffic.
-- backward: recompute-based VJP through the XLA reference (correct, memory-
-  lean — the flash trick IS recomputation; a dedicated Pallas bwd kernel can
-  swap in behind the same custom_vjp without touching callers).
+- backward: dedicated Pallas kernels (dq with sequential k-blocks, dk/dv
+  with sequential q-blocks) sharing per-block dS math, including the lse
+  output's cotangent so ring/ulysses merges differentiate through the
+  kernels; MTPU_FLASH_BWD=recompute switches to an XLA-recompute fallback.
 
 Runs in interpreter mode off-TPU so CPU CI exercises the same code path.
 """
@@ -210,9 +211,14 @@ def _use_interpret() -> bool:
 # ---------------------------------------------------------------------------
 
 
-def _bwd_block_ds(q, k, lse_row, delta_row, do, v, *, sm_scale, causal,
-                  q_start, k_start):
-    """Shared per-block math: returns (p, ds) both (block_q, block_k) f32."""
+def _bwd_block_ds(q, k, lse_row, delta_row, dlse_row, do, v, *, sm_scale,
+                  causal, q_start, k_start):
+    """Shared per-block math: returns (p, ds) both (block_q, block_k) f32.
+
+    dS has two sources: the output path p*(dP - D), and the lse output's own
+    cotangent (d lse/dS = p), so dS = p * (dP - D + dLSE) — the latter is
+    what makes ring/ulysses merges (which consume lse) kernel-differentiable.
+    """
     s = jax.lax.dot_general(
         q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
     ) * sm_scale
@@ -225,12 +231,12 @@ def _bwd_block_ds(q, k, lse_row, delta_row, do, v, *, sm_scale, causal,
     dp = jax.lax.dot_general(
         do, v, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
     )
-    ds = p * (dp - delta_row)
+    ds = p * (dp - delta_row + dlse_row)
     return p, ds
 
 
-def _dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
-               dq_scr, *, sm_scale, causal, block_q):
+def _dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dlse_ref,
+               dq_ref, dq_scr, *, sm_scale, causal, block_q):
     qi, ki, nk = pl.program_id(1), pl.program_id(2), pl.num_programs(2)
     block_k = k_ref.shape[1]
     q_start, k_start = qi * block_q, ki * block_k
@@ -249,9 +255,10 @@ def _dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
         do = do_ref[0].astype(jnp.float32)
         lse_row = lse_ref[0][:, None]
         delta_row = delta_ref[0][:, None]
+        dlse_row = dlse_ref[0][:, None]
         _, ds = _bwd_block_ds(
-            q, k, lse_row, delta_row, do, v, sm_scale=sm_scale, causal=causal,
-            q_start=q_start, k_start=k_start,
+            q, k, lse_row, delta_row, dlse_row, do, v, sm_scale=sm_scale,
+            causal=causal, q_start=q_start, k_start=k_start,
         )
         dq_scr[:] += sm_scale * jax.lax.dot_general(
             ds, k, (((1,), (0,)), ((), ())), preferred_element_type=jnp.float32
@@ -262,7 +269,7 @@ def _dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
         dq_ref[0] = dq_scr[:].astype(dq_ref.dtype)
 
 
-def _dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+def _dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dlse_ref,
                 dk_ref, dv_ref, dk_scr, dv_scr, *, sm_scale, causal, block_k):
     ki, qi, nq = pl.program_id(1), pl.program_id(2), pl.num_programs(2)
     block_q = q_ref.shape[1]
@@ -283,9 +290,10 @@ def _dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
         do = do_ref[0].astype(jnp.float32)
         lse_row = lse_ref[0][:, None]
         delta_row = delta_ref[0][:, None]
+        dlse_row = dlse_ref[0][:, None]
         p, ds = _bwd_block_ds(
-            q, k, lse_row, delta_row, do, v, sm_scale=sm_scale, causal=causal,
-            q_start=q_start, k_start=k_start,
+            q, k, lse_row, delta_row, dlse_row, do, v, sm_scale=sm_scale,
+            causal=causal, q_start=q_start, k_start=k_start,
         )
         dv_scr[:] += jax.lax.dot_general(
             p, do, (((0,), (0,)), ((), ())), preferred_element_type=jnp.float32
@@ -301,8 +309,9 @@ def _dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
 
 
 def _flash_backward(q, k, v, o, lse, g, *, causal, sm_scale, block_q, block_k,
-                    interpret):
-    """Pallas backward: returns (dq, dk, dv) with GQA group reduction."""
+                    interpret, g_lse=None):
+    """Pallas backward: returns (dq, dk, dv) with GQA group reduction.
+    ``g_lse`` carries the lse output's cotangent (ring/ulysses merges)."""
     B, Hq, S, D = q.shape
     Hkv = k.shape[1]
     group = Hq // Hkv
@@ -312,6 +321,11 @@ def _flash_backward(q, k, v, o, lse, g, *, causal, sm_scale, block_q, block_k,
     vf = v.reshape(B * Hkv, S, D)
     dof = g.reshape(BHq, S, D)
     lsef = lse.reshape(BHq, S)
+    dlsef = (
+        jnp.zeros((BHq, S), jnp.float32)
+        if g_lse is None
+        else g_lse.astype(jnp.float32).reshape(BHq, S)
+    )
     delta = jnp.sum(
         g.astype(jnp.float32) * o.astype(jnp.float32), axis=-1
     ).reshape(BHq, S)
@@ -330,12 +344,13 @@ def _flash_backward(q, k, v, o, lse, g, *, causal, sm_scale, block_q, block_k,
             pl.BlockSpec((1, block_q, D), lambda bh, qi, ki: (bh, qi, 0)),
             pl.BlockSpec((1, block_q), lambda bh, qi, ki: (bh, qi)),
             pl.BlockSpec((1, block_q), lambda bh, qi, ki: (bh, qi)),
+            pl.BlockSpec((1, block_q), lambda bh, qi, ki: (bh, qi)),
         ],
         out_specs=pl.BlockSpec((1, block_q, D), lambda bh, qi, ki: (bh, qi, 0)),
         out_shape=jax.ShapeDtypeStruct(qf.shape, q.dtype),
         scratch_shapes=[pltpu.VMEM((block_q, D), jnp.float32)],
         interpret=interpret,
-    )(qf, kf, vf, dof, lsef, delta)
+    )(qf, kf, vf, dof, lsef, delta, dlsef)
 
     # dk/dv per QUERY head (kv blocks replicated across the group), then
     # group-summed outside the kernel
@@ -352,6 +367,7 @@ def _flash_backward(q, k, v, o, lse, g, *, causal, sm_scale, block_q, block_k,
             pl.BlockSpec((1, block_q, D), lambda bh, ki, qi: (bh, qi, 0)),
             pl.BlockSpec((1, block_q), lambda bh, ki, qi: (bh, qi)),
             pl.BlockSpec((1, block_q), lambda bh, ki, qi: (bh, qi)),
+            pl.BlockSpec((1, block_q), lambda bh, ki, qi: (bh, qi)),
         ],
         out_specs=[
             pl.BlockSpec((1, block_k, D), lambda bh, ki, qi: (bh, ki, 0)),
@@ -366,7 +382,7 @@ def _flash_backward(q, k, v, o, lse, g, *, causal, sm_scale, block_q, block_k,
             pltpu.VMEM((block_k, D), jnp.float32),
         ],
         interpret=interpret,
-    )(qf, kf, vf, dof, lsef, delta)
+    )(qf, kf, vf, dof, lsef, delta, dlsef)
 
     dq = dq.reshape(B, Hq, S, D)
     dk = dk_h.reshape(B, Hkv, group, S, D).sum(axis=2).astype(k.dtype)
@@ -441,20 +457,35 @@ def _flash_with_lse(q, k, v, causal, sm_scale):
 
 def _flash_with_lse_fwd(q, k, v, causal, sm_scale):
     out = _flash_with_lse(q, k, v, causal, sm_scale)
-    return out, (q, k, v)
+    return out, (q, k, v, *out)
+
+
+def _flash_with_lse_fwd_res(q, k, v, causal, sm_scale):
+    o, lse = _flash_with_lse(q, k, v, causal, sm_scale)
+    return (o, lse), (q, k, v, o, lse)
 
 
 def _flash_with_lse_bwd(causal, sm_scale, res, cots):
-    # recompute through the differentiable reference; the lse output carries
-    # real cotangents in ring attention's softmax merge, so both flow back
-    q, k, v = res
-    _, vjp = jax.vjp(
-        lambda q, k, v: reference.attention_with_lse(
-            q, k, v, causal=causal, sm_scale=sm_scale
-        ),
-        q, k, v,
+    # dedicated Pallas backward; the lse output's cotangent (nonzero inside
+    # ring/ulysses softmax merges) feeds the kernels' dS term directly
+    q, k, v, o, lse = res
+    g_o, g_lse = cots
+    S = q.shape[2]
+    import os as _os
+
+    if _os.environ.get("MTPU_FLASH_BWD", "kernel") == "recompute":
+        _, vjp = jax.vjp(
+            lambda q, k, v: reference.attention_with_lse(
+                q, k, v, causal=causal, sm_scale=sm_scale
+            ),
+            q, k, v,
+        )
+        return vjp(cots)
+    return _flash_backward(
+        q, k, v, o, lse, g_o, causal=causal, sm_scale=sm_scale,
+        block_q=min(128, S), block_k=min(128, S),
+        interpret=_use_interpret(), g_lse=g_lse,
     )
-    return vjp(cots)
 
 
 _flash_with_lse.defvjp(_flash_with_lse_fwd, _flash_with_lse_bwd)
