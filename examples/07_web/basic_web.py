@@ -30,3 +30,31 @@ def stream(n: int = 3):
     for i in range(n):
         yield {"count": i}
         time.sleep(0.1)
+
+
+# ## Self-test entrypoint — `tpurun serve` hosts these endpoints for real
+# traffic; `tpurun run` drives them through an ephemeral gateway.
+
+
+@app.local_entrypoint()
+def main():
+    import json
+    import urllib.request
+
+    from modal_examples_tpu.web.gateway import Gateway
+
+    with app.run():
+        gw = Gateway(app).start()
+        with urllib.request.urlopen(f"{gw.base_url}/greet?user=tpu") as r:
+            assert json.load(r)["greeting"] == "Hello, tpu!"
+        req = urllib.request.Request(
+            f"{gw.base_url}/square", data=b'{"x": 12}',
+            headers={"content-type": "application/json"},
+        )
+        with urllib.request.urlopen(req) as r:
+            assert json.load(r)["squared"] == 144
+        with urllib.request.urlopen(f"{gw.base_url}/stream?n=2") as r:
+            events = [l for l in r.read().decode().splitlines() if l.startswith("data:")]
+        assert len(events) == 2
+        gw.stop()
+    print("GET, POST, and SSE endpoints OK")

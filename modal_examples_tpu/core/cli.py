@@ -202,6 +202,9 @@ def cmd_examples(argv: list[str]) -> int:
         for e in targets:
             env = dict(os.environ)
             env.setdefault("MTPU_STATE_DIR", tempfile.mkdtemp(prefix="mtpu-ex-"))
+            # cheap-mode defaults (the reference's frontmatter env overrides,
+            # SURVEY §4): CI runs on CPU unless the caller opts into a chip
+            env.setdefault("MTPU_TPU", "")
             print(f"=== {e.path} ===", flush=True)
             try:
                 proc = subprocess.run(
