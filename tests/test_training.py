@@ -79,6 +79,36 @@ class TestTrainer:
         # params stayed tensor-sharded through the step
         assert state.params["layers"]["wq"].sharding.spec == P(None, None, "tensor")
 
+    def test_remat_matches_plain(self, jax):
+        """jax.checkpoint rematerialization must not change results."""
+        import jax.numpy as jnp
+
+        from modal_examples_tpu.models import llama
+        from modal_examples_tpu.training import (
+            Trainer, cross_entropy_loss, make_optimizer,
+        )
+
+        cfg = llama.LlamaConfig(
+            vocab_size=64, dim=64, n_layers=2, n_heads=2, n_kv_heads=2,
+            ffn_dim=128, max_seq_len=64, dtype="float32",
+        )
+
+        def loss_fn(p, batch):
+            logits = llama.forward(p, batch["tokens"], cfg, attn_impl="xla")
+            return cross_entropy_loss(logits[:, :-1], batch["tokens"][:, 1:])
+
+        batch = {"tokens": jax.random.randint(jax.random.PRNGKey(1), (2, 32), 0, 64)}
+        outs = []
+        for remat in (False, True):
+            t = Trainer(loss_fn, make_optimizer(1e-2, grad_clip=1e9), remat=remat)
+            state = t.init_state(llama.init_params(jax.random.PRNGKey(0), cfg))
+            state, m = t.train_step(state, batch)
+            outs.append((float(m["loss"]), state.params["final_norm"]))
+        assert outs[0][0] == pytest.approx(outs[1][0], abs=1e-5)
+        np.testing.assert_allclose(
+            np.asarray(outs[0][1]), np.asarray(outs[1][1]), atol=1e-5
+        )
+
     def test_grad_accum_equivalence(self, jax):
         import jax.numpy as jnp
 
@@ -121,6 +151,22 @@ class TestCheckpoints:
         assert mgr.latest_step() == 5
         restored = mgr.restore(state)
         np.testing.assert_array_equal(np.asarray(restored["w"]), np.asarray(state["w"]))
+
+    def test_async_save(self, jax, tmp_path):
+        """wait=False returns immediately; wait_until_finished makes the
+        checkpoint durable (orbax async path)."""
+        import jax.numpy as jnp
+
+        from modal_examples_tpu.training import CheckpointManager
+
+        mgr = CheckpointManager(tmp_path / "async", keep_n=2)
+        state = {"w": jnp.ones((64, 64))}
+        mgr.save(1, state, wait=False)
+        mgr._ckptr.wait_until_finished()
+        restored = mgr.restore(state)
+        np.testing.assert_array_equal(
+            np.asarray(restored["w"]), np.asarray(state["w"])
+        )
 
     def test_keep_n_prunes(self, jax, tmp_path):
         import jax.numpy as jnp
