@@ -39,10 +39,42 @@ def rotary_embedding(
     head_dim: int,
     theta: float = 10000.0,
     dtype=jnp.float32,
+    rope_scaling: dict | None = None,
 ) -> tuple[jax.Array, jax.Array]:
-    """cos/sin tables for RoPE at the given positions: [..., S, head_dim/2]."""
+    """cos/sin tables for RoPE at the given positions: [..., S, head_dim/2].
+
+    ``rope_scaling`` supports the llama3.1 scheme (HF config keys:
+    factor, low_freq_factor, high_freq_factor, original_max_position_
+    embeddings): low-frequency components are stretched by ``factor``,
+    high-frequency kept, mid-band smoothly interpolated — the context
+    extension used by llama-3.1/3.2 checkpoints.
+    """
     half = head_dim // 2
     freqs = theta ** (-jnp.arange(0, half, dtype=jnp.float32) / half)
+    if rope_scaling:
+        factor = float(rope_scaling.get("factor", 8.0))
+        low = float(rope_scaling.get("low_freq_factor", 1.0))
+        high = float(rope_scaling.get("high_freq_factor", 4.0))
+        orig = float(
+            rope_scaling.get("original_max_position_embeddings", 8192)
+        )
+        wavelen = 2.0 * jnp.pi / freqs
+        low_wavelen = orig / low
+        high_wavelen = orig / high
+        # smooth factor in [0,1]: 1 at high-freq end, 0 at low-freq end
+        smooth = jnp.clip(
+            (orig / wavelen - low) / jnp.maximum(high - low, 1e-6), 0.0, 1.0
+        )
+        scaled = jnp.where(
+            wavelen > low_wavelen,
+            freqs / factor,  # low frequency: stretch fully
+            jnp.where(
+                wavelen < high_wavelen,
+                freqs,  # high frequency: keep
+                (1 - smooth) * freqs / factor + smooth * freqs,
+            ),
+        )
+        freqs = scaled
     angles = positions[..., None].astype(jnp.float32) * freqs  # [..., S, half]
     return jnp.cos(angles).astype(dtype), jnp.sin(angles).astype(dtype)
 

@@ -55,6 +55,9 @@ class LlamaConfig:
     n_experts: int = 0
     top_k_experts: int = 2
     expert_capacity_factor: float = 1.5
+    # llama3.1-style rope scaling (HF config 'rope_scaling'); hashable for
+    # static jit args
+    rope_scaling: tuple | None = None  # tuple(sorted(dict.items())) or None
 
     @property
     def head_dim(self) -> int:
@@ -108,6 +111,12 @@ class LlamaConfig:
             norm_eps=cfg.get("rms_norm_eps", 1e-5),
             max_seq_len=cfg.get("max_position_embeddings", 4096),
             tie_embeddings=cfg.get("tie_word_embeddings", False),
+            rope_scaling=(
+                tuple(sorted(cfg["rope_scaling"].items()))
+                if isinstance(cfg.get("rope_scaling"), dict)
+                and cfg["rope_scaling"].get("rope_type", cfg["rope_scaling"].get("type")) == "llama3"
+                else None
+            ),
         )
 
 
@@ -222,7 +231,8 @@ def forward(
         positions = jnp.broadcast_to(jnp.arange(S), (B, S))
     x = params["embed"][tokens]  # [B, S, D]
     cos, sin = layers.rotary_embedding(
-        positions, cfg.head_dim, cfg.rope_theta, dtype=jnp.float32
+        positions, cfg.head_dim, cfg.rope_theta, dtype=jnp.float32,
+        rope_scaling=dict(cfg.rope_scaling) if cfg.rope_scaling else None,
     )  # [B, S, hd/2]
 
     def layer_fn(x, scanned):
@@ -295,7 +305,8 @@ def prefill(
     positions = jnp.broadcast_to(jnp.arange(S), (B, S))
     valid = positions < seq_lens[:, None]
     cos, sin = layers.rotary_embedding(
-        positions, cfg.head_dim, cfg.rope_theta, dtype=jnp.float32
+        positions, cfg.head_dim, cfg.rope_theta, dtype=jnp.float32,
+        rope_scaling=dict(cfg.rope_scaling) if cfg.rope_scaling else None,
     )
     x = params["embed"][tokens]
 
@@ -369,7 +380,8 @@ def prefill_chunk(
     positions = q_offset + jnp.broadcast_to(jnp.arange(C), (B, C))
     valid = jnp.arange(C)[None, :] < chunk_lens[:, None]
     cos, sin = layers.rotary_embedding(
-        positions, cfg.head_dim, cfg.rope_theta, dtype=jnp.float32
+        positions, cfg.head_dim, cfg.rope_theta, dtype=jnp.float32,
+        rope_scaling=dict(cfg.rope_scaling) if cfg.rope_scaling else None,
     )
     x = params["embed"][tokens]
 
@@ -452,7 +464,8 @@ def decode_step(
     page_size = k_pages.shape[3]
     x = params["embed"][tokens]  # [B, D]
     cos, sin = layers.rotary_embedding(
-        positions[:, None], cfg.head_dim, cfg.rope_theta, dtype=jnp.float32
+        positions[:, None], cfg.head_dim, cfg.rope_theta, dtype=jnp.float32,
+        rope_scaling=dict(cfg.rope_scaling) if cfg.rope_scaling else None,
     )  # [B, 1, hd/2]
 
     page_idx = jnp.take_along_axis(
