@@ -78,10 +78,11 @@ class TestRopeScaling:
             pos, 64, 500000.0, rope_scaling=scaling
         )
         diff = np.abs(np.asarray(base_cos - scaled_cos))[0, -1]  # pos 8000
-        # highest-frequency channels (early dims) unchanged; lowest-frequency
-        # channels (late dims) stretched by the factor
+        # highest-frequency channels (early dims) unchanged; stretched bands
+        # (mid/low freq) visibly rotated at long range
         assert diff[0] < 1e-6
-        assert diff[-1] > 1e-2
+        assert diff.max() > 0.1
+        assert diff[-1] > 1e-4  # lowest channel moves too (cos is flat there)
 
     def test_from_hf_config_parses_rope_scaling(self, tmp_path):
         import json
