@@ -168,6 +168,8 @@ def load_hf_weights(model_dir: str | Path, cfg: BertConfig, dtype=None) -> dict:
 
     dt = dtype or cfg.jnp_dtype
     files = sorted(Path(model_dir).glob("*.safetensors"))
+    if not files:
+        raise FileNotFoundError(f"no safetensors under {model_dir}")
     raw: dict[str, np.ndarray] = {}
     for f in files:
         with safe_open(str(f), framework="np") as sf:

@@ -33,6 +33,54 @@ class TestBert:
             np.asarray(emb[1]), np.asarray(emb2[1]), atol=1e-5
         )
 
+    def test_hf_weight_roundtrip(self, jax, tmp_path):
+        """Bit-exact export/import through HF BERT names (the bge loader)."""
+        import numpy as np
+        from safetensors.numpy import save_file
+
+        from modal_examples_tpu.models import bert
+
+        cfg = bert.BertConfig.tiny()
+        params = bert.init_params(jax.random.PRNGKey(0), cfg)
+        raw = {
+            "embeddings.word_embeddings.weight": np.asarray(params["word_emb"]),
+            "embeddings.position_embeddings.weight": np.asarray(params["pos_emb"]),
+            "embeddings.token_type_embeddings.weight": np.asarray(params["type_emb"]),
+            "embeddings.LayerNorm.weight": np.asarray(params["emb_norm_w"]),
+            "embeddings.LayerNorm.bias": np.asarray(params["emb_norm_b"]),
+        }
+        mapping = {
+            "wq": ("attention.self.query.weight", True),
+            "bq": ("attention.self.query.bias", False),
+            "wk": ("attention.self.key.weight", True),
+            "bk": ("attention.self.key.bias", False),
+            "wv": ("attention.self.value.weight", True),
+            "bv": ("attention.self.value.bias", False),
+            "wo": ("attention.output.dense.weight", True),
+            "bo": ("attention.output.dense.bias", False),
+            "attn_norm_w": ("attention.output.LayerNorm.weight", False),
+            "attn_norm_b": ("attention.output.LayerNorm.bias", False),
+            "fc_w": ("intermediate.dense.weight", True),
+            "fc_b": ("intermediate.dense.bias", False),
+            "proj_w": ("output.dense.weight", True),
+            "proj_b": ("output.dense.bias", False),
+            "mlp_norm_w": ("output.LayerNorm.weight", False),
+            "mlp_norm_b": ("output.LayerNorm.bias", False),
+        }
+        for i in range(cfg.n_layers):
+            for ours, (name, transpose) in mapping.items():
+                arr = np.asarray(params["layers"][ours][i])
+                raw[f"encoder.layer.{i}.{name}"] = np.ascontiguousarray(
+                    arr.T if transpose else arr
+                )
+        save_file(raw, str(tmp_path / "model.safetensors"))
+        loaded = bert.load_hf_weights(tmp_path, cfg, dtype=np.float32)
+        jax.tree.map(
+            lambda a, b: np.testing.assert_array_equal(np.asarray(a), np.asarray(b)),
+            params,
+            loaded,
+        )
+
     def test_mean_pooling(self, jax):
         import dataclasses
 

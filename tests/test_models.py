@@ -89,6 +89,52 @@ class TestLlama:
                 atol=1e-3,
             )
 
+    def test_hf_weight_roundtrip(self, jax, tmp_path):
+        """Export random params under HF llama names, reload via
+        load_hf_weights, require a bit-identical tree — proves the
+        name/transpose mapping for the flagship loader."""
+        import numpy as np
+        from safetensors.numpy import save_file
+
+        from modal_examples_tpu.models import llama
+
+        cfg = llama.LlamaConfig(
+            vocab_size=64, dim=32, n_layers=2, n_heads=2, n_kv_heads=2,
+            ffn_dim=48, dtype="float32",
+        )
+        params = llama.init_params(jax.random.PRNGKey(0), cfg)
+        raw = {
+            "model.embed_tokens.weight": np.asarray(params["embed"]),
+            "model.norm.weight": np.asarray(params["final_norm"]),
+            "lm_head.weight": np.ascontiguousarray(np.asarray(params["lm_head"]).T),
+        }
+        hf = {
+            "wq": "self_attn.q_proj.weight", "wk": "self_attn.k_proj.weight",
+            "wv": "self_attn.v_proj.weight", "wo": "self_attn.o_proj.weight",
+            "gate": "mlp.gate_proj.weight", "up": "mlp.up_proj.weight",
+            "down": "mlp.down_proj.weight",
+        }
+        norms = {
+            "attn_norm": "input_layernorm.weight",
+            "mlp_norm": "post_attention_layernorm.weight",
+        }
+        for i in range(cfg.n_layers):
+            for ours, name in hf.items():
+                raw[f"model.layers.{i}.{name}"] = np.ascontiguousarray(
+                    np.asarray(params["layers"][ours][i]).T
+                )
+            for ours, name in norms.items():
+                raw[f"model.layers.{i}.{name}"] = np.asarray(
+                    params["layers"][ours][i]
+                )
+        save_file(raw, str(tmp_path / "model.safetensors"))
+        loaded = llama.load_hf_weights(tmp_path, cfg, dtype=jax.numpy.float32)
+        jax.tree.map(
+            lambda a, b: np.testing.assert_array_equal(np.asarray(a), np.asarray(b)),
+            params,
+            loaded,
+        )
+
     def test_param_count_property(self):
         from modal_examples_tpu.models import llama
 
