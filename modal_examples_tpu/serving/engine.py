@@ -287,9 +287,23 @@ class LLMEngine:
         return self
 
     def stop(self) -> None:
+        """Stop the scheduler and release every caller: in-flight and queued
+        requests get their terminal _FINISH so stream()/generate() return
+        (partial output for in-flight ones) instead of blocking forever."""
         self._running = False
         if self._thread:
             self._thread.join(timeout=10)
+        for slot in self.slots:
+            if not slot.free:
+                slot.request.out_queue.put(_FINISH)
+                self._release_slot_pages(slot)
+                slot.request = None
+        while True:
+            try:
+                req = self.waiting.get_nowait()
+            except queue.Empty:
+                break
+            req.out_queue.put(_FINISH)
 
     # -- scheduler loop ------------------------------------------------------
 

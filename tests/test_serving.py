@@ -118,6 +118,35 @@ class TestEngine:
         outs = ["".join(engine.stream(r)) for r in reqs]
         assert len(outs) == 8
 
+    def test_stop_releases_inflight_callers(self, jax):
+        """stop() must unblock stream()/generate() callers rather than
+        leaving them waiting on a dead scheduler."""
+        import threading
+
+        from modal_examples_tpu.models import llama
+        from modal_examples_tpu.serving import LLMEngine, SamplingParams
+
+        eng = LLMEngine(
+            llama.LlamaConfig.tiny(), max_slots=2, max_model_len=64,
+            prefill_buckets=(32,), seed=3,
+        )
+        eng.start()
+        req = eng.submit("drain me", SamplingParams(max_tokens=10_000))
+        got_out = threading.Event()
+
+        def consume():
+            for _ in eng.stream(req):
+                pass
+            got_out.set()
+
+        t = threading.Thread(target=consume, daemon=True)
+        t.start()
+        import time
+
+        time.sleep(0.5)  # let it start decoding
+        eng.stop()
+        assert got_out.wait(timeout=10), "stream() caller still blocked after stop()"
+
     def test_concurrent_client_threads(self, engine):
         """Many client threads submit/stream at once: the single scheduler
         thread must serve all without loss, duplication, or deadlock."""
