@@ -156,6 +156,7 @@ class LLMEngine:
         self._temps = np.ones((max_slots,), np.float32)
         self._top_ps = np.ones((max_slots,), np.float32)
         self._top_ks = np.zeros((max_slots,), np.int32)
+        self._seeds = np.full((max_slots,), -1, np.int32)
 
         self._decode_jit = jax.jit(self._decode_and_sample, donate_argnums=(1, 2))
         self._prefill_jits: dict[int, object] = {}
@@ -165,23 +166,27 @@ class LLMEngine:
 
     def _decode_and_sample(
         self, params, k_pages, v_pages, tokens, positions, page_tables, active,
-        key, temps, top_ps, top_ks,
+        key, temps, top_ps, top_ks, seeds,
     ):
         logits, k_pages, v_pages = llama.decode_step(
             params, tokens, positions, k_pages, v_pages, page_tables, active,
             self.cfg,
         )
-        next_tokens = sample(logits, key, temps, top_ps, top_ks)
+        next_tokens = sample(
+            logits, key, temps, top_ps, top_ks, seeds=seeds, step_ids=positions
+        )
         return next_tokens, k_pages, v_pages
 
     def _prefill_and_sample(
         self, params, k_pages, v_pages, tokens, page_tables, seq_lens, key,
-        temps, top_ps, top_ks,
+        temps, top_ps, top_ks, seeds,
     ):
         logits, k_pages, v_pages = llama.prefill(
             params, tokens, k_pages, v_pages, page_tables, seq_lens, self.cfg
         )
-        next_tokens = sample(logits, key, temps, top_ps, top_ks)
+        next_tokens = sample(
+            logits, key, temps, top_ps, top_ks, seeds=seeds, step_ids=seq_lens
+        )
         return next_tokens, k_pages, v_pages
 
     def _prefill_jit(self, bucket: int):
@@ -254,6 +259,7 @@ class LLMEngine:
                 jnp.ones((B,), jnp.float32),
                 jnp.ones((B,), jnp.float32),
                 jnp.zeros((B,), jnp.int32),
+                jnp.full((B,), -1, jnp.int32),
             )
         _tok, self.cache.k_pages, self.cache.v_pages = self._decode_jit(
             self.params,
@@ -267,6 +273,7 @@ class LLMEngine:
             jnp.ones((self.max_slots,), jnp.float32),
             jnp.ones((self.max_slots,), jnp.float32),
             jnp.zeros((self.max_slots,), jnp.int32),
+            jnp.full((self.max_slots,), -1, jnp.int32),
         )
         jax.block_until_ready(self.cache.k_pages)
         return time.monotonic() - t0
@@ -495,6 +502,8 @@ class LLMEngine:
             jnp.asarray([p.temperature], np.float32),
             jnp.asarray([p.top_p], np.float32),
             jnp.asarray([p.top_k], np.int32),
+            seeds=jnp.asarray([-1 if p.seed is None else p.seed], np.int32),
+            step_ids=jnp.asarray([n_prompt], np.int32),
         )
         self.stats.prompt_tokens += n_prompt
         slot.position = n_prompt
@@ -510,6 +519,7 @@ class LLMEngine:
         temps = np.ones((B,), np.float32)
         top_ps = np.ones((B,), np.float32)
         top_ks = np.zeros((B,), np.int32)
+        seeds = np.full((B,), -1, np.int32)
         for i, (slot_idx, req, claim) in enumerate(group):
             pages, n_prompt = claim["pages"], claim["n_prompt"]
             slot = self.slots[slot_idx]
@@ -527,6 +537,7 @@ class LLMEngine:
             seq_lens[i] = n_prompt
             p = req.params
             temps[i], top_ps[i], top_ks[i] = p.temperature, p.top_p, p.top_k
+            seeds[i] = -1 if p.seed is None else p.seed
 
         next_tok, self.cache.k_pages, self.cache.v_pages = self._prefill_jit(
             (bucket, B)
@@ -541,6 +552,7 @@ class LLMEngine:
             jnp.asarray(temps),
             jnp.asarray(top_ps),
             jnp.asarray(top_ks),
+            jnp.asarray(seeds),
         )
         next_np = np.asarray(next_tok)
         for i, (slot_idx, req, claim) in enumerate(group):
@@ -571,6 +583,7 @@ class LLMEngine:
             self._temps[i] = p.temperature
             self._top_ps[i] = p.top_p
             self._top_ks[i] = p.top_k
+            self._seeds[i] = -1 if p.seed is None else p.seed
 
         next_tokens, self.cache.k_pages, self.cache.v_pages = self._decode_jit(
             self.params,
@@ -584,6 +597,7 @@ class LLMEngine:
             jnp.asarray(self._temps),
             jnp.asarray(self._top_ps),
             jnp.asarray(self._top_ks),
+            jnp.asarray(self._seeds),
         )
         next_np = np.asarray(next_tokens)
         self.stats.steps += 1

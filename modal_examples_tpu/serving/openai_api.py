@@ -29,6 +29,7 @@ def _params_from_body(body: dict) -> SamplingParams:
             [body["stop"]] if isinstance(body.get("stop"), str)
             else body.get("stop") or []
         ),
+        seed=int(body["seed"]) if body.get("seed") is not None else None,
     )
 
 

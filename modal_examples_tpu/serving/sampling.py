@@ -19,6 +19,7 @@ class SamplingParams:
     top_k: int = 0  # 0 = disabled
     max_tokens: int = 128
     stop: tuple[str, ...] = ()
+    seed: int | None = None  # per-request determinism (OpenAI `seed`)
 
 
 def sample(
@@ -27,6 +28,11 @@ def sample(
     temperature: jax.Array,  # [B]
     top_p: jax.Array,  # [B]
     top_k: jax.Array,  # [B] int32 (0 = off)
+    seeds: jax.Array | None = None,  # [B] int32; >=0 rows use fold_in(seed,
+    #                                  step) instead of the engine key, so a
+    #                                  request with seed= samples identically
+    #                                  regardless of batch composition
+    step_ids: jax.Array | None = None,  # [B] int32 per-slot decode step
 ) -> jax.Array:  # [B] int32
     """Vectorized per-slot sampling; temperature 0 means greedy."""
     V = logits.shape[-1]
@@ -54,5 +60,22 @@ def sample(
     ].set(keep_sorted)
     scaled = jnp.where(keep, scaled, -jnp.inf)
 
-    sampled = jax.random.categorical(key, scaled, axis=-1)
+    if seeds is not None:
+        B = logits.shape[0]
+        if step_ids is None:
+            step_ids = jnp.zeros((B,), jnp.int32)
+        base_keys = jax.random.split(key, B)
+
+        def row_key(i):
+            seeded = jax.random.fold_in(
+                jax.random.fold_in(jax.random.PRNGKey(0), seeds[i]), step_ids[i]
+            )
+            return jnp.where(seeds[i] >= 0, seeded, base_keys[i])
+
+        keys = jax.vmap(row_key)(jnp.arange(B))
+        sampled = jax.vmap(
+            lambda k, row: jax.random.categorical(k, row)
+        )(keys, scaled)
+    else:
+        sampled = jax.random.categorical(key, scaled, axis=-1)
     return jnp.where(temperature <= 0.0, greedy, sampled).astype(jnp.int32)

@@ -225,6 +225,23 @@ class TestEngine:
             time.sleep(0.05)
         assert all(s.free for s in engine.slots)
 
+    def test_seeded_sampling_deterministic_across_batches(self, engine):
+        """A seeded request must sample identically whether it runs alone or
+        alongside other traffic (the OpenAI `seed` contract)."""
+        from modal_examples_tpu.serving import SamplingParams
+
+        p = SamplingParams(max_tokens=6, temperature=1.0, seed=1234)
+        alone = engine.generate("seeded prompt", p)
+        # now with concurrent unseeded traffic sharing the batch
+        noise = [
+            engine.submit(f"noise {i}", SamplingParams(max_tokens=6, temperature=1.0))
+            for i in range(3)
+        ]
+        busy = engine.generate("seeded prompt", p)
+        for r in noise:
+            "".join(engine.stream(r))
+        assert alone == busy
+
     def test_stats_accumulate(self, engine):
         assert engine.stats.generated_tokens > 0
         assert engine.stats.steps > 0
