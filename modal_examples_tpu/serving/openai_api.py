@@ -117,11 +117,43 @@ class _Handler(BaseHTTPRequestHandler):
             prompt = body.get("prompt") or ""
         params = _params_from_body(body)
         stream = bool(body.get("stream", False))
-        req = srv.engine.submit(prompt, params)
+        n = max(1, int(body.get("n", 1)))
         rid = f"chatcmpl-{uuid.uuid4().hex[:12]}"
         created = int(time.time())
         kind = "chat.completion" if chat else "text_completion"
 
+        if n > 1 and not stream:
+            # OpenAI `n`: fan out engine requests, one choice each (the
+            # engine's continuous batching runs them concurrently)
+            reqs = [srv.engine.submit(prompt, params) for _ in range(n)]
+            texts = ["".join(srv.engine.stream(r)) for r in reqs]
+            choices = []
+            for i, text in enumerate(texts):
+                content = (
+                    {"message": {"role": "assistant", "content": text}}
+                    if chat
+                    else {"text": text}
+                )
+                choices.append({"index": i, **content, "finish_reason": "stop"})
+            n_prompt = len(reqs[0].prompt_tokens or [])
+            n_out = sum(
+                len(srv.engine.tokenizer.encode(t, add_bos=False)) for t in texts
+            )
+            self._json(
+                200,
+                {
+                    "id": rid, "object": kind, "created": created,
+                    "model": srv.model_name, "choices": choices,
+                    "usage": {
+                        "prompt_tokens": n_prompt,
+                        "completion_tokens": n_out,
+                        "total_tokens": n_prompt + n_out,
+                    },
+                },
+            )
+            return
+
+        req = srv.engine.submit(prompt, params)
         if stream:
             self.send_response(200)
             self.send_header("content-type", "text/event-stream")

@@ -309,6 +309,25 @@ class TestOpenAIServer:
         ]
         assert chunks and chunks[0]["object"] == "chat.completion.chunk"
 
+    def test_n_choices(self, server):
+        body = json.dumps(
+            {
+                "messages": [{"role": "user", "content": "pick"}],
+                "max_tokens": 3,
+                "n": 3,
+                "temperature": 1.0,
+            }
+        ).encode()
+        req = urllib.request.Request(
+            self._url(server, "/v1/chat/completions"),
+            data=body,
+            headers={"content-type": "application/json"},
+        )
+        with urllib.request.urlopen(req) as r:
+            out = json.load(r)
+        assert [c["index"] for c in out["choices"]] == [0, 1, 2]
+        assert all("content" in c["message"] for c in out["choices"])
+
     def test_metrics_endpoint(self, server):
         with urllib.request.urlopen(self._url(server, "/metrics")) as r:
             text = r.read().decode()
