@@ -1,0 +1,48 @@
+"""Driver-contract tests: bench.py must print exactly one JSON line with the
+required schema, and must degrade (not hang) when a model config fails."""
+
+import json
+import os
+import subprocess
+import sys
+from pathlib import Path
+
+REPO = Path(__file__).resolve().parents[1]
+
+
+def test_bench_emits_schema_json():
+    out = subprocess.run(
+        [sys.executable, str(REPO / "bench.py")],
+        capture_output=True,
+        text=True,
+        timeout=500,
+        env={**os.environ, "BENCH_CPU": "1", "BENCH_MODEL": "tiny"},
+        cwd=str(REPO),
+    )
+    assert out.returncode == 0, out.stderr[-500:]
+    lines = [l for l in out.stdout.strip().splitlines() if l.strip()]
+    assert len(lines) == 1, f"expected ONE line, got {len(lines)}: {lines}"
+    payload = json.loads(lines[0])
+    for key in ("metric", "value", "unit", "vs_baseline"):
+        assert key in payload, payload
+    assert payload["value"] > 0
+    assert payload["unit"] == "tok/s"
+
+
+def test_bench_supervisor_degrades_on_bad_model():
+    """An impossible child must yield the error JSON line, not a hang."""
+    out = subprocess.run(
+        [sys.executable, str(REPO / "bench.py")],
+        capture_output=True,
+        text=True,
+        timeout=180,
+        env={**os.environ, "BENCH_CPU": "1", "BENCH_MODEL": "nonexistent"},
+        cwd=str(REPO),
+    )
+    # unknown BENCH_MODEL: supervisor KeyErrors per config -> error line path
+    lines = [l for l in out.stdout.strip().splitlines() if l.strip()]
+    if lines:
+        payload = json.loads(lines[-1])
+        assert "metric" in payload
+    else:
+        assert out.returncode != 0
