@@ -90,6 +90,40 @@ class LlamaConfig:
         )
 
     @staticmethod
+    def llama31_8b() -> "LlamaConfig":
+        return LlamaConfig(
+            vocab_size=128256, dim=4096, n_layers=32, n_heads=32, n_kv_heads=8,
+            ffn_dim=14336, rope_theta=500000.0, max_seq_len=131072,
+            rope_scaling=(
+                ("factor", 8.0), ("high_freq_factor", 4.0),
+                ("low_freq_factor", 1.0),
+                ("original_max_position_embeddings", 8192),
+            ),
+        )
+
+    @staticmethod
+    def llama32_1b() -> "LlamaConfig":
+        return LlamaConfig(
+            vocab_size=128256, dim=2048, n_layers=16, n_heads=32, n_kv_heads=8,
+            ffn_dim=8192, rope_theta=500000.0, max_seq_len=131072,
+            tie_embeddings=True,
+            rope_scaling=(
+                ("factor", 32.0), ("high_freq_factor", 4.0),
+                ("low_freq_factor", 1.0),
+                ("original_max_position_embeddings", 8192),
+            ),
+        )
+
+    @staticmethod
+    def mistral_7b() -> "LlamaConfig":
+        # sliding-window attention not yet modeled; full attention within
+        # max_seq_len is exact for contexts <= the window (4096)
+        return LlamaConfig(
+            vocab_size=32000, dim=4096, n_layers=32, n_heads=32, n_kv_heads=8,
+            ffn_dim=14336, rope_theta=10000.0, max_seq_len=4096,
+        )
+
+    @staticmethod
     def tiny(vocab_size: int = 512) -> "LlamaConfig":
         """Test-tier config (the reference's cheap-mode switch, SURVEY.md §4)."""
         return LlamaConfig(
