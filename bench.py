@@ -116,40 +116,131 @@ def _child(model: str) -> None:
     )
 
 
+def _kill_stray_children() -> None:
+    """Kill leftover bench/claim children from a previous wedged run.
+
+    Round-1 postmortem (NOTES.md): a crash-looping child holding the chip's
+    claim handshake wedged every later device attach. Sweep any prior
+    `bench.py --child` / preflight processes before we touch the device.
+    """
+    me = os.getpid()
+    try:
+        out = subprocess.run(
+            ["pgrep", "-f", "bench.py --child|_bench_preflight"],
+            capture_output=True, text=True, timeout=10,
+        ).stdout
+    except Exception:
+        return
+    for pid_s in out.split():
+        try:
+            pid = int(pid_s)
+            if pid in (me, os.getppid()):
+                continue
+            # only reap ORPHANS (reparented to init): a live bench's children
+            # have their live supervisor as parent and must not be touched
+            with open(f"/proc/{pid}/stat") as f:
+                ppid = int(f.read().rsplit(")", 1)[1].split()[1])
+            if ppid == 1:
+                os.kill(pid, 9)
+        except (ValueError, OSError, IndexError):
+            pass
+
+
+def _preflight(timeout_s: int = 120) -> str:
+    """Cheap device-attach probe in a subprocess; returns backend or ''.
+
+    A wedged chip blocks *inside* device attach, so the probe must be a
+    separate killable process (the round-1 failure burned every config's
+    full timeout on exactly this block).
+    """
+    code = (
+        "import jax; print('_bench_preflight', jax.default_backend(), "
+        "len(jax.devices()))"
+    )
+    try:
+        proc = subprocess.run(
+            [sys.executable, "-c", code],
+            capture_output=True, text=True, timeout=timeout_s,
+        )
+    except subprocess.TimeoutExpired:
+        return ""
+    for line in proc.stdout.splitlines():
+        if line.startswith("_bench_preflight"):
+            return line.split()[1]
+    return ""
+
+
+def _extract_json(stdout: str) -> str | None:
+    for line in reversed(stdout.strip().splitlines()):
+        try:
+            json.loads(line)
+            return line
+        except json.JSONDecodeError:
+            continue
+    return None
+
+
 def main() -> int:
     if len(sys.argv) > 1 and sys.argv[1] == "--child":
         _child(sys.argv[2])
         return 0
 
-    if os.environ.get("BENCH_MODEL"):
-        order = [os.environ["BENCH_MODEL"]]
-    elif os.environ.get("BENCH_CPU"):
+    # Hard wall-clock budget for the WHOLE bench (driver runs us with its own
+    # timeout; round 1 summed per-config timeouts to 72 min and got rc=124).
+    deadline = time.time() + float(os.environ.get("BENCH_BUDGET_S", "1100"))
+    _kill_stray_children()
+
+    env = dict(os.environ)
+    if not os.environ.get("BENCH_CPU"):
+        backend = _preflight(timeout_s=int(os.environ.get("BENCH_PREFLIGHT_S", "120")))
+        if not backend or backend == "cpu":
+            # Chip unreachable (or no TPU plugin): degrade to a measured CPU
+            # number immediately instead of burning the budget on attach.
+            env["BENCH_CPU"] = "1"
+
+    if env.get("BENCH_MODEL"):
+        order = [env["BENCH_MODEL"]]
+    elif env.get("BENCH_CPU"):
         order = ["tiny"]
     else:
-        order = ["llama2-7b", "llama2-7b-int8", "llama-1b", "tiny"]
+        # canary-first: the tiny config proves the full engine path end to end
+        # in ~1 min and becomes the guaranteed fallback line; then try the real
+        # targets largest-first within the remaining budget.
+        order = ["tiny", "llama2-7b", "llama2-7b-int8", "llama-1b"]
 
+    fallback_line = None
     last_err = ""
-    for model in order:
+    for i, model in enumerate(order):
         spec = CONFIGS[model]
+        remaining = deadline - time.time() - 15
+        if remaining < 60:
+            last_err = last_err or "budget exhausted before any config ran"
+            break
         try:
             proc = subprocess.run(
                 [sys.executable, os.path.abspath(__file__), "--child", model],
                 capture_output=True,
                 text=True,
-                timeout=spec["timeout"],
+                timeout=min(spec["timeout"], remaining),
                 cwd=os.path.dirname(os.path.abspath(__file__)),
+                env=env,
             )
         except subprocess.TimeoutExpired:
-            last_err = f"{model}: timeout after {spec['timeout']}s"
+            last_err = f"{model}: timeout"
             continue
-        for line in reversed(proc.stdout.strip().splitlines()):
-            try:
-                json.loads(line)
-                print(line)
-                return 0
-            except json.JSONDecodeError:
-                continue
-        last_err = f"{model}: exit={proc.returncode} stderr={proc.stderr[-400:]}"
+        line = _extract_json(proc.stdout)
+        if line is None:
+            last_err = f"{model}: exit={proc.returncode} stderr={proc.stderr[-400:]}"
+            continue
+        is_canary = len(order) > 1 and i == 0
+        if not is_canary:
+            print(line)
+            return 0
+        fallback_line = line
+
+    if fallback_line is not None:
+        print(fallback_line)
+        return 0
     print(
         json.dumps(
             {

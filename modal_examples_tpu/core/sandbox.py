@@ -89,8 +89,14 @@ class Sandbox:
         self._terminated = False
         self._tags: dict[str, str] = {}
         _live_sandboxes[self.object_id] = self
+        self._timeout_timer: threading.Timer | None = None
         if timeout:
-            threading.Timer(timeout, self.terminate).start()
+            # daemon + cancelled on terminate: a live timer must not pin the
+            # interpreter open for the full sandbox timeout after the user is
+            # done with the sandbox
+            self._timeout_timer = threading.Timer(timeout, self.terminate)
+            self._timeout_timer.daemon = True
+            self._timeout_timer.start()
 
     # -- creation -----------------------------------------------------------
 
@@ -130,6 +136,7 @@ class Sandbox:
             if not target.exists():
                 target.symlink_to(vol.local_path)
         sb = cls(sb_dir, env, timeout)
+        sb._volumes = dict(volumes or {})
         if workdir:
             (sb_dir / workdir.lstrip("/")).mkdir(parents=True, exist_ok=True)
             sb._workdir = str(sb_dir / workdir.lstrip("/"))
@@ -180,16 +187,27 @@ class Sandbox:
         with self._lock:
             self._procs.append(proc)
         if timeout:
-            threading.Timer(
+            t = threading.Timer(
                 timeout, lambda: proc.poll() is None and proc.kill()
-            ).start()
+            )
+            t.daemon = True
+            t.start()
         return ContainerProcess(proc, self)
 
     # -- filesystem ---------------------------------------------------------
 
     def open(self, path: str, mode: str = "r"):
         p = (Path(self._workdir) / path.lstrip("/")).resolve()
-        if not str(p).startswith(str(self._dir.resolve())):
+        root = self._dir.resolve()
+        # proper containment check (str.startswith lets /tmp/sb-abcd pass a
+        # /tmp/sb-abc root); volume mounts resolve outside the sandbox dir
+        # via symlinks and are legitimate targets
+        allowed = [root] + [
+            Path(v.local_path).resolve()
+            for v in getattr(self, "_volumes", {}).values()
+            if hasattr(v, "local_path")
+        ]
+        if not any(p == a or p.is_relative_to(a) for a in allowed):
             raise PermissionError(f"path escapes sandbox: {path}")
         p.parent.mkdir(parents=True, exist_ok=True)
         return open(p, mode)
@@ -222,6 +240,8 @@ class Sandbox:
             time.sleep(0.05)
 
     def terminate(self) -> None:
+        if self._timeout_timer is not None:
+            self._timeout_timer.cancel()
         with self._lock:
             self._terminated = True
             procs = list(self._procs)

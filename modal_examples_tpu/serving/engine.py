@@ -50,6 +50,7 @@ class Request:
     out_queue: queue.Queue = dataclasses.field(default_factory=queue.Queue)
     created: float = dataclasses.field(default_factory=time.monotonic)
     aborted: bool = False
+    finish_reason: str | None = None  # set when the terminal marker arrives
 
 
 @dataclasses.dataclass
@@ -80,7 +81,30 @@ class EngineStats:
         return self.generated_tokens / dt if dt > 0 else 0.0
 
 
-_FINISH = object()
+def _stop_safe_len(text: str, stop: tuple[str, ...]) -> int:
+    """Longest prefix of ``text`` that cannot be the start of a pending stop
+    match: anything past it must be withheld until the stop either completes
+    (then truncated) or can no longer match (then flushed)."""
+    safe = len(text)
+    for stop_s in stop:
+        lo = max(0, len(text) - len(stop_s) + 1)
+        for start in range(lo, len(text)):
+            if stop_s.startswith(text[start:]):
+                safe = min(safe, start)
+                break
+    return safe
+
+
+class _Finish:
+    """Terminal stream marker carrying the OpenAI finish_reason."""
+
+    __slots__ = ("reason",)
+
+    def __init__(self, reason: str = "stop"):
+        self.reason = reason
+
+
+_FINISH = _Finish("stop")
 
 
 class LLMEngine:
@@ -230,7 +254,8 @@ class LLMEngine:
             self.start()
         while True:
             item = req.out_queue.get()
-            if item is _FINISH:
+            if isinstance(item, _Finish):
+                req.finish_reason = item.reason
                 return
             yield item
 
@@ -368,7 +393,17 @@ class LLMEngine:
         ]
         assignments = [a for a in assignments if a not in long_ones]
         for a in long_ones:
-            self._prefill_long(*a)
+            try:
+                self._prefill_long(*a)
+            except Exception:
+                # same contract as the grouped path: a failed chunked prefill
+                # must not leave a half-initialized slot (next decode tick
+                # would read uninitialized KV), leak its page claim, or poison
+                # the prefix trie with partially-written pages
+                import traceback
+
+                traceback.print_exc()
+                self._fail_claims([a])
         by_bucket: dict[int, list] = {}
         for a in assignments:
             by_bucket.setdefault(self._bucket_for(a[2]["n_prompt"]), []).append(a)
@@ -384,24 +419,30 @@ class LLMEngine:
                     import traceback
 
                     traceback.print_exc()
-                    for slot_idx, req, claim in chunk:
-                        if self.prefix_cache is not None:
-                            self.prefix_cache.invalidate(claim["trie_pages"])
-                        # trie pages another request still holds stay theirs;
-                        # free everything this claim exclusively owns
-                        owned = [
-                            p for p in claim["private_pages"]
-                        ] + [
-                            p for p in claim["trie_pages"]
-                            if self.prefix_cache is None
-                            or p not in self.prefix_cache._by_page
-                        ]
-                        self.cache.allocator.free(owned)
-                        slot = self.slots[slot_idx]
-                        slot.request = None
-                        slot.pages = slot.trie_pages = slot.private_pages = []
-                        req.out_queue.put(_FINISH)
+                    self._fail_claims(chunk)
         return bool(assignments)
+
+    def _fail_claims(self, chunk: list) -> None:
+        """Unwind failed prefill claims: invalidate trie pages, free privately
+        owned pages, clear the slot, and release the caller."""
+        for slot_idx, req, claim in chunk:
+            if self.prefix_cache is not None:
+                self.prefix_cache.invalidate(claim["trie_pages"])
+            # trie pages another request still holds stay theirs;
+            # free everything this claim exclusively owns
+            owned = [
+                p for p in claim["private_pages"]
+            ] + [
+                p for p in claim["trie_pages"]
+                if self.prefix_cache is None
+                or p not in self.prefix_cache._by_page
+            ]
+            self.cache.allocator.free(owned)
+            slot = self.slots[slot_idx]
+            slot.request = None
+            slot.pages = slot.trie_pages = slot.private_pages = []
+            self._active[slot_idx] = False
+            req.out_queue.put(_Finish("error"))
 
     def _claim_pages(self, req: Request) -> dict | None:
         """Slot page claim with prefix-cache sharing + eviction pressure."""
@@ -632,12 +673,20 @@ class LLMEngine:
                     text = text[:idx]
                     finished, reason = True, "stop"
                     break
-        new = text[slot.emitted_text_len :]
+        # hold back any trailing text that is still a prefix of a stop string
+        # (OpenAI/vLLM contract: stop='END' arriving as 'E','N','D' must not
+        # leak 'EN' into the stream before the match completes)
+        safe_len = (
+            len(text)
+            if finished
+            else _stop_safe_len(text, req.params.stop)
+        )
+        new = text[slot.emitted_text_len : safe_len]
         if new and (finished or not new.endswith("�")):
             req.out_queue.put(new)
-            slot.emitted_text_len = len(text)
+            slot.emitted_text_len = slot.emitted_text_len + len(new)
         if finished:
-            req.out_queue.put(_FINISH)
+            req.out_queue.put(_Finish(reason))
             self._release_slot_pages(slot)
             slot.request = None
             self._active[slot_idx] = False

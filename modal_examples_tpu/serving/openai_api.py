@@ -124,9 +124,28 @@ class _Handler(BaseHTTPRequestHandler):
 
         if n > 1 and not stream:
             # OpenAI `n`: fan out engine requests, one choice each (the
-            # engine's continuous batching runs them concurrently)
-            reqs = [srv.engine.submit(prompt, params) for _ in range(n)]
+            # engine's continuous batching runs them concurrently). A fixed
+            # seed derives per-choice seeds (seed+i) — otherwise seeded
+            # sampling depends only on (seed, position) and every choice
+            # would be identical.
+            import dataclasses as _dc
+
+            reqs = [
+                srv.engine.submit(
+                    prompt,
+                    _dc.replace(params, seed=params.seed + i)
+                    if params.seed is not None
+                    else params,
+                )
+                for i in range(n)
+            ]
             texts = ["".join(srv.engine.stream(r)) for r in reqs]
+            if any(r.finish_reason == "error" for r in reqs):
+                self._json(500, {"error": {
+                    "message": "engine error while processing the request",
+                    "type": "server_error",
+                }})
+                return
             choices = []
             for i, text in enumerate(texts):
                 content = (
@@ -134,7 +153,10 @@ class _Handler(BaseHTTPRequestHandler):
                     if chat
                     else {"text": text}
                 )
-                choices.append({"index": i, **content, "finish_reason": "stop"})
+                choices.append({
+                    "index": i, **content,
+                    "finish_reason": reqs[i].finish_reason or "stop",
+                })
             n_prompt = len(reqs[0].prompt_tokens or [])
             n_out = sum(
                 len(srv.engine.tokenizer.encode(t, add_bos=False)) for t in texts
@@ -173,6 +195,27 @@ class _Handler(BaseHTTPRequestHandler):
                     }
                     self.wfile.write(f"data: {json.dumps(chunk)}\n\n".encode())
                     self.wfile.flush()
+                if req.finish_reason == "error":
+                    # headers already sent: surface an SSE error event (the
+                    # OpenAI stream-error shape) rather than a fake 'stop'
+                    err = {"error": {
+                        "message": "engine error while processing the request",
+                        "type": "server_error",
+                    }}
+                    self.wfile.write(f"data: {json.dumps(err)}\n\n".encode())
+                else:
+                    final = {
+                        "id": rid,
+                        "object": kind + ".chunk",
+                        "created": created,
+                        "model": srv.model_name,
+                        "choices": [{
+                            "index": 0,
+                            **({"delta": {}} if chat else {"text": ""}),
+                            "finish_reason": req.finish_reason or "stop",
+                        }],
+                    }
+                    self.wfile.write(f"data: {json.dumps(final)}\n\n".encode())
                 self.wfile.write(b"data: [DONE]\n\n")
                 self.wfile.flush()
             except BrokenPipeError:
@@ -180,6 +223,14 @@ class _Handler(BaseHTTPRequestHandler):
             return
 
         text = "".join(srv.engine.stream(req))
+        if req.finish_reason == "error":
+            # engine-side prefill/decode failure: a 5xx, not a fake success
+            # with a non-OpenAI finish_reason
+            self._json(500, {"error": {
+                "message": "engine error while processing the request",
+                "type": "server_error",
+            }})
+            return
         n_prompt = len(req.prompt_tokens or [])
         n_out = len(srv.engine.tokenizer.encode(text, add_bos=False))
         content = (
@@ -194,7 +245,10 @@ class _Handler(BaseHTTPRequestHandler):
                 "object": kind,
                 "created": created,
                 "model": srv.model_name,
-                "choices": [{"index": 0, **content, "finish_reason": "stop"}],
+                "choices": [{
+                    "index": 0, **content,
+                    "finish_reason": req.finish_reason or "stop",
+                }],
                 "usage": {
                     "prompt_tokens": n_prompt,
                     "completion_tokens": n_out,

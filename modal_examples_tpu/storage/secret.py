@@ -71,8 +71,11 @@ class Secret:
         path = _secrets_root() / f"{name}.json"
         if path.exists() and not overwrite:
             raise FileExistsError(name)
-        path.write_text(json.dumps(env))
-        os.chmod(path, 0o600)
+        # create 0600 from the first byte — write_text-then-chmod leaves a
+        # window where the plaintext is world-readable
+        fd = os.open(path, os.O_WRONLY | os.O_CREAT | os.O_TRUNC, 0o600)
+        with os.fdopen(fd, "w") as f:
+            f.write(json.dumps(env))
 
     def env_vars(self) -> dict[str, str]:
         return dict(self._env)

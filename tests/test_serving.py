@@ -118,6 +118,56 @@ class TestEngine:
         outs = ["".join(engine.stream(r)) for r in reqs]
         assert len(outs) == 8
 
+    def test_stop_safe_len_withholds_partial_stop(self):
+        # OpenAI/vLLM contract: never emit a prefix of a stop string before
+        # the match can resolve (stop='END' arriving token-wise as E,N,D)
+        from modal_examples_tpu.serving.engine import _stop_safe_len
+
+        assert _stop_safe_len("hello EN", ("END",)) == len("hello ")
+        assert _stop_safe_len("hello E", ("END",)) == len("hello ")
+        assert _stop_safe_len("hello ENX", ("END",)) == len("hello ENX")
+        assert _stop_safe_len("hello", ()) == 5
+        # multiple stops: the longest pending hold wins
+        assert _stop_safe_len("abc<|e", ("<|end|>", "STOP")) == 3
+        # (complete matches never reach here: the caller truncates via
+        # text.find before computing the safe length)
+
+    def test_stop_string_never_leaks_into_stream(self, jax):
+        # end-to-end: patch detokenization so generation deterministically
+        # walks through a stop string char by char; the stream must not
+        # contain any prefix of it
+        from modal_examples_tpu.models import llama
+        from modal_examples_tpu.serving import LLMEngine, SamplingParams
+
+        eng = LLMEngine(
+            llama.LlamaConfig.tiny(), max_slots=2, max_model_len=64,
+            page_size=16, prefill_buckets=(32,), seed=0,
+        )
+        script = "abETCdef"  # stop 'ETC' arrives split across steps
+        eng.tokenizer.decode = lambda toks: script[: len(toks)]
+        try:
+            req = eng.submit(
+                "x", SamplingParams(max_tokens=16, temperature=1.0, stop=("ETC",))
+            )
+            pieces = list(eng.stream(req))
+            assert "".join(pieces) == "ab"
+            assert req.finish_reason == "stop"
+            for p in pieces:
+                assert "E" not in p and "T" not in p and "C" not in p
+        finally:
+            eng.stop()
+
+    def test_finish_reason_length_on_max_tokens(self, engine):
+        from modal_examples_tpu.serving import SamplingParams
+
+        req = engine.submit("hi", SamplingParams(max_tokens=3, temperature=1.0))
+        text = "".join(engine.stream(req))
+        assert req.finish_reason in ("length", "stop")
+        if req.finish_reason == "stop":
+            # only legitimate if EOS actually fired before the cap
+            n = len(engine.tokenizer.encode(text, add_bos=False))
+            assert n < 3 + 1
+
     def test_stop_releases_inflight_callers(self, jax):
         """stop() must unblock stream()/generate() callers rather than
         leaving them waiting on a dead scheduler."""
