@@ -216,12 +216,15 @@ def main() -> int:
         if remaining < 60:
             last_err = last_err or "budget exhausted before any config ran"
             break
+        # reserve >=60s for each config still behind this one, so one
+        # hanging config can't starve smaller ones that would succeed
+        reserve = 60 * (len(order) - i - 1)
         try:
             proc = subprocess.run(
                 [sys.executable, os.path.abspath(__file__), "--child", model],
                 capture_output=True,
                 text=True,
-                timeout=min(spec["timeout"], remaining),
+                timeout=max(60, min(spec["timeout"], remaining - reserve)),
                 cwd=os.path.dirname(os.path.abspath(__file__)),
                 env=env,
             )

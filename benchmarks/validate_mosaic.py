@@ -1,0 +1,203 @@
+#!/usr/bin/env python
+"""First real-chip validation of every Pallas kernel (VERDICT round-1 weak #3).
+
+Round 1 verified all kernels in interpreter mode on CPU only. This script
+compiles each kernel via Mosaic on the attached TPU, checks numerics against
+the pure-XLA references at bf16 tolerances, and times kernel vs XLA. One
+section per kernel; a section failure doesn't stop the rest. Prints a JSON
+summary line at the end.
+
+Run: python benchmarks/validate_mosaic.py  (expects a healthy TPU; ~2 min)
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+import traceback
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax
+import jax.numpy as jnp
+
+from modal_examples_tpu import ops
+from modal_examples_tpu.ops import reference
+
+RESULTS: dict[str, dict] = {}
+
+
+def section(name):
+    def deco(fn):
+        t0 = time.time()
+        try:
+            out = fn() or {}
+            out["ok"] = True
+        except Exception as e:
+            traceback.print_exc()
+            out = {"ok": False, "error": f"{type(e).__name__}: {e}"}
+        out["wall_s"] = round(time.time() - t0, 1)
+        RESULTS[name] = out
+        print(f"[{name}] {out}", flush=True)
+        return fn
+
+    return deco
+
+
+def timeit(fn, *args, iters=20):
+    fn(*args)[0].block_until_ready() if isinstance(fn(*args), tuple) else jax.block_until_ready(fn(*args))
+    t0 = time.time()
+    for _ in range(iters):
+        r = fn(*args)
+    jax.block_until_ready(r)
+    return (time.time() - t0) / iters * 1e3  # ms
+
+
+def main():
+    assert jax.default_backend() == "tpu", jax.default_backend()
+    print("device:", jax.devices()[0], flush=True)
+
+    key = jax.random.PRNGKey(0)
+    B, Hq, Hkv, S, D = 4, 32, 8, 1024, 128
+    q = jax.random.normal(key, (B, Hq, S, D), jnp.bfloat16)
+    k = jax.random.normal(jax.random.PRNGKey(1), (B, Hkv, S, D), jnp.bfloat16)
+    v = jax.random.normal(jax.random.PRNGKey(2), (B, Hkv, S, D), jnp.bfloat16)
+
+    @section("flash_fwd")
+    def _():
+        flash = jax.jit(ops.flash_attention)
+        ref = jax.jit(lambda q, k, v: reference.attention(q, k, v))
+        o1 = flash(q, k, v)
+        o2 = ref(q, k, v)
+        err = float(jnp.max(jnp.abs(o1.astype(jnp.float32) - o2.astype(jnp.float32))))
+        assert err < 0.06, err
+        ms_flash = timeit(flash, q, k, v)
+        ms_ref = timeit(ref, q, k, v)
+        # causal attention flops: 2 matmuls, half the square
+        flops = 2 * 2 * B * Hq * S * S * D / 2
+        return {
+            "max_err": round(err, 4),
+            "pallas_ms": round(ms_flash, 3),
+            "xla_ms": round(ms_ref, 3),
+            "pallas_tflops": round(flops / ms_flash / 1e9, 1),
+        }
+
+    @section("flash_bwd")
+    def _():
+        def loss_flash(q, k, v):
+            return jnp.sum(ops.flash_attention(q, k, v).astype(jnp.float32))
+
+        def loss_ref(q, k, v):
+            return jnp.sum(reference.attention(q, k, v).astype(jnp.float32))
+
+        g1 = jax.jit(jax.grad(loss_flash, argnums=(0, 1, 2)))
+        g2 = jax.jit(jax.grad(loss_ref, argnums=(0, 1, 2)))
+        r1 = g1(q, k, v)
+        r2 = g2(q, k, v)
+        errs = [
+            float(jnp.max(jnp.abs(a.astype(jnp.float32) - b.astype(jnp.float32))))
+            for a, b in zip(r1, r2)
+        ]
+        assert max(errs) < 1.0, errs  # bf16 sum-of-S grads; scale ~sqrt(S)
+        ms_flash = timeit(lambda *a: g1(*a)[0], q, k, v, iters=10)
+        ms_ref = timeit(lambda *a: g2(*a)[0], q, k, v, iters=10)
+        return {
+            "max_err": round(max(errs), 4),
+            "pallas_ms": round(ms_flash, 3),
+            "xla_ms": round(ms_ref, 3),
+        }
+
+    @section("flash_chunked")
+    def _():
+        q_off = 512
+        qc = q[:, :, :256, :]
+        fn = jax.jit(
+            lambda qc, k, v: ops.flash_attention_chunked(qc, k, v, q_offset=q_off)
+        )
+        o1 = fn(qc, k, v)
+        full = reference.attention(q, k, v)  # causal over the full S
+        # chunk rows [q_off, q_off+256) of a causal full-seq attention where
+        # q rows are the same tokens
+        o2 = jax.jit(lambda q, k, v: reference.attention(q, k, v))(q, k, v)[
+            :, :, q_off : q_off + 256, :
+        ]
+        # but chunked uses q rows from qc = q[:, :, :256]; recompute ref properly
+        qfull = q.at[:, :, q_off : q_off + 256, :].set(qc)
+        o2 = jax.jit(lambda q, k, v: reference.attention(q, k, v))(qfull, k, v)[
+            :, :, q_off : q_off + 256, :
+        ]
+        err = float(jnp.max(jnp.abs(o1.astype(jnp.float32) - o2.astype(jnp.float32))))
+        assert err < 0.06, err
+        del full
+        return {"max_err": round(err, 4), "ms": round(timeit(fn, qc, k, v), 3)}
+
+    @section("paged_decode")
+    def _():
+        page_size, pages_per_seq = 16, 32
+        n_pages = B * pages_per_seq + 8
+        kp = jax.random.normal(
+            jax.random.PRNGKey(3), (Hkv, n_pages, page_size, D), jnp.bfloat16
+        )
+        vp = jax.random.normal(
+            jax.random.PRNGKey(4), (Hkv, n_pages, page_size, D), jnp.bfloat16
+        )
+        pt = jax.random.permutation(jax.random.PRNGKey(5), n_pages)[
+            : B * pages_per_seq
+        ].reshape(B, pages_per_seq).astype(jnp.int32)
+        lens = jnp.array([100, 512, 37, 480], jnp.int32)
+        qd = jax.random.normal(jax.random.PRNGKey(6), (B, Hq, D), jnp.bfloat16)
+        fn = jax.jit(ops.paged_decode_attention)
+        refn = jax.jit(reference.paged_decode_attention)
+        o1 = fn(qd, kp, vp, pt, lens)
+        o2 = refn(qd, kp, vp, pt, lens)
+        err = float(jnp.max(jnp.abs(o1.astype(jnp.float32) - o2.astype(jnp.float32))))
+        assert err < 0.06, err
+        return {
+            "max_err": round(err, 4),
+            "pallas_ms": round(timeit(fn, qd, kp, vp, pt, lens), 3),
+            "xla_ms": round(timeit(refn, qd, kp, vp, pt, lens), 3),
+        }
+
+    @section("quantized_matmul")
+    def _():
+        M, K, N = 1024, 4096, 4096
+        x = jax.random.normal(jax.random.PRNGKey(7), (M, K), jnp.bfloat16)
+        w = jax.random.normal(jax.random.PRNGKey(8), (K, N), jnp.float32)
+        w_q, w_scale = ops.quantize_int8(w)
+        fn = jax.jit(ops.quantized_matmul)
+        o1 = fn(x, w_q, w_scale)
+        o2 = jnp.dot(
+            x.astype(jnp.float32), ops.dequantize_int8(w_q, w_scale)
+        ).astype(x.dtype)
+        err = float(
+            jnp.max(jnp.abs(o1.astype(jnp.float32) - o2.astype(jnp.float32)))
+        )
+        rel = err / float(jnp.max(jnp.abs(o2.astype(jnp.float32))) + 1e-6)
+        assert rel < 0.05, (err, rel)
+        ms_q = timeit(fn, x, w_q, w_scale)
+        bf16 = jax.jit(lambda x, w: jnp.dot(x, w.astype(jnp.bfloat16)))
+        ms_bf16 = timeit(bf16, x, w)
+        return {
+            "rel_err": round(rel, 4),
+            "pallas_int8_ms": round(ms_q, 3),
+            "xla_bf16_ms": round(ms_bf16, 3),
+        }
+
+    n_ok = sum(1 for r in RESULTS.values() if r["ok"])
+    print(
+        json.dumps(
+            {
+                "mosaic_validation": RESULTS,
+                "ok": n_ok,
+                "total": len(RESULTS),
+                "device": str(jax.devices()[0]),
+            }
+        ),
+        flush=True,
+    )
+
+
+if __name__ == "__main__":
+    main()

@@ -42,7 +42,10 @@ def _fwd_kernel(
     k_ref,  # (1, block_k, D)
     v_ref,  # (1, block_k, D)
     o_ref,  # (1, block_q, D)
-    lse_ref,  # (1, block_q)
+    lse_ref,  # (1, block_q, LANES) — row stats ride a 128-lane dim: Mosaic
+    #           requires output tiles shaped (8k, 128m); a bare (1, block_q)
+    #           block fails lowering (the official TPU flash kernel pads the
+    #           same way)
     m_scr,  # (block_q, LANES) f32
     l_scr,  # (block_q, LANES) f32
     acc_scr,  # (block_q, D) f32
@@ -114,7 +117,7 @@ def _fwd_kernel(
         l_safe = jnp.where(l > 0, l, 1.0)
         o_ref[0] = (acc_scr[:] / l_safe).astype(o_ref.dtype)
         lse = jnp.where(l > 0, m + jnp.log(l_safe), -jnp.inf)
-        lse_ref[0] = lse[:, 0]
+        lse_ref[0] = jnp.broadcast_to(lse, lse_ref.shape[1:])
 
 
 def _flash_forward(
@@ -173,11 +176,14 @@ def _flash_forward(
                 (1, block_q, D), lambda bh, qi, ki: (bh, qi, 0),
                 memory_space=pltpu.VMEM,
             ),
-            pl.BlockSpec((1, block_q), lambda bh, qi, ki: (bh, qi)),
+            pl.BlockSpec(
+                (1, block_q, _LANES), lambda bh, qi, ki: (bh, qi, 0),
+                memory_space=pltpu.VMEM,
+            ),
         ],
         out_shape=[
             jax.ShapeDtypeStruct(qf.shape, q.dtype),
-            jax.ShapeDtypeStruct((B * Hkv * group, S), jnp.float32),
+            jax.ShapeDtypeStruct((B * Hkv * group, S, _LANES), jnp.float32),
         ],
         scratch_shapes=[
             pltpu.VMEM((block_q, _LANES), jnp.float32),
@@ -191,7 +197,7 @@ def _flash_forward(
         ),
         interpret=interpret,
     )(qf, kf, vf)
-    return o.reshape(B, Hq, S, D), lse.reshape(B, Hq, S)
+    return o.reshape(B, Hq, S, D), lse[:, :, 0].reshape(B, Hq, S)
 
 
 def _use_interpret() -> bool:
@@ -253,9 +259,9 @@ def _dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dlse_ref,
         k = k_ref[0].astype(jnp.float32)
         v = v_ref[0].astype(jnp.float32)
         do = do_ref[0].astype(jnp.float32)
-        lse_row = lse_ref[0][:, None]
-        delta_row = delta_ref[0][:, None]
-        dlse_row = dlse_ref[0][:, None]
+        lse_row = lse_ref[0][:, :1]
+        delta_row = delta_ref[0][:, :1]
+        dlse_row = dlse_ref[0][:, :1]
         _, ds = _bwd_block_ds(
             q, k, lse_row, delta_row, dlse_row, do, v, sm_scale=sm_scale,
             causal=causal, q_start=q_start, k_start=k_start,
@@ -288,9 +294,9 @@ def _dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dlse_ref,
         k = k_ref[0].astype(jnp.float32)
         v = v_ref[0].astype(jnp.float32)
         do = do_ref[0].astype(jnp.float32)
-        lse_row = lse_ref[0][:, None]
-        delta_row = delta_ref[0][:, None]
-        dlse_row = dlse_ref[0][:, None]
+        lse_row = lse_ref[0][:, :1]
+        delta_row = delta_ref[0][:, :1]
+        dlse_row = dlse_ref[0][:, :1]
         p, ds = _bwd_block_ds(
             q, k, lse_row, delta_row, dlse_row, do, v, sm_scale=sm_scale,
             causal=causal, q_start=q_start, k_start=k_start,
@@ -320,15 +326,22 @@ def _flash_backward(q, k, v, o, lse, g, *, causal, sm_scale, block_q, block_k,
     kf = k.reshape(B * Hkv, S, D)
     vf = v.reshape(B * Hkv, S, D)
     dof = g.reshape(BHq, S, D)
-    lsef = lse.reshape(BHq, S)
+    # per-row stats ride a 128-lane dim (same Mosaic tiling constraint as the
+    # forward's lse output; the kernels read lane 0)
+    lsef = jnp.broadcast_to(lse.reshape(BHq, S)[:, :, None], (BHq, S, _LANES))
     dlsef = (
-        jnp.zeros((BHq, S), jnp.float32)
+        jnp.zeros((BHq, S, _LANES), jnp.float32)
         if g_lse is None
-        else g_lse.astype(jnp.float32).reshape(BHq, S)
+        else jnp.broadcast_to(
+            g_lse.astype(jnp.float32).reshape(BHq, S)[:, :, None],
+            (BHq, S, _LANES),
+        )
     )
-    delta = jnp.sum(
-        g.astype(jnp.float32) * o.astype(jnp.float32), axis=-1
-    ).reshape(BHq, S)
+    delta = jnp.broadcast_to(
+        jnp.sum(g.astype(jnp.float32) * o.astype(jnp.float32), axis=-1)
+        .reshape(BHq, S)[:, :, None],
+        (BHq, S, _LANES),
+    )
 
     kv_index = lambda bh, g=group: bh // g
 
@@ -342,9 +355,9 @@ def _flash_backward(q, k, v, o, lse, g, *, causal, sm_scale, block_q, block_k,
             pl.BlockSpec((1, block_k, D), lambda bh, qi, ki: (kv_index(bh), ki, 0)),
             pl.BlockSpec((1, block_k, D), lambda bh, qi, ki: (kv_index(bh), ki, 0)),
             pl.BlockSpec((1, block_q, D), lambda bh, qi, ki: (bh, qi, 0)),
-            pl.BlockSpec((1, block_q), lambda bh, qi, ki: (bh, qi)),
-            pl.BlockSpec((1, block_q), lambda bh, qi, ki: (bh, qi)),
-            pl.BlockSpec((1, block_q), lambda bh, qi, ki: (bh, qi)),
+            pl.BlockSpec((1, block_q, _LANES), lambda bh, qi, ki: (bh, qi, 0)),
+            pl.BlockSpec((1, block_q, _LANES), lambda bh, qi, ki: (bh, qi, 0)),
+            pl.BlockSpec((1, block_q, _LANES), lambda bh, qi, ki: (bh, qi, 0)),
         ],
         out_specs=pl.BlockSpec((1, block_q, D), lambda bh, qi, ki: (bh, qi, 0)),
         out_shape=jax.ShapeDtypeStruct(qf.shape, q.dtype),
@@ -365,9 +378,9 @@ def _flash_backward(q, k, v, o, lse, g, *, causal, sm_scale, block_q, block_k,
             pl.BlockSpec((1, block_k, D), lambda bh, ki, qi: (kv_index(bh), ki, 0)),
             pl.BlockSpec((1, block_k, D), lambda bh, ki, qi: (kv_index(bh), ki, 0)),
             pl.BlockSpec((1, block_q, D), lambda bh, ki, qi: (bh, qi, 0)),
-            pl.BlockSpec((1, block_q), lambda bh, ki, qi: (bh, qi)),
-            pl.BlockSpec((1, block_q), lambda bh, ki, qi: (bh, qi)),
-            pl.BlockSpec((1, block_q), lambda bh, ki, qi: (bh, qi)),
+            pl.BlockSpec((1, block_q, _LANES), lambda bh, ki, qi: (bh, qi, 0)),
+            pl.BlockSpec((1, block_q, _LANES), lambda bh, ki, qi: (bh, qi, 0)),
+            pl.BlockSpec((1, block_q, _LANES), lambda bh, ki, qi: (bh, qi, 0)),
         ],
         out_specs=[
             pl.BlockSpec((1, block_k, D), lambda bh, ki, qi: (bh, ki, 0)),

@@ -150,6 +150,18 @@ def paged_decode_attention(
     if interpret is None:
         interpret = jax.default_backend() != "tpu"
 
+    # Mosaic DMA units are (sublane, lane) tiles — a page must be a whole
+    # number of (16, 128) bf16 tiles or the HBM→VMEM copies fail to lower
+    # (observed on-chip with head_dim 32). Sub-tile shapes (tiny/test models)
+    # take the dense XLA path instead; every production config (D=128,
+    # page_size>=16) stays on the kernel.
+    if not interpret and (D % 128 or page_size % 16):
+        from .reference import paged_decode_attention as _ref
+
+        return _ref(
+            q, k_pages, v_pages, page_tables, context_lens, sm_scale=sm_scale
+        )
+
     qg = q.reshape(B * Hkv, G, D)  # block (b, h) lives at row b * Hkv + h
 
     grid_spec = pltpu.PrefetchScalarGridSpec(

@@ -122,7 +122,16 @@ class _Handler(BaseHTTPRequestHandler):
         created = int(time.time())
         kind = "chat.completion" if chat else "text_completion"
 
-        if n > 1 and not stream:
+        if n > 1 and stream:
+            # OpenAI supports streaming multiple choices interleaved; this
+            # server intentionally does not (one slot per SSE connection) —
+            # reject loudly rather than silently returning one choice
+            self._json(400, {"error": {
+                "message": "n > 1 with stream=true is not supported",
+                "type": "invalid_request_error",
+            }})
+            return
+        if n > 1:
             # OpenAI `n`: fan out engine requests, one choice each (the
             # engine's continuous batching runs them concurrently). A fixed
             # seed derives per-choice seeds (seed+i) — otherwise seeded
@@ -219,7 +228,12 @@ class _Handler(BaseHTTPRequestHandler):
                 self.wfile.write(b"data: [DONE]\n\n")
                 self.wfile.flush()
             except BrokenPipeError:
-                pass
+                # client went away mid-stream: stop decoding for it so the
+                # slot and its KV pages go back to the pool (vLLM aborts on
+                # client disconnect the same way)
+                srv.engine.abort(req)
+                for _ in srv.engine.stream(req):  # drain until _FINISH
+                    pass
             return
 
         text = "".join(srv.engine.stream(req))

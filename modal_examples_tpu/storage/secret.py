@@ -74,6 +74,9 @@ class Secret:
         # create 0600 from the first byte — write_text-then-chmod leaves a
         # window where the plaintext is world-readable
         fd = os.open(path, os.O_WRONLY | os.O_CREAT | os.O_TRUNC, 0o600)
+        # the mode arg only applies at creation: when overwriting a file that
+        # already exists with looser permissions, tighten it too
+        os.fchmod(fd, 0o600)
         with os.fdopen(fd, "w") as f:
             f.write(json.dumps(env))
 
