@@ -1,0 +1,99 @@
+# # Embed a huge dataset with a spawn queue and an autoscaled fleet
+#
+# The counterpart of the reference's embeddings/amazon_embeddings.py (30M
+# Amazon reviews at 575k tok/s, :6): a launcher function chunks the corpus
+# and `.spawn`s one embedding call per batch from a thread pool
+# (:108-112) — the spawned calls queue up while the autoscaler grows the
+# embedder fleet (up to max_containers), and the client gathers results by
+# FunctionCall id later, detached from the launcher.
+#
+# Cheap mode embeds a synthetic corpus with a tiny random-weight encoder;
+# `down_scale`-style sizing (amazon_embeddings.py:55) keeps CI fast. The
+# job shape — launcher → spawn-per-batch → gather — is the real pattern.
+
+import time
+from concurrent.futures import ThreadPoolExecutor
+
+import modal_examples_tpu as mtpu
+
+app = mtpu.App("example-mass-embeddings")
+
+BATCH_SIZE = 16
+
+
+@app.function(max_containers=4, timeout=600)
+def embed_batch(batch_id: int, texts: list[str]) -> dict:
+    """One fleet worker input: encode a batch, return stats + vectors.
+
+    (The real deployment calls the Embedder Cls from text_embeddings.py;
+    this inlines a tiny JAX encoder so the example is self-contained.)
+    """
+    import jax
+    import jax.numpy as jnp
+
+    from modal_examples_tpu.models import bert
+    from modal_examples_tpu.utils.tokenizer import load_tokenizer
+
+    cfg = bert.BertConfig.tiny()
+    params = bert.init_params(jax.random.PRNGKey(0), cfg)
+    tok = load_tokenizer(None)
+
+    ids = [tok.encode(t)[:32] for t in texts]
+    n_tokens = sum(len(i) for i in ids)
+    width = max(len(i) for i in ids)
+    padded = jnp.array([i + [0] * (width - len(i)) for i in ids])
+    mask = jnp.array([[1] * len(i) + [0] * (width - len(i)) for i in ids])
+    vecs = bert.embed(params, padded, mask, cfg)
+    return {
+        "batch_id": batch_id,
+        "n_texts": len(texts),
+        "n_tokens": n_tokens,
+        "dim": int(vecs.shape[-1]),
+    }
+
+
+@app.function(timeout=3600)
+def launch_job(n_docs: int = 48) -> list[str]:
+    """The detached launcher (amazon_embeddings.py:56-60): chunk the corpus,
+    spawn a call per batch from a thread pool, return the call ids."""
+    corpus = [
+        f"review {i}: the product arrived quickly and works as described"
+        for i in range(n_docs)
+    ]
+    batches = [
+        (i // BATCH_SIZE, corpus[i : i + BATCH_SIZE])
+        for i in range(0, len(corpus), BATCH_SIZE)
+    ]
+    t0 = time.time()
+    with ThreadPoolExecutor(max_workers=8) as pool:
+        calls = list(
+            pool.map(lambda b: embed_batch.spawn(b[0], b[1]), batches)
+        )
+    print(
+        f"spawned {len(calls)} batches ({n_docs} docs) in "
+        f"{time.time() - t0:.2f}s; fleet is processing"
+    )
+    return [c.object_id for c in calls]
+
+
+@app.local_entrypoint()
+def main(n_docs: int = 48):
+    # the launcher itself runs remotely (run with --detach for long jobs)
+    call_ids = launch_job.remote(n_docs)
+
+    # gather later, by id — the spawn queue holds results for the client
+    calls = [mtpu.FunctionCall.from_id(cid) for cid in call_ids]
+    t0 = time.time()
+    results = mtpu.gather(*calls)
+    dt = time.time() - t0
+
+    total_docs = sum(r["n_texts"] for r in results)
+    total_tokens = sum(r["n_tokens"] for r in results)
+    print(
+        f"embedded {total_docs} docs / {total_tokens} tokens across "
+        f"{len(results)} batches in {dt:.2f}s "
+        f"({total_tokens / max(dt, 1e-9):.0f} tok/s)"
+    )
+    assert total_docs == n_docs
+    assert all(r["dim"] > 0 for r in results)
+    print("mass embeddings job OK")

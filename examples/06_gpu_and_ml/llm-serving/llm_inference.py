@@ -26,6 +26,19 @@ MODEL_DIR = os.environ.get("MTPU_MODEL_DIR")  # HF safetensors dir on a Volume
 PORT = int(os.environ.get("MTPU_PORT", "8000"))
 # resource spec; MTPU_TPU="" runs the server container on CPU (dev mode)
 TPU = os.environ.get("MTPU_TPU", "v5e-1") or None
+# tensor parallelism: one flag on the same engine, like the reference's
+# --tensor-parallel-size (vllm_inference.py:179-180). MTPU_TP=2 shards
+# weights (Megatron layout) + the paged KV cache (by kv head) over a
+# "tensor" mesh axis; XLA inserts the ICI collectives.
+TP = int(os.environ.get("MTPU_TP", "1"))
+# speculative decoding: draft-model gamma, like the reference's
+# --speculative-config (vllm_inference.py:196-205). MTPU_SPEC_GAMMA=4 with
+# MTPU_SPEC_DRAFT naming a preset enables it; point MTPU_SPEC_DRAFT_DIR at
+# an HF checkout for real draft weights. Draft and target must share a
+# vocabulary (the engine validates).
+SPEC_GAMMA = int(os.environ.get("MTPU_SPEC_GAMMA", "0"))
+SPEC_DRAFT = os.environ.get("MTPU_SPEC_DRAFT", "tiny")
+SPEC_DRAFT_DIR = os.environ.get("MTPU_SPEC_DRAFT_DIR")
 MINUTES = 60
 
 app = mtpu.App("example-llm-inference")
@@ -70,11 +83,23 @@ class LLMServer:
             pass
         from modal_examples_tpu.serving import OpenAIServer, build_engine
 
+        engine_kw = {}
+        if TP > 1:
+            from modal_examples_tpu.parallel import make_mesh
+
+            engine_kw["mesh"] = make_mesh(
+                {"tensor": TP}, devices=jax.devices()[:TP]
+            )
+        if SPEC_GAMMA > 0:
+            engine_kw["speculative"] = (SPEC_DRAFT, SPEC_GAMMA)
+            if SPEC_DRAFT_DIR:
+                engine_kw["draft_model_dir"] = SPEC_DRAFT_DIR
         engine = build_engine(
             MODEL,
             model_dir=MODEL_DIR,
             max_slots=8 if MODEL != "tiny" else 4,
             max_model_len=1024 if MODEL != "tiny" else 128,
+            **engine_kw,
         )
         self.server = OpenAIServer(engine, model_name=MODEL, port=PORT)
         self.server.start()  # replica advertised once the port accepts

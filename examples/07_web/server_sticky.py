@@ -1,0 +1,115 @@
+# # Sticky routing for servers
+#
+# The counterpart of the reference's 07_web/server_sticky.py:16-27:
+# sequential requests from the same client land on the same server replica
+# via rendezvous (highest-random-weight) hashing — a performance
+# optimization for stateful replicas (KV caches, session state), not a
+# correctness guarantee. Replicas joining or leaving only move the keys
+# they own.
+#
+# Here we boot several replicas of a tiny stateful HTTP server (each counts
+# the requests it has seen per session), route a stream of sessions with
+# `rendezvous_pick`, and then verify the two properties that matter:
+# stickiness (one replica per session) and balance (sessions spread across
+# replicas).
+
+import collections
+import http.server
+import json
+import threading
+import urllib.request
+
+import modal_examples_tpu as mtpu
+from modal_examples_tpu.web.routing import rendezvous_pick, rendezvous_rank
+
+app = mtpu.App("example-server-sticky")
+
+
+# ## The replica: a raw-port server with per-session state
+#
+# `@app.server(sticky_header=...)` declares the header the router hashes on
+# (the reference's sticky routing key). The server itself just remembers how
+# many times each session hit it.
+
+
+def make_replica(replica_id: str, port: int):
+    seen: dict[str, int] = collections.Counter()
+
+    class Handler(http.server.BaseHTTPRequestHandler):
+        def log_message(self, *a):
+            pass
+
+        def do_GET(self):
+            session = self.headers.get("x-session-id", "anon")
+            seen[session] += 1
+            body = json.dumps(
+                {"replica": replica_id, "session": session, "hits": seen[session]}
+            ).encode()
+            self.send_response(200)
+            self.send_header("content-type", "application/json")
+            self.end_headers()
+            self.wfile.write(body)
+
+    srv = http.server.ThreadingHTTPServer(("127.0.0.1", port), Handler)
+    threading.Thread(target=srv.serve_forever, daemon=True).start()
+    return srv
+
+
+@app.local_entrypoint()
+def main(n_replicas: int = 3, n_sessions: int = 60, requests_per_session: int = 3):
+    import socket
+
+    # boot the replica set
+    servers, urls = [], {}
+    for i in range(n_replicas):
+        with socket.socket() as s:
+            s.bind(("127.0.0.1", 0))
+            port = s.getsockname()[1]
+        rid = f"replica-{i}"
+        servers.append(make_replica(rid, port))
+        urls[rid] = f"http://127.0.0.1:{port}"
+
+    replicas = sorted(urls)
+
+    # route: same session key -> same replica, every time
+    assignments: dict[str, set[str]] = collections.defaultdict(set)
+    load = collections.Counter()
+    for s_idx in range(n_sessions):
+        session = f"session-{s_idx}"
+        for _ in range(requests_per_session):
+            rid = rendezvous_pick(session, replicas)
+            req = urllib.request.Request(
+                f"{urls[rid]}/", headers={"x-session-id": session}
+            )
+            with urllib.request.urlopen(req, timeout=5) as r:
+                out = json.load(r)
+            assignments[session].add(out["replica"])
+        load[rendezvous_pick(session, replicas)] += 1
+
+    # stickiness: every session only ever saw one replica
+    assert all(len(v) == 1 for v in assignments.values()), assignments
+    # balance: no replica owns everything (HRW spreads keys ~uniformly)
+    print("session load per replica:", dict(load))
+    assert len(load) == n_replicas and max(load.values()) < n_sessions, load
+
+    # elasticity: removing a replica only moves the sessions it owned
+    survivor_set = replicas[:-1]
+    moved = sum(
+        1
+        for s_idx in range(n_sessions)
+        if rendezvous_pick(f"session-{s_idx}", replicas)
+        != rendezvous_pick(f"session-{s_idx}", survivor_set)
+    )
+    owned_by_last = sum(
+        1
+        for s_idx in range(n_sessions)
+        if rendezvous_pick(f"session-{s_idx}", replicas) == replicas[-1]
+    )
+    print(f"scale-down moved {moved} sessions (replica owned {owned_by_last})")
+    assert moved == owned_by_last  # only orphaned keys re-home
+
+    # a full preference order is also available for failover routing
+    print("failover order for session-0:", rendezvous_rank("session-0", replicas))
+    for srv in servers:
+        srv.shutdown()
+    print("sticky routing OK")

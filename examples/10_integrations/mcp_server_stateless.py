@@ -1,0 +1,166 @@
+# # Deploy a remote, stateless MCP server
+#
+# The counterpart of the reference's 10_integrations/mcp_server_stateless.py:
+# a Model Context Protocol server hosted as a serverless web endpoint, using
+# the stateless "streamable HTTP" transport (every request carries a full
+# JSON-RPC message; no session state between requests — which is exactly
+# what maps onto serverless Functions). The reference wraps the FastMCP
+# library; here the protocol layer is small enough to speak directly: an
+# ASGI app handling `initialize`, `tools/list`, and `tools/call`.
+#
+# The server exposes the same tool as the reference: current date and time
+# in a requested timezone.
+
+import datetime
+import json
+import urllib.request
+import zoneinfo
+
+import modal_examples_tpu as mtpu
+
+app = mtpu.App("example-mcp-server")
+
+PROTOCOL_VERSION = "2025-03-26"
+
+TOOLS = [
+    {
+        "name": "current_date_and_time",
+        "description": "Get the current date and time in a timezone "
+        "(ISO 8601). Defaults to UTC.",
+        "inputSchema": {
+            "type": "object",
+            "properties": {
+                "timezone": {"type": "string", "description": "IANA timezone"}
+            },
+        },
+    }
+]
+
+
+def _call_tool(name: str, arguments: dict) -> dict:
+    if name != "current_date_and_time":
+        return {
+            "content": [{"type": "text", "text": f"unknown tool {name!r}"}],
+            "isError": True,
+        }
+    tz_name = arguments.get("timezone", "UTC")
+    try:
+        tz = zoneinfo.ZoneInfo(tz_name)
+    except Exception:
+        return {
+            "content": [
+                {"type": "text", "text": f"Invalid timezone {tz_name!r}"}
+            ],
+            "isError": True,
+        }
+    now = datetime.datetime.now(tz).isoformat()
+    return {"content": [{"type": "text", "text": now}], "isError": False}
+
+
+def _handle_rpc(msg: dict) -> dict | None:
+    """One stateless JSON-RPC 2.0 exchange (notifications return None)."""
+    method = msg.get("method", "")
+    rpc_id = msg.get("id")
+    if rpc_id is None:
+        return None  # notification (e.g. notifications/initialized)
+    if method == "initialize":
+        result = {
+            "protocolVersion": PROTOCOL_VERSION,
+            "capabilities": {"tools": {}},
+            "serverInfo": {"name": "Date and Time MCP Server", "version": "1.0"},
+        }
+    elif method == "tools/list":
+        result = {"tools": TOOLS}
+    elif method == "tools/call":
+        params = msg.get("params", {})
+        result = _call_tool(params.get("name", ""), params.get("arguments", {}))
+    else:
+        return {
+            "jsonrpc": "2.0",
+            "id": rpc_id,
+            "error": {"code": -32601, "message": f"method {method!r} not found"},
+        }
+    return {"jsonrpc": "2.0", "id": rpc_id, "result": result}
+
+
+# ## The ASGI app — the streamable-HTTP endpoint at /mcp
+
+
+@app.function()
+@mtpu.asgi_app()
+def mcp():
+    async def asgi(scope, receive, send):
+        if scope["type"] != "http" or scope["method"] != "POST":
+            await send(
+                {"type": "http.response.start", "status": 405, "headers": []}
+            )
+            await send({"type": "http.response.body", "body": b""})
+            return
+        body = b""
+        while True:
+            event = await receive()
+            body += event.get("body", b"")
+            if not event.get("more_body"):
+                break
+        reply = _handle_rpc(json.loads(body or b"{}"))
+        payload = json.dumps(reply).encode() if reply else b""
+        await send(
+            {
+                "type": "http.response.start",
+                "status": 200 if reply else 202,
+                "headers": [(b"content-type", b"application/json")],
+            }
+        )
+        await send({"type": "http.response.body", "body": payload})
+
+    return asgi
+
+
+# ## Client smoke test — the reference's test_tool entrypoint shape:
+# initialize, list tools, call the tool, check the answer
+
+
+@app.local_entrypoint()
+def main(timezone: str = "Europe/Istanbul"):
+    from modal_examples_tpu.web.gateway import Gateway
+
+    def rpc(url: str, method: str, params: dict | None = None, rpc_id=1):
+        body = {"jsonrpc": "2.0", "id": rpc_id, "method": method}
+        if params is not None:
+            body["params"] = params
+        req = urllib.request.Request(
+            url,
+            data=json.dumps(body).encode(),
+            headers={"content-type": "application/json"},
+        )
+        with urllib.request.urlopen(req, timeout=10) as r:
+            return json.load(r)
+
+    with app.run():
+        gw = Gateway(app).start()
+        try:
+            url = f"{gw.base_url}/mcp"
+            init = rpc(url, "initialize", {"protocolVersion": PROTOCOL_VERSION})
+            assert init["result"]["serverInfo"]["name"].startswith("Date")
+            tools = rpc(url, "tools/list")["result"]["tools"]
+            print("tools:", [t["name"] for t in tools])
+            assert tools[0]["name"] == "current_date_and_time"
+
+            out = rpc(
+                url,
+                "tools/call",
+                {"name": "current_date_and_time", "arguments": {"timezone": timezone}},
+            )["result"]
+            stamp = out["content"][0]["text"]
+            print(f"time in {timezone}: {stamp}")
+            assert not out["isError"] and "T" in stamp
+
+            bad = rpc(
+                url,
+                "tools/call",
+                {"name": "current_date_and_time", "arguments": {"timezone": "Not/AZone"}},
+            )["result"]
+            assert bad["isError"]
+        finally:
+            gw.stop()
+    print("MCP server OK")

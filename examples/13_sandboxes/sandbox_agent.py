@@ -1,0 +1,84 @@
+# # Drive a sandbox with an agent loop
+#
+# The counterpart of the reference's 13_sandboxes/sandbox_agent.py:29-62: an
+# agent operates an isolated sandbox through an observe → decide → act loop
+# — it runs commands, reads their output, and decides the next action until
+# the task is done. The reference puts a hosted coding agent in the loop;
+# here the policy is a small deterministic planner (swap `policy` for a call
+# to the llm_inference example's OpenAI endpoint to make it model-driven —
+# the action protocol stays the same).
+#
+# The task: the sandbox contains a failing test. The agent explores the
+# workspace, runs the test, localizes the bug from the traceback, patches
+# the file, and re-runs the test until green.
+
+import modal_examples_tpu as mtpu
+
+app = mtpu.App("example-sandbox-agent")
+
+BUGGY_MODULE = """\
+def add(a, b):
+    return a - b  # BUG
+"""
+
+TEST_FILE = """\
+import mylib
+assert mylib.add(2, 3) == 5, f"add(2,3) gave {mylib.add(2, 3)}"
+print("TESTS PASSED")
+"""
+
+
+def policy(transcript: list[dict]) -> dict:
+    """Decide the next action from what the agent has seen so far.
+
+    Actions (the same shape an LLM tool-use loop would emit):
+      {"run": [...argv]}                 — execute a command
+      {"write": {"path":..., "text":..}} — write a file
+      {"done": bool}                     — finish
+    """
+    if not transcript:
+        return {"run": ["ls"]}  # observe the workspace first
+    last = transcript[-1]
+    if last["action"] == {"run": ["ls"]}:
+        return {"run": ["python", "test_mylib.py"]}  # reproduce the failure
+    if "TESTS PASSED" in last.get("stdout", ""):
+        return {"done": True}
+    if "AssertionError" in last.get("stderr", ""):
+        # localize: the traceback names mylib.add; patch the implementation
+        return {"write": {"path": "mylib.py", "text": "def add(a, b):\n    return a + b\n"}}
+    if last["action"].get("write"):
+        return {"run": ["python", "test_mylib.py"]}  # verify the fix
+    return {"done": False}
+
+
+@app.local_entrypoint()
+def main(max_steps: int = 8):
+    sb = mtpu.Sandbox.create(app=app, timeout=120)
+    with sb.open("mylib.py", "w") as f:
+        f.write(BUGGY_MODULE)
+    with sb.open("test_mylib.py", "w") as f:
+        f.write(TEST_FILE)
+
+    transcript: list[dict] = []
+    solved = False
+    for step in range(max_steps):
+        action = policy(transcript)
+        print(f"step {step}: {action}")
+        if "done" in action:
+            solved = action["done"]
+            break
+        obs = {"action": action, "stdout": "", "stderr": ""}
+        if "run" in action:
+            p = sb.exec(*action["run"])
+            p.wait()
+            obs["stdout"] = p.stdout.read()
+            obs["stderr"] = p.stderr.read()
+        elif "write" in action:
+            with sb.open(action["write"]["path"], "w") as f:
+                f.write(action["write"]["text"])
+        transcript.append(obs)
+
+    sb.terminate()
+    assert solved, "agent did not finish the task"
+    assert any("TESTS PASSED" in t.get("stdout", "") for t in transcript)
+    print(f"agent fixed the bug in {len(transcript)} actions")

@@ -1,0 +1,90 @@
+# # Maintain a pool of warm sandboxes
+#
+# The counterpart of the reference's 13_sandboxes/sandbox_pool.py:6-30: a
+# pool of pre-created ("warm") sandboxes registered in a Queue, so claiming
+# one is instant — useful when sandboxes do significant setup (installing
+# dependencies, starting a server) before they can serve.
+#
+# Mechanics mirrored from the reference: a Queue holds references to warm
+# sandboxes with their expiry times; `claim` pops until it finds one with
+# enough time-to-live left; a `fill` step tops the pool back up; expired or
+# broken sandboxes are terminated and skipped.
+
+import time
+
+import modal_examples_tpu as mtpu
+
+app = mtpu.App("example-sandbox-pool")
+
+POOL_NAME = "sandbox-pool-demo"
+SANDBOX_TTL = 120.0  # seconds each sandbox lives after creation
+MIN_TTL_AT_CLAIM = 10.0  # don't hand out sandboxes about to expire
+
+
+def _make_warm_sandbox() -> dict:
+    """Create a sandbox and do its expensive warmup once, up front."""
+    sb = mtpu.Sandbox.create(app=app, timeout=SANDBOX_TTL)
+    # warmup: the reference installs deps / boots a server here; we stage a
+    # workspace file the claimant will use
+    with sb.open("workspace.txt", "w") as f:
+        f.write("warmed\n")
+    return {"sandbox_id": sb.object_id, "expires_at": time.time() + SANDBOX_TTL}
+
+
+def fill_pool(pool: mtpu.Queue, target: int) -> int:
+    """Top the pool up to `target` warm sandboxes."""
+    added = 0
+    while pool.len() < target:
+        pool.put(_make_warm_sandbox())
+        added += 1
+    return added
+
+
+def claim(pool: mtpu.Queue) -> mtpu.Sandbox | None:
+    """Pop until a sandbox with enough TTL appears; terminate stale ones."""
+    while True:
+        try:
+            entry = pool.get(block=False)
+        except Exception:
+            return None
+        if entry is None:
+            return None
+        ttl = entry["expires_at"] - time.time()
+        sb = mtpu.Sandbox.from_id(entry["sandbox_id"])
+        if ttl < MIN_TTL_AT_CLAIM:
+            sb.terminate()  # stale: drop and keep looking
+            continue
+        return sb
+
+
+@app.local_entrypoint()
+def main(pool_size: int = 3):
+    pool = mtpu.Queue.from_name(POOL_NAME, create_if_missing=True)
+
+    added = fill_pool(pool, pool_size)
+    print(f"filled pool with {added} warm sandboxes (size={pool.len()})")
+    assert pool.len() == pool_size
+
+    # claiming is instant: the warmup already happened
+    t0 = time.time()
+    sb = claim(pool)
+    claim_s = time.time() - t0
+    assert sb is not None
+    print(f"claimed {sb.object_id} in {claim_s * 1000:.0f}ms")
+
+    # the claimed sandbox is warm: the staged workspace is there and it
+    # executes immediately
+    p = sb.exec("cat", "workspace.txt")
+    assert p.wait() == 0 and "warmed" in p.stdout.read()
+    print("claimed sandbox is warm and serving")
+    sb.terminate()
+
+    # top back up after the claim, like the reference's maintain step
+    fill_pool(pool, pool_size)
+    assert pool.len() == pool_size
+    print(f"pool refilled to {pool.len()}")
+
+    # drain on the way out
+    while (left := claim(pool)) is not None:
+        left.terminate()
+    print("sandbox pool OK")

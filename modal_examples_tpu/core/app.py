@@ -112,6 +112,21 @@ class App:
         self._implicit_run: AppRun | None = None
         _app_instances[self.name] = self
 
+    def __getstate__(self):
+        """Serialize the app DEFINITION, not its runtime: live runs hold
+        container pools, threads, and locks (unpicklable, and meaningless in
+        another process). A Function handle captured in a spawned function's
+        globals (launcher patterns: amazon_embeddings.py:108-112) rehydrates
+        against the receiving process's own run context."""
+        d = self.__dict__.copy()
+        d["_current_run"] = None
+        d["_implicit_run"] = None
+        return d
+
+    def __setstate__(self, d):
+        self.__dict__.update(d)
+        _app_instances.setdefault(self.name, self)
+
     # -- decorators ---------------------------------------------------------
 
     def function(

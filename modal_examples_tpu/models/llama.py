@@ -326,11 +326,12 @@ def forward(
 def prefill(
     params: dict,
     tokens: jax.Array,  # [B, S] padded
-    k_pages: jax.Array,  # [L, Hkv, n_pages, page_size, hd]
+    k_pages: jax.Array,  # [L, n_pages, Hkv, page_size, hd]
     v_pages: jax.Array,
     page_tables: jax.Array,  # [B, pages_per_seq]
     seq_lens: jax.Array,  # [B] true lengths
     cfg: LlamaConfig,
+    attn_impl: str = "flash",  # "xla": auto-partitionable (TP prefill)
 ):
     """Process prompts, filling the paged KV cache; returns (logits_last,
     k_pages, v_pages). Padded positions write to reserved trash page 0."""
@@ -362,7 +363,12 @@ def prefill(
         v = v.reshape(B, S, cfg.n_kv_heads, D).transpose(0, 2, 1, 3)
         q = layers.apply_rope(q, cos, sin)
         k = layers.apply_rope(k, cos, sin)
-        o = flash_attention(q, k, v, True)
+        if attn_impl == "flash":
+            o = flash_attention(q, k, v, True)
+        else:
+            from ..ops import reference as _ref
+
+            o = _ref.attention(q, k, v, causal=True)
         o = o.transpose(0, 2, 1, 3).reshape(B, S, cfg.n_heads * D)
         x = x + layers.mm(o, layer["wo"]).astype(x.dtype)
         h = layers.rms_norm(x, layer["mlp_norm"], cfg.norm_eps)
@@ -386,10 +392,14 @@ def prefill(
 
 
 def _scatter_pages(k_pages, v_pages, k_all, v_all, page_idx, slot):
-    """Write [L, Hkv, B, S, D] new KV into [L, Hkv, P, page_size, D] pages at
+    """Write [L, Hkv, B, S, D] new KV into [L, P, Hkv, page_size, D] pages at
     (page_idx[b,s], slot[b,s])."""
-    k_pages = k_pages.at[:, :, page_idx, slot].set(k_all)
-    v_pages = v_pages.at[:, :, page_idx, slot].set(v_all)
+    # advanced indices (page_idx, slot) at dims 1 and 3 move to the front:
+    # the target block is [B, S, L, Hkv, D]
+    upd_k = k_all.transpose(2, 3, 0, 1, 4)
+    upd_v = v_all.transpose(2, 3, 0, 1, 4)
+    k_pages = k_pages.at[:, page_idx, :, slot].set(upd_k)
+    v_pages = v_pages.at[:, page_idx, :, slot].set(upd_v)
     return k_pages, v_pages
 
 
@@ -403,6 +413,7 @@ def prefill_chunk(
     cfg: LlamaConfig,
     *,
     q_offset: int,  # global position of the chunk's first token (static)
+    attn_impl: str = "flash",  # "xla": auto-partitionable (TP prefill)
 ):
     """One chunk of a long prompt: attends to the already-cached prefix (via
     page gather) + itself (rectangular flash kernel with q_offset), writes
@@ -443,20 +454,25 @@ def prefill_chunk(
         k = layers.apply_rope(k, cos, sin)
 
         if n_prefix_pages:
-            # [Hkv, B, n_pp, ps, D] -> [B, Hkv, prefix, D]
-            pk = k_pg[:, prefix_tables].transpose(1, 0, 2, 3, 4).reshape(
+            # [B, n_pp, Hkv, ps, D] -> [B, Hkv, prefix, D]
+            pk = k_pg[prefix_tables].transpose(0, 2, 1, 3, 4).reshape(
                 B, cfg.n_kv_heads, n_prefix_pages * page_size, D
             )
-            pv = v_pg[:, prefix_tables].transpose(1, 0, 2, 3, 4).reshape(
+            pv = v_pg[prefix_tables].transpose(0, 2, 1, 3, 4).reshape(
                 B, cfg.n_kv_heads, n_prefix_pages * page_size, D
             )
             k_full = jnp.concatenate([pk, k], axis=2)
             v_full = jnp.concatenate([pv, v], axis=2)
         else:
             k_full, v_full = k, v
-        from ..ops import flash_attention_chunked
+        if attn_impl == "flash":
+            from ..ops import flash_attention_chunked
 
-        o = flash_attention_chunked(q, k_full, v_full, q_offset=q_offset)
+            o = flash_attention_chunked(q, k_full, v_full, q_offset=q_offset)
+        else:
+            from ..ops import reference as _ref
+
+            o = _ref.attention_chunked(q, k_full, v_full, q_offset=q_offset)
         o = o.transpose(0, 2, 1, 3).reshape(B, C, cfg.n_heads * D)
         x = x + layers.mm(o, layer["wo"]).astype(x.dtype)
         h = layers.rms_norm(x, layer["mlp_norm"], cfg.norm_eps)
@@ -483,7 +499,7 @@ def decode_step(
     params: dict,
     tokens: jax.Array,  # [B] int32 — current token per slot
     positions: jax.Array,  # [B] int32 — its position
-    k_pages: jax.Array,  # [L, Hkv, P, page_size, hd]
+    k_pages: jax.Array,  # [L, P, Hkv, page_size, hd]
     v_pages: jax.Array,
     page_tables: jax.Array,  # [B, pages_per_seq]
     active: jax.Array,  # [B] bool — live slots (dead slots write trash page 0)
@@ -522,9 +538,10 @@ def decode_step(
         v = v.reshape(B, 1, cfg.n_kv_heads, D).transpose(0, 2, 1, 3)
         q = layers.apply_rope(q, cos, sin)
         k = layers.apply_rope(k, cos, sin)
-        # write this token's KV into the page cache
-        k_pg = k_pg.at[:, page_idx, slot].set(k[:, :, 0].transpose(1, 0, 2))
-        v_pg = v_pg.at[:, page_idx, slot].set(v[:, :, 0].transpose(1, 0, 2))
+        # write this token's KV into the page cache ([P, Hkv, ps, D] layout:
+        # advanced indices at dims 0 and 2 land the [B, Hkv, D] update)
+        k_pg = k_pg.at[page_idx, :, slot].set(k[:, :, 0])
+        v_pg = v_pg.at[page_idx, :, slot].set(v[:, :, 0])
         o = paged_decode_attention(
             q[:, :, 0], k_pg, v_pg, page_tables, ctx_lens
         )  # [B, H, D]
@@ -540,6 +557,81 @@ def decode_step(
     x = layers.rms_norm(x, params["final_norm"], cfg.norm_eps)
     head = params["embed"].T if cfg.tie_embeddings else params["lm_head"]
     logits = layers.mm(x, head)
+    return logits, k_pages, v_pages
+
+
+def verify_step(
+    params: dict,
+    tokens: jax.Array,  # [B, T] int32 — chain: committed token then proposals
+    positions0: jax.Array,  # [B] int32 — global position of tokens[:, 0]
+    k_pages: jax.Array,  # [L, P, Hkv, page_size, hd]
+    v_pages: jax.Array,
+    page_tables: jax.Array,  # [B, pages_per_seq]
+    active: jax.Array,  # [B] bool
+    cfg: LlamaConfig,
+):
+    """T tokens of teacher-forced decode against the paged cache — the
+    target-model scoring half of speculative decoding (the reference enables
+    this engine-side: vllm_inference.py:196-205, sglang_low_latency.py:194).
+
+    Writes KV for ALL T chain tokens at positions0..positions0+T-1 (rejected
+    tokens' entries are overwritten by later steps and never attended past
+    the accept point), and returns logits for every chain position:
+    ``logits[:, t]`` is the target's distribution for position
+    positions0+t+1. Returns (logits [B, T, vocab], k_pages, v_pages).
+    """
+    from ..ops import reference as _ref
+
+    B, T = tokens.shape
+    page_size = k_pages.shape[3]
+    cap = page_tables.shape[1] * page_size
+    positions = positions0[:, None] + jnp.arange(T)[None, :]  # [B, T]
+    # positions beyond the table capacity write to the trash page (a slot
+    # near max length can overshoot by <= T-1 rejected tokens)
+    valid = active[:, None] & (positions < cap)
+    pos_c = jnp.minimum(positions, cap - 1)
+    cos, sin = layers.rotary_embedding(
+        pos_c, cfg.head_dim, cfg.rope_theta, dtype=jnp.float32,
+        rope_scaling=dict(cfg.rope_scaling) if cfg.rope_scaling else None,
+    )  # [B, T, hd/2]
+    x = params["embed"][tokens]  # [B, T, D]
+
+    page_idx = jnp.take_along_axis(page_tables, pos_c // page_size, axis=1)
+    page_idx = jnp.where(valid, page_idx, 0)
+    slot = jnp.where(valid, pos_c % page_size, 0)
+
+    def layer_fn(carry, layer_with_pages):
+        x = carry
+        layer, k_pg, v_pg = layer_with_pages  # [P, Hkv, ps, D]
+        D = cfg.head_dim
+        h = layers.rms_norm(x, layer["attn_norm"], cfg.norm_eps)
+        q = layers.mm(h, layer["wq"]).astype(x.dtype)
+        k = layers.mm(h, layer["wk"]).astype(x.dtype)
+        v = layers.mm(h, layer["wv"]).astype(x.dtype)
+        q = q.reshape(B, T, cfg.n_heads, D).transpose(0, 2, 1, 3)
+        k = k.reshape(B, T, cfg.n_kv_heads, D).transpose(0, 2, 1, 3)
+        v = v.reshape(B, T, cfg.n_kv_heads, D).transpose(0, 2, 1, 3)
+        q = layers.apply_rope(q, cos, sin)
+        k = layers.apply_rope(k, cos, sin)
+        # write the whole chain's KV, then attend (the per-t causal mask in
+        # the verify attention keeps token t from seeing tokens > t)
+        k_pg = k_pg.at[page_idx, :, slot].set(k.transpose(0, 2, 1, 3))
+        v_pg = v_pg.at[page_idx, :, slot].set(v.transpose(0, 2, 1, 3))
+        o = _ref.paged_verify_attention(
+            q.transpose(0, 2, 1, 3), k_pg, v_pg, page_tables, positions
+        )  # [B, T, Hq, D]
+        o = o.reshape(B, T, cfg.n_heads * D)
+        x = x + layers.mm(o, layer["wo"]).astype(x.dtype)
+        h = layers.rms_norm(x, layer["mlp_norm"], cfg.norm_eps)
+        h = layers.swiglu_mlp({n: layer[n] for n in ("gate", "up", "down")}, h)
+        return x + h, (k_pg, v_pg)
+
+    x, (k_pages, v_pages) = jax.lax.scan(
+        layer_fn, x, (_layer_stack(params), k_pages, v_pages)
+    )
+    x = layers.rms_norm(x, params["final_norm"], cfg.norm_eps)
+    head = params["embed"].T if cfg.tie_embeddings else params["lm_head"]
+    logits = layers.mm(x, head)  # [B, T, vocab]
     return logits, k_pages, v_pages
 
 

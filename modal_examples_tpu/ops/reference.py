@@ -72,10 +72,38 @@ def attention_with_lse(
     return o.reshape(B, Hq, S, D), lse.reshape(B, Hq, S)
 
 
+def attention_chunked(
+    q: jax.Array,  # [B, Hq, Sq, D] — queries at positions q_offset..q_offset+Sq
+    k: jax.Array,  # [B, Hkv, Skv, D] — full (or so-far) K
+    v: jax.Array,
+    *,
+    q_offset: int,
+    sm_scale: float | None = None,
+) -> jax.Array:
+    """Rectangular causal attention (XLA ground truth / auto-partitionable
+    alternative for ops.flash_attention_chunked — tensor-parallel prefill
+    uses this path since a pallas_call cannot be auto-partitioned)."""
+    B, Hq, Sq, D = q.shape
+    Hkv, Skv = k.shape[1], k.shape[2]
+    if sm_scale is None:
+        sm_scale = D**-0.5
+    group = Hq // Hkv
+    qg = q.reshape(B, Hkv, group, Sq, D)
+    s = jnp.einsum(
+        "bhgqd,bhkd->bhgqk", qg, k, preferred_element_type=jnp.float32
+    ) * sm_scale
+    rows = q_offset + jnp.arange(Sq)[:, None]
+    cols = jnp.arange(Skv)[None, :]
+    s = jnp.where(rows >= cols, s, -jnp.inf)
+    p = jax.nn.softmax(s, axis=-1)
+    o = jnp.einsum("bhgqk,bhkd->bhgqd", p.astype(v.dtype), v)
+    return o.reshape(B, Hq, Sq, D)
+
+
 def paged_decode_attention(
     q: jax.Array,  # [B, Hq, D] — one new token per sequence
-    k_pages: jax.Array,  # [Hkv, n_pages, page_size, D]
-    v_pages: jax.Array,  # [Hkv, n_pages, page_size, D]
+    k_pages: jax.Array,  # [n_pages, Hkv, page_size, D]
+    v_pages: jax.Array,  # [n_pages, Hkv, page_size, D]
     page_tables: jax.Array,  # [B, pages_per_seq] int32 — physical page ids
     context_lens: jax.Array,  # [B] int32 — tokens already in cache (incl. new)
     *,
@@ -84,7 +112,7 @@ def paged_decode_attention(
     """Decode-step attention over a paged KV cache (vLLM-semantics ground
     truth for the Pallas ragged kernel)."""
     B, Hq, D = q.shape
-    Hkv, _, page_size, _ = k_pages.shape
+    _, Hkv, page_size, _ = k_pages.shape
     group = Hq // Hkv
     pages_per_seq = page_tables.shape[1]
     S = pages_per_seq * page_size
@@ -92,10 +120,10 @@ def paged_decode_attention(
         sm_scale = D**-0.5
 
     # gather each sequence's logical KV [B, Hkv, S, D]
-    ks = k_pages[:, page_tables]  # [Hkv, B, pages, page_size, D]
-    vs = v_pages[:, page_tables]
-    ks = ks.transpose(1, 0, 2, 3, 4).reshape(B, Hkv, S, D)
-    vs = vs.transpose(1, 0, 2, 3, 4).reshape(B, Hkv, S, D)
+    ks = k_pages[page_tables]  # [B, pages, Hkv, page_size, D]
+    vs = v_pages[page_tables]
+    ks = ks.transpose(0, 2, 1, 3, 4).reshape(B, Hkv, S, D)
+    vs = vs.transpose(0, 2, 1, 3, 4).reshape(B, Hkv, S, D)
 
     qg = q.reshape(B, Hkv, group, D)
     s = jnp.einsum("bhgd,bhkd->bhgk", qg, ks, preferred_element_type=jnp.float32)
@@ -106,3 +134,44 @@ def paged_decode_attention(
     p = jax.nn.softmax(s, axis=-1)
     o = jnp.einsum("bhgk,bhkd->bhgd", p.astype(vs.dtype), vs)
     return o.reshape(B, Hq, D)
+
+
+def paged_verify_attention(
+    q: jax.Array,  # [B, T, Hq, D] — a short chain of new tokens per sequence
+    k_pages: jax.Array,  # [n_pages, Hkv, page_size, D]
+    v_pages: jax.Array,  # [n_pages, Hkv, page_size, D]
+    page_tables: jax.Array,  # [B, pages_per_seq] int32
+    positions: jax.Array,  # [B, T] int32 — global position of each query
+    *,
+    sm_scale: float | None = None,
+) -> jax.Array:  # [B, T, Hq, D]
+    """Teacher-forced attention of a T-token chain against the paged cache
+    (the chain's own KV must already be written). Query t attends to cache
+    positions <= positions[b, t] — the multi-token generalization of
+    ``paged_decode_attention`` used by speculative-decoding verification
+    (the reference ships spec decode engine-side, vllm_inference.py:196-205).
+    """
+    B, T, Hq, D = q.shape
+    _, Hkv, page_size, _ = k_pages.shape
+    group = Hq // Hkv
+    pages_per_seq = page_tables.shape[1]
+    S = pages_per_seq * page_size
+    if sm_scale is None:
+        sm_scale = D**-0.5
+
+    ks = k_pages[page_tables]  # [B, pages, Hkv, page_size, D]
+    vs = v_pages[page_tables]
+    ks = ks.transpose(0, 2, 1, 3, 4).reshape(B, Hkv, S, D)
+    vs = vs.transpose(0, 2, 1, 3, 4).reshape(B, Hkv, S, D)
+
+    qg = q.transpose(0, 2, 1, 3).reshape(B, Hkv, group, T, D)
+    s = jnp.einsum(
+        "bhgtd,bhkd->bhgtk", qg, ks, preferred_element_type=jnp.float32
+    )
+    s = s * sm_scale
+    cols = jnp.arange(S)[None, None, :]  # [1, 1, S]
+    valid = cols <= positions[:, :, None]  # [B, T, S]
+    s = jnp.where(valid[:, None, None, :, :], s, -jnp.inf)
+    p = jax.nn.softmax(s, axis=-1)
+    o = jnp.einsum("bhgtk,bhkd->bhgtd", p.astype(vs.dtype), vs)
+    return o.reshape(B, Hq, T, D).transpose(0, 2, 1, 3)

@@ -61,6 +61,7 @@ class _Slot:
     private_pages: list[int] = dataclasses.field(default_factory=list)  # free()
     position: int = 0  # position of the NEXT token to decode
     last_token: int = 0
+    fresh: bool = False  # just prefilled: first token rides the override lane
     generated: list[int] = dataclasses.field(default_factory=list)
     emitted_text_len: int = 0
 
@@ -74,11 +75,16 @@ class EngineStats:
     prompt_tokens: int = 0
     generated_tokens: int = 0
     steps: int = 0
+    spec_proposed: int = 0  # draft tokens proposed (speculative mode)
+    spec_accepted: int = 0  # draft tokens accepted by the target
     started: float = dataclasses.field(default_factory=time.monotonic)
 
     def tokens_per_second(self) -> float:
         dt = time.monotonic() - self.started
         return self.generated_tokens / dt if dt > 0 else 0.0
+
+    def acceptance_rate(self) -> float:
+        return self.spec_accepted / self.spec_proposed if self.spec_proposed else 0.0
 
 
 def _stop_safe_len(text: str, stop: tuple[str, ...]) -> int:
@@ -124,6 +130,11 @@ class LLMEngine:
         quantization: str | None = None,  # "int8": weight-only quant serving
         seed: int = 0,
         kv_dtype=jnp.bfloat16,
+        speculative: tuple | None = None,  # (draft preset|LlamaConfig, gamma)
+        draft_params=None,
+        draft_model_dir: str | None = None,
+        decode_block: int = 8,  # decode steps rolled into one dispatch
+        mesh=None,  # jax Mesh with a "tensor" axis: tensor-parallel serving
     ):
         self.cfg = cfg
         self.tokenizer = load_tokenizer(model_dir)
@@ -138,6 +149,32 @@ class LLMEngine:
             params = quantize_llama(params)
         elif quantization is not None:
             raise ValueError(f"unknown quantization {quantization!r}")
+
+        # tensor parallelism is ONE ENGINE FLAG, not a separate code path
+        # (matching vllm_inference.py:180's --tensor-parallel-size): weights
+        # get the Megatron partition specs, the paged KV cache shards by kv
+        # head, and the same jitted prefill/decode/spec programs run under
+        # auto-partitioning — XLA inserts the ICI all-reduces. Prefill
+        # switches its flash kernel to the XLA attention path because a
+        # pallas_call cannot be auto-partitioned.
+        self.mesh = mesh
+        self._attn_impl = "flash" if mesh is None else "xla"
+        if mesh is not None:
+            if quantization is not None:
+                raise ValueError(
+                    "mesh= (tensor parallel) with quantization is not yet "
+                    "supported"
+                )
+            from jax.sharding import NamedSharding
+            from jax.sharding import PartitionSpec as P
+
+            specs = llama.partition_specs(cfg)
+            params = jax.tree.map(
+                lambda p, s: jax.device_put(p, NamedSharding(mesh, s)),
+                params,
+                specs,
+                is_leaf=lambda x: isinstance(x, P),
+            )
         self.params = params
         self.max_slots = max_slots
         self.max_model_len = max_model_len
@@ -152,6 +189,8 @@ class LLMEngine:
             page_size=page_size,
             dtype=kv_dtype,
         )
+        if mesh is not None:
+            self._shard_cache(self.cache)
         self.prefill_buckets = tuple(
             b for b in sorted(prefill_buckets) if b <= max_model_len
         ) or (max_model_len,)
@@ -167,6 +206,7 @@ class LLMEngine:
         self.slots = [_Slot() for _ in range(max_slots)]
         self.waiting: queue.Queue[Request] = queue.Queue()
         self.stats = EngineStats()
+        self.error_log: list[str] = []  # recent scheduler tracebacks
         self._key = jax.random.PRNGKey(seed)
         self._lock = threading.Lock()
         self._running = False
@@ -182,9 +222,98 @@ class LLMEngine:
         self._top_ks = np.zeros((max_slots,), np.int32)
         self._seeds = np.full((max_slots,), -1, np.int32)
 
+        # pipelined multi-step decode (the dispatch-latency killer: one
+        # blocking read per `decode_block` tokens, and the next block is
+        # already queued on-device while the host reads the previous one —
+        # measured 79 ms per blocking round trip on a tunneled v5e vs 1.5 ms
+        # async-chained; vLLM's async scheduling solves the same problem)
+        self.decode_block = max(1, int(decode_block))
+        self._device_tokens = None  # [max_slots] device int32: last sampled
+        self._opt_positions = np.zeros((max_slots,), np.int32)  # dispatch-side
+        self._override = np.zeros((max_slots,), np.int32)
+        self._override_mask = np.zeros((max_slots,), bool)
+        import collections
+
+        self._inflight = collections.deque()  # (tokens [K, B] device, snapshot)
+
         self._decode_jit = jax.jit(self._decode_and_sample, donate_argnums=(1, 2))
+        self._block_jit = jax.jit(self._decode_block_fn, donate_argnums=(1, 2))
         self._prefill_jits: dict[int, object] = {}
         self._chunk_jits: dict[int, object] = {}  # keyed by chunk q_offset
+
+        # speculative decoding (the engine-side flag the reference exposes:
+        # vllm_inference.py:196-205): a small draft model proposes gamma
+        # tokens per tick, the target verifies them in one teacher-forced
+        # pass, and accept/reject runs in-graph. The draft keeps its own
+        # paged KV cache ADDRESSED BY THE SAME page ids/tables as the
+        # target's, so allocation, prefix sharing, and slot recycling are
+        # managed once.
+        self.spec_gamma = 0
+        self.draft_cfg = None
+        if speculative is not None:
+            draft, gamma = speculative
+            if isinstance(draft, str):
+                presets = {
+                    "llama2-7b": llama.LlamaConfig.llama2_7b,
+                    "llama3-8b": llama.LlamaConfig.llama3_8b,
+                    "tiny": llama.LlamaConfig.tiny,
+                }
+                draft = presets[draft]()
+            self.draft_cfg = draft
+            self.spec_gamma = int(gamma)
+            if self.spec_gamma < 1:
+                raise ValueError("speculative gamma must be >= 1")
+            if draft.vocab_size != cfg.vocab_size:
+                raise ValueError(
+                    f"draft vocab_size {draft.vocab_size} != target "
+                    f"{cfg.vocab_size}: speculative accept/reject compares "
+                    "token distributions and requires a shared vocabulary"
+                )
+            if draft_params is None:
+                if draft_model_dir is not None:
+                    draft_params = llama.load_hf_weights(draft_model_dir, draft)
+                else:
+                    draft_params = llama.init_params(
+                        jax.random.PRNGKey(seed + 1), draft
+                    )
+            if mesh is not None:
+                from jax.sharding import NamedSharding
+                from jax.sharding import PartitionSpec as P
+
+                dspecs = llama.partition_specs(draft)
+                draft_params = jax.tree.map(
+                    lambda p, s: jax.device_put(p, NamedSharding(mesh, s)),
+                    draft_params,
+                    dspecs,
+                    is_leaf=lambda x: isinstance(x, P),
+                )
+            self.draft_params = draft_params
+            self.draft_cache = PagedKVCache.create(
+                n_layers=draft.n_layers,
+                n_kv_heads=draft.n_kv_heads,
+                head_dim=draft.head_dim,
+                n_pages=n_pages,
+                page_size=page_size,
+                dtype=kv_dtype,
+                prefer_native=False,  # page ids come from the target's allocator
+            )
+            if mesh is not None:
+                self._shard_cache(self.draft_cache)
+            self._spec_jit = jax.jit(
+                self._spec_propose_verify, donate_argnums=(2, 3, 4, 5)
+            )
+            self._draft_prefill_jits: dict[object, object] = {}
+
+    def _shard_cache(self, cache) -> None:
+        """Shard page arrays [L, P, Hkv, ps, D] by kv head over ``tensor`` —
+        every cache byte and its attention math stay on the chip owning the
+        head; page tables/ids remain host-global."""
+        from jax.sharding import NamedSharding
+        from jax.sharding import PartitionSpec as P
+
+        sh = NamedSharding(self.mesh, P(None, None, "tensor", None, None))
+        cache.k_pages = jax.device_put(cache.k_pages, sh)
+        cache.v_pages = jax.device_put(cache.v_pages, sh)
 
     # -- jitted programs ----------------------------------------------------
 
@@ -201,12 +330,43 @@ class LLMEngine:
         )
         return next_tokens, k_pages, v_pages
 
+    def _decode_block_fn(
+        self, params, k_pages, v_pages, prev_tokens, override, override_mask,
+        positions, page_tables, active, key, temps, top_ps, top_ks, seeds,
+    ):
+        """`decode_block` decode+sample steps in one program: tokens feed
+        forward in-graph (lax.scan), so nothing crosses the host boundary
+        between steps. ``prev_tokens`` is the previous block's device-resident
+        output; freshly prefilled slots merge their host-known first token via
+        (override, override_mask). Returns (tokens [K, B], last [B], caches).
+        """
+        tok0 = jnp.where(override_mask, override, prev_tokens)
+
+        def body(carry, k_i):
+            tok, pos, kp, vp = carry
+            logits, kp, vp = llama.decode_step(
+                params, tok, pos, kp, vp, page_tables, active, self.cfg
+            )
+            nxt = sample(
+                logits, k_i, temps, top_ps, top_ks, seeds=seeds, step_ids=pos
+            )
+            nxt = jnp.where(active, nxt, tok)  # dead slots hold steady
+            return (nxt, pos + 1, kp, vp), nxt
+
+        (last, _, k_pages, v_pages), toks = jax.lax.scan(
+            body,
+            (tok0, positions, k_pages, v_pages),
+            jax.random.split(key, self.decode_block),
+        )
+        return toks, last, k_pages, v_pages
+
     def _prefill_and_sample(
         self, params, k_pages, v_pages, tokens, page_tables, seq_lens, key,
         temps, top_ps, top_ks, seeds,
     ):
         logits, k_pages, v_pages = llama.prefill(
-            params, tokens, k_pages, v_pages, page_tables, seq_lens, self.cfg
+            params, tokens, k_pages, v_pages, page_tables, seq_lens, self.cfg,
+            attn_impl=self._attn_impl,
         )
         next_tokens = sample(
             logits, key, temps, top_ps, top_ks, seeds=seeds, step_ids=seq_lens
@@ -219,6 +379,126 @@ class LLMEngine:
             fn = jax.jit(self._prefill_and_sample, donate_argnums=(1, 2))
             self._prefill_jits[bucket] = fn
         return fn
+
+    def _draft_prefill_jit(self, key):
+        fn = self._draft_prefill_jits.get(key)
+        if fn is None:
+            dcfg = self.draft_cfg
+
+            def run(params, k_pages, v_pages, tokens, tables, seq_lens):
+                return llama.prefill(
+                    params, tokens, k_pages, v_pages, tables, seq_lens, dcfg,
+                    attn_impl=self._attn_impl,
+                )
+
+            fn = jax.jit(run, donate_argnums=(1, 2))
+            self._draft_prefill_jits[key] = fn
+        return fn
+
+    def _spec_propose_verify(
+        self, params, d_params, tk, tv, dk, dv, tokens, positions,
+        page_tables, active, key, temps, seeds,
+    ):
+        """One speculative tick, fully in-graph: draft chain -> target verify
+        -> accept/reject. Returns (out_tokens [B, gamma+1], n_emit [B], and
+        the four updated cache arrays).
+
+        Greedy slots (temperature 0) accept while draft argmax == target
+        argmax — reproducing the target's greedy decode token-for-token.
+        Sampling slots use standard speculative sampling: accept draft token
+        x with prob min(1, p_t(x)/p_d(x)); on rejection resample from the
+        residual max(p_t - p_d, 0) — the output distribution equals the
+        target's. Rejected tokens' KV entries are left in place and
+        overwritten as positions advance (never attended past the accept
+        point). ``seeds`` is accepted for signature parity but per-request
+        seeded determinism is not batch-invariant in speculative mode.
+        """
+        del seeds
+        gamma = self.spec_gamma
+        cfg, dcfg = self.cfg, self.draft_cfg
+        B = tokens.shape[0]
+        cap = self.pages_per_slot * self.cache.page_size
+        keys = jax.random.split(key, gamma + 2)
+
+        def draft_step(carry, k_i):
+            tok, pos, dk, dv = carry
+            step_active = active & (pos < cap)
+            logits, dk, dv = llama.decode_step(
+                d_params, tok, pos, dk, dv, page_tables, step_active, dcfg
+            )
+            scaled = logits / jnp.maximum(temps, 1e-6)[:, None]
+            proposed = jnp.where(
+                temps <= 0.0,
+                jnp.argmax(logits, axis=-1),
+                jax.vmap(jax.random.categorical)(
+                    jax.random.split(k_i, B), scaled
+                ),
+            ).astype(jnp.int32)
+            logp = jax.nn.log_softmax(scaled, axis=-1)
+            return (proposed, pos + 1, dk, dv), (proposed, logp)
+
+        (last_d, last_pos, dk, dv), (draft_toks, draft_logps) = jax.lax.scan(
+            draft_step, (tokens, positions, dk, dv), keys[:gamma]
+        )
+        # complete the draft cache: the scan proposed d_gamma but never wrote
+        # its KV — without this, a fully-accepted round leaves a hole at
+        # position+gamma and the next round's draft attends to stale state,
+        # collapsing the acceptance rate (logits discarded; draft is small)
+        _, dk, dv = llama.decode_step(
+            d_params, last_d, last_pos, dk, dv, page_tables,
+            active & (last_pos < cap), dcfg,
+        )
+        draft_toks = draft_toks.T  # [B, gamma]
+        draft_logps = draft_logps.transpose(1, 0, 2)  # [B, gamma, V]
+
+        # target scores the whole chain in ONE pass against the paged cache
+        chain = jnp.concatenate([tokens[:, None], draft_toks], axis=1)
+        t_logits, tk, tv = llama.verify_step(
+            params, chain, positions, tk, tv, page_tables, active, cfg
+        )  # [B, gamma+1, V]
+        t_scaled = t_logits / jnp.maximum(temps, 1e-6)[:, None, None]
+        t_logp = jax.nn.log_softmax(t_scaled, axis=-1)
+        greedy_choice = jnp.argmax(t_logits, axis=-1).astype(jnp.int32)
+
+        rows = jnp.arange(B)
+        match = draft_toks == greedy_choice[:, :gamma]
+        lp_t = jnp.take_along_axis(
+            t_logp[:, :gamma], draft_toks[..., None], axis=-1
+        )[..., 0]
+        lp_d = jnp.take_along_axis(
+            draft_logps, draft_toks[..., None], axis=-1
+        )[..., 0]
+        u = jax.random.uniform(keys[gamma], (B, gamma))
+        accept_sto = u < jnp.exp(jnp.minimum(0.0, lp_t - lp_d))
+        accept = jnp.where((temps <= 0.0)[:, None], match, accept_sto)
+        n_acc = jnp.argmin(
+            jnp.concatenate(
+                [accept.astype(jnp.int32), jnp.zeros((B, 1), jnp.int32)], axis=1
+            ),
+            axis=1,
+        )  # first rejection; == gamma when all accepted
+
+        # token at the cut: target's fix on rejection, fresh bonus sample
+        # when every draft token was accepted
+        j = n_acc
+        t_row = t_logp[rows, j]  # [B, V]
+        d_row = draft_logps[rows, jnp.minimum(j, gamma - 1)]
+        p_t_row, p_d_row = jnp.exp(t_row), jnp.exp(d_row)
+        residual = jnp.maximum(p_t_row - p_d_row, 0.0)
+        has_res = residual.sum(-1, keepdims=True) > 0
+        residual = jnp.where(
+            (j[:, None] < gamma) & has_res, residual, p_t_row
+        )
+        sampled_fix = jax.vmap(jax.random.categorical)(
+            jax.random.split(keys[gamma + 1], B), jnp.log(residual + 1e-20)
+        ).astype(jnp.int32)
+        fix = jnp.where(temps <= 0.0, greedy_choice[rows, j], sampled_fix)
+        out = jnp.concatenate(
+            [draft_toks, jnp.zeros((B, 1), jnp.int32)], axis=1
+        )
+        out = out.at[rows, j].set(fix)
+        n_emit = jnp.where(active, n_acc + 1, 0)
+        return out, n_emit, tk, tv, dk, dv
 
     def _bucket_for(self, n: int) -> int:
         for b in self.prefill_buckets:
@@ -234,6 +514,13 @@ class LLMEngine:
 
     def submit(self, prompt: str, params: SamplingParams | None = None) -> Request:
         req = Request(prompt=prompt, params=params or SamplingParams())
+        if self.spec_gamma and (
+            req.params.top_p < 1.0 or req.params.top_k > 0
+        ):
+            raise ValueError(
+                "speculative decoding supports greedy (temperature=0) and "
+                "plain temperature sampling; top_p/top_k are unsupported"
+            )
         # prompts longer than the largest bucket prefill in chunks; the hard
         # cap is the model length (minus >=1 decode slot)
         req.prompt_tokens = self.tokenizer.encode(prompt)[: self.max_model_len - 1]
@@ -286,20 +573,61 @@ class LLMEngine:
                 jnp.zeros((B,), jnp.int32),
                 jnp.full((B,), -1, jnp.int32),
             )
-        _tok, self.cache.k_pages, self.cache.v_pages = self._decode_jit(
-            self.params,
-            self.cache.k_pages,
-            self.cache.v_pages,
-            jnp.zeros((self.max_slots,), jnp.int32),
-            jnp.zeros((self.max_slots,), jnp.int32),
-            jnp.zeros((self.max_slots, self.pages_per_slot), jnp.int32),
-            jnp.zeros((self.max_slots,), bool),
-            self._next_key(),
-            jnp.ones((self.max_slots,), jnp.float32),
-            jnp.ones((self.max_slots,), jnp.float32),
-            jnp.zeros((self.max_slots,), jnp.int32),
-            jnp.full((self.max_slots,), -1, jnp.int32),
-        )
+        B = self.max_slots
+        if not self.spec_gamma:
+            # spec mode never runs the block program — compiling the 8-step
+            # scan there would be pure cold-start cost for a dead path
+            _toks, _last, self.cache.k_pages, self.cache.v_pages = self._block_jit(
+                self.params,
+                self.cache.k_pages,
+                self.cache.v_pages,
+                jnp.zeros((B,), jnp.int32),
+                jnp.zeros((B,), jnp.int32),
+                jnp.zeros((B,), bool),
+                jnp.zeros((B,), jnp.int32),
+                jnp.zeros((B, self.pages_per_slot), jnp.int32),
+                jnp.zeros((B,), bool),
+                self._next_key(),
+                jnp.ones((B,), jnp.float32),
+                jnp.ones((B,), jnp.float32),
+                jnp.zeros((B,), jnp.int32),
+                jnp.full((B,), -1, jnp.int32),
+            )
+        if self.spec_gamma:
+            for bucket in buckets or self.prefill_buckets:
+                B = self.prefill_batch
+                _, self.draft_cache.k_pages, self.draft_cache.v_pages = (
+                    self._draft_prefill_jit((bucket, B))(
+                        self.draft_params,
+                        self.draft_cache.k_pages,
+                        self.draft_cache.v_pages,
+                        jnp.zeros((B, bucket), jnp.int32),
+                        jnp.zeros((B, self.pages_per_slot), jnp.int32),
+                        jnp.ones((B,), jnp.int32),
+                    )
+                )
+            (
+                _,
+                _,
+                self.cache.k_pages,
+                self.cache.v_pages,
+                self.draft_cache.k_pages,
+                self.draft_cache.v_pages,
+            ) = self._spec_jit(
+                self.params,
+                self.draft_params,
+                self.cache.k_pages,
+                self.cache.v_pages,
+                self.draft_cache.k_pages,
+                self.draft_cache.v_pages,
+                jnp.zeros((self.max_slots,), jnp.int32),
+                jnp.zeros((self.max_slots,), jnp.int32),
+                jnp.zeros((self.max_slots, self.pages_per_slot), jnp.int32),
+                jnp.zeros((self.max_slots,), bool),
+                self._next_key(),
+                jnp.ones((self.max_slots,), jnp.float32),
+                jnp.full((self.max_slots,), -1, jnp.int32),
+            )
         jax.block_until_ready(self.cache.k_pages)
         return time.monotonic() - t0
 
@@ -325,6 +653,8 @@ class LLMEngine:
         self._running = False
         if self._thread:
             self._thread.join(timeout=10)
+        self._inflight.clear()
+        self._device_tokens = None
         for slot in self.slots:
             if not slot.free:
                 slot.request.out_queue.put(_FINISH)
@@ -346,8 +676,13 @@ class LLMEngine:
             try:
                 worked = self.step()
             except Exception:
-                # a poisoned request must not kill the serving loop
-                traceback.print_exc()
+                # a poisoned request must not kill the serving loop; keep the
+                # traceback on the engine so intermittent scheduler failures
+                # are diagnosable after the fact (surfaced in /metrics)
+                tb = traceback.format_exc()
+                self.error_log.append(tb)
+                del self.error_log[:-20]
+                print(tb, flush=True)
                 worked = False
             if not worked:
                 time.sleep(0.002)
@@ -522,7 +857,10 @@ class LLMEngine:
             fn = self._chunk_jits.get(offset)
             if fn is None:
                 fn = jax.jit(
-                    functools.partial(llama.prefill_chunk, q_offset=offset),
+                    functools.partial(
+                        llama.prefill_chunk, q_offset=offset,
+                        attn_impl=self._attn_impl,
+                    ),
                     static_argnames=("cfg",),
                     donate_argnums=(2, 3),
                 )
@@ -536,6 +874,19 @@ class LLMEngine:
                 jnp.asarray([len(chunk)], np.int32),
                 cfg=self.cfg,
             )
+            if self.spec_gamma:
+                # the same cached jit serves the draft: cfg is a static call
+                # argument, so target and draft get separate compile-cache
+                # entries under one callable
+                _, self.draft_cache.k_pages, self.draft_cache.v_pages = fn(
+                    self.draft_params,
+                    jnp.asarray(toks),
+                    self.draft_cache.k_pages,
+                    self.draft_cache.v_pages,
+                    jnp.asarray(table[None, :]),
+                    jnp.asarray([len(chunk)], np.int32),
+                    cfg=self.draft_cfg,
+                )
         p = req.params
         first = sample(
             logits,
@@ -549,6 +900,7 @@ class LLMEngine:
         self.stats.prompt_tokens += n_prompt
         slot.position = n_prompt
         slot.last_token = int(first[0])
+        slot.fresh = True
         self._accept_token(slot_idx, slot.last_token)
 
     def _prefill_group(self, bucket: int, group: list) -> None:
@@ -595,12 +947,26 @@ class LLMEngine:
             jnp.asarray(top_ks),
             jnp.asarray(seeds),
         )
+        if self.spec_gamma:
+            # fill the draft model's cache over the same pages (same tables:
+            # page ids are shared between the two caches)
+            _, self.draft_cache.k_pages, self.draft_cache.v_pages = (
+                self._draft_prefill_jit((bucket, B))(
+                    self.draft_params,
+                    self.draft_cache.k_pages,
+                    self.draft_cache.v_pages,
+                    jnp.asarray(tokens),
+                    jnp.asarray(tables),
+                    jnp.asarray(seq_lens),
+                )
+            )
         next_np = np.asarray(next_tok)
         for i, (slot_idx, req, claim) in enumerate(group):
             slot = self.slots[slot_idx]
             self.stats.prompt_tokens += claim["n_prompt"]
             slot.position = claim["n_prompt"]
             slot.last_token = int(next_np[i])
+            slot.fresh = True
             self._accept_token(slot_idx, slot.last_token)
 
     def _decode_tick(self) -> bool:
@@ -611,26 +977,81 @@ class LLMEngine:
                 self._release_slot_pages(s)
                 s.request = None
                 self._active[i] = False
-        active_idx = [i for i, s in enumerate(self.slots) if not s.free]
-        if not active_idx:
-            return False
+        live = [i for i, s in enumerate(self.slots) if not s.free]
+
+        if self.spec_gamma:
+            if not live:
+                return False
+            self._active[:] = False
+            for i in live:
+                s = self.slots[i]
+                self._active[i] = True
+                self._tokens[i] = s.last_token
+                self._positions[i] = s.position
+                p = s.request.params
+                self._temps[i] = p.temperature
+                self._top_ps[i] = p.top_p
+                self._top_ks[i] = p.top_k
+                self._seeds[i] = -1 if p.seed is None else p.seed
+            return self._spec_tick(live)
+
+        # pipelined path: keep one decode block in flight ahead of the one
+        # being read, so the device never waits on the host round trip
+        worked = False
+        if live:
+            self._dispatch_block(live)
+            worked = True
+        if self._inflight and (len(self._inflight) >= 2 or not live):
+            worked = self._process_block() or worked
+        return worked
+
+    def _dispatch_block(self, live: list[int]) -> None:
+        """Queue one decode block (async — returns before it runs).
+
+        Slot-state lag safety: a slot that finishes (eos/stop/length) while
+        an already-dispatched block still decodes it only ever writes
+        generated-position KV, i.e. its own private pages; if those pages are
+        freed and reclaimed, the reclaimer's prefill is dispatched AFTER this
+        block (device program order) and overwrites the stale writes. The
+        per-block snapshot pins request identity so the host drops output
+        rows whose slot was recycled.
+        """
         self._active[:] = False
-        for i in active_idx:
+        self._override_mask[:] = False
+        # reset dead-slot sampling params to the no-filter defaults: a stale
+        # top_p/top_k from a finished request would keep sample()'s runtime
+        # lax.cond on the expensive sort path for every later block
+        self._temps[:] = 1.0
+        self._top_ps[:] = 1.0
+        self._top_ks[:] = 0
+        self._seeds[:] = -1
+        for i in live:
             s = self.slots[i]
             self._active[i] = True
-            self._tokens[i] = s.last_token
-            self._positions[i] = s.position
+            if s.fresh:
+                # freshly prefilled: first token is host-known (sampled by
+                # the prefill program); continuing slots feed the previous
+                # block's device-resident token
+                self._override[i] = s.last_token
+                self._override_mask[i] = True
+                self._opt_positions[i] = s.position
+                s.fresh = False
+            self._positions[i] = self._opt_positions[i]
             p = s.request.params
             self._temps[i] = p.temperature
             self._top_ps[i] = p.top_p
             self._top_ks[i] = p.top_k
             self._seeds[i] = -1 if p.seed is None else p.seed
-
-        next_tokens, self.cache.k_pages, self.cache.v_pages = self._decode_jit(
+        prev = self._device_tokens
+        if prev is None:
+            prev = jnp.zeros((self.max_slots,), jnp.int32)
+        toks, last, self.cache.k_pages, self.cache.v_pages = self._block_jit(
             self.params,
             self.cache.k_pages,
             self.cache.v_pages,
-            jnp.asarray(self._tokens),
+            prev,
+            jnp.asarray(self._override),
+            jnp.asarray(self._override_mask),
             jnp.asarray(self._positions),
             jnp.asarray(self._page_tables),
             jnp.asarray(self._active),
@@ -640,13 +1061,67 @@ class LLMEngine:
             jnp.asarray(self._top_ks),
             jnp.asarray(self._seeds),
         )
-        next_np = np.asarray(next_tokens)
+        self._device_tokens = last
+        self._inflight.append((toks, [(i, self.slots[i].request) for i in live]))
+        for i in live:
+            self._opt_positions[i] += self.decode_block
+
+    def _process_block(self) -> bool:
+        toks, snapshot = self._inflight.popleft()
+        toks_np = np.asarray(toks)  # [K, B] — the ONE blocking read per block
+        self.stats.steps += self.decode_block
+        worked = False
+        for i, req in snapshot:
+            s = self.slots[i]
+            if s.request is not req or req.aborted:
+                continue  # slot finished/recycled while the block was in flight
+            for k in range(self.decode_block):
+                if s.request is not req:
+                    break  # finished mid-block
+                s.position += 1
+                s.last_token = int(toks_np[k, i])
+                self._accept_token(i, s.last_token)
+                worked = True
+        return worked
+
+    def _spec_tick(self, active_idx: list[int]) -> bool:
+        """Speculative decode tick: up to gamma+1 tokens per slot per step."""
+        (
+            out_tokens,
+            n_emit,
+            self.cache.k_pages,
+            self.cache.v_pages,
+            self.draft_cache.k_pages,
+            self.draft_cache.v_pages,
+        ) = self._spec_jit(
+            self.params,
+            self.draft_params,
+            self.cache.k_pages,
+            self.cache.v_pages,
+            self.draft_cache.k_pages,
+            self.draft_cache.v_pages,
+            jnp.asarray(self._tokens),
+            jnp.asarray(self._positions),
+            jnp.asarray(self._page_tables),
+            jnp.asarray(self._active),
+            self._next_key(),
+            jnp.asarray(self._temps),
+            jnp.asarray(self._seeds),
+        )
+        out_np = np.asarray(out_tokens)
+        n_np = np.asarray(n_emit)
         self.stats.steps += 1
         for i in active_idx:
             s = self.slots[i]
-            s.position += 1
-            s.last_token = int(next_np[i])
-            self._accept_token(i, s.last_token)
+            take = int(n_np[i])
+            self.stats.spec_proposed += self.spec_gamma
+            self.stats.spec_accepted += max(0, take - 1)
+            for t in range(take):
+                if s.request is None:
+                    break  # finished mid-chain (eos/stop/length)
+                s.position += 1
+                s.last_token = int(out_np[i, t])
+                self._accept_token(i, s.last_token)
         return True
 
     def _accept_token(self, slot_idx: int, token: int) -> None:

@@ -2,8 +2,10 @@
 
 The TPU analog of vLLM's PagedAttention block manager (the engine inside the
 reference's vllm_inference.py). Device side: two arrays
-``[n_layers, n_kv_heads, n_pages, page_size, head_dim]`` living in HBM, page
-0 reserved as the trash page (padded/dead slots write there). Host side: a
+``[n_layers, n_pages, n_kv_heads, page_size, head_dim]`` living in HBM — a
+page holds all kv heads contiguously so the decode kernel moves one fat DMA
+per page — with page 0 reserved as the trash page (padded/dead slots write
+there). Host side: a
 free-list allocator — intentionally simple; each sequence claims
 ``ceil(max_tokens/page_size)`` pages at admission so decode can never fail
 mid-flight (no preemption/swap in v1, documented trade-off vs vLLM's
@@ -50,7 +52,7 @@ class PageAllocator:
 
 @dataclasses.dataclass
 class PagedKVCache:
-    k_pages: object  # [L, Hkv, P, page_size, hd]
+    k_pages: object  # [L, P, Hkv, page_size, hd]
     v_pages: object
     page_size: int
     allocator: PageAllocator
@@ -67,7 +69,7 @@ class PagedKVCache:
         dtype=jnp.bfloat16,
         prefer_native: bool = True,
     ) -> "PagedKVCache":
-        shape = (n_layers, n_kv_heads, n_pages, page_size, head_dim)
+        shape = (n_layers, n_pages, n_kv_heads, page_size, head_dim)
         allocator = None
         if prefer_native:
             try:  # C++ free list (native/mtpu_host.cpp); same semantics
@@ -85,7 +87,7 @@ class PagedKVCache:
 
     @property
     def n_pages(self) -> int:
-        return self.k_pages.shape[2]
+        return self.k_pages.shape[1]
 
     def bytes(self) -> int:
         return 2 * self.k_pages.size * self.k_pages.dtype.itemsize

@@ -78,6 +78,14 @@ class _Handler(BaseHTTPRequestHandler):
                 f"mtpu_active_slots {active}\n"
                 f"mtpu_waiting_requests {eng.waiting.qsize()}\n"
                 f"mtpu_kv_pages_free {eng.cache.allocator.available}\n"
+                f"mtpu_scheduler_errors_total {len(eng.error_log)}\n"
+                + (
+                    f"mtpu_spec_proposed_total {s.spec_proposed}\n"
+                    f"mtpu_spec_accepted_total {s.spec_accepted}\n"
+                    f"mtpu_spec_acceptance_rate {s.acceptance_rate():.4f}\n"
+                    if eng.spec_gamma
+                    else ""
+                )
                 + (
                     f"mtpu_prefix_cache_hits_total {pc.hits}\n"
                     f"mtpu_prefix_cache_misses_total {pc.misses}\n"
@@ -230,10 +238,14 @@ class _Handler(BaseHTTPRequestHandler):
             except BrokenPipeError:
                 # client went away mid-stream: stop decoding for it so the
                 # slot and its KV pages go back to the pool (vLLM aborts on
-                # client disconnect the same way)
-                srv.engine.abort(req)
-                for _ in srv.engine.stream(req):  # drain until _FINISH
-                    pass
+                # client disconnect the same way). Only drain when the
+                # request is still live — a disconnect during the final
+                # chunk/[DONE] writes arrives after the terminal marker was
+                # already consumed, and draining then would block forever.
+                if req.finish_reason is None:
+                    srv.engine.abort(req)
+                    for _ in srv.engine.stream(req):  # drain until _FINISH
+                        pass
             return
 
         text = "".join(srv.engine.stream(req))

@@ -131,17 +131,20 @@ class TestPagedAttention:
         page_size, n_pages, pages_per_seq = 16, 32, 4
         ks = jax.random.split(jax.random.PRNGKey(1), 4)
         q = jax.random.normal(ks[0], (B, Hq, D), jnp.float32)
-        kp = jax.random.normal(ks[1], (Hkv, n_pages, page_size, D), jnp.float32)
-        vp = jax.random.normal(ks[2], (Hkv, n_pages, page_size, D), jnp.float32)
+        kp = jax.random.normal(ks[1], (n_pages, Hkv, page_size, D), jnp.float32)
+        vp = jax.random.normal(ks[2], (n_pages, Hkv, page_size, D), jnp.float32)
         pt = (
             jax.random.permutation(ks[3], n_pages)[: B * pages_per_seq]
             .reshape(B, pages_per_seq)
             .astype(jnp.int32)
         )
         cl = jnp.array([5, 16, 33, 64], jnp.int32)  # ragged, page-unaligned
-        out = paged_decode_attention(q, kp, vp, pt, cl)
         want = reference.paged_decode_attention(q, kp, vp, pt, cl)
-        np.testing.assert_allclose(np.asarray(out), np.asarray(want), atol=2e-5)
+        for impl in ("xla", "pallas"):  # default fused-gather path + kernel
+            out = paged_decode_attention(q, kp, vp, pt, cl, impl=impl)
+            np.testing.assert_allclose(
+                np.asarray(out), np.asarray(want), atol=2e-5, err_msg=impl
+            )
 
     def test_mha_group_of_one(self, jax, jnp):
         from modal_examples_tpu.ops import paged_decode_attention, reference
@@ -150,13 +153,16 @@ class TestPagedAttention:
         page_size, n_pages, pages_per_seq = 16, 16, 2
         ks = jax.random.split(jax.random.PRNGKey(5), 4)
         q = jax.random.normal(ks[0], (B, H, D), jnp.float32)
-        kp = jax.random.normal(ks[1], (H, n_pages, page_size, D), jnp.float32)
-        vp = jax.random.normal(ks[2], (H, n_pages, page_size, D), jnp.float32)
+        kp = jax.random.normal(ks[1], (n_pages, H, page_size, D), jnp.float32)
+        vp = jax.random.normal(ks[2], (n_pages, H, page_size, D), jnp.float32)
         pt = jnp.arange(B * pages_per_seq, dtype=jnp.int32).reshape(B, -1)
         cl = jnp.array([17, 32], jnp.int32)
-        out = paged_decode_attention(q, kp, vp, pt, cl)
         want = reference.paged_decode_attention(q, kp, vp, pt, cl)
-        np.testing.assert_allclose(np.asarray(out), np.asarray(want), atol=2e-5)
+        for impl in ("xla", "pallas"):
+            out = paged_decode_attention(q, kp, vp, pt, cl, impl=impl)
+            np.testing.assert_allclose(
+                np.asarray(out), np.asarray(want), atol=2e-5, err_msg=impl
+            )
 
 
 class TestQuantizedMatmul:

@@ -243,11 +243,11 @@ class TestEngine:
                 b: fn._cache_size() for b, fn in eng._prefill_jits.items()
             }
             assert all(s >= 1 for s in sizes.values())
-            decode_size = eng._decode_jit._cache_size()
+            decode_size = eng._block_jit._cache_size()
             assert decode_size >= 1
             # serving a request must NOT trigger new compiles
             eng.generate("warm", SamplingParams(max_tokens=2, temperature=0.0))
-            assert eng._decode_jit._cache_size() == decode_size
+            assert eng._block_jit._cache_size() == decode_size
             assert all(
                 fn._cache_size() == sizes[b]
                 for b, fn in eng._prefill_jits.items()
