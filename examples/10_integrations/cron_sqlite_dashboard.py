@@ -99,7 +99,9 @@ def main():
             with urllib.request.urlopen(f"{gw.base_url}/query") as r:
                 summary = json.load(r)["rows"]
             print("per-day summary:", summary)
-            assert len(summary) == 2 and all(s["points"] == 24 for s in summary)
+            # >= 2: the named Volume persists across runs, so re-running on a
+            # later calendar day legitimately accumulates more day-rows
+            assert len(summary) >= 2 and all(s["points"] == 24 for s in summary)
 
             day = summary[0]["day"]
             with urllib.request.urlopen(

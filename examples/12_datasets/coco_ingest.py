@@ -14,6 +14,7 @@
 import io
 import json
 import os
+import shutil
 import sys
 import tarfile
 import threading
@@ -84,10 +85,12 @@ def ingest_split(split: str, n_images: int = 8) -> dict:
     os.makedirs(f"{dest}/images", exist_ok=True)
     os.makedirs(f"{dest}/annotations", exist_ok=True)
     n_moved = 0
+    # shutil.move, not os.replace: scratch (/tmp, often tmpfs) and the bucket
+    # mount are usually different filesystems (EXDEV)
     for name in sorted(os.listdir(f"{scratch}/images")):
-        os.replace(f"{scratch}/images/{name}", f"{dest}/images/{name}")
+        shutil.move(f"{scratch}/images/{name}", f"{dest}/images/{name}")
         n_moved += 1
-    os.replace(
+    shutil.move(
         f"{scratch}/annotations/instances.json",
         f"{dest}/annotations/instances.json",
     )

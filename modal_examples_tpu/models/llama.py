@@ -70,10 +70,14 @@ class LlamaConfig:
     @property
     def param_count(self) -> int:
         emb = self.vocab_size * self.dim * (1 if self.tie_embeddings else 2)
+        if self.n_experts > 0:
+            mlp = self.n_experts * 3 * self.dim * self.ffn_dim + self.dim * self.n_experts
+        else:
+            mlp = 3 * self.dim * self.ffn_dim  # gate/up/down
         per_layer = (
             self.dim * self.head_dim * (self.n_heads + 2 * self.n_kv_heads)  # qkv
             + self.n_heads * self.head_dim * self.dim  # o
-            + 3 * self.dim * self.ffn_dim  # gate/up/down
+            + mlp
             + 2 * self.dim  # norms
         )
         return emb + self.n_layers * per_layer + self.dim
@@ -124,6 +128,24 @@ class LlamaConfig:
         )
 
     @staticmethod
+    def mixtral_8x7b() -> "LlamaConfig":
+        # the Mixtral-shape MoE (reference serves MoE models engine-side:
+        # vllm_inference.py:54-58, sglang_low_latency.py:67)
+        return LlamaConfig(
+            vocab_size=32000, dim=4096, n_layers=32, n_heads=32, n_kv_heads=8,
+            ffn_dim=14336, rope_theta=1e6, max_seq_len=32768,
+            n_experts=8, top_k_experts=2,
+        )
+
+    @staticmethod
+    def tiny_moe(vocab_size: int = 512) -> "LlamaConfig":
+        """Test-tier Mixtral-shape config (cheap-mode switch, SURVEY.md §4)."""
+        return LlamaConfig(
+            vocab_size=vocab_size, dim=128, n_layers=2, n_heads=4, n_kv_heads=2,
+            ffn_dim=256, max_seq_len=256, n_experts=4, top_k_experts=2,
+        )
+
+    @staticmethod
     def tiny(vocab_size: int = 512) -> "LlamaConfig":
         """Test-tier config (the reference's cheap-mode switch, SURVEY.md §4)."""
         return LlamaConfig(
@@ -145,6 +167,8 @@ class LlamaConfig:
             norm_eps=cfg.get("rms_norm_eps", 1e-5),
             max_seq_len=cfg.get("max_position_embeddings", 4096),
             tie_embeddings=cfg.get("tie_word_embeddings", False),
+            n_experts=cfg.get("num_local_experts", 0),
+            top_k_experts=cfg.get("num_experts_per_tok", 2),
             rope_scaling=(
                 tuple(sorted(cfg["rope_scaling"].items()))
                 if isinstance(cfg.get("rope_scaling"), dict)
@@ -171,10 +195,12 @@ def init_params(key: jax.Array, cfg: LlamaConfig) -> dict:
 
     if cfg.n_experts > 0:
         E = cfg.n_experts
+        k9 = jax.random.split(keys[9])[0]
         mlp = {
             "router": dense(keys[5], L, D, E),
-            "moe_w_in": dense(keys[6], L, E, D, F),
-            "moe_w_out": dense(keys[7], L, E, F, D),
+            "moe_gate": dense(keys[6], L, E, D, F),
+            "moe_up": dense(keys[7], L, E, D, F),
+            "moe_down": dense(k9, L, E, F, D),
         }
     else:
         mlp = {
@@ -213,8 +239,9 @@ def partition_specs(cfg: LlamaConfig) -> dict:
         # through moe.moe_mlp_ep / shard_map, not these specs)
         mlp_specs = {
             "router": P(None, None, None),
-            "moe_w_in": P(None, None, None, "tensor"),
-            "moe_w_out": P(None, None, "tensor", None),
+            "moe_gate": P(None, None, None, "tensor"),
+            "moe_up": P(None, None, None, "tensor"),
+            "moe_down": P(None, None, "tensor", None),
         }
     else:
         mlp_specs = {
@@ -245,6 +272,40 @@ def _layer_stack(params: dict):
     return params["layers"]
 
 
+def _mlp_block(
+    layer: dict, h: jax.Array, cfg: LlamaConfig, *, lora=None, lora_scale=1.0,
+    moe_impl: str = "nodrop",
+) -> tuple[jax.Array, jax.Array]:
+    """Post-norm MLP for one layer: dense SwiGLU, or — when cfg.n_experts > 0
+    — top-k routed SwiGLU experts (the reference's served MoE lives inside
+    vLLM/SGLang: vllm_inference.py:54-58). ``moe_impl="nodrop"`` (serving
+    default) runs every expert so incremental decode reproduces the dense
+    forward token-for-token; ``"capacity"`` is the GShard-dispatched
+    formulation at ~top_k/E the FLOPs for compute-bound training forward.
+    Returns (out, aux_load_balance_loss)."""
+    if cfg.n_experts > 0:
+        from . import moe as _moe
+
+        shape = h.shape
+        if moe_impl == "capacity":
+            flat, aux = _moe.moe_swiglu_capacity(
+                layer["router"], layer["moe_gate"], layer["moe_up"],
+                layer["moe_down"], h.reshape(-1, cfg.dim), cfg.top_k_experts,
+                cfg.expert_capacity_factor,
+            )
+        else:
+            flat, aux = _moe.moe_swiglu_nodrop(
+                layer["router"], layer["moe_gate"], layer["moe_up"],
+                layer["moe_down"], h.reshape(-1, cfg.dim), cfg.top_k_experts,
+            )
+        return flat.reshape(shape).astype(h.dtype), aux
+    out = layers.swiglu_mlp(
+        {k: layer[k] for k in ("gate", "up", "down")}, h,
+        lora=lora, lora_scale=lora_scale,
+    )
+    return out, jnp.zeros((), jnp.float32)
+
+
 # -- forward (training / prefill) ------------------------------------------
 
 
@@ -258,6 +319,7 @@ def forward(
     lora: dict | None = None,  # adapter pytree (models.lora), applied on the fly
     lora_scale: float = 1.0,
     return_aux: bool = False,  # MoE: also return the mean load-balance loss
+    moe_impl: str = "nodrop",  # "capacity": GShard dispatch (training scale)
 ):  # [B, S, vocab] (, aux)
     """Full-sequence forward with causal attention (flash or xla impl)."""
     B, S = tokens.shape
@@ -282,29 +344,9 @@ def forward(
         )
         x = x + h
         h = layers.rms_norm(x, layer["mlp_norm"], cfg.norm_eps)
-        if cfg.n_experts > 0:
-            from . import moe as _moe
-
-            mcfg = _moe.MoEConfig(
-                n_experts=cfg.n_experts, top_k=cfg.top_k_experts,
-                capacity_factor=cfg.expert_capacity_factor,
-                d_model=cfg.dim, d_ff=cfg.ffn_dim,
-            )
-            mparams = {
-                "router": layer["router"],
-                "w_in": layer["moe_w_in"],
-                "w_out": layer["moe_w_out"],
-            }
-            flat, aux = _moe.moe_mlp(
-                mparams, h.reshape(-1, cfg.dim).astype(jnp.float32), mcfg
-            )
-            h = flat.reshape(h.shape).astype(h.dtype)
-        else:
-            aux = jnp.zeros((), jnp.float32)
-            h = layers.swiglu_mlp(
-                {k: layer[k] for k in ("gate", "up", "down")}, h,
-                lora=llayer, lora_scale=lora_scale,
-            )
+        h, aux = _mlp_block(
+            layer, h, cfg, lora=llayer, lora_scale=lora_scale, moe_impl=moe_impl
+        )
         return x + h, aux
 
     xs = (
@@ -372,7 +414,7 @@ def prefill(
         o = o.transpose(0, 2, 1, 3).reshape(B, S, cfg.n_heads * D)
         x = x + layers.mm(o, layer["wo"]).astype(x.dtype)
         h = layers.rms_norm(x, layer["mlp_norm"], cfg.norm_eps)
-        h = layers.swiglu_mlp({n: layer[n] for n in ("gate", "up", "down")}, h)
+        h, _ = _mlp_block(layer, h, cfg)
         x = x + h
         # stack KV for a single scatter outside the scan: [Hkv, B, S, D]
         return x, (k.transpose(1, 0, 2, 3), v.transpose(1, 0, 2, 3))
@@ -476,7 +518,7 @@ def prefill_chunk(
         o = o.transpose(0, 2, 1, 3).reshape(B, C, cfg.n_heads * D)
         x = x + layers.mm(o, layer["wo"]).astype(x.dtype)
         h = layers.rms_norm(x, layer["mlp_norm"], cfg.norm_eps)
-        h = layers.swiglu_mlp({n: layer[n] for n in ("gate", "up", "down")}, h)
+        h, _ = _mlp_block(layer, h, cfg)
         x = x + h
         return x, (k.transpose(1, 0, 2, 3), v.transpose(1, 0, 2, 3))
 
@@ -548,7 +590,7 @@ def decode_step(
         o = o.reshape(B, cfg.n_heads * D)
         x = x + layers.mm(o, layer["wo"]).astype(x.dtype)
         h = layers.rms_norm(x, layer["mlp_norm"], cfg.norm_eps)
-        h = layers.swiglu_mlp({n: layer[n] for n in ("gate", "up", "down")}, h)
+        h, _ = _mlp_block(layer, h, cfg)
         return x + h, (k_pg, v_pg)
 
     x, (k_pages, v_pages) = jax.lax.scan(
@@ -623,7 +665,7 @@ def verify_step(
         o = o.reshape(B, T, cfg.n_heads * D)
         x = x + layers.mm(o, layer["wo"]).astype(x.dtype)
         h = layers.rms_norm(x, layer["mlp_norm"], cfg.norm_eps)
-        h = layers.swiglu_mlp({n: layer[n] for n in ("gate", "up", "down")}, h)
+        h, _ = _mlp_block(layer, h, cfg)
         return x + h, (k_pg, v_pg)
 
     x, (k_pages, v_pages) = jax.lax.scan(
@@ -666,6 +708,34 @@ def load_hf_weights(model_dir: str | Path, cfg: LlamaConfig, dtype=None) -> dict
             mats.append(arr.T if transpose else arr)
         return jnp.asarray(np.stack(mats), dtype=dt)
 
+    def stack_experts(fmt):
+        # [L, E, D, F] from per-(layer, expert) HF [F, D] matrices
+        mats = [
+            np.stack([raw.pop(fmt.format(li, e)).T for e in range(cfg.n_experts)])
+            for li in range(cfg.n_layers)
+        ]
+        return jnp.asarray(np.stack(mats), dtype=dt)
+
+    if cfg.n_experts > 0:
+        # Mixtral layout: block_sparse_moe.gate (router) + experts.{e}.w1/w3/w2
+        mlp = {
+            "router": stack("model.layers.{}.block_sparse_moe.gate.weight"),
+            "moe_gate": stack_experts(
+                "model.layers.{}.block_sparse_moe.experts.{}.w1.weight"
+            ),
+            "moe_up": stack_experts(
+                "model.layers.{}.block_sparse_moe.experts.{}.w3.weight"
+            ),
+            "moe_down": stack_experts(
+                "model.layers.{}.block_sparse_moe.experts.{}.w2.weight"
+            ),
+        }
+    else:
+        mlp = {
+            "gate": stack("model.layers.{}.mlp.gate_proj.weight"),
+            "up": stack("model.layers.{}.mlp.up_proj.weight"),
+            "down": stack("model.layers.{}.mlp.down_proj.weight"),
+        }
     params = {
         "embed": jnp.asarray(raw.pop("model.embed_tokens.weight"), dtype=dt),
         "layers": {
@@ -675,9 +745,7 @@ def load_hf_weights(model_dir: str | Path, cfg: LlamaConfig, dtype=None) -> dict
             "wv": stack("model.layers.{}.self_attn.v_proj.weight"),
             "wo": stack("model.layers.{}.self_attn.o_proj.weight"),
             "mlp_norm": stack("model.layers.{}.post_attention_layernorm.weight", False),
-            "gate": stack("model.layers.{}.mlp.gate_proj.weight"),
-            "up": stack("model.layers.{}.mlp.up_proj.weight"),
-            "down": stack("model.layers.{}.mlp.down_proj.weight"),
+            **mlp,
         },
         "final_norm": jnp.asarray(raw.pop("model.norm.weight"), dtype=dt),
     }

@@ -125,6 +125,95 @@ def moe_mlp(
     return out.reshape(T, D), jnp.mean(aux)
 
 
+def _swiglu_expert(w_gate, w_up, w_down, h):
+    """SwiGLU expert FFN (Mixtral w1/w3/w2): h [T, D] -> [T, D]."""
+    a = jnp.einsum("td,df->tf", h, w_gate)
+    b = jnp.einsum("td,df->tf", h, w_up)
+    return jnp.einsum("tf,fd->td", jax.nn.silu(a) * b, w_down)
+
+
+def moe_swiglu_nodrop(
+    router: jax.Array,  # [D, E]
+    w_gate: jax.Array,  # [E, D, F]
+    w_up: jax.Array,  # [E, D, F]
+    w_down: jax.Array,  # [E, F, D]
+    x: jax.Array,  # [T, D]
+    top_k: int,
+) -> tuple[jax.Array, jax.Array]:
+    """Top-k routed SwiGLU experts with NO capacity drops — the serving
+    formulation (and the per-token ground truth the capacity-routed training
+    path approximates).
+
+    Routing is per-token, so incremental decode reproduces full-sequence
+    results token-for-token — the property the engine's exact-vs-dense MoE
+    test relies on. Every expert runs on every token (a grouped-matmul over
+    the full expert set); at decode batch sizes all experts' weights are the
+    HBM-bandwidth floor anyway, and the [T, F] intermediate stays bounded by
+    scanning over experts rather than materializing [T, E, F].
+
+    Replaces the engine-internal MoE the reference serves via vLLM/SGLang
+    (vllm_inference.py:54-58 Gemma MoE, sglang_low_latency.py:67 Qwen MoE).
+    Returns (out [T, D] float32, aux load-balance loss).
+    """
+    E = w_gate.shape[0]
+    xf = x.astype(jnp.float32)
+    logits = jnp.einsum("td,de->te", xf, router.astype(jnp.float32))
+    probs = jax.nn.softmax(logits, axis=-1)  # [T, E]
+
+    top1 = jnp.argmax(probs, axis=-1)
+    aux = E * jnp.sum(
+        jnp.mean(jax.nn.one_hot(top1, E), axis=0) * jnp.mean(probs, axis=0)
+    )
+
+    topk_p, topk_idx = jax.lax.top_k(probs, top_k)  # [T, k]
+    topk_p = topk_p / jnp.sum(topk_p, axis=-1, keepdims=True)
+    # [T, E] combine weights, zero off the top-k
+    w_full = jnp.zeros_like(probs)
+    w_full = jax.vmap(lambda w, p, i: w.at[i].add(p))(w_full, topk_p, topk_idx)
+
+    def body(acc, ew):
+        wg, wu, wd, we = ew  # we: [T] this expert's combine weight per token
+        return acc + we[:, None] * _swiglu_expert(wg, wu, wd, xf), None
+
+    out, _ = jax.lax.scan(
+        body,
+        jnp.zeros_like(xf),
+        (w_gate, w_up, w_down, w_full.T),
+    )
+    return out, aux
+
+
+def moe_swiglu_capacity(
+    router: jax.Array,  # [D, E]
+    w_gate: jax.Array,  # [E, D, F]
+    w_up: jax.Array,  # [E, D, F]
+    w_down: jax.Array,  # [E, F, D]
+    x: jax.Array,  # [T, D]
+    top_k: int,
+    capacity_factor: float,
+) -> tuple[jax.Array, jax.Array]:
+    """Capacity-routed SwiGLU experts (GShard dispatch): each expert computes
+    only its capacity slots, ~top_k/E of the no-drop cost — the right
+    formulation for compute-bound prefill/training at scale (tokens over
+    capacity are dropped, so it is NOT bit-identical to the no-drop serving
+    path). Returns (out [T, D] float32, aux load-balance loss)."""
+    E, D, F = w_gate.shape
+    cfg = MoEConfig(
+        n_experts=E, top_k=top_k, capacity_factor=capacity_factor,
+        d_model=D, d_ff=F,
+    )
+    xf = x.astype(jnp.float32)
+    cap = cfg.capacity(x.shape[0])
+    dispatch, combine, aux = _route(xf, router.astype(jnp.float32), cfg, cap)
+    expert_in = jnp.einsum("tec,td->ecd", dispatch, xf)  # [E, C, D]
+    h = jax.nn.silu(jnp.einsum("ecd,edf->ecf", expert_in, w_gate)) * jnp.einsum(
+        "ecd,edf->ecf", expert_in, w_up
+    )
+    expert_out = jnp.einsum("ecf,efd->ecd", h, w_down)
+    out = jnp.einsum("tec,ecd->td", combine, expert_out)
+    return out, aux
+
+
 def moe_mlp_ep(
     params: dict, x: jax.Array, cfg: MoEConfig, mesh, *, axis: str = "expert"
 ) -> tuple[jax.Array, jax.Array]:

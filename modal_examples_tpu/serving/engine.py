@@ -51,6 +51,10 @@ class Request:
     created: float = dataclasses.field(default_factory=time.monotonic)
     aborted: bool = False
     finish_reason: str | None = None  # set when the terminal marker arrives
+    # engine-assigned when params.seed is None: sampling is derived from
+    # (auto_seed, position) so outputs never depend on scheduler timing —
+    # how many blocks/keys the engine happened to burn before this request
+    auto_seed: int | None = None
 
 
 @dataclasses.dataclass
@@ -113,6 +117,43 @@ class _Finish:
 _FINISH = _Finish("stop")
 
 
+def _req_seed(req: "Request") -> int:
+    """The seed sample() uses for this request's rows: the user's, else the
+    engine-assigned auto_seed (-1 only if neither exists, e.g. warmup)."""
+    if req.params.seed is not None:
+        return req.params.seed
+    return req.auto_seed if req.auto_seed is not None else -1
+
+
+def _shard_params(params, cfg, mesh):
+    """Place a llama param tree with its Megatron partition specs — one
+    implementation for target and draft so the paths can't drift."""
+    from jax.sharding import NamedSharding
+    from jax.sharding import PartitionSpec as P
+
+    specs = llama.partition_specs(cfg)
+    return jax.tree.map(
+        lambda p, s: jax.device_put(p, NamedSharding(mesh, s)),
+        params,
+        specs,
+        is_leaf=lambda x: isinstance(x, P),
+    )
+
+
+#: the MODEL_NAME surface (vllm_inference.py:54-58) — shared by build_engine
+#: and the speculative draft resolver so the two can never drift
+MODEL_PRESETS = {
+    "llama2-7b": llama.LlamaConfig.llama2_7b,
+    "llama3-8b": llama.LlamaConfig.llama3_8b,
+    "llama3.1-8b": llama.LlamaConfig.llama31_8b,
+    "llama3.2-1b": llama.LlamaConfig.llama32_1b,
+    "mistral-7b": llama.LlamaConfig.mistral_7b,
+    "mixtral-8x7b": llama.LlamaConfig.mixtral_8x7b,
+    "tiny": llama.LlamaConfig.tiny,
+    "tiny-moe": llama.LlamaConfig.tiny_moe,
+}
+
+
 class LLMEngine:
     def __init__(
         self,
@@ -165,16 +206,7 @@ class LLMEngine:
                     "mesh= (tensor parallel) with quantization is not yet "
                     "supported"
                 )
-            from jax.sharding import NamedSharding
-            from jax.sharding import PartitionSpec as P
-
-            specs = llama.partition_specs(cfg)
-            params = jax.tree.map(
-                lambda p, s: jax.device_put(p, NamedSharding(mesh, s)),
-                params,
-                specs,
-                is_leaf=lambda x: isinstance(x, P),
-            )
+            params = _shard_params(params, cfg, mesh)
         self.params = params
         self.max_slots = max_slots
         self.max_model_len = max_model_len
@@ -207,7 +239,10 @@ class LLMEngine:
         self.waiting: queue.Queue[Request] = queue.Queue()
         self.stats = EngineStats()
         self.error_log: list[str] = []  # recent scheduler tracebacks
+        self.error_count = 0  # monotonic (error_log is capped at 20)
         self._key = jax.random.PRNGKey(seed)
+        self._seed_base = int(seed)
+        self._submit_seq = 0  # feeds auto_seed: deterministic per submission
         self._lock = threading.Lock()
         self._running = False
         self._thread: threading.Thread | None = None
@@ -236,7 +271,6 @@ class LLMEngine:
 
         self._inflight = collections.deque()  # (tokens [K, B] device, snapshot)
 
-        self._decode_jit = jax.jit(self._decode_and_sample, donate_argnums=(1, 2))
         self._block_jit = jax.jit(self._decode_block_fn, donate_argnums=(1, 2))
         self._prefill_jits: dict[int, object] = {}
         self._chunk_jits: dict[int, object] = {}  # keyed by chunk q_offset
@@ -253,12 +287,18 @@ class LLMEngine:
         if speculative is not None:
             draft, gamma = speculative
             if isinstance(draft, str):
-                presets = {
-                    "llama2-7b": llama.LlamaConfig.llama2_7b,
-                    "llama3-8b": llama.LlamaConfig.llama3_8b,
-                    "tiny": llama.LlamaConfig.tiny,
-                }
-                draft = presets[draft]()
+                if draft not in MODEL_PRESETS:
+                    raise ValueError(
+                        f"unknown draft preset {draft!r}; "
+                        f"known: {sorted(MODEL_PRESETS)}"
+                    )
+                draft = MODEL_PRESETS[draft]()
+            if draft_model_dir is not None:
+                # the checkout's own config describes the draft weights (the
+                # preset name is then just a default for when no dir is given)
+                draft = llama.LlamaConfig.from_hf_config(
+                    f"{draft_model_dir}/config.json"
+                )
             self.draft_cfg = draft
             self.spec_gamma = int(gamma)
             if self.spec_gamma < 1:
@@ -277,16 +317,7 @@ class LLMEngine:
                         jax.random.PRNGKey(seed + 1), draft
                     )
             if mesh is not None:
-                from jax.sharding import NamedSharding
-                from jax.sharding import PartitionSpec as P
-
-                dspecs = llama.partition_specs(draft)
-                draft_params = jax.tree.map(
-                    lambda p, s: jax.device_put(p, NamedSharding(mesh, s)),
-                    draft_params,
-                    dspecs,
-                    is_leaf=lambda x: isinstance(x, P),
-                )
+                draft_params = _shard_params(draft_params, draft, mesh)
             self.draft_params = draft_params
             self.draft_cache = PagedKVCache.create(
                 n_layers=draft.n_layers,
@@ -316,19 +347,6 @@ class LLMEngine:
         cache.v_pages = jax.device_put(cache.v_pages, sh)
 
     # -- jitted programs ----------------------------------------------------
-
-    def _decode_and_sample(
-        self, params, k_pages, v_pages, tokens, positions, page_tables, active,
-        key, temps, top_ps, top_ks, seeds,
-    ):
-        logits, k_pages, v_pages = llama.decode_step(
-            params, tokens, positions, k_pages, v_pages, page_tables, active,
-            self.cfg,
-        )
-        next_tokens = sample(
-            logits, key, temps, top_ps, top_ks, seeds=seeds, step_ids=positions
-        )
-        return next_tokens, k_pages, v_pages
 
     def _decode_block_fn(
         self, params, k_pages, v_pages, prev_tokens, override, override_mask,
@@ -512,15 +530,34 @@ class LLMEngine:
 
     # -- public API ---------------------------------------------------------
 
-    def submit(self, prompt: str, params: SamplingParams | None = None) -> Request:
-        req = Request(prompt=prompt, params=params or SamplingParams())
-        if self.spec_gamma and (
-            req.params.top_p < 1.0 or req.params.top_k > 0
-        ):
+    def validate_params(self, params: SamplingParams) -> None:
+        """Raise ValueError for parameter combinations this engine rejects —
+        servers call this up front so a bad request becomes a 400, not a
+        dropped connection."""
+        if self.spec_gamma and (params.top_p < 1.0 or params.top_k > 0):
             raise ValueError(
                 "speculative decoding supports greedy (temperature=0) and "
                 "plain temperature sampling; top_p/top_k are unsupported"
             )
+        if self.spec_gamma and params.seed is not None and params.temperature > 0:
+            # the spec accept/reject kernel samples from the engine key
+            # (_spec_propose_verify ignores per-request seeds); accepting
+            # seed= would silently break the seeded-determinism contract
+            raise ValueError(
+                "speculative decoding does not support seed= with "
+                "temperature > 0 (per-request seeded sampling is not "
+                "implemented in the spec accept/reject kernel)"
+            )
+
+    def submit(self, prompt: str, params: SamplingParams | None = None) -> Request:
+        req = Request(prompt=prompt, params=params or SamplingParams())
+        self.validate_params(req.params)
+        if req.params.seed is None:
+            with self._lock:
+                self._submit_seq += 1
+                req.auto_seed = (
+                    self._seed_base * 1_000_003 + self._submit_seq
+                ) % (2**31 - 1)
         # prompts longer than the largest bucket prefill in chunks; the hard
         # cap is the model length (minus >=1 decode slot)
         req.prompt_tokens = self.tokenizer.encode(prompt)[: self.max_model_len - 1]
@@ -681,6 +718,7 @@ class LLMEngine:
                 # are diagnosable after the fact (surfaced in /metrics)
                 tb = traceback.format_exc()
                 self.error_log.append(tb)
+                self.error_count += 1
                 del self.error_log[:-20]
                 print(tb, flush=True)
                 worked = False
@@ -894,7 +932,7 @@ class LLMEngine:
             jnp.asarray([p.temperature], np.float32),
             jnp.asarray([p.top_p], np.float32),
             jnp.asarray([p.top_k], np.int32),
-            seeds=jnp.asarray([-1 if p.seed is None else p.seed], np.int32),
+            seeds=jnp.asarray([_req_seed(req)], np.int32),
             step_ids=jnp.asarray([n_prompt], np.int32),
         )
         self.stats.prompt_tokens += n_prompt
@@ -930,7 +968,7 @@ class LLMEngine:
             seq_lens[i] = n_prompt
             p = req.params
             temps[i], top_ps[i], top_ks[i] = p.temperature, p.top_p, p.top_k
-            seeds[i] = -1 if p.seed is None else p.seed
+            seeds[i] = _req_seed(req)
 
         next_tok, self.cache.k_pages, self.cache.v_pages = self._prefill_jit(
             (bucket, B)
@@ -990,9 +1028,7 @@ class LLMEngine:
                 self._positions[i] = s.position
                 p = s.request.params
                 self._temps[i] = p.temperature
-                self._top_ps[i] = p.top_p
-                self._top_ks[i] = p.top_k
-                self._seeds[i] = -1 if p.seed is None else p.seed
+                self._seeds[i] = _req_seed(s.request)
             return self._spec_tick(live)
 
         # pipelined path: keep one decode block in flight ahead of the one
@@ -1041,7 +1077,7 @@ class LLMEngine:
             self._temps[i] = p.temperature
             self._top_ps[i] = p.top_p
             self._top_ks[i] = p.top_k
-            self._seeds[i] = -1 if p.seed is None else p.seed
+            self._seeds[i] = _req_seed(s.request)
         prev = self._device_tokens
         if prev is None:
             prev = jnp.zeros((self.max_slots,), jnp.int32)
@@ -1050,16 +1086,16 @@ class LLMEngine:
             self.cache.k_pages,
             self.cache.v_pages,
             prev,
-            jnp.asarray(self._override),
-            jnp.asarray(self._override_mask),
-            jnp.asarray(self._positions),
-            jnp.asarray(self._page_tables),
-            jnp.asarray(self._active),
+            jnp.asarray(self._override.copy()),
+            jnp.asarray(self._override_mask.copy()),
+            jnp.asarray(self._positions.copy()),
+            jnp.asarray(self._page_tables.copy()),
+            jnp.asarray(self._active.copy()),
             self._next_key(),
-            jnp.asarray(self._temps),
-            jnp.asarray(self._top_ps),
-            jnp.asarray(self._top_ks),
-            jnp.asarray(self._seeds),
+            jnp.asarray(self._temps.copy()),
+            jnp.asarray(self._top_ps.copy()),
+            jnp.asarray(self._top_ks.copy()),
+            jnp.asarray(self._seeds.copy()),
         )
         self._device_tokens = last
         self._inflight.append((toks, [(i, self.slots[i].request) for i in live]))
@@ -1100,13 +1136,13 @@ class LLMEngine:
             self.cache.v_pages,
             self.draft_cache.k_pages,
             self.draft_cache.v_pages,
-            jnp.asarray(self._tokens),
-            jnp.asarray(self._positions),
-            jnp.asarray(self._page_tables),
-            jnp.asarray(self._active),
+            jnp.asarray(self._tokens.copy()),
+            jnp.asarray(self._positions.copy()),
+            jnp.asarray(self._page_tables.copy()),
+            jnp.asarray(self._active.copy()),
             self._next_key(),
-            jnp.asarray(self._temps),
-            jnp.asarray(self._seeds),
+            jnp.asarray(self._temps.copy()),
+            jnp.asarray(self._seeds.copy()),
         )
         out_np = np.asarray(out_tokens)
         n_np = np.asarray(n_emit)
@@ -1174,13 +1210,12 @@ def build_engine(
 ) -> LLMEngine:
     """Factory mirroring the reference's MODEL_NAME/engine-flags surface
     (vllm_inference.py:54-58,168-209)."""
-    presets = {
-        "llama2-7b": llama.LlamaConfig.llama2_7b,
-        "llama3-8b": llama.LlamaConfig.llama3_8b,
-        "tiny": llama.LlamaConfig.tiny,
-    }
     if model_dir is not None:
         cfg = llama.LlamaConfig.from_hf_config(f"{model_dir}/config.json")
     else:
-        cfg = presets[model]()
+        if model not in MODEL_PRESETS:
+            raise ValueError(
+                f"unknown model preset {model!r}; known: {sorted(MODEL_PRESETS)}"
+            )
+        cfg = MODEL_PRESETS[model]()
     return LLMEngine(cfg, model_dir=model_dir, **engine_kw)

@@ -78,7 +78,7 @@ class _Handler(BaseHTTPRequestHandler):
                 f"mtpu_active_slots {active}\n"
                 f"mtpu_waiting_requests {eng.waiting.qsize()}\n"
                 f"mtpu_kv_pages_free {eng.cache.allocator.available}\n"
-                f"mtpu_scheduler_errors_total {len(eng.error_log)}\n"
+                f"mtpu_scheduler_errors_total {eng.error_count}\n"
                 + (
                     f"mtpu_spec_proposed_total {s.spec_proposed}\n"
                     f"mtpu_spec_accepted_total {s.spec_accepted}\n"
@@ -123,7 +123,14 @@ class _Handler(BaseHTTPRequestHandler):
             prompt = srv.engine.tokenizer.apply_chat_template(messages)
         else:
             prompt = body.get("prompt") or ""
-        params = _params_from_body(body)
+        try:
+            params = _params_from_body(body)
+            srv.engine.validate_params(params)
+        except ValueError as e:
+            self._json(400, {"error": {
+                "message": str(e), "type": "invalid_request_error",
+            }})
+            return
         stream = bool(body.get("stream", False))
         n = max(1, int(body.get("n", 1)))
         rid = f"chatcmpl-{uuid.uuid4().hex[:12]}"

@@ -125,6 +125,35 @@ class TestMoEExpertParallel:
             first = first if first is not None else float(m["loss"])
         assert float(m["loss"]) < first
 
+    def test_nodrop_swiglu_matches_explicit_loop(self, jax):
+        """The serving MoE (no capacity drops) must equal the explicit
+        'each token through its top-k SwiGLU experts' computation."""
+        import jax.numpy as jnp
+
+        from modal_examples_tpu.models import moe
+
+        T, D, F, E, k = 16, 32, 64, 4, 2
+        keys = jax.random.split(jax.random.PRNGKey(0), 5)
+        router = jax.random.normal(keys[0], (D, E)) * D**-0.5
+        wg = jax.random.normal(keys[1], (E, D, F)) * D**-0.5
+        wu = jax.random.normal(keys[2], (E, D, F)) * D**-0.5
+        wd = jax.random.normal(keys[3], (E, F, D)) * F**-0.5
+        x = jax.random.normal(keys[4], (T, D))
+
+        out, aux = moe.moe_swiglu_nodrop(router, wg, wu, wd, x, k)
+        assert float(aux) >= 1.0 - 1e-5
+
+        probs = jax.nn.softmax(x @ router, -1)
+        topk_p, topk_i = jax.lax.top_k(probs, k)
+        topk_p = topk_p / topk_p.sum(-1, keepdims=True)
+        want = jnp.zeros_like(x)
+        for t in range(T):
+            for j in range(k):
+                e = int(topk_i[t, j])
+                h = jax.nn.silu(x[t] @ wg[e]) * (x[t] @ wu[e])
+                want = want.at[t].add(float(topk_p[t, j]) * (h @ wd[e]))
+        np.testing.assert_allclose(np.asarray(out), np.asarray(want), atol=1e-4)
+
     def test_ep_under_jit(self, jax, setup):
         import jax.numpy as jnp
 
@@ -136,3 +165,184 @@ class TestMoEExpertParallel:
         f = jax.jit(lambda p, x: moe.moe_mlp_ep(p, x, cfg, mesh)[0])
         out = f(params, x)
         assert bool(jnp.isfinite(out).all())
+
+
+class TestMoEServing:
+    """MoE through the serving paths (VERDICT #6): paged decode and prefill
+    must reproduce the dense full-sequence forward — routing is per-token, so
+    incremental and full-sequence computation agree exactly."""
+
+    @pytest.fixture(scope="class")
+    def served(self, jax):
+        import jax.numpy as jnp
+
+        from modal_examples_tpu.models import llama
+
+        cfg = llama.LlamaConfig(
+            vocab_size=64, dim=32, n_layers=2, n_heads=2, n_kv_heads=2,
+            ffn_dim=64, max_seq_len=128, dtype="float32",
+            n_experts=4, top_k_experts=2,
+        )
+        params = llama.init_params(jax.random.PRNGKey(0), cfg)
+        return cfg, params
+
+    def test_paged_decode_matches_dense_forward(self, jax, served):
+        import jax.numpy as jnp
+
+        from modal_examples_tpu.models import llama
+
+        cfg, params = served
+        B, ps, pps = 2, 16, 4
+        n_pages = 1 + B * pps
+        shape = (cfg.n_layers, n_pages, cfg.n_kv_heads, ps, cfg.head_dim)
+        pt = (1 + jnp.arange(B * pps, dtype=jnp.int32)).reshape(B, pps)
+        active = jnp.ones((B,), bool)
+
+        prompt = jnp.array([[1, 2, 3, 5, 0, 0], [7, 8, 9, 11, 13, 2]], jnp.int32)
+        seq_lens = jnp.array([4, 6], jnp.int32)
+        k_pg = jnp.zeros(shape, jnp.float32)
+        v_pg = jnp.zeros(shape, jnp.float32)
+        logits_p, k_pg, v_pg = llama.prefill(
+            params, prompt, k_pg, v_pg, pt, seq_lens, cfg, attn_impl="xla"
+        )
+
+        # decode 4 more tokens (teacher-forced so the comparison is exact)
+        chain = jnp.array([[3, 5, 2, 9], [1, 4, 6, 8]], jnp.int32)
+        dec_logits = []
+        for t in range(4):
+            lg, k_pg, v_pg = llama.decode_step(
+                params, chain[:, t], seq_lens + t, k_pg, v_pg, pt, active, cfg
+            )
+            dec_logits.append(lg)
+
+        # dense ground truth: full-sequence forward over prompt + chain
+        full = []
+        for b, L in enumerate([4, 6]):
+            seq = jnp.concatenate([prompt[b, :L], chain[b]])
+            full.append(jnp.pad(seq, (0, 10 - L)))
+        tokens = jnp.stack(full)
+        logits_f = llama.forward(params, tokens, cfg, attn_impl="xla")
+
+        for b, L in enumerate([4, 6]):
+            # prefill's last-token logits == forward at position L-1
+            np.testing.assert_allclose(
+                np.asarray(logits_p[b]), np.asarray(logits_f[b, L - 1]),
+                atol=2e-4,
+            )
+            for t in range(4):
+                np.testing.assert_allclose(
+                    np.asarray(dec_logits[t][b]),
+                    np.asarray(logits_f[b, L + t]),
+                    atol=2e-4,
+                )
+
+    def test_verify_step_matches_decode(self, jax, served):
+        import jax.numpy as jnp
+
+        from modal_examples_tpu.models import llama
+
+        cfg, params = served
+        B, ps, pps = 2, 16, 4
+        n_pages = 1 + B * pps
+        shape = (cfg.n_layers, n_pages, cfg.n_kv_heads, ps, cfg.head_dim)
+        pt = (1 + jnp.arange(B * pps, dtype=jnp.int32)).reshape(B, pps)
+        active = jnp.ones((B,), bool)
+        prompt = jnp.array([[1, 2, 3, 5], [7, 8, 9, 11]], jnp.int32)
+        seq_lens = jnp.array([4, 4], jnp.int32)
+        k1 = jnp.zeros(shape, jnp.float32)
+        v1 = jnp.zeros(shape, jnp.float32)
+        _, k1, v1 = llama.prefill(
+            params, prompt, k1, v1, pt, seq_lens, cfg, attn_impl="xla"
+        )
+        k2, v2 = k1, v1
+
+        chain = jnp.array([[3, 5, 2], [1, 4, 6]], jnp.int32)
+        logits_v, k1, v1 = llama.verify_step(
+            params, chain, seq_lens, k1, v1, pt, active, cfg
+        )
+        for t in range(3):
+            lg, k2, v2 = llama.decode_step(
+                params, chain[:, t], seq_lens + t, k2, v2, pt, active, cfg
+            )
+            np.testing.assert_allclose(
+                np.asarray(logits_v[:, t]), np.asarray(lg), atol=2e-4
+            )
+
+    def test_engine_serves_moe(self, jax):
+        """End to end: the continuous-batching engine serves the Mixtral-shape
+        config, greedy output matches an explicit dense-forward greedy loop
+        token-for-token (the exact-vs-dense contract, vllm_inference.py:54-58
+        parity)."""
+        import jax.numpy as jnp
+
+        from modal_examples_tpu.models import llama
+        from modal_examples_tpu.serving import LLMEngine, SamplingParams
+
+        cfg = llama.LlamaConfig(
+            vocab_size=512, dim=32, n_layers=2, n_heads=2, n_kv_heads=2,
+            ffn_dim=64, max_seq_len=256, dtype="float32",
+            n_experts=4, top_k_experts=2,
+        )
+        params = llama.init_params(jax.random.PRNGKey(3), cfg)
+        eng = LLMEngine(
+            cfg, params, max_slots=2, max_model_len=64, page_size=16,
+            prefill_buckets=(32,), kv_dtype=jnp.float32, seed=0,
+        )
+        try:
+            p = SamplingParams(max_tokens=8, temperature=0.0)
+            got = eng.generate("mixture of experts", p)
+
+            ids = list(eng.tokenizer.encode("mixture of experts"))
+            gen = []
+            for _ in range(8):
+                lg = llama.forward(
+                    params, jnp.asarray([ids + gen], jnp.int32), cfg,
+                    attn_impl="xla",
+                )
+                nxt = int(jnp.argmax(lg[0, -1]))
+                if nxt == eng.tokenizer.eos_id:
+                    break
+                gen.append(nxt)
+            want = eng.tokenizer.decode(gen)
+            assert got == want
+        finally:
+            eng.stop()
+
+
+class TestMoECapacityRouted:
+    def test_capacity_matches_nodrop_when_generous(self, jax):
+        """With capacity >= all tokens, the GShard-dispatched SwiGLU path
+        equals the no-drop serving path (dropping is the only difference)."""
+        from modal_examples_tpu.models import moe
+
+        T, D, F, E, k = 16, 32, 64, 4, 2
+        keys = jax.random.split(jax.random.PRNGKey(2), 5)
+        router = jax.random.normal(keys[0], (D, E)) * D**-0.5
+        wg = jax.random.normal(keys[1], (E, D, F)) * D**-0.5
+        wu = jax.random.normal(keys[2], (E, D, F)) * D**-0.5
+        wd = jax.random.normal(keys[3], (E, F, D)) * F**-0.5
+        x = jax.random.normal(keys[4], (T, D))
+
+        want, aux_a = moe.moe_swiglu_nodrop(router, wg, wu, wd, x, k)
+        got, aux_b = moe.moe_swiglu_capacity(
+            router, wg, wu, wd, x, k, capacity_factor=100.0
+        )
+        np.testing.assert_allclose(np.asarray(got), np.asarray(want), atol=1e-4)
+        np.testing.assert_allclose(float(aux_a), float(aux_b), atol=1e-5)
+
+    def test_forward_capacity_impl_trains(self, jax):
+        from modal_examples_tpu.models import llama
+
+        cfg = llama.LlamaConfig(
+            vocab_size=64, dim=32, n_layers=2, n_heads=2, n_kv_heads=2,
+            ffn_dim=64, max_seq_len=64, dtype="float32",
+            n_experts=4, top_k_experts=2,
+        )
+        params = llama.init_params(jax.random.PRNGKey(0), cfg)
+        tokens = jax.random.randint(jax.random.PRNGKey(1), (2, 16), 0, 64)
+        logits, aux = llama.forward(
+            params, tokens, cfg, attn_impl="xla", return_aux=True,
+            moe_impl="capacity",
+        )
+        assert logits.shape == (2, 16, 64)
+        assert float(aux) > 0

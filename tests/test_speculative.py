@@ -167,6 +167,38 @@ class TestEngineSpeculative:
         finally:
             eng.stop()
 
+    def test_top_p_is_http_400_in_spec_mode(self, jax):
+        """An unsupported-but-valid OpenAI field must come back as a JSON 400
+        (invalid_request_error), not a dropped connection."""
+        import json
+        import urllib.error
+        import urllib.request
+
+        from modal_examples_tpu.models import llama
+        from modal_examples_tpu.serving import OpenAIServer
+
+        eng = self._mk_engine(jax, speculative=(llama.LlamaConfig.tiny(), 2))
+        srv = OpenAIServer(eng, model_name="spec", host="127.0.0.1", port=0)
+        srv.start()
+        try:
+            body = json.dumps(
+                {"prompt": "x", "max_tokens": 4, "top_p": 0.9}
+            ).encode()
+            req = urllib.request.Request(
+                f"http://127.0.0.1:{srv.port}/v1/completions",
+                data=body,
+                headers={"content-type": "application/json"},
+            )
+            with pytest.raises(urllib.error.HTTPError) as exc:
+                urllib.request.urlopen(req)
+            assert exc.value.code == 400
+            err = json.load(exc.value)
+            assert err["error"]["type"] == "invalid_request_error"
+            assert "top_p" in err["error"]["message"]
+        finally:
+            srv.httpd.shutdown()
+            eng.stop()
+
 
 class TestVerifyStep:
     def test_verify_matches_sequential_decode(self, jax):
