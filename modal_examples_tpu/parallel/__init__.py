@@ -14,6 +14,7 @@ _LAZY = {
     "FSDP": "mesh",
     "SEQ": "mesh",
     "TENSOR": "mesh",
+    "fsdp_specs": "mesh",
     "make_mesh": "mesh",
     "replicated": "mesh",
     "sharding": "mesh",

@@ -98,6 +98,34 @@ def make_mesh(
     return Mesh(dev_array, tuple(names))
 
 
+def fsdp_specs(params, mesh: Mesh, *, axis: str = FSDP, min_size: int = 2**12):
+    """Derive ZeRO-3/FSDP PartitionSpecs for an arbitrary param pytree: every
+    sufficiently large leaf is sharded along its largest axis-divisible dim
+    over ``axis``; small leaves (norms, biases) stay replicated.
+
+    Under jit, GSPMD turns these annotations into exactly the FSDP schedule
+    the reference delegates to torch FSDP/verl (grpo_verl.py:176-202,
+    SURVEY.md §2.3): per-layer all-gather of the shard on use, reduce-scatter
+    of the gradients, and optimizer state that lives sharded — optax init
+    under jit propagates the param shardings to the moment buffers, so
+    per-device memory for params+grads+optimizer shrinks ~linearly with the
+    axis size (proven by tests/test_parallel.py::TestFSDP).
+    """
+    n = mesh.shape[axis]
+
+    def spec_for(leaf):
+        shape = getattr(leaf, "shape", ())
+        if not shape or math.prod(shape) < min_size:
+            return P()
+        dims = sorted(range(len(shape)), key=lambda d: shape[d], reverse=True)
+        for d in dims:
+            if shape[d] % n == 0:
+                return P(*(axis if i == d else None for i in range(len(shape))))
+        return P()
+
+    return jax.tree.map(spec_for, params)
+
+
 def single_device_mesh() -> Mesh:
     return Mesh(np.asarray(jax.devices()[:1]), (DATA,))
 

@@ -168,3 +168,82 @@ class TestClustered:
     def test_cluster_info_outside_raises(self):
         with pytest.raises(RuntimeError):
             mtpu.experimental.get_cluster_info()
+
+
+class TestFSDP:
+    """ZeRO/FSDP semantics proof (VERDICT #10): sharding params + optimizer
+    state over the fsdp axis must actually shrink per-device memory ~linearly
+    with mesh size, while training stays correct (same losses as unsharded)."""
+
+    @staticmethod
+    def _device0_bytes(jax, tree):
+        d0 = jax.devices()[0]
+        total = 0
+        for leaf in jax.tree.leaves(tree):
+            if not hasattr(leaf, "addressable_shards"):
+                continue
+            for sh in leaf.addressable_shards:
+                if sh.device == d0:
+                    total += sh.data.nbytes
+        return total
+
+    def _train(self, jax, n_shards):
+        import jax.numpy as jnp
+        from jax.sharding import PartitionSpec as P
+
+        from modal_examples_tpu.models import llama
+        from modal_examples_tpu.parallel import fsdp_specs, make_mesh
+        from modal_examples_tpu.training import (
+            Trainer, cross_entropy_loss, make_optimizer,
+        )
+
+        cfg = llama.LlamaConfig(
+            vocab_size=512, dim=128, n_layers=2, n_heads=4, n_kv_heads=4,
+            ffn_dim=256, max_seq_len=64, dtype="float32",
+        )
+        params = llama.init_params(jax.random.PRNGKey(0), cfg)
+
+        def loss_fn(p, batch):
+            lg = llama.forward(p, batch["tokens"], cfg, attn_impl="xla")
+            return cross_entropy_loss(lg[:, :-1], batch["tokens"][:, 1:])
+
+        mesh = make_mesh({"fsdp": n_shards})
+        t = Trainer(
+            loss_fn, make_optimizer(1e-2), mesh=mesh,
+            param_specs=fsdp_specs(params, mesh), batch_spec=P("fsdp"),
+        )
+        state = t.init_state(params)
+        tokens = jax.random.randint(jax.random.PRNGKey(1), (8, 32), 0, 512)
+        losses = []
+        for _ in range(3):
+            state, m = t.train_step(state, t.shard_batch({"tokens": tokens}))
+            losses.append(float(m["loss"]))
+        return state, losses
+
+    def test_memory_shrinks_linearly_and_training_matches(self, jax):
+        state1, losses1 = self._train(jax, 1)
+        bytes1 = self._device0_bytes(jax, (state1.params, state1.opt_state))
+        state8, losses8 = self._train(jax, 8)
+        bytes8 = self._device0_bytes(jax, (state8.params, state8.opt_state))
+
+        # params+optimizer on device 0 must shrink ~linearly (small replicated
+        # norm leaves keep it from exactly 8x; require > 4x)
+        assert bytes8 < bytes1 / 4, (bytes1, bytes8)
+        # and the sharded run must train identically (same data, same init)
+        np.testing.assert_allclose(losses8, losses1, rtol=2e-3)
+
+    def test_opt_state_is_sharded(self, jax):
+        from jax.sharding import PartitionSpec as P
+
+        state8, _ = self._train(jax, 8)
+        # adam moments for the big matrices must carry the fsdp spec, not be
+        # replicated (ZeRO: optimizer state partitioned like the params)
+        sharded = [
+            leaf
+            for leaf in jax.tree.leaves(state8.opt_state)
+            if hasattr(leaf, "sharding")
+            and leaf.ndim >= 2
+            and any(ax == "fsdp" for axes in (leaf.sharding.spec or ()) if axes
+                    for ax in (axes if isinstance(axes, tuple) else (axes,)))
+        ]
+        assert sharded, "no fsdp-sharded optimizer-state leaves found"
