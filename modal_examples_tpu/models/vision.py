@@ -1,0 +1,304 @@
+"""Vision: single-stage anchor-free object detector (YOLO/FCOS family).
+
+The reference's vision workloads delegate to torch CUDA models
+(/root/reference/06_gpu_and_ml/yolo/finetune_yolo.py — ultralytics YOLO
+fine-tune; sam/segment_anything.py — SAM inference). This module is the
+TPU-native counterpart: a from-scratch JAX detector whose convolutions XLA
+maps onto the MXU, trained/fine-tuned with the same Trainer the LLM
+workloads use.
+
+Architecture (anchor-free, FCOS-style single level):
+- conv backbone: stride-2 conv stem + N conv blocks with group norm + silu
+  (NHWC layout — the TPU-friendly convention; channels-last keeps the MXU
+  contraction on the last dim);
+- detection head per grid cell: objectness logit, class logits, and an
+  ltrb box regressed via softplus (distances from the cell center, in
+  cell units — always positive, no anchors to tune);
+- loss: BCE on objectness (all cells), CE on class + IoU-loss on boxes
+  (positive cells only) — the standard one-positive-per-target assignment
+  (the cell containing the box center).
+
+Everything is jit-compatible with static shapes: images are [B, H, W, 3],
+targets are padded to ``max_boxes`` with a validity mask.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+
+
+@dataclasses.dataclass(frozen=True)
+class DetectorConfig:
+    image_size: int = 64  # square inputs
+    n_classes: int = 3
+    width: int = 32  # stem channels
+    depth: int = 2  # conv blocks after the stem
+    stride: int = 8  # total downsample: grid = image_size // stride
+    max_boxes: int = 8  # padded targets per image
+    dtype: str = "float32"
+
+    @property
+    def grid(self) -> int:
+        return self.image_size // self.stride
+
+    @property
+    def jnp_dtype(self):
+        return jnp.dtype(self.dtype)
+
+
+def _conv(key, k, cin, cout, dtype):
+    scale = (k * k * cin) ** -0.5
+    return jax.random.normal(key, (k, k, cin, cout), dtype) * scale
+
+
+def init_params(key: jax.Array, cfg: DetectorConfig) -> dict:
+    dt = cfg.jnp_dtype
+    w = cfg.width
+    keys = jax.random.split(key, cfg.depth + 4)
+    # stem: two stride-2 convs (x4 down), then blocks; remaining stride via
+    # a final stride-2 conv when cfg.stride == 8
+    params = {
+        "stem1": _conv(keys[0], 3, 3, w, dt),
+        "stem2": _conv(keys[1], 3, w, 2 * w, dt),
+        "down": _conv(keys[2], 3, 2 * w, 2 * w, dt),
+        "blocks": [
+            {"conv": _conv(keys[3 + i], 3, 2 * w, 2 * w, dt),
+             "gn_scale": jnp.ones((2 * w,), dt),
+             "gn_bias": jnp.zeros((2 * w,), dt)}
+            for i in range(cfg.depth)
+        ],
+        # head: 1x1 conv -> [obj(1), classes, ltrb(4)]
+        "head": _conv(keys[-1], 1, 2 * w, 1 + cfg.n_classes + 4, dt),
+        "head_bias": jnp.zeros((1 + cfg.n_classes + 4,), dt),
+    }
+    return params
+
+
+def _conv2d(x, w, stride=1):
+    return jax.lax.conv_general_dilated(
+        x, w, (stride, stride), "SAME",
+        dimension_numbers=("NHWC", "HWIO", "NHWC"),
+    )
+
+
+def _group_norm(x, scale, bias, groups=8, eps=1e-5):
+    B, H, W, C = x.shape
+    xg = x.reshape(B, H, W, groups, C // groups)
+    mean = xg.mean(axis=(1, 2, 4), keepdims=True)
+    var = xg.var(axis=(1, 2, 4), keepdims=True)
+    xg = (xg - mean) * jax.lax.rsqrt(var + eps)
+    return xg.reshape(B, H, W, C) * scale + bias
+
+
+def forward(params: dict, images: jax.Array, cfg: DetectorConfig) -> dict:
+    """images [B, S, S, 3] in [0, 1] -> per-cell predictions.
+
+    Returns dict with obj [B, G, G], cls [B, G, G, n_classes],
+    ltrb [B, G, G, 4] (positive distances in cell units).
+    """
+    x = images.astype(cfg.jnp_dtype)
+    x = jax.nn.silu(_conv2d(x, params["stem1"], stride=2))
+    x = jax.nn.silu(_conv2d(x, params["stem2"], stride=2))
+    if cfg.stride == 8:
+        x = jax.nn.silu(_conv2d(x, params["down"], stride=2))
+    for blk in params["blocks"]:
+        h = _group_norm(x, blk["gn_scale"], blk["gn_bias"])
+        x = x + jax.nn.silu(_conv2d(h, blk["conv"]))
+    out = _conv2d(x, params["head"]) + params["head_bias"]
+    n_cls = cfg.n_classes
+    return {
+        "obj": out[..., 0],
+        "cls": out[..., 1 : 1 + n_cls],
+        "ltrb": jax.nn.softplus(out[..., 1 + n_cls :]),
+    }
+
+
+# -- target assignment + loss ------------------------------------------------
+
+
+def _cell_targets(boxes, labels, mask, cfg: DetectorConfig):
+    """Rasterize padded targets onto the grid (one positive cell per box:
+    the cell containing the box center). boxes are [max_boxes, 4] xyxy in
+    image pixels; returns (obj_t [G,G], cls_t [G,G], ltrb_t [G,G,4],
+    pos [G,G])."""
+    G, s = cfg.grid, cfg.stride
+    obj_t = jnp.zeros((G, G))
+    cls_t = jnp.zeros((G, G), jnp.int32)
+    ltrb_t = jnp.zeros((G, G, 4))
+
+    def add_box(carry, i):
+        obj_t, cls_t, ltrb_t = carry
+        x1, y1, x2, y2 = boxes[i]
+        cx, cy = (x1 + x2) / 2, (y1 + y2) / 2
+        gx = jnp.clip((cx / s).astype(jnp.int32), 0, G - 1)
+        gy = jnp.clip((cy / s).astype(jnp.int32), 0, G - 1)
+        # distances from the positive cell's center, in cell units
+        ccx, ccy = (gx + 0.5) * s, (gy + 0.5) * s
+        tgt = jnp.stack([ccx - x1, ccy - y1, x2 - ccx, y2 - ccy]) / s
+        valid = mask[i]
+        obj_t = obj_t.at[gy, gx].set(jnp.where(valid, 1.0, obj_t[gy, gx]))
+        cls_t = cls_t.at[gy, gx].set(jnp.where(valid, labels[i], cls_t[gy, gx]))
+        ltrb_t = ltrb_t.at[gy, gx].set(
+            jnp.where(valid, tgt, ltrb_t[gy, gx])
+        )
+        return (obj_t, cls_t, ltrb_t), None
+
+    (obj_t, cls_t, ltrb_t), _ = jax.lax.scan(
+        add_box, (obj_t, cls_t, ltrb_t), jnp.arange(cfg.max_boxes)
+    )
+    return obj_t, cls_t, ltrb_t, obj_t > 0.5
+
+
+def _iou_ltrb(a, b, eps=1e-6):
+    """IoU of two ltrb distance-boxes around a shared center point."""
+    inter_w = jnp.minimum(a[..., 0], b[..., 0]) + jnp.minimum(a[..., 2], b[..., 2])
+    inter_h = jnp.minimum(a[..., 1], b[..., 1]) + jnp.minimum(a[..., 3], b[..., 3])
+    inter = jnp.clip(inter_w, 0) * jnp.clip(inter_h, 0)
+    area_a = (a[..., 0] + a[..., 2]) * (a[..., 1] + a[..., 3])
+    area_b = (b[..., 0] + b[..., 2]) * (b[..., 1] + b[..., 3])
+    return inter / (area_a + area_b - inter + eps)
+
+
+def detection_loss(params, batch, cfg: DetectorConfig):
+    """batch: images [B,S,S,3], boxes [B,max_boxes,4] xyxy px,
+    labels [B,max_boxes] int32, box_mask [B,max_boxes] bool."""
+    preds = forward(params, batch["images"], cfg)
+    obj_t, cls_t, ltrb_t, pos = jax.vmap(
+        lambda b, l, m: _cell_targets(b, l, m, cfg)
+    )(batch["boxes"], batch["labels"], batch["box_mask"])
+
+    obj = preds["obj"].astype(jnp.float32)
+    obj_loss = jnp.mean(
+        jnp.maximum(obj, 0) - obj * obj_t + jnp.log1p(jnp.exp(-jnp.abs(obj)))
+    )
+    n_pos = jnp.maximum(pos.sum(), 1.0)
+
+    logp = jax.nn.log_softmax(preds["cls"].astype(jnp.float32), axis=-1)
+    cls_nll = -jnp.take_along_axis(logp, cls_t[..., None], axis=-1)[..., 0]
+    cls_loss = jnp.sum(cls_nll * pos) / n_pos
+
+    iou = _iou_ltrb(preds["ltrb"].astype(jnp.float32), ltrb_t)
+    box_loss = jnp.sum((1.0 - iou) * pos) / n_pos
+    return obj_loss + cls_loss + 2.0 * box_loss
+
+
+# -- inference ---------------------------------------------------------------
+
+
+def decode_boxes(preds: dict, cfg: DetectorConfig):
+    """Per-cell predictions -> (boxes [B,G*G,4] xyxy px, scores [B,G*G],
+    classes [B,G*G]). Static shapes: all cells are returned — callers filter
+    by score via nms_host (cheap on the host at G*G<=256 candidates,
+    matching how the reference's exported models postprocess
+    off-accelerator)."""
+    G, s = cfg.grid, cfg.stride
+    cy, cx = jnp.mgrid[0:G, 0:G]
+    ccx = (cx + 0.5) * s
+    ccy = (cy + 0.5) * s
+    ltrb = preds["ltrb"].astype(jnp.float32) * s
+    boxes = jnp.stack(
+        [ccx - ltrb[..., 0], ccy - ltrb[..., 1],
+         ccx + ltrb[..., 2], ccy + ltrb[..., 3]],
+        axis=-1,
+    )  # [B, G, G, 4]
+    scores = jax.nn.sigmoid(preds["obj"].astype(jnp.float32))
+    classes = jnp.argmax(preds["cls"], axis=-1)
+    B = boxes.shape[0]
+    return (
+        boxes.reshape(B, G * G, 4),
+        scores.reshape(B, G * G),
+        classes.reshape(B, G * G),
+    )
+
+
+def nms_host(boxes, scores, classes, *, score_thresh=0.5, iou_thresh=0.5):
+    """Greedy per-class NMS on the host (numpy); boxes [N,4] xyxy."""
+    import numpy as np
+
+    boxes, scores, classes = map(np.asarray, (boxes, scores, classes))
+    keep = []
+    order = np.argsort(-scores)
+    order = [i for i in order if scores[i] >= score_thresh]
+    while order:
+        i = order.pop(0)
+        keep.append(i)
+        rest = []
+        for j in order:
+            if classes[j] != classes[i]:
+                rest.append(j)
+                continue
+            xx1 = max(boxes[i, 0], boxes[j, 0])
+            yy1 = max(boxes[i, 1], boxes[j, 1])
+            xx2 = min(boxes[i, 2], boxes[j, 2])
+            yy2 = min(boxes[i, 3], boxes[j, 3])
+            inter = max(0.0, xx2 - xx1) * max(0.0, yy2 - yy1)
+            a = (boxes[i, 2] - boxes[i, 0]) * (boxes[i, 3] - boxes[i, 1])
+            b = (boxes[j, 2] - boxes[j, 0]) * (boxes[j, 3] - boxes[j, 1])
+            if inter / (a + b - inter + 1e-6) < iou_thresh:
+                rest.append(j)
+        order = rest
+    return keep
+
+
+# -- synthetic shapes dataset (cheap-mode fine-tune data) --------------------
+
+
+def synthetic_batch(key: jax.Array, batch: int, cfg: DetectorConfig) -> dict:
+    """Geometric-shapes detection data, generated on device: each image has
+    1..max shapes (filled rectangle=0 / cross=1 / stripe=2) on a noisy
+    background — the cheap-mode stand-in for a real labeled dataset, playing
+    the role of the reference's tiny-split fine-tune switches (SURVEY.md §4:
+    max_train_samples=5, down_scale=0.001)."""
+    S = cfg.image_size
+    kb, kn, kc = jax.random.split(key, 3)
+    n_boxes = min(2, cfg.max_boxes)
+    keys = jax.random.split(kb, batch * n_boxes * 2).reshape(batch, n_boxes, 2, 2)
+
+    def one_box(k):
+        kxy, kwh = k
+        wh = jax.random.uniform(kwh, (2,), minval=12.0, maxval=24.0)
+        xy = jax.random.uniform(kxy, (2,), minval=2.0, maxval=S - 26.0)
+        return jnp.concatenate([xy, xy + wh])  # xyxy
+
+    boxes = jax.vmap(jax.vmap(one_box))(keys)  # [B, n, 4]
+    labels = jax.random.randint(kc, (batch, n_boxes), 0, cfg.n_classes)
+
+    yy, xx = jnp.mgrid[0:S, 0:S]
+
+    def paint(boxes_i, labels_i):
+        img = jnp.zeros((S, S))
+
+        def add(img, bl):
+            box, lab = bl
+            x1, y1, x2, y2 = box
+            inside = (xx >= x1) & (xx < x2) & (yy >= y1) & (yy < y2)
+            cx, cy = (x1 + x2) / 2, (y1 + y2) / 2
+            cross = inside & (
+                (jnp.abs(xx - cx) < 2) | (jnp.abs(yy - cy) < 2)
+            )
+            stripe = inside & (((xx + yy) % 8) < 4)
+            shape = jnp.where(
+                lab == 0, inside, jnp.where(lab == 1, cross, stripe)
+            )
+            return jnp.maximum(img, shape.astype(jnp.float32)), None
+
+        img, _ = jax.lax.scan(add, img, (boxes_i, labels_i))
+        return img
+
+    imgs = jax.vmap(paint)(boxes, labels)  # [B, S, S]
+    noise = 0.1 * jax.random.uniform(kn, (batch, S, S))
+    imgs = jnp.clip(imgs * 0.9 + noise, 0, 1)
+    images = jnp.repeat(imgs[..., None], 3, axis=-1)
+
+    pad = cfg.max_boxes - n_boxes
+    return {
+        "images": images,
+        "boxes": jnp.pad(boxes, ((0, 0), (0, pad), (0, 0))),
+        "labels": jnp.pad(labels, ((0, 0), (0, pad))),
+        "box_mask": jnp.pad(
+            jnp.ones((batch, n_boxes), bool), ((0, 0), (0, pad))
+        ),
+    }

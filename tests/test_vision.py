@@ -1,0 +1,133 @@
+"""Vision detector tests (VERDICT #8): the JAX counterpart of the
+reference's torch vision family (yolo/finetune_yolo.py fine-tune loop,
+sam/segment_anything.py inference service). e2e contract: a train step
+decreases the loss, and a short fine-tune on synthetic shapes localizes an
+easy box with IoU > 0.5."""
+
+import numpy as np
+import pytest
+
+
+@pytest.fixture(scope="module")
+def jax(jax_cpu):
+    return jax_cpu
+
+
+@pytest.fixture(scope="module")
+def setup(jax):
+    from modal_examples_tpu.models import vision
+
+    cfg = vision.DetectorConfig(image_size=64, n_classes=3, width=16, depth=1)
+    params = vision.init_params(jax.random.PRNGKey(0), cfg)
+    return cfg, params
+
+
+def _xyxy_iou(a, b):
+    x1, y1 = max(a[0], b[0]), max(a[1], b[1])
+    x2, y2 = min(a[2], b[2]), min(a[3], b[3])
+    inter = max(0.0, x2 - x1) * max(0.0, y2 - y1)
+    area = lambda r: (r[2] - r[0]) * (r[3] - r[1])  # noqa: E731
+    return inter / (area(a) + area(b) - inter + 1e-6)
+
+
+class TestDetector:
+    def test_forward_shapes(self, jax, setup):
+        from modal_examples_tpu.models import vision
+
+        cfg, params = setup
+        batch = vision.synthetic_batch(jax.random.PRNGKey(1), 2, cfg)
+        preds = vision.forward(params, batch["images"], cfg)
+        G = cfg.grid
+        assert preds["obj"].shape == (2, G, G)
+        assert preds["cls"].shape == (2, G, G, 3)
+        assert preds["ltrb"].shape == (2, G, G, 4)
+        assert float(preds["ltrb"].min()) >= 0  # softplus distances
+
+    def test_cell_targets_roundtrip(self, jax, setup):
+        """decode_boxes on the rasterized targets must reproduce the input
+        box (assignment and decoding are inverses at the positive cell)."""
+        import jax.numpy as jnp
+
+        from modal_examples_tpu.models import vision
+
+        cfg, _ = setup
+        boxes = jnp.zeros((cfg.max_boxes, 4)).at[0].set(
+            jnp.array([10.0, 18.0, 34.0, 40.0])
+        )
+        labels = jnp.zeros((cfg.max_boxes,), jnp.int32).at[0].set(2)
+        mask = jnp.zeros((cfg.max_boxes,), bool).at[0].set(True)
+        obj_t, cls_t, ltrb_t, pos = vision._cell_targets(boxes, labels, mask, cfg)
+        assert int(pos.sum()) == 1
+        gy, gx = np.unravel_index(int(np.argmax(np.asarray(obj_t))), obj_t.shape)
+        assert int(cls_t[gy, gx]) == 2
+        preds = {
+            "obj": obj_t[None] * 100 - 50,  # logits: positive cell >> 0
+            "cls": jnp.eye(3)[cls_t][None] * 10,
+            "ltrb": ltrb_t[None],
+        }
+        bxs, scores, classes = vision.decode_boxes(preds, cfg)
+        best = int(np.argmax(np.asarray(scores[0])))
+        np.testing.assert_allclose(
+            np.asarray(bxs[0, best]), [10.0, 18.0, 34.0, 40.0], atol=1e-3
+        )
+        assert int(classes[0, best]) == 2
+
+    def test_train_step_decreases_loss(self, jax, setup):
+        from modal_examples_tpu.models import vision
+        from modal_examples_tpu.training import Trainer, make_optimizer
+
+        cfg, _ = setup
+        # fresh params: train_step donates the state, which would delete the
+        # module fixture's buffers
+        params = vision.init_params(jax.random.PRNGKey(0), cfg)
+        batch = vision.synthetic_batch(jax.random.PRNGKey(2), 8, cfg)
+        t = Trainer(
+            lambda p, b: vision.detection_loss(p, b, cfg), make_optimizer(1e-3)
+        )
+        state = t.init_state(params)
+        first = None
+        for _ in range(10):
+            state, m = t.train_step(state, batch)
+            first = first if first is not None else float(m["loss"])
+        assert float(m["loss"]) < first
+
+    def test_short_finetune_localizes_golden_box(self, jax, setup):
+        """Fine-tune briefly on the synthetic shapes, then the top
+        detection on a held-out image must hit the true box with IoU > 0.5
+        (the end-to-end check the reference does by WER/weights-roundtrip
+        for ASR — here by localization quality)."""
+        from modal_examples_tpu.models import vision
+        from modal_examples_tpu.training import Trainer, make_optimizer
+
+        cfg, _ = setup
+        params = vision.init_params(jax.random.PRNGKey(0), cfg)
+        t = Trainer(
+            lambda p, b: vision.detection_loss(p, b, cfg), make_optimizer(3e-3)
+        )
+        state = t.init_state(params)
+        for i in range(60):
+            batch = vision.synthetic_batch(jax.random.PRNGKey(100 + i), 16, cfg)
+            state, m = t.train_step(state, batch)
+
+        held = vision.synthetic_batch(jax.random.PRNGKey(999), 4, cfg)
+        preds = vision.forward(state.params, held["images"], cfg)
+        boxes, scores, classes = vision.decode_boxes(preds, cfg)
+        hits = 0
+        for b in range(4):
+            best = int(np.argmax(np.asarray(scores[b])))
+            pred_box = np.asarray(boxes[b, best])
+            true = np.asarray(held["boxes"][b][np.asarray(held["box_mask"][b])])
+            iou = max(_xyxy_iou(pred_box, tb) for tb in true)
+            hits += iou > 0.5
+        assert hits >= 3, f"only {hits}/4 held-out images localized"
+
+    def test_nms_dedupes_overlaps(self, setup):
+        from modal_examples_tpu.models import vision
+
+        boxes = np.array(
+            [[10, 10, 30, 30], [11, 11, 31, 31], [50, 50, 60, 60]], np.float32
+        )
+        scores = np.array([0.9, 0.8, 0.7], np.float32)
+        classes = np.array([0, 0, 1])
+        keep = vision.nms_host(boxes, scores, classes, iou_thresh=0.5)
+        assert keep == [0, 2]
