@@ -1,0 +1,192 @@
+"""CLIP text encoder — the prompt side of the SD family.
+
+The reference's diffusion pipelines condition on CLIP text embeddings
+(text_to_image.py loads the full SD3.5 pipeline whose text encoders are
+CLIP-L/G (+T5); flux.py likewise). This is the TPU-native counterpart: the
+HF CLIPTextModel architecture in JAX with a safetensors loader, so a
+standard `text_encoder/model.safetensors` checkout drops in.
+
+Architecture (CLIPTextModel):
+- token + learned position embeddings;
+- pre-LN transformer with causal attention and quick_gelu MLP;
+- final layer norm; pooled output = hidden state at each sequence's
+  EOS token (the highest token id in CLIP's vocab convention).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from pathlib import Path
+
+import jax
+import jax.numpy as jnp
+
+from . import layers
+
+
+@dataclasses.dataclass(frozen=True)
+class CLIPTextConfig:
+    vocab_size: int = 49408
+    dim: int = 768
+    n_layers: int = 12
+    n_heads: int = 12
+    max_len: int = 77
+    eos_token_id: int = 49407
+    norm_eps: float = 1e-5
+    dtype: str = "float32"
+
+    @property
+    def jnp_dtype(self):
+        return jnp.dtype(self.dtype)
+
+    @staticmethod
+    def clip_l() -> "CLIPTextConfig":
+        """CLIP-L/14 text tower (SD1/2/XL/3 primary text encoder)."""
+        return CLIPTextConfig()
+
+    @staticmethod
+    def tiny(vocab_size: int = 512) -> "CLIPTextConfig":
+        return CLIPTextConfig(
+            vocab_size=vocab_size, dim=64, n_layers=2, n_heads=2, max_len=32,
+            eos_token_id=vocab_size - 1,
+        )
+
+
+def init_params(key: jax.Array, cfg: CLIPTextConfig) -> dict:
+    dt = cfg.jnp_dtype
+    D, L = cfg.dim, cfg.n_layers
+    ks = iter(jax.random.split(key, 12))
+
+    def dense(*shape):
+        return layers.init_dense(next(ks), shape, dtype=dt)
+
+    return {
+        "token_emb": layers.init_dense(
+            next(ks), (cfg.vocab_size, D), scale=0.02, dtype=dt
+        ),
+        "pos_emb": layers.init_dense(next(ks), (cfg.max_len, D), scale=0.02, dtype=dt),
+        "layers": {
+            "ln1_scale": jnp.ones((L, D), dt), "ln1_bias": jnp.zeros((L, D), dt),
+            "wq": dense(L, D, D), "bq": jnp.zeros((L, D), dt),
+            "wk": dense(L, D, D), "bk": jnp.zeros((L, D), dt),
+            "wv": dense(L, D, D), "bv": jnp.zeros((L, D), dt),
+            "wo": dense(L, D, D), "bo": jnp.zeros((L, D), dt),
+            "ln2_scale": jnp.ones((L, D), dt), "ln2_bias": jnp.zeros((L, D), dt),
+            "fc1": dense(L, D, 4 * D), "fc1_b": jnp.zeros((L, 4 * D), dt),
+            "fc2": dense(L, 4 * D, D), "fc2_b": jnp.zeros((L, D), dt),
+        },
+        "final_ln_scale": jnp.ones((D,), dt),
+        "final_ln_bias": jnp.zeros((D,), dt),
+    }
+
+
+def _ln(x, scale, bias, eps):
+    mu = jnp.mean(x, axis=-1, keepdims=True)
+    var = jnp.var(x, axis=-1, keepdims=True)
+    return (x - mu) * jax.lax.rsqrt(var + eps) * scale + bias
+
+
+def _quick_gelu(x):
+    return x * jax.nn.sigmoid(1.702 * x)
+
+
+def forward(
+    params: dict,
+    tokens: jax.Array,  # [B, S] int32 (padded to max_len or shorter)
+    cfg: CLIPTextConfig,
+) -> tuple[jax.Array, jax.Array]:
+    """Returns (hidden [B, S, D] — the per-token states diffusion models
+    cross-attend to, pooled [B, D] — the EOS-position state)."""
+    B, S = tokens.shape
+    x = params["token_emb"][tokens] + params["pos_emb"][None, :S]
+    mask = jnp.tril(jnp.ones((S, S), bool))  # causal (CLIP convention)
+
+    def layer_fn(x, l):
+        h = _ln(x, l["ln1_scale"], l["ln1_bias"], cfg.norm_eps)
+        q = h @ l["wq"] + l["bq"]
+        k = h @ l["wk"] + l["bk"]
+        v = h @ l["wv"] + l["bv"]
+        hd = cfg.dim // cfg.n_heads
+        q = q.reshape(B, S, cfg.n_heads, hd).transpose(0, 2, 1, 3)
+        k = k.reshape(B, S, cfg.n_heads, hd).transpose(0, 2, 1, 3)
+        v = v.reshape(B, S, cfg.n_heads, hd).transpose(0, 2, 1, 3)
+        s = jnp.einsum("bhqd,bhkd->bhqk", q, k, preferred_element_type=jnp.float32)
+        s = jnp.where(mask[None, None], s * hd**-0.5, -jnp.inf)
+        a = jax.nn.softmax(s, axis=-1).astype(v.dtype)
+        o = jnp.einsum("bhqk,bhkd->bhqd", a, v)
+        o = o.transpose(0, 2, 1, 3).reshape(B, S, cfg.dim)
+        x = x + (o @ l["wo"] + l["bo"])
+        h = _ln(x, l["ln2_scale"], l["ln2_bias"], cfg.norm_eps)
+        h = _quick_gelu(h @ l["fc1"] + l["fc1_b"]) @ l["fc2"] + l["fc2_b"]
+        return x + h, None
+
+    x, _ = jax.lax.scan(layer_fn, x, params["layers"])
+    hidden = _ln(x, params["final_ln_scale"], params["final_ln_bias"], cfg.norm_eps)
+    # pooled = state at the first EOS token per sequence (CLIP convention)
+    is_eos = tokens == cfg.eos_token_id
+    idx = jnp.where(
+        is_eos.any(axis=1), jnp.argmax(is_eos, axis=1), S - 1
+    )  # [B]
+    pooled = jnp.take_along_axis(
+        hidden, idx[:, None, None].repeat(cfg.dim, -1), axis=1
+    )[:, 0]
+    return hidden, pooled
+
+
+# -- HF (transformers CLIPTextModel) interop ---------------------------------
+
+
+def load_hf_weights(model_dir: str | Path, cfg: CLIPTextConfig, dtype=None) -> dict:
+    """Map a transformers CLIPTextModel safetensors checkpoint
+    (text_encoder/model.safetensors naming) into this tree."""
+    import numpy as np
+    from safetensors import safe_open
+
+    dt = dtype or cfg.jnp_dtype
+    raw = {}
+    for f in sorted(Path(model_dir).glob("*.safetensors")):
+        with safe_open(str(f), framework="np") as sf:
+            for name in sf.keys():
+                raw[name] = sf.get_tensor(name)
+
+    P = "text_model."
+
+    def stack_lin(fmt):
+        return jnp.asarray(
+            np.stack([raw.pop(fmt.format(i)).T for i in range(cfg.n_layers)]), dt
+        )
+
+    def stack_vec(fmt):
+        return jnp.asarray(
+            np.stack([raw.pop(fmt.format(i)) for i in range(cfg.n_layers)]), dt
+        )
+
+    E = P + "encoder.layers.{}."
+    return {
+        "token_emb": jnp.asarray(
+            raw.pop(P + "embeddings.token_embedding.weight"), dt
+        ),
+        "pos_emb": jnp.asarray(
+            raw.pop(P + "embeddings.position_embedding.weight"), dt
+        ),
+        "layers": {
+            "ln1_scale": stack_vec(E + "layer_norm1.weight"),
+            "ln1_bias": stack_vec(E + "layer_norm1.bias"),
+            "wq": stack_lin(E + "self_attn.q_proj.weight"),
+            "bq": stack_vec(E + "self_attn.q_proj.bias"),
+            "wk": stack_lin(E + "self_attn.k_proj.weight"),
+            "bk": stack_vec(E + "self_attn.k_proj.bias"),
+            "wv": stack_lin(E + "self_attn.v_proj.weight"),
+            "bv": stack_vec(E + "self_attn.v_proj.bias"),
+            "wo": stack_lin(E + "self_attn.out_proj.weight"),
+            "bo": stack_vec(E + "self_attn.out_proj.bias"),
+            "ln2_scale": stack_vec(E + "layer_norm2.weight"),
+            "ln2_bias": stack_vec(E + "layer_norm2.bias"),
+            "fc1": stack_lin(E + "mlp.fc1.weight"),
+            "fc1_b": stack_vec(E + "mlp.fc1.bias"),
+            "fc2": stack_lin(E + "mlp.fc2.weight"),
+            "fc2_b": stack_vec(E + "mlp.fc2.bias"),
+        },
+        "final_ln_scale": jnp.asarray(raw.pop(P + "final_layer_norm.weight"), dt),
+        "final_ln_bias": jnp.asarray(raw.pop(P + "final_layer_norm.bias"), dt),
+    }

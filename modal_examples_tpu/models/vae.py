@@ -1,0 +1,367 @@
+"""VAE: the latent-space autoencoder of the SD family (AutoencoderKL).
+
+The reference serves SD3.5/Flux pipelines whose image side is a conv VAE
+(text_to_image.py:99-137 loads the full diffusers pipeline; the VAE decodes
+latents to pixels). This is the TPU-native counterpart: a diffusers
+AutoencoderKL-shape model in JAX/NHWC with an HF safetensors loader, so a
+standard `vae/diffusion_pytorch_model.safetensors` checkout drops in.
+
+Architecture (diffusers AutoencoderKL):
+- encoder: conv_in -> down blocks (2 resnets each, downsample conv between
+  levels) -> mid (resnet, attention, resnet) -> group-norm -> conv_out
+  producing 2*latent_channels (mean, logvar);
+- decoder: conv_in -> mid (resnet, attention, resnet) -> up blocks
+  (3 resnets each, nearest-2x upsample + conv between levels) -> conv_out;
+- scaling: latents are multiplied by ``scaling_factor`` after encode and
+  divided before decode (the SD convention diffusion models train against).
+
+NHWC layout throughout (TPU conv convention); weights stored HWIO.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from pathlib import Path
+
+import jax
+import jax.numpy as jnp
+
+
+@dataclasses.dataclass(frozen=True)
+class VAEConfig:
+    in_channels: int = 3
+    latent_channels: int = 4
+    base: int = 128  # first-level width
+    channel_mults: tuple = (1, 2, 4, 4)  # SD: 128/256/512/512, 8x down
+    scaling_factor: float = 0.18215  # SD1/2; SD3 uses 1.5305 (+shift)
+    shift_factor: float = 0.0  # SD3: 0.0609
+    norm_groups: int = 32
+    dtype: str = "float32"
+
+    @property
+    def jnp_dtype(self):
+        return jnp.dtype(self.dtype)
+
+    @property
+    def downscale(self) -> int:
+        return 2 ** (len(self.channel_mults) - 1)
+
+    @staticmethod
+    def sd_shape() -> "VAEConfig":
+        """The SD1/2/XL VAE shape (4-ch latents, 8x downsample)."""
+        return VAEConfig()
+
+    @staticmethod
+    def sd3_shape() -> "VAEConfig":
+        """SD3/Flux VAE: 16-channel latents."""
+        return VAEConfig(
+            latent_channels=16, scaling_factor=1.5305, shift_factor=0.0609
+        )
+
+    @staticmethod
+    def tiny() -> "VAEConfig":
+        return VAEConfig(base=32, channel_mults=(1, 2), norm_groups=8)
+
+
+def _conv_init(key, k, cin, cout, dtype):
+    scale = (k * k * cin) ** -0.5
+    return jax.random.normal(key, (k, k, cin, cout), dtype) * scale
+
+
+def _resnet_init(ks, cin, cout, dt):
+    k1, k2, k3 = jax.random.split(ks, 3)
+    p = {
+        "norm1_scale": jnp.ones((cin,), dt), "norm1_bias": jnp.zeros((cin,), dt),
+        "conv1": _conv_init(k1, 3, cin, cout, dt),
+        "conv1_b": jnp.zeros((cout,), dt),
+        "norm2_scale": jnp.ones((cout,), dt), "norm2_bias": jnp.zeros((cout,), dt),
+        "conv2": _conv_init(k2, 3, cout, cout, dt),
+        "conv2_b": jnp.zeros((cout,), dt),
+    }
+    if cin != cout:
+        p["shortcut"] = _conv_init(k3, 1, cin, cout, dt)
+        p["shortcut_b"] = jnp.zeros((cout,), dt)
+    return p
+
+
+def _attn_init(ks, c, dt):
+    k1, k2, k3, k4 = jax.random.split(ks, 4)
+    s = c**-0.5
+    return {
+        "norm_scale": jnp.ones((c,), dt), "norm_bias": jnp.zeros((c,), dt),
+        "q": jax.random.normal(k1, (c, c), dt) * s,
+        "q_b": jnp.zeros((c,), dt),
+        "k": jax.random.normal(k2, (c, c), dt) * s,
+        "k_b": jnp.zeros((c,), dt),
+        "v": jax.random.normal(k3, (c, c), dt) * s,
+        "v_b": jnp.zeros((c,), dt),
+        "o": jax.random.normal(k4, (c, c), dt) * s,
+        "o_b": jnp.zeros((c,), dt),
+    }
+
+
+def init_params(key: jax.Array, cfg: VAEConfig) -> dict:
+    dt = cfg.jnp_dtype
+    widths = [cfg.base * m for m in cfg.channel_mults]
+    ks = iter(jax.random.split(key, 64))
+    enc = {
+        "conv_in": _conv_init(next(ks), 3, cfg.in_channels, widths[0], dt),
+        "conv_in_b": jnp.zeros((widths[0],), dt),
+        "down": [],
+        "mid_res1": _resnet_init(next(ks), widths[-1], widths[-1], dt),
+        "mid_attn": _attn_init(next(ks), widths[-1], dt),
+        "mid_res2": _resnet_init(next(ks), widths[-1], widths[-1], dt),
+        "norm_out_scale": jnp.ones((widths[-1],), dt),
+        "norm_out_bias": jnp.zeros((widths[-1],), dt),
+        "conv_out": _conv_init(next(ks), 3, widths[-1], 2 * cfg.latent_channels, dt),
+        "conv_out_b": jnp.zeros((2 * cfg.latent_channels,), dt),
+    }
+    cin = widths[0]
+    for i, w in enumerate(widths):
+        blk = {
+            "res1": _resnet_init(next(ks), cin, w, dt),
+            "res2": _resnet_init(next(ks), w, w, dt),
+        }
+        if i < len(widths) - 1:
+            blk["downsample"] = _conv_init(next(ks), 3, w, w, dt)
+            blk["downsample_b"] = jnp.zeros((w,), dt)
+        enc["down"].append(blk)
+        cin = w
+
+    dec = {
+        "conv_in": _conv_init(next(ks), 3, cfg.latent_channels, widths[-1], dt),
+        "conv_in_b": jnp.zeros((widths[-1],), dt),
+        "mid_res1": _resnet_init(next(ks), widths[-1], widths[-1], dt),
+        "mid_attn": _attn_init(next(ks), widths[-1], dt),
+        "mid_res2": _resnet_init(next(ks), widths[-1], widths[-1], dt),
+        "up": [],
+        "norm_out_scale": jnp.ones((widths[0],), dt),
+        "norm_out_bias": jnp.zeros((widths[0],), dt),
+        "conv_out": _conv_init(next(ks), 3, widths[0], cfg.in_channels, dt),
+        "conv_out_b": jnp.zeros((cfg.in_channels,), dt),
+    }
+    cin = widths[-1]
+    for i, w in enumerate(reversed(widths)):
+        blk = {
+            "res1": _resnet_init(next(ks), cin, w, dt),
+            "res2": _resnet_init(next(ks), w, w, dt),
+            "res3": _resnet_init(next(ks), w, w, dt),
+        }
+        if i < len(widths) - 1:
+            blk["upsample"] = _conv_init(next(ks), 3, w, w, dt)
+            blk["upsample_b"] = jnp.zeros((w,), dt)
+        dec["up"].append(blk)
+        cin = w
+    return {"encoder": enc, "decoder": dec}
+
+
+def _conv(x, w, b, stride=1):
+    y = jax.lax.conv_general_dilated(
+        x, w, (stride, stride), "SAME",
+        dimension_numbers=("NHWC", "HWIO", "NHWC"),
+    )
+    return y + b
+
+
+def _gn(x, scale, bias, groups, eps=1e-6):
+    B, H, W, C = x.shape
+    xg = x.reshape(B, H, W, groups, C // groups)
+    mu = xg.mean(axis=(1, 2, 4), keepdims=True)
+    var = xg.var(axis=(1, 2, 4), keepdims=True)
+    xg = (xg - mu) * jax.lax.rsqrt(var + eps)
+    return xg.reshape(B, H, W, C) * scale + bias
+
+
+def _resnet(p, x, groups):
+    h = jax.nn.silu(_gn(x, p["norm1_scale"], p["norm1_bias"], groups))
+    h = _conv(h, p["conv1"], p["conv1_b"])
+    h = jax.nn.silu(_gn(h, p["norm2_scale"], p["norm2_bias"], groups))
+    h = _conv(h, p["conv2"], p["conv2_b"])
+    if "shortcut" in p:
+        x = _conv(x, p["shortcut"], p["shortcut_b"])
+    return x + h
+
+
+def _attn(p, x, groups):
+    B, H, W, C = x.shape
+    h = _gn(x, p["norm_scale"], p["norm_bias"], groups)
+    flat = h.reshape(B, H * W, C)
+    q = flat @ p["q"] + p["q_b"]
+    k = flat @ p["k"] + p["k_b"]
+    v = flat @ p["v"] + p["v_b"]
+    s = jnp.einsum("bqc,bkc->bqk", q, k, preferred_element_type=jnp.float32)
+    a = jax.nn.softmax(s * C**-0.5, axis=-1).astype(v.dtype)
+    o = jnp.einsum("bqk,bkc->bqc", a, v) @ p["o"] + p["o_b"]
+    return x + o.reshape(B, H, W, C)
+
+
+def encode(
+    params: dict, images: jax.Array, cfg: VAEConfig, *, key=None
+) -> jax.Array:
+    """images [B, H, W, C] in [-1, 1] -> latents [B, H/8, W/8, Cl] (scaled).
+    With ``key`` the posterior is sampled; without, the mean is returned."""
+    g = cfg.norm_groups
+    p = params["encoder"]
+    x = _conv(images.astype(cfg.jnp_dtype), p["conv_in"], p["conv_in_b"])
+    for i, blk in enumerate(p["down"]):
+        x = _resnet(blk["res1"], x, g)
+        x = _resnet(blk["res2"], x, g)
+        if "downsample" in blk:
+            x = _conv(x, blk["downsample"], blk["downsample_b"], stride=2)
+    x = _resnet(p["mid_res1"], x, g)
+    x = _attn(p["mid_attn"], x, g)
+    x = _resnet(p["mid_res2"], x, g)
+    x = jax.nn.silu(_gn(x, p["norm_out_scale"], p["norm_out_bias"], g))
+    x = _conv(x, p["conv_out"], p["conv_out_b"])
+    if "quant_conv" in params:  # SD1/2 checkpoints; SD3/Flux drop it
+        x = _conv(x, params["quant_conv"], params["quant_conv_b"])
+    mean, logvar = jnp.split(x, 2, axis=-1)
+    if key is not None:
+        std = jnp.exp(0.5 * jnp.clip(logvar, -30, 20))
+        mean = mean + std * jax.random.normal(key, mean.shape, mean.dtype)
+    return (mean - cfg.shift_factor) * cfg.scaling_factor
+
+
+def decode(params: dict, latents: jax.Array, cfg: VAEConfig) -> jax.Array:
+    """latents (scaled) -> images [B, H, W, C] in [-1, 1]."""
+    g = cfg.norm_groups
+    p = params["decoder"]
+    z = latents.astype(cfg.jnp_dtype) / cfg.scaling_factor + cfg.shift_factor
+    if "post_quant_conv" in params:
+        z = _conv(z, params["post_quant_conv"], params["post_quant_conv_b"])
+    x = _conv(z, p["conv_in"], p["conv_in_b"])
+    x = _resnet(p["mid_res1"], x, g)
+    x = _attn(p["mid_attn"], x, g)
+    x = _resnet(p["mid_res2"], x, g)
+    for i, blk in enumerate(p["up"]):
+        x = _resnet(blk["res1"], x, g)
+        x = _resnet(blk["res2"], x, g)
+        x = _resnet(blk["res3"], x, g)
+        if "upsample" in blk:
+            B, H, W, C = x.shape
+            x = jax.image.resize(x, (B, 2 * H, 2 * W, C), "nearest")
+            x = _conv(x, blk["upsample"], blk["upsample_b"])
+    x = jax.nn.silu(_gn(x, p["norm_out_scale"], p["norm_out_bias"], g))
+    x = _conv(x, p["conv_out"], p["conv_out_b"])
+    return jnp.clip(x, -1.0, 1.0)
+
+
+# -- HF (diffusers AutoencoderKL) interop ------------------------------------
+
+
+def _t_conv(arr):
+    """torch conv [out, in, kh, kw] -> HWIO [kh, kw, in, out]."""
+    return arr.transpose(2, 3, 1, 0)
+
+
+def load_hf_weights(model_dir: str | Path, cfg: VAEConfig, dtype=None) -> dict:
+    """Map a diffusers AutoencoderKL safetensors checkpoint
+    (vae/diffusion_pytorch_model.safetensors naming) into this tree.
+    Proven by the synthesize->load->compare roundtrip in tests
+    (zero-egress environment: real checkpoints drop in unchanged)."""
+    import numpy as np
+    from safetensors import safe_open
+
+    dt = dtype or cfg.jnp_dtype
+    raw = {}
+    for f in sorted(Path(model_dir).glob("*.safetensors")):
+        with safe_open(str(f), framework="np") as sf:
+            for name in sf.keys():
+                raw[name] = sf.get_tensor(name)
+
+    def conv(name):
+        return jnp.asarray(_t_conv(raw.pop(name + ".weight")), dt)
+
+    def bias(name):
+        return jnp.asarray(raw.pop(name + ".bias"), dt)
+
+    def vec(name):
+        return jnp.asarray(raw.pop(name), dt)
+
+    def resnet(prefix, cin, cout):
+        p = {
+            "norm1_scale": vec(f"{prefix}.norm1.weight"),
+            "norm1_bias": vec(f"{prefix}.norm1.bias"),
+            "conv1": conv(f"{prefix}.conv1"),
+            "conv1_b": bias(f"{prefix}.conv1"),
+            "norm2_scale": vec(f"{prefix}.norm2.weight"),
+            "norm2_bias": vec(f"{prefix}.norm2.bias"),
+            "conv2": conv(f"{prefix}.conv2"),
+            "conv2_b": bias(f"{prefix}.conv2"),
+        }
+        if f"{prefix}.conv_shortcut.weight" in raw:
+            p["shortcut"] = conv(f"{prefix}.conv_shortcut")
+            p["shortcut_b"] = bias(f"{prefix}.conv_shortcut")
+        return p
+
+    def attn(prefix):
+        # diffusers Attention: linear [out, in] -> ours [in, out]
+        def lin(n):
+            return jnp.asarray(raw.pop(f"{prefix}.{n}.weight").T, dt)
+
+        return {
+            "norm_scale": vec(f"{prefix}.group_norm.weight"),
+            "norm_bias": vec(f"{prefix}.group_norm.bias"),
+            "q": lin("to_q"), "q_b": vec(f"{prefix}.to_q.bias"),
+            "k": lin("to_k"), "k_b": vec(f"{prefix}.to_k.bias"),
+            "v": lin("to_v"), "v_b": vec(f"{prefix}.to_v.bias"),
+            "o": lin("to_out.0"), "o_b": vec(f"{prefix}.to_out.0.bias"),
+        }
+
+    widths = [cfg.base * m for m in cfg.channel_mults]
+    enc = {
+        "conv_in": conv("encoder.conv_in"),
+        "conv_in_b": bias("encoder.conv_in"),
+        "down": [],
+        "mid_res1": resnet("encoder.mid_block.resnets.0", widths[-1], widths[-1]),
+        "mid_attn": attn("encoder.mid_block.attentions.0"),
+        "mid_res2": resnet("encoder.mid_block.resnets.1", widths[-1], widths[-1]),
+        "norm_out_scale": vec("encoder.conv_norm_out.weight"),
+        "norm_out_bias": vec("encoder.conv_norm_out.bias"),
+        "conv_out": conv("encoder.conv_out"),
+        "conv_out_b": bias("encoder.conv_out"),
+    }
+    cin = widths[0]
+    for i, w in enumerate(widths):
+        blk = {
+            "res1": resnet(f"encoder.down_blocks.{i}.resnets.0", cin, w),
+            "res2": resnet(f"encoder.down_blocks.{i}.resnets.1", w, w),
+        }
+        if i < len(widths) - 1:
+            blk["downsample"] = conv(f"encoder.down_blocks.{i}.downsamplers.0.conv")
+            blk["downsample_b"] = bias(f"encoder.down_blocks.{i}.downsamplers.0.conv")
+        enc["down"].append(blk)
+        cin = w
+
+    dec = {
+        "conv_in": conv("decoder.conv_in"),
+        "conv_in_b": bias("decoder.conv_in"),
+        "mid_res1": resnet("decoder.mid_block.resnets.0", widths[-1], widths[-1]),
+        "mid_attn": attn("decoder.mid_block.attentions.0"),
+        "mid_res2": resnet("decoder.mid_block.resnets.1", widths[-1], widths[-1]),
+        "up": [],
+        "norm_out_scale": vec("decoder.conv_norm_out.weight"),
+        "norm_out_bias": vec("decoder.conv_norm_out.bias"),
+        "conv_out": conv("decoder.conv_out"),
+        "conv_out_b": bias("decoder.conv_out"),
+    }
+    cin = widths[-1]
+    for i, w in enumerate(reversed(widths)):
+        blk = {
+            "res1": resnet(f"decoder.up_blocks.{i}.resnets.0", cin, w),
+            "res2": resnet(f"decoder.up_blocks.{i}.resnets.1", w, w),
+            "res3": resnet(f"decoder.up_blocks.{i}.resnets.2", w, w),
+        }
+        if i < len(widths) - 1:
+            blk["upsample"] = conv(f"decoder.up_blocks.{i}.upsamplers.0.conv")
+            blk["upsample_b"] = bias(f"decoder.up_blocks.{i}.upsamplers.0.conv")
+        dec["up"].append(blk)
+        cin = w
+    # quant convs (1x1) exist in SD1/2 checkpoints; SD3/Flux drop them.
+    params = {"encoder": enc, "decoder": dec}
+    if "quant_conv.weight" in raw:
+        params["quant_conv"] = conv("quant_conv")
+        params["quant_conv_b"] = bias("quant_conv")
+        params["post_quant_conv"] = conv("post_quant_conv")
+        params["post_quant_conv_b"] = bias("post_quant_conv")
+    return params
