@@ -8,11 +8,15 @@ throughput on one A100-40GB at moderate batch. vs_baseline = value/1400.
 
 Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline", ...}.
 
-Supervisor/child structure: the supervisor tries model configs largest-first
-in subprocesses with timeouts (a wedged TPU or an OOM must degrade, not
-hang the driver); the child measures engine decode throughput after a
-compile warmup. BENCH_MODEL env forces a config; BENCH_CPU=1 forces the CPU
-backend (for local smoke tests).
+Supervisor/child structure: the supervisor tries every model config its
+wall-clock budget allows in subprocesses with timeouts (a wedged TPU or an
+OOM must degrade, not hang the driver), then prints the BEST result —
+round 2 printed the first success, which could never be the int8 config
+that actually has headroom. Extra keys report every config tried
+(``all_configs``), the achieved weight-streaming rate as a fraction of the
+v5e HBM ceiling (``pct_hbm_ceiling``), and warm-boot timings measured with
+the persistent XLA compile cache (``warm_build_s``/``warm_compile_s``).
+BENCH_MODEL env forces a config; BENCH_CPU=1 forces the CPU backend.
 """
 
 from __future__ import annotations
@@ -24,14 +28,20 @@ import sys
 import time
 
 A100_LLAMA2_7B_TOK_S = 1400.0
+V5E_HBM_GBPS = 819.0  # v5e HBM bandwidth ceiling, bytes streamed per second
 
 CONFIGS = {
-    # name: (engine model preset/config kwargs, slots, max_model_len, max_tokens, timeout_s)
-    "llama2-7b": dict(slots=8, max_len=256, max_tokens=128, timeout=1500),
-    # int8 weights: ~7GB on HBM, leaves room for a bigger batch/KV on 16GB
-    "llama2-7b-int8": dict(
-        slots=16, max_len=384, max_tokens=128, timeout=1500, quant="int8"
+    # name: engine kwargs + measurement shape. int8 weight-only quantization
+    # halves weight-streaming bytes AND frees HBM for slots — the bf16 8-slot
+    # config's ceiling is ~486 tok/s (8 tok per 16.5 ms weight read), so the
+    # quantized high-slot configs are the only road to the 1400 target.
+    "llama2-7b-int8-s32": dict(
+        slots=32, max_len=256, max_tokens=128, timeout=1200, quant="int8"
     ),
+    "llama2-7b-int8-s16": dict(
+        slots=16, max_len=384, max_tokens=128, timeout=1200, quant="int8"
+    ),
+    "llama2-7b": dict(slots=8, max_len=256, max_tokens=128, timeout=1200),
     "llama-1b": dict(slots=16, max_len=512, max_tokens=128, timeout=900),
     "tiny": dict(slots=4, max_len=128, max_tokens=16, timeout=420),
 }
@@ -46,6 +56,7 @@ def _child(model: str) -> None:
     import jax.numpy as jnp
 
     from modal_examples_tpu.models import llama
+    from modal_examples_tpu.models.quantize import param_bytes
     from modal_examples_tpu.serving import LLMEngine, SamplingParams
 
     spec = CONFIGS[model]
@@ -70,8 +81,12 @@ def _child(model: str) -> None:
         quantization=spec.get("quant"),
     )
     build_s = time.time() - t0
+    weight_bytes = param_bytes(engine.params)
     prompt = "The quick brown fox jumps over the lazy dog. " * 2
-    params = SamplingParams(max_tokens=spec["max_tokens"], temperature=1.0)
+    max_tokens = spec["max_tokens"]
+    if os.environ.get("BENCH_WARM"):
+        max_tokens = 16  # warm rerun only measures boot, not throughput
+    params = SamplingParams(max_tokens=max_tokens, temperature=1.0)
 
     # boot-time compiles, then a live warmup round through the scheduler
     t0 = time.time()
@@ -93,9 +108,14 @@ def _child(model: str) -> None:
             pass
     elapsed = time.time() - t0
     generated = engine.stats.generated_tokens - base_tokens
+    errors = engine.error_count
     engine.stop()
 
     tok_s = generated / elapsed
+    # decode is weight-streaming-bound: every step reads the full weight set
+    # once for up to `slots` tokens. steps/s * weight_bytes over the HBM
+    # ceiling says how close the whole serving stack runs to the hardware.
+    stream_gbps = (tok_s / spec["slots"]) * weight_bytes / 1e9
     print(
         json.dumps(
             {
@@ -105,12 +125,15 @@ def _child(model: str) -> None:
                 "vs_baseline": round(tok_s / A100_LLAMA2_7B_TOK_S, 4),
                 "model": model,
                 "params": cfg.param_count,
+                "weight_gb": round(weight_bytes / 1e9, 2),
                 "backend": jax.default_backend(),
                 "slots": spec["slots"],
                 "generated_tokens": generated,
                 "elapsed_s": round(elapsed, 2),
                 "engine_build_s": round(build_s, 1),
                 "compile_s": round(compile_s, 1),
+                "pct_hbm_ceiling": round(stream_gbps / V5E_HBM_GBPS, 4),
+                "engine_errors": errors,
             }
         )
     )
@@ -170,18 +193,38 @@ def _preflight(timeout_s: int = 120) -> str:
     return ""
 
 
-def _extract_json(stdout: str) -> str | None:
+def _extract_json(stdout: str) -> dict | None:
     for line in reversed(stdout.strip().splitlines()):
         try:
-            json.loads(line)
-            return line
+            return json.loads(line)
         except json.JSONDecodeError:
             continue
     return None
 
 
+def _run_config(model: str, env: dict, timeout: float) -> tuple[dict | None, str]:
+    try:
+        proc = subprocess.run(
+            [sys.executable, os.path.abspath(__file__), "--child", model],
+            capture_output=True,
+            text=True,
+            timeout=timeout,
+            cwd=os.path.dirname(os.path.abspath(__file__)),
+            env=env,
+        )
+    except subprocess.TimeoutExpired:
+        return None, f"{model}: timeout"
+    result = _extract_json(proc.stdout)
+    if result is None:
+        return None, f"{model}: exit={proc.returncode} stderr={proc.stderr[-400:]}"
+    return result, ""
+
+
 def main() -> int:
     if len(sys.argv) > 1 and sys.argv[1] == "--child":
+        from modal_examples_tpu.utils.compile_cache import enable_compile_cache
+
+        enable_compile_cache()
         _child(sys.argv[2])
         return 0
 
@@ -203,59 +246,75 @@ def main() -> int:
     elif env.get("BENCH_CPU"):
         order = ["tiny"]
     else:
-        # canary-first: the tiny config proves the full engine path end to end
-        # in ~1 min and becomes the guaranteed fallback line; then try the real
-        # targets largest-first within the remaining budget.
-        order = ["tiny", "llama2-7b", "llama2-7b-int8", "llama-1b"]
+        # canary-first: the tiny config proves the full engine path end to
+        # end in ~1 min and becomes the guaranteed fallback line; then every
+        # real target, best-expected first so budget exhaustion still leaves
+        # the strongest measured number on the table.
+        order = [
+            "tiny",
+            "llama2-7b-int8-s32",
+            "llama2-7b-int8-s16",
+            "llama2-7b",
+            "llama-1b",
+        ]
 
-    fallback_line = None
+    results: dict[str, dict] = {}
     last_err = ""
     for i, model in enumerate(order):
-        spec = CONFIGS[model]
-        remaining = deadline - time.time() - 15
-        if remaining < 60:
-            last_err = last_err or "budget exhausted before any config ran"
-            break
-        # reserve >=60s for each config still behind this one, so one
-        # hanging config can't starve smaller ones that would succeed
-        reserve = 60 * (len(order) - i - 1)
-        try:
-            proc = subprocess.run(
-                [sys.executable, os.path.abspath(__file__), "--child", model],
-                capture_output=True,
-                text=True,
-                timeout=max(60, min(spec["timeout"], remaining - reserve)),
-                cwd=os.path.dirname(os.path.abspath(__file__)),
-                env=env,
-            )
-        except subprocess.TimeoutExpired:
-            last_err = f"{model}: timeout"
-            continue
-        line = _extract_json(proc.stdout)
-        if line is None:
-            last_err = f"{model}: exit={proc.returncode} stderr={proc.stderr[-400:]}"
+        spec = CONFIGS.get(model)
+        if spec is None:
+            last_err = f"unknown config {model!r}"
             continue
         is_canary = len(order) > 1 and i == 0
-        if not is_canary:
-            print(line)
-            return 0
-        fallback_line = line
+        remaining = deadline - time.time() - 15
+        if remaining < 60:
+            last_err = last_err or "budget exhausted"
+            break
+        # a canary keeps >=60s reserved per pending config so it can't starve
+        # them; real configs run with whatever remains (best-first order)
+        reserve = 60 * (len(order) - i - 1) if is_canary else 0
+        timeout = max(60, min(spec["timeout"], remaining - reserve))
+        result, err = _run_config(model, env, timeout)
+        if result is None:
+            last_err = err
+            continue
+        results[model] = result
+        if env.get("BENCH_FIRST_WIN") and not is_canary:
+            break
 
-    if fallback_line is not None:
-        print(fallback_line)
-        return 0
-    print(
-        json.dumps(
-            {
-                "metric": "serving decode throughput",
-                "value": 0.0,
-                "unit": "tok/s",
-                "vs_baseline": 0.0,
-                "error": last_err,
-            }
+    real = {k: v for k, v in results.items() if k != "tiny"} or results
+    if not real:
+        print(
+            json.dumps(
+                {
+                    "metric": "serving decode throughput",
+                    "value": 0.0,
+                    "unit": "tok/s",
+                    "vs_baseline": 0.0,
+                    "error": last_err,
+                }
+            )
         )
-    )
-    return 1
+        return 1
+
+    best_name = max(real, key=lambda k: real[k]["value"])
+    best = real[best_name]
+    best["all_configs"] = {k: v["value"] for k, v in results.items()}
+
+    # warm-boot proof for the compile cache: rerun the winner (tiny token
+    # budget) — its compiles are now disk hits, so build+compile collapses.
+    if deadline - time.time() > 150 and not env.get("BENCH_CPU"):
+        warm_env = dict(env)
+        warm_env["BENCH_WARM"] = "1"
+        warm, _ = _run_config(
+            best_name, warm_env, max(60, deadline - time.time() - 15)
+        )
+        if warm is not None:
+            best["warm_build_s"] = warm["engine_build_s"]
+            best["warm_compile_s"] = warm["compile_s"]
+
+    print(json.dumps(best))
+    return 0
 
 
 if __name__ == "__main__":

@@ -329,6 +329,20 @@ class _Container:
             py_paths = [p for p in py_paths if "axon" not in p]
             env["JAX_PLATFORMS"] = env.get("JAX_PLATFORMS_CPU_OVERRIDE", "cpu")
         env["PYTHONPATH"] = os.pathsep.join(py_paths)
+        # persistent XLA compile cache for every container (jax reads the
+        # env var natively, keeping core/ jax-free); MTPU_COMPILE_CACHE=0
+        # opts out, a path overrides (utils/compile_cache.py is the policy)
+        cache = os.environ.get("MTPU_COMPILE_CACHE", "")
+        if cache.lower() not in ("0", "off", "none"):
+            env.setdefault(
+                "JAX_COMPILATION_CACHE_DIR",
+                cache
+                or str(
+                    Path.home() / ".cache" / "modal_examples_tpu" / "xla-cache"
+                ),
+            )
+            env.setdefault("JAX_PERSISTENT_CACHE_MIN_COMPILE_TIME_SECS", "0.5")
+            env.setdefault("JAX_PERSISTENT_CACHE_MIN_ENTRY_SIZE_BYTES", "0")
         env.update(self.extra_env)
         self.proc = subprocess.Popen(
             [sys.executable, "-m", "modal_examples_tpu.core.container_worker"],

@@ -279,6 +279,11 @@ class MMDiTConfig:
     qk_norm: bool = True  # RMS q/k norm (SD3.5)
     norm_eps: float = 1e-6
     dtype: str = "float32"
+    # diffusers SD3Transformer2DModel builds its LAST JointTransformerBlock
+    # with context_pre_only=True: a 2*dim continuous context norm, no
+    # attn.to_add_out, no ff_context — real SD3/SD3.5 checkpoints only load
+    # with this on (the params tree then carries a separate "last_block")
+    context_pre_only_last: bool = False
 
     @property
     def n_patches(self) -> int:
@@ -303,6 +308,7 @@ class MMDiTConfig:
         return MMDiTConfig(
             img_size=64, channels=16, patch=2, dim=1536, n_layers=24,
             n_heads=24, text_dim=4096, pooled_dim=2048, dtype="bfloat16",
+            context_pre_only_last=True,
         )
 
     @staticmethod
@@ -312,8 +318,11 @@ class MMDiTConfig:
 
 def mmdit_init(key: jax.Array, cfg: MMDiTConfig) -> dict:
     dt = cfg.jnp_dtype
-    D, L = cfg.dim, cfg.n_layers
-    ks = iter(jax.random.split(key, 24))
+    D = cfg.dim
+    # with context_pre_only_last, the final block has its own (smaller)
+    # leaf set under "last_block"; the scan stack holds the uniform L-1
+    L = cfg.n_layers - int(cfg.context_pre_only_last)
+    ks = iter(jax.random.split(key, 48))
 
     def dense(*shape, scale=None):
         return layers.init_dense(next(ks), shape, scale=scale, dtype=dt)
@@ -321,7 +330,30 @@ def mmdit_init(key: jax.Array, cfg: MMDiTConfig) -> dict:
     def per_layer(*shape, scale=None):
         return layers.init_dense(next(ks), (L, *shape), scale=scale, dtype=dt)
 
-    return {
+    last_block = None
+    if cfg.context_pre_only_last:
+        last_block = {
+            "img_mod_w": jnp.zeros((D, 6 * D), dt),
+            "img_mod_b": jnp.zeros((6 * D,), dt),
+            # continuous context norm: (scale, shift) only — no gates
+            "ctx_mod_w": jnp.zeros((D, 2 * D), dt),
+            "ctx_mod_b": jnp.zeros((2 * D,), dt),
+            "img_wq": dense(D, D), "img_bq": jnp.zeros((D,), dt),
+            "img_wk": dense(D, D), "img_bk": jnp.zeros((D,), dt),
+            "img_wv": dense(D, D), "img_bv": jnp.zeros((D,), dt),
+            "img_wo": dense(D, D), "img_bo": jnp.zeros((D,), dt),
+            "ctx_wq": dense(D, D), "ctx_bq": jnp.zeros((D,), dt),
+            "ctx_wk": dense(D, D), "ctx_bk": jnp.zeros((D,), dt),
+            "ctx_wv": dense(D, D), "ctx_bv": jnp.zeros((D,), dt),
+            "img_qnorm": jnp.ones((cfg.head_dim,), dt),
+            "img_knorm": jnp.ones((cfg.head_dim,), dt),
+            "ctx_qnorm": jnp.ones((cfg.head_dim,), dt),
+            "ctx_knorm": jnp.ones((cfg.head_dim,), dt),
+            "img_fc1": dense(D, 4 * D), "img_fc1_b": jnp.zeros((4 * D,), dt),
+            "img_fc2": dense(4 * D, D), "img_fc2_b": jnp.zeros((D,), dt),
+        }
+
+    tree = {
         "patch_proj": dense(cfg.patch_dim, D, scale=0.02),
         "patch_bias": jnp.zeros((D,), dt),
         "pos_emb": dense(cfg.n_patches, D, scale=0.02),
@@ -361,6 +393,9 @@ def mmdit_init(key: jax.Array, cfg: MMDiTConfig) -> dict:
         "final_proj": jnp.zeros((D, cfg.patch_dim), dt),
         "final_proj_b": jnp.zeros((cfg.patch_dim,), dt),
     }
+    if last_block is not None:
+        tree["last_block"] = last_block
+    return tree
 
 
 def _rms(x, scale, eps=1e-6):
@@ -408,12 +443,22 @@ def mmdit_forward(
     def heads(v):
         return v.reshape(B, -1, H, hd).transpose(0, 2, 1, 3)
 
-    def block_fn(carry, l):
-        img, ctx = carry
+    def joint_block(img, ctx, l, pre_only: bool):
+        """One MMDiT block. ``pre_only`` mirrors diffusers'
+        JointTransformerBlock(context_pre_only=True) — SD3's FINAL block:
+        the context stream is normed with a continuous adaLN (2*dim:
+        (scale, shift), no gates), contributes q/k/v to the joint
+        attention, but its output is discarded (no to_add_out, no
+        ff_context)."""
         im = cond @ l["img_mod_w"] + l["img_mod_b"]
-        cm = cond @ l["ctx_mod_w"] + l["ctx_mod_b"]
         i_s1, i_sc1, i_g1, i_s2, i_sc2, i_g2 = jnp.split(im, 6, axis=-1)
-        c_s1, c_sc1, c_g1, c_s2, c_sc2, c_g2 = jnp.split(cm, 6, axis=-1)
+        cm = cond @ l["ctx_mod_w"] + l["ctx_mod_b"]
+        if pre_only:
+            # AdaLayerNormContinuous chunk order is (scale, shift) —
+            # opposite of AdaLayerNormZero's (shift, scale, ...)
+            c_sc1, c_s1 = jnp.split(cm, 2, axis=-1)
+        else:
+            c_s1, c_sc1, c_g1, c_s2, c_sc2, c_g2 = jnp.split(cm, 6, axis=-1)
 
         ia = _modulate(norm(img), i_s1, i_sc1)
         ca = _modulate(norm(ctx), c_s1, c_sc1)
@@ -436,19 +481,28 @@ def mmdit_forward(
         o = o.transpose(0, 2, 1, 3).reshape(B, -1, cfg.dim)
         oc, oi = o[:, : -Si], o[:, -Si:]
         img = img + i_g1[:, None] * (oi @ l["img_wo"] + l["img_bo"])
-        ctx = ctx + c_g1[:, None] * (oc @ l["ctx_wo"] + l["ctx_bo"])
-
         m = _modulate(norm(img), i_s2, i_sc2)
         m = jax.nn.gelu(m @ l["img_fc1"] + l["img_fc1_b"], approximate=True)
         img = img + i_g2[:, None] * (m @ l["img_fc2"] + l["img_fc2_b"])
+        if pre_only:
+            return img, ctx  # context output discarded
+        ctx = ctx + c_g1[:, None] * (oc @ l["ctx_wo"] + l["ctx_bo"])
         m = _modulate(norm(ctx), c_s2, c_sc2)
         m = jax.nn.gelu(m @ l["ctx_fc1"] + l["ctx_fc1_b"], approximate=True)
         ctx = ctx + c_g2[:, None] * (m @ l["ctx_fc2"] + l["ctx_fc2_b"])
+        return img, ctx
+
+    def block_fn(carry, l):
+        img, ctx = carry
+        img, ctx = joint_block(img, ctx, l, pre_only=False)
         return (img, ctx), None
 
     (img, ctx), _ = jax.lax.scan(block_fn, (img, ctx), params["blocks"])
+    if cfg.context_pre_only_last:
+        img, _ = joint_block(img, ctx, params["last_block"], pre_only=True)
     fmod = cond @ params["final_mod_w"] + params["final_mod_b"]
-    shift, scale = jnp.split(fmod, 2, axis=-1)
+    # norm_out is AdaLayerNormContinuous: chunk order (scale, shift)
+    scale, shift = jnp.split(fmod, 2, axis=-1)
     out = _modulate(norm(img), shift, scale) @ params["final_proj"]
     out = out + params["final_proj_b"]
     return unpatchify(out, dcfg).astype(jnp.float32)
@@ -510,7 +564,11 @@ def load_mmdit_hf_weights(model_dir, cfg: MMDiTConfig, dtype=None) -> dict:
     """Map a diffusers SD3Transformer2DModel safetensors checkpoint
     (transformer/diffusion_pytorch_model.safetensors naming) into the
     mmdit tree. Zero-egress proof: synthesize->load->compare roundtrip in
-    tests; a real SD3/SD3.5 checkout maps through the same names."""
+    tests (TestMMDiT); a real SD3/SD3.5 checkout maps through the same
+    names, including the context_pre_only FINAL block (no attn.to_add_out /
+    ff_context.*, 2*dim norm1_context) — set
+    ``cfg.context_pre_only_last=True`` for real checkpoints (sd3_shape()
+    does)."""
     from pathlib import Path
 
     import numpy as np
@@ -523,13 +581,16 @@ def load_mmdit_hf_weights(model_dir, cfg: MMDiTConfig, dtype=None) -> dict:
             for name in sf.keys():
                 raw[name] = sf.get_tensor(name)
 
-    L = cfg.n_layers
+    L = cfg.n_layers - int(cfg.context_pre_only_last)
 
     def lin(name):
         return jnp.asarray(raw.pop(name + ".weight").T, dt)
 
     def b(name):
         return jnp.asarray(raw.pop(name + ".bias"), dt)
+
+    def vec(name):
+        return jnp.asarray(raw.pop(name), dt)
 
     def stack_lin(fmt):
         return jnp.asarray(
@@ -554,7 +615,35 @@ def load_mmdit_hf_weights(model_dir, cfg: MMDiTConfig, dtype=None) -> dict:
     patch_proj = jnp.asarray(
         pw.transpose(2, 3, 1, 0).reshape(p_ * p_ * C_, D_), dt
     )
-    return {
+    last_block = None
+    if cfg.context_pre_only_last:
+        Tl = T.format(cfg.n_layers - 1)
+        last_block = {
+            "img_mod_w": lin(Tl + "norm1.linear"),
+            "img_mod_b": b(Tl + "norm1.linear"),
+            "ctx_mod_w": lin(Tl + "norm1_context.linear"),  # [D, 2D]
+            "ctx_mod_b": b(Tl + "norm1_context.linear"),
+            "img_wq": lin(Tl + "attn.to_q"), "img_bq": b(Tl + "attn.to_q"),
+            "img_wk": lin(Tl + "attn.to_k"), "img_bk": b(Tl + "attn.to_k"),
+            "img_wv": lin(Tl + "attn.to_v"), "img_bv": b(Tl + "attn.to_v"),
+            "img_wo": lin(Tl + "attn.to_out.0"),
+            "img_bo": b(Tl + "attn.to_out.0"),
+            "ctx_wq": lin(Tl + "attn.add_q_proj"),
+            "ctx_bq": b(Tl + "attn.add_q_proj"),
+            "ctx_wk": lin(Tl + "attn.add_k_proj"),
+            "ctx_bk": b(Tl + "attn.add_k_proj"),
+            "ctx_wv": lin(Tl + "attn.add_v_proj"),
+            "ctx_bv": b(Tl + "attn.add_v_proj"),
+            "img_qnorm": vec(Tl + "attn.norm_q.weight"),
+            "img_knorm": vec(Tl + "attn.norm_k.weight"),
+            "ctx_qnorm": vec(Tl + "attn.norm_added_q.weight"),
+            "ctx_knorm": vec(Tl + "attn.norm_added_k.weight"),
+            "img_fc1": lin(Tl + "ff.net.0.proj"),
+            "img_fc1_b": b(Tl + "ff.net.0.proj"),
+            "img_fc2": lin(Tl + "ff.net.2"),
+            "img_fc2_b": b(Tl + "ff.net.2"),
+        }
+    tree = {
         "patch_proj": patch_proj,
         "patch_bias": jnp.asarray(raw.pop("pos_embed.proj.bias"), dt),
         "pos_emb": jnp.asarray(raw.pop("pos_embed.pos_embed")[0], dt),
@@ -607,3 +696,6 @@ def load_mmdit_hf_weights(model_dir, cfg: MMDiTConfig, dtype=None) -> dict:
         "final_proj": lin("proj_out"),
         "final_proj_b": b("proj_out"),
     }
+    if last_block is not None:
+        tree["last_block"] = last_block
+    return tree

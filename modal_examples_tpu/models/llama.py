@@ -32,7 +32,11 @@ import jax
 import jax.numpy as jnp
 from jax.sharding import PartitionSpec as P
 
-from ..ops import flash_attention, paged_decode_attention
+from ..ops import (
+    flash_attention,
+    paged_decode_attention,
+    paged_decode_attention_inflight,
+)
 from . import layers
 
 
@@ -284,6 +288,17 @@ def _mlp_block(
     formulation at ~top_k/E the FLOPs for compute-bound training forward.
     Returns (out, aux_load_balance_loss)."""
     if cfg.n_experts > 0:
+        if lora is not None and any(
+            f"{n}_a" in lora for n in ("gate", "up", "down")
+        ):
+            # silently skipping MLP adapters on the expert branch would make
+            # "LoRA fine-tune a MoE model" train only the attention adapters
+            # with no signal anything was dropped (ADVICE r2)
+            raise ValueError(
+                "LoRA MLP adapters (gate/up/down) are not supported for MoE "
+                "expert MLPs; restrict LoRAConfig.targets to attention "
+                "projections (wq/wk/wv/wo) for n_experts > 0"
+            )
         from . import moe as _moe
 
         shape = h.shape
@@ -551,7 +566,94 @@ def decode_step(
 
     Returns (logits [B, vocab], k_pages, v_pages). Pass donated pages for
     in-place updates under jit.
+
+    Structure (round-3 rework): the page arrays are READ-ONLY inside the
+    layer scan — attention sees the cached prefix via a fused gather plus
+    the current token's K/V still in registers
+    (ops.paged_decode_attention_inflight) — and every layer's new KV is
+    scattered into the pages in ONE update after the scan (the same shape
+    ``prefill`` uses). Round 2 threaded the full caches through the scan as
+    stacked ys, which XLA materialized as cache-slice copies every layer of
+    every step — the main gap between the measured 28 ms decode step and the
+    16.5 ms weight-streaming floor (NOTES.md round 2).
+
+    The Pallas-kernel path (``MTPU_PAGED_IMPL=pallas``) keeps the
+    write-then-attend formulation: the kernel reads the current token from
+    the cache, so its KV must land in the pages before attention
+    (``MTPU_PAGED_IMPL=xla-writeback`` keeps that structure but with the XLA
+    attention — the A/B lever for benchmarks/decode_micro.py).
     """
+    import os
+
+    if os.environ.get("MTPU_PAGED_IMPL", "xla") in ("pallas", "xla-writeback"):
+        return _decode_step_writeback(
+            params, tokens, positions, k_pages, v_pages, page_tables, active,
+            cfg,
+        )
+    B = tokens.shape[0]
+    page_size = k_pages.shape[3]
+    x = params["embed"][tokens]  # [B, D]
+    cos, sin = layers.rotary_embedding(
+        positions[:, None], cfg.head_dim, cfg.rope_theta, dtype=jnp.float32,
+        rope_scaling=dict(cfg.rope_scaling) if cfg.rope_scaling else None,
+    )  # [B, 1, hd/2]
+
+    page_idx = jnp.take_along_axis(
+        page_tables, (positions // page_size)[:, None], axis=1
+    )[:, 0]
+    page_idx = jnp.where(active, page_idx, 0)
+    slot = jnp.where(active, positions % page_size, 0)
+    prefix_lens = jnp.where(active, positions, 0).astype(jnp.int32)
+    L = cfg.n_layers
+
+    def layer_fn(carry, scanned):
+        x = carry
+        layer, li = scanned
+        D = cfg.head_dim
+        h = layers.rms_norm(x, layer["attn_norm"], cfg.norm_eps)
+        q = layers.mm(h, layer["wq"]).astype(x.dtype)
+        k = layers.mm(h, layer["wk"]).astype(x.dtype)
+        v = layers.mm(h, layer["wv"]).astype(x.dtype)
+        q = q.reshape(B, 1, cfg.n_heads, D).transpose(0, 2, 1, 3)  # [B,H,1,D]
+        k = k.reshape(B, 1, cfg.n_kv_heads, D).transpose(0, 2, 1, 3)
+        v = v.reshape(B, 1, cfg.n_kv_heads, D).transpose(0, 2, 1, 3)
+        q = layers.apply_rope(q, cos, sin)
+        k = layers.apply_rope(k, cos, sin)
+        k_tok, v_tok = k[:, :, 0], v[:, :, 0]  # [B, Hkv, D]
+        # one gather from the full [L, P, ...] arrays (layer scalar + table
+        # array fuse into a single XLA gather — no per-layer slice copy)
+        ks = k_pages[li, page_tables]  # [B, pp, Hkv, ps, D]
+        vs = v_pages[li, page_tables]
+        o = paged_decode_attention_inflight(
+            q[:, :, 0], ks, vs, prefix_lens, k_tok, v_tok
+        )  # [B, H, D]
+        o = o.reshape(B, cfg.n_heads * D)
+        x = x + layers.mm(o, layer["wo"]).astype(x.dtype)
+        h = layers.rms_norm(x, layer["mlp_norm"], cfg.norm_eps)
+        h, _ = _mlp_block(layer, h, cfg)
+        return x + h, (k_tok, v_tok)
+
+    x, (k_all, v_all) = jax.lax.scan(
+        layer_fn, x, (_layer_stack(params), jnp.arange(L))
+    )
+    # k_all: [L, B, Hkv, D] -> one scatter for every layer's token. Advanced
+    # indices at dims 1 (page_idx [B]) and 3 (slot [B]) are separated by a
+    # slice, so the batch dim moves to the front: update is [B, L, Hkv, D].
+    k_pages = k_pages.at[:, page_idx, :, slot].set(k_all.transpose(1, 0, 2, 3))
+    v_pages = v_pages.at[:, page_idx, :, slot].set(v_all.transpose(1, 0, 2, 3))
+    x = layers.rms_norm(x, params["final_norm"], cfg.norm_eps)
+    head = params["embed"].T if cfg.tie_embeddings else params["lm_head"]
+    logits = layers.mm(x, head)
+    return logits, k_pages, v_pages
+
+
+def _decode_step_writeback(
+    params, tokens, positions, k_pages, v_pages, page_tables, active, cfg
+):
+    """Write-then-attend decode (Pallas paged kernel path): each layer lands
+    its KV in the pages before calling the kernel, which reads the current
+    token back from the cache. See ``decode_step`` for why the default path
+    avoids threading the caches through the scan."""
     B = tokens.shape[0]
     page_size = k_pages.shape[3]
     x = params["embed"][tokens]  # [B, D]
@@ -680,11 +782,30 @@ def verify_step(
 # -- HF safetensors interop -------------------------------------------------
 
 
-def load_hf_weights(model_dir: str | Path, cfg: LlamaConfig, dtype=None) -> dict:
+def load_hf_weights(
+    model_dir: str | Path, cfg: LlamaConfig, dtype=None,
+    quantization: str | None = None,
+) -> dict:
     """Stream HF llama safetensors into this tree (no 2x RAM: tensors are
-    read file-by-file and stacked per layer)."""
+    read file-by-file and stacked per layer).
+
+    ``quantization="int8"`` quantizes each matmul weight ON THE HOST before
+    the device transfer (models.quantize.quantize_weight_host), so a 7B
+    load costs ~7 GB of HBM — the bf16 tensors never exist on device.
+    """
     import numpy as np
     from safetensors import safe_open
+
+    if quantization not in (None, "int8"):
+        raise ValueError(f"unknown quantization {quantization!r}")
+    quant_targets = set()
+    if quantization == "int8":
+        from .quantize import LLAMA_TARGETS, quantize_weight_host
+
+        # router stays high precision (tiny, routing-critical); so do norms
+        quant_targets = set(LLAMA_TARGETS) | {
+            "lm_head", "moe_gate", "moe_up", "moe_down",
+        }
 
     model_dir = Path(model_dir)
     dt = dtype or cfg.jnp_dtype
@@ -698,57 +819,65 @@ def load_hf_weights(model_dir: str | Path, cfg: LlamaConfig, dtype=None) -> dict
             for name in sf.keys():
                 raw[name] = sf.get_tensor(name)
 
-    def t(name):  # HF stores [out, in]; we use [in, out]
-        return jnp.asarray(raw.pop(name).T, dtype=dt)
+    def dev(arr: np.ndarray, target: str):
+        if target in quant_targets:
+            return quantize_weight_host(arr)
+        return jnp.asarray(arr, dtype=dt)
 
-    def stack(fmt, transpose=True):
+    def t(name, target="_"):  # HF stores [out, in]; we use [in, out]
+        return dev(raw.pop(name).T, target)
+
+    def stack(fmt, transpose=True, target="_"):
         mats = []
         for li in range(cfg.n_layers):
             arr = raw.pop(fmt.format(li))
             mats.append(arr.T if transpose else arr)
-        return jnp.asarray(np.stack(mats), dtype=dt)
+        return dev(np.stack(mats), target)
 
-    def stack_experts(fmt):
+    def stack_experts(fmt, target="_"):
         # [L, E, D, F] from per-(layer, expert) HF [F, D] matrices
         mats = [
             np.stack([raw.pop(fmt.format(li, e)).T for e in range(cfg.n_experts)])
             for li in range(cfg.n_layers)
         ]
-        return jnp.asarray(np.stack(mats), dtype=dt)
+        return dev(np.stack(mats), target)
 
     if cfg.n_experts > 0:
         # Mixtral layout: block_sparse_moe.gate (router) + experts.{e}.w1/w3/w2
         mlp = {
             "router": stack("model.layers.{}.block_sparse_moe.gate.weight"),
             "moe_gate": stack_experts(
-                "model.layers.{}.block_sparse_moe.experts.{}.w1.weight"
+                "model.layers.{}.block_sparse_moe.experts.{}.w1.weight",
+                "moe_gate",
             ),
             "moe_up": stack_experts(
-                "model.layers.{}.block_sparse_moe.experts.{}.w3.weight"
+                "model.layers.{}.block_sparse_moe.experts.{}.w3.weight",
+                "moe_up",
             ),
             "moe_down": stack_experts(
-                "model.layers.{}.block_sparse_moe.experts.{}.w2.weight"
+                "model.layers.{}.block_sparse_moe.experts.{}.w2.weight",
+                "moe_down",
             ),
         }
     else:
         mlp = {
-            "gate": stack("model.layers.{}.mlp.gate_proj.weight"),
-            "up": stack("model.layers.{}.mlp.up_proj.weight"),
-            "down": stack("model.layers.{}.mlp.down_proj.weight"),
+            "gate": stack("model.layers.{}.mlp.gate_proj.weight", target="gate"),
+            "up": stack("model.layers.{}.mlp.up_proj.weight", target="up"),
+            "down": stack("model.layers.{}.mlp.down_proj.weight", target="down"),
         }
     params = {
         "embed": jnp.asarray(raw.pop("model.embed_tokens.weight"), dtype=dt),
         "layers": {
             "attn_norm": stack("model.layers.{}.input_layernorm.weight", False),
-            "wq": stack("model.layers.{}.self_attn.q_proj.weight"),
-            "wk": stack("model.layers.{}.self_attn.k_proj.weight"),
-            "wv": stack("model.layers.{}.self_attn.v_proj.weight"),
-            "wo": stack("model.layers.{}.self_attn.o_proj.weight"),
+            "wq": stack("model.layers.{}.self_attn.q_proj.weight", target="wq"),
+            "wk": stack("model.layers.{}.self_attn.k_proj.weight", target="wk"),
+            "wv": stack("model.layers.{}.self_attn.v_proj.weight", target="wv"),
+            "wo": stack("model.layers.{}.self_attn.o_proj.weight", target="wo"),
             "mlp_norm": stack("model.layers.{}.post_attention_layernorm.weight", False),
             **mlp,
         },
         "final_norm": jnp.asarray(raw.pop("model.norm.weight"), dtype=dt),
     }
     if not cfg.tie_embeddings:
-        params["lm_head"] = t("lm_head.weight")
+        params["lm_head"] = t("lm_head.weight", "lm_head")
     return params

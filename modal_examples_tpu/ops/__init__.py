@@ -11,7 +11,10 @@ from .flash_attention import (
     flash_attention_chunked,
     flash_attention_with_lse,
 )
-from .paged_attention import paged_decode_attention
+from .paged_attention import (
+    paged_decode_attention,
+    paged_decode_attention_inflight,
+)
 from .quantized_matmul import dequantize_int8, quantize_int8, quantized_matmul
 from .ring_attention import (
     ring_attention,
@@ -27,6 +30,7 @@ __all__ = [
     "flash_attention_chunked",
     "flash_attention_with_lse",
     "paged_decode_attention",
+    "paged_decode_attention_inflight",
     "quantize_int8",
     "quantized_matmul",
     "reference",

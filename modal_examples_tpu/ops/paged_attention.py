@@ -177,6 +177,59 @@ def _paged_decode_xla(
     return o.reshape(B, Hq, D).astype(q.dtype)
 
 
+def paged_decode_attention_inflight(
+    q: jax.Array,  # [B, Hq, D]
+    ks: jax.Array,  # [B, pages_per_seq, Hkv, page_size, D] — gathered pages
+    vs: jax.Array,
+    prefix_lens: jax.Array,  # [B] int32 — tokens already IN the cache
+    k_new: jax.Array,  # [B, Hkv, D] — current token's K (not yet written)
+    v_new: jax.Array,
+    *,
+    sm_scale: float | None = None,
+) -> jax.Array:  # [B, Hq, D]
+    """Decode attention over the cached prefix PLUS the in-flight token.
+
+    The round-2 decode step wrote each token's K/V into the page arrays
+    *inside* the layer scan and returned the full caches as stacked scan
+    ys — a structure XLA materializes as full cache-slice traffic every
+    layer of every step (measured: the single biggest gap between the 28 ms
+    step and the weight-streaming floor). Keeping the current token's K/V in
+    registers lets the model scatter ALL layers' KV once per step, outside
+    the scan, so the pages are read-only here: prefix scores come from the
+    gathered pages, the current token contributes one extra logit column,
+    and both share one softmax. Exact same math as write-then-attend with
+    ``ctx_lens = prefix_lens + 1``.
+    """
+    B, Hq, D = q.shape
+    _, pages_per_seq, Hkv, page_size, _ = ks.shape
+    G = Hq // Hkv
+    if sm_scale is None:
+        sm_scale = D**-0.5
+    qg = q.reshape(B, Hkv, G, D).astype(jnp.float32)
+    s = jnp.einsum("bhgd,bphtd->bhgpt", qg, ks.astype(jnp.float32)) * sm_scale
+    pos = (
+        jnp.arange(pages_per_seq)[:, None] * page_size
+        + jnp.arange(page_size)[None, :]
+    )  # [pp, ps]
+    valid = pos[None] < prefix_lens[:, None, None]  # [B, pp, ps]
+    s = jnp.where(valid[:, None, None], s, -jnp.inf)
+    flat = s.reshape(B, Hkv, G, pages_per_seq * page_size)
+    # match the numerics of the write-then-attend path bit-for-bit: the old
+    # path read the current token back from the cache, i.e. at cache dtype
+    s_new = jnp.einsum(
+        "bhgd,bhd->bhg", qg, k_new.astype(ks.dtype).astype(jnp.float32)
+    )[..., None] * sm_scale  # [B, Hkv, G, 1]
+    all_s = jnp.concatenate([flat, s_new], axis=-1)
+    p = jax.nn.softmax(all_s, axis=-1)
+    p_prefix = p[..., :-1].reshape(s.shape)
+    p_new = p[..., -1]  # [B, Hkv, G]
+    o = jnp.einsum("bhgpt,bphtd->bhgd", p_prefix, vs.astype(jnp.float32))
+    o = o + p_new[..., None] * (
+        v_new.astype(vs.dtype).astype(jnp.float32)[:, :, None, :]
+    )
+    return o.reshape(B, Hq, D).astype(q.dtype)
+
+
 def paged_decode_attention(
     q: jax.Array,  # [B, Hq, D]
     k_pages: jax.Array,  # [n_pages, Hkv, page_size, D]

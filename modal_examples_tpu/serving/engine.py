@@ -53,7 +53,11 @@ class Request:
     finish_reason: str | None = None  # set when the terminal marker arrives
     # engine-assigned when params.seed is None: sampling is derived from
     # (auto_seed, position) so outputs never depend on scheduler timing —
-    # how many blocks/keys the engine happened to burn before this request
+    # how many blocks/keys the engine happened to burn before this request.
+    # SPECULATIVE-MODE EXCEPTION: the spec accept/reject kernel samples
+    # unseeded temperature>0 rows from the engine key, so those outputs DO
+    # depend on scheduler timing (explicit seed= there is rejected up front
+    # by validate_params; see _spec_propose_verify's docstring).
     auto_seed: int | None = None
 
 
@@ -177,19 +181,32 @@ class LLMEngine:
         decode_block: int = 8,  # decode steps rolled into one dispatch
         mesh=None,  # jax Mesh with a "tensor" axis: tensor-parallel serving
     ):
+        from ..utils.compile_cache import enable_compile_cache
+
+        enable_compile_cache()  # warm restarts hit disk, not the compiler
         self.cfg = cfg
         self.tokenizer = load_tokenizer(model_dir)
+        if quantization not in (None, "int8"):
+            raise ValueError(f"unknown quantization {quantization!r}")
         if params is None:
             if model_dir is not None:
-                params = llama.load_hf_weights(model_dir, cfg)
+                # checkpoint loads quantize on the HOST (the bf16 tensors
+                # never reach the device: ~7 GB HBM for a 7B int8 model)
+                params = llama.load_hf_weights(
+                    model_dir, cfg, quantization=quantization
+                )
+            elif quantization == "int8":
+                # init+quantize fused into ONE program so the bf16 tree is
+                # an XLA-internal temporary, not a 13.5 GB resident peak
+                from ..models.quantize import init_quantized_llama
+
+                params = init_quantized_llama(jax.random.PRNGKey(seed), cfg)
             else:
                 params = llama.init_params(jax.random.PRNGKey(seed), cfg)
-        if quantization == "int8":
+        elif quantization == "int8":
             from ..models.quantize import quantize_llama
 
             params = quantize_llama(params)
-        elif quantization is not None:
-            raise ValueError(f"unknown quantization {quantization!r}")
 
         # tensor parallelism is ONE ENGINE FLAG, not a separate code path
         # (matching vllm_inference.py:180's --tensor-parallel-size): weights
@@ -665,7 +682,9 @@ class LLMEngine:
                 jnp.ones((self.max_slots,), jnp.float32),
                 jnp.full((self.max_slots,), -1, jnp.int32),
             )
-        jax.block_until_ready(self.cache.k_pages)
+        from ..utils.sync import force
+
+        force(self.cache.k_pages)  # block_until_ready is a no-op on axon
         return time.monotonic() - t0
 
     def abort(self, request: Request) -> None:

@@ -1,0 +1,22 @@
+"""True device synchronization for backends with deferred execution.
+
+On the tunneled axon TPU backend, ``jax.block_until_ready`` returns
+immediately while execution is still queued (measured: 0.03 ms vs the full
+exec+round-trip for ``np.asarray`` on the same value). Anything that needs
+"this work has actually run on the chip" semantics — warmup timing, freeing
+donated buffers, OOM attribution — must force with a host fetch. ``force``
+fetches ONE element per leaf, so the cost is a round trip, not a transfer
+of the (possibly multi-GB) array.
+"""
+
+from __future__ import annotations
+
+import jax
+import numpy as np
+
+
+def force(tree) -> None:
+    """Materialize every array leaf in ``tree`` by fetching one element."""
+    for leaf in jax.tree.leaves(tree):
+        if hasattr(leaf, "reshape") and getattr(leaf, "size", 0):
+            np.asarray(jax.lax.slice(leaf.reshape(-1), (0,), (1,)))

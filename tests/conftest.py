@@ -23,9 +23,22 @@ if "xla_force_host_platform_device_count" not in flags:
         flags + " --xla_force_host_platform_device_count=8"
     ).strip()
 
-# Isolate the local control plane (volumes/dicts/queues/apps) per session.
+# Isolate the local control plane (volumes/dicts/queues/apps) per test
+# session.
 _state_tmp = tempfile.mkdtemp(prefix="mtpu-test-state-")
 os.environ.setdefault("MTPU_STATE_DIR", _state_tmp)
+
+# Persistent XLA compile cache (utils/compile_cache.py): the suite is
+# compile-bound on CPU, so warm runs trade recompiles for disk hits. jax
+# reads these env vars natively, including in executor child processes.
+if os.environ.get("MTPU_COMPILE_CACHE", "").lower() not in ("0", "off", "none"):
+    _cache = os.environ.get("MTPU_COMPILE_CACHE") or str(
+        Path.home() / ".cache" / "modal_examples_tpu" / "xla-cache"
+    )
+    Path(_cache).mkdir(parents=True, exist_ok=True)
+    os.environ.setdefault("JAX_COMPILATION_CACHE_DIR", _cache)
+    os.environ.setdefault("JAX_PERSISTENT_CACHE_MIN_COMPILE_TIME_SECS", "0.5")
+    os.environ.setdefault("JAX_PERSISTENT_CACHE_MIN_ENTRY_SIZE_BYTES", "0")
 
 import pytest  # noqa: E402
 
