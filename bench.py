@@ -35,6 +35,11 @@ CONFIGS = {
     # halves weight-streaming bytes AND frees HBM for slots — the bf16 8-slot
     # config's ceiling is ~486 tok/s (8 tok per 16.5 ms weight read), so the
     # quantized high-slot configs are the only road to the 1400 target.
+    "llama2-7b-int8-s36": dict(
+        # 36 slots is the measured sweet spot with the ragged kernel; the
+        # remote-compile helper crashes somewhere past ~40 (round-4 sweep)
+        slots=36, max_len=256, max_tokens=128, timeout=1200, quant="int8"
+    ),
     "llama2-7b-int8-s32": dict(
         slots=32, max_len=256, max_tokens=128, timeout=1200, quant="int8"
     ),
@@ -79,6 +84,10 @@ def _child(model: str) -> None:
         prefill_buckets=(64, 128, 256),
         kv_dtype=jnp.bfloat16,
         quantization=spec.get("quant"),
+        # the v3 ragged kernel + pallas scatter decode structure (round 4);
+        # models whose shapes don't fit the kernel fall back to XLA inside
+        # decode_step
+        paged_impl="pallas",
     )
     build_s = time.time() - t0
     weight_bytes = param_bytes(engine.params)
@@ -252,6 +261,7 @@ def main() -> int:
         # the strongest measured number on the table.
         order = [
             "tiny",
+            "llama2-7b-int8-s36",
             "llama2-7b-int8-s32",
             "llama2-7b-int8-s16",
             "llama2-7b",
@@ -282,7 +292,12 @@ def main() -> int:
         if env.get("BENCH_FIRST_WIN") and not is_canary:
             break
 
-    real = {k: v for k, v in results.items() if k != "tiny"} or results
+    # the HEADLINE is pinned to the north-star family: vs_baseline compares
+    # against the A100 Llama-2-7B number, so only llama2-7b* configs may
+    # claim it (round-3 VERDICT: a 1B model must never be scored against
+    # the 7B baseline). Other models still appear in all_configs.
+    real = {k: v for k, v in results.items() if k.startswith("llama2-7b")}
+    real = real or {k: v for k, v in results.items() if k != "tiny"} or results
     if not real:
         print(
             json.dumps(
@@ -299,6 +314,14 @@ def main() -> int:
 
     best_name = max(real, key=lambda k: real[k]["value"])
     best = real[best_name]
+    if not best_name.startswith("llama2-7b"):
+        # fallback headline (7B configs all failed): vs_baseline against the
+        # 7B A100 number would be dishonest for another model — null it out
+        best["vs_baseline"] = 0.0
+        best["baseline_note"] = (
+            "no llama2-7b config completed; value is NOT comparable to the "
+            "A100 llama2-7b baseline"
+        )
     best["all_configs"] = {k: v["value"] for k, v in results.items()}
 
     # warm-boot proof for the compile cache: rerun the winner (tiny token

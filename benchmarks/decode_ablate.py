@@ -1,0 +1,201 @@
+#!/usr/bin/env python
+"""Decode-step ablation: attribute per-step ms to weights / attention /
+scatter / sampling.
+
+decode_micro.py showed xla == pallas at every slot count (round 4), so the
+paged-attention impl is NOT the bottleneck — this script finds what is, by
+timing the same jitted decode block with components knocked out:
+
+- ``full``      : llama.decode_step + fused sampling (what the engine runs)
+- ``nosample``  : decode_step only; sampling replaced by argmax-free pass-through
+- ``noattn``    : paged attention monkeypatched to identity -> XLA DCEs the
+                  page gather AND the attention math (isolates weights+scatter)
+- ``noscatter`` : noattn + the post-scan KV scatter dropped (pure weight chain)
+
+Run: python benchmarks/decode_ablate.py [--quant int8] [--slots 8,16,32]
+Prints one JSON line per (variant, slots).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--model", default="llama2-7b")
+    ap.add_argument("--quant", default=None, choices=[None, "int8"])
+    ap.add_argument("--slots", default="8,16,32")
+    ap.add_argument("--variants", default="full,nosample,noattn,noscatter")
+    ap.add_argument("--steps", type=int, default=8)
+    ap.add_argument("--max-len", type=int, default=256)
+    ap.add_argument("--page-size", type=int, default=16)
+    ap.add_argument("--iters", type=int, default=20)
+    ap.add_argument("--kv-dtype", default="bfloat16")
+    args = ap.parse_args()
+
+    from modal_examples_tpu.utils.compile_cache import enable_compile_cache
+
+    enable_compile_cache()
+
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from modal_examples_tpu.models import llama
+    from modal_examples_tpu.models.quantize import param_bytes
+    from modal_examples_tpu.serving.sampling import sample
+    from modal_examples_tpu.utils.sync import force
+
+    cfg = (
+        llama.LlamaConfig.tiny()
+        if args.model == "tiny"
+        else getattr(
+            llama.LlamaConfig, args.model.replace("-", "_").replace(".", "")
+        )()
+    )
+    if args.quant == "int8":
+        from modal_examples_tpu.models.quantize import init_quantized_llama
+
+        params = init_quantized_llama(jax.random.PRNGKey(0), cfg)
+    else:
+        params = llama.init_params(jax.random.PRNGKey(0), cfg)
+    force(params)
+    weight_bytes = param_bytes(params)
+    print(
+        f"# {args.model} quant={args.quant} weights={weight_bytes/1e9:.2f} GB "
+        f"floor={weight_bytes/819e9*1e3:.1f} ms/step",
+        file=sys.stderr,
+    )
+
+    K = args.steps
+    kv_dt = jnp.dtype(args.kv_dtype)
+
+    real_attn = llama.paged_decode_attention_inflight
+
+    def fake_attn(q, ks, vs, prefix_lens, k_new, v_new, **kw):
+        # ignores ks/vs -> XLA dead-code-eliminates the page gather entirely
+        return q
+
+    import contextlib
+
+    @contextlib.contextmanager
+    def attn_patched(on: bool):
+        if on:
+            llama.paged_decode_attention_inflight = fake_attn
+        try:
+            yield
+        finally:
+            llama.paged_decode_attention_inflight = real_attn
+
+    def make_block(variant):
+        do_sample = variant == "full"
+        no_scatter = variant == "noscatter"
+
+        def block(params, k_pages, v_pages, prev, positions, tables, active,
+                  key, temps, top_ps, top_ks, seeds):
+            def body(carry, k_i):
+                tok, pos, kp, vp = carry
+                logits, kp2, vp2 = llama.decode_step(
+                    # impl pinned to the XLA inflight path: the noattn/
+                    # noscatter DCE monkeypatch only works there
+                    params, tok, pos, kp, vp, tables, active, cfg, impl="xla"
+                )
+                if no_scatter:
+                    kp2, vp2 = kp, vp  # scatter result dropped -> DCE'd
+                if do_sample:
+                    nxt = sample(
+                        logits, k_i, temps, top_ps, top_ks, seeds=seeds,
+                        step_ids=pos,
+                    )
+                else:
+                    # cheapest data-dependent token: keeps the scan sequential
+                    nxt = logits[:, 0].astype(jnp.int32) % 17
+                nxt = jnp.where(active, nxt, tok)
+                return (nxt, pos + 1, kp2, vp2), nxt
+
+            (last, _, k_pages, v_pages), toks = jax.lax.scan(
+                body, (prev, positions, k_pages, v_pages),
+                jax.random.split(key, K),
+            )
+            return toks, last, k_pages, v_pages
+
+        return block
+
+    for variant in args.variants.split(","):
+        patch = variant in ("noattn", "noscatter")
+        for slots in [int(s) for s in args.slots.split(",")]:
+            pp = args.max_len // args.page_size
+            n_pages = 1 + slots * pp
+            try:
+                with attn_patched(patch):
+                    kp = jnp.zeros(
+                        (cfg.n_layers, n_pages, args.page_size,
+                         cfg.n_kv_heads, cfg.head_dim), kv_dt,
+                    )
+                    vp = jnp.zeros_like(kp)
+                    tables = jnp.asarray(
+                        1 + np.arange(slots * pp).reshape(slots, pp), jnp.int32
+                    )
+                    positions = jnp.full((slots,), args.max_len // 2, jnp.int32)
+                    active = jnp.ones((slots,), bool)
+                    prev = jnp.zeros((slots,), jnp.int32)
+                    temps = jnp.ones((slots,), jnp.float32)
+                    top_ps = jnp.ones((slots,), jnp.float32)
+                    top_ks = jnp.zeros((slots,), jnp.int32)
+                    seeds = jnp.arange(slots, dtype=jnp.int32)
+                    fn = jax.jit(make_block(variant), donate_argnums=(1, 2))
+                    t0 = time.time()
+                    toks, last, kp, vp = fn(
+                        params, kp, vp, prev, positions, tables, active,
+                        jax.random.PRNGKey(1), temps, top_ps, top_ks, seeds,
+                    )
+                    np.asarray(last)  # block_until_ready is a no-op on axon
+                    compile_s = time.time() - t0
+
+                    def run(n):
+                        nonlocal toks, last, kp, vp
+                        t0 = time.time()
+                        for i in range(n):
+                            toks, last, kp, vp = fn(
+                                params, kp, vp, last, positions, tables,
+                                active, jax.random.PRNGKey(2 + i), temps,
+                                top_ps, top_ks, seeds,
+                            )
+                        np.asarray(last)
+                        return time.time() - t0
+
+                    n1, n2 = max(2, args.iters // 3), args.iters
+                    t1, t2 = run(n1), run(n2)
+                    step_ms = (t2 - t1) / ((n2 - n1) * K) * 1e3
+                    print(
+                        json.dumps(
+                            {
+                                "variant": variant,
+                                "slots": slots,
+                                "step_ms": round(step_ms, 2),
+                                "compile_s": round(compile_s, 1),
+                            }
+                        ),
+                        flush=True,
+                    )
+                    del kp, vp
+            except Exception as e:
+                print(
+                    json.dumps(
+                        {"variant": variant, "slots": slots,
+                         "error": f"{type(e).__name__}: {str(e)[:200]}"}
+                    ),
+                    flush=True,
+                )
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -77,32 +77,39 @@ def main() -> int:
 
     K = args.steps
 
-    def block(params, k_pages, v_pages, prev, positions, tables, active, key,
-              temps, top_ps, top_ks, seeds):
-        def body(carry, k_i):
-            tok, pos, kp, vp = carry
-            logits, kp, vp = llama.decode_step(
-                params, tok, pos, kp, vp, tables, active, cfg
-            )
-            nxt = sample(
-                logits, k_i, temps, top_ps, top_ks, seeds=seeds, step_ids=pos
-            )
-            nxt = jnp.where(active, nxt, tok)
-            return (nxt, pos + 1, kp, vp), nxt
+    def make_block(impl):
+        # impl passed explicitly (NOT via MTPU_PAGED_IMPL): the env var is
+        # read at trace time and is not part of any jit cache key (ADVICE r3)
+        def block(params, k_pages, v_pages, prev, positions, tables, active,
+                  key, temps, top_ps, top_ks, seeds):
+            def body(carry, k_i):
+                tok, pos, kp, vp = carry
+                logits, kp, vp = llama.decode_step(
+                    params, tok, pos, kp, vp, tables, active, cfg, impl=impl
+                )
+                nxt = sample(
+                    logits, k_i, temps, top_ps, top_ks, seeds=seeds,
+                    step_ids=pos,
+                )
+                nxt = jnp.where(active, nxt, tok)
+                return (nxt, pos + 1, kp, vp), nxt
 
-        (last, _, k_pages, v_pages), toks = jax.lax.scan(
-            body, (prev, positions, k_pages, v_pages), jax.random.split(key, K)
-        )
-        return toks, last, k_pages, v_pages
+            (last, _, k_pages, v_pages), toks = jax.lax.scan(
+                body, (prev, positions, k_pages, v_pages),
+                jax.random.split(key, K),
+            )
+            return toks, last, k_pages, v_pages
+
+        return block
 
     for impl in args.impl.split(","):
-        os.environ["MTPU_PAGED_IMPL"] = impl
+        block = make_block(impl)
         for slots in [int(s) for s in args.slots.split(",")]:
             pp = args.max_len // args.page_size
             n_pages = 1 + slots * pp
             try:
                 kp = jnp.zeros(
-                    (cfg.n_layers, n_pages, cfg.n_kv_heads, args.page_size,
+                    (cfg.n_layers, n_pages, args.page_size, cfg.n_kv_heads,
                      cfg.head_dim),
                     jnp.bfloat16,
                 )

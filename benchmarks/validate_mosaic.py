@@ -47,11 +47,15 @@ def section(name):
 
 
 def timeit(fn, *args, iters=20):
-    fn(*args)[0].block_until_ready() if isinstance(fn(*args), tuple) else jax.block_until_ready(fn(*args))
+    # force(), not block_until_ready: the latter is a no-op on the tunneled
+    # axon backend, so these timings would otherwise measure dispatch only
+    from modal_examples_tpu.utils.sync import force
+
+    force(fn(*args))
     t0 = time.time()
     for _ in range(iters):
         r = fn(*args)
-    jax.block_until_ready(r)
+    force(r)
     return (time.time() - t0) / iters * 1e3  # ms
 
 
@@ -132,10 +136,10 @@ def main():
         page_size, pages_per_seq = 16, 32
         n_pages = B * pages_per_seq + 8
         kp = jax.random.normal(
-            jax.random.PRNGKey(3), (n_pages, Hkv, page_size, D), jnp.bfloat16
+            jax.random.PRNGKey(3), (n_pages, page_size, Hkv, D), jnp.bfloat16
         )
         vp = jax.random.normal(
-            jax.random.PRNGKey(4), (n_pages, Hkv, page_size, D), jnp.bfloat16
+            jax.random.PRNGKey(4), (n_pages, page_size, Hkv, D), jnp.bfloat16
         )
         pt = jax.random.permutation(jax.random.PRNGKey(5), n_pages)[
             : B * pages_per_seq

@@ -65,7 +65,10 @@ class Embedder:
         import numpy as np
 
         t = np.zeros((MAX_BATCH, MAX_SEQ), np.int32)
-        self._embed(self.params, t, np.ones_like(t)).block_until_ready()
+        from modal_examples_tpu.utils.sync import force
+
+        # force(): block_until_ready is a no-op on the tunneled axon backend
+        force(self._embed(self.params, t, np.ones_like(t)))
 
     def _encode_batch(self, texts: list[str]):
         import numpy as np

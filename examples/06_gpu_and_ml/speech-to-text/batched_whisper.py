@@ -44,9 +44,13 @@ class WhisperTranscriber:
             )
         )
         # warm the fixed batch shape
-        self._transcribe(
+        from modal_examples_tpu.utils.sync import force
+
+        # force(), not block_until_ready: the latter is a no-op on the
+        # tunneled axon backend, so the warmup would not actually compile+run
+        force(self._transcribe(
             self.params, np.zeros((MAX_BATCH, MEL_FRAMES, 80), np.float32)
-        ).block_until_ready()
+        ))
 
     @mtpu.batched(max_batch_size=MAX_BATCH, wait_ms=100)
     @mtpu.method()

@@ -43,7 +43,11 @@ class Embedder:
         self._embed = jax.jit(lambda p, t: bert.embed(p, t, None, self.cfg))
         import numpy as np
 
-        self._embed(self.params, np.zeros((4, 32), np.int32)).block_until_ready()
+        from modal_examples_tpu.utils.sync import force
+
+        # force(): block_until_ready is a no-op on the tunneled axon backend,
+        # and compile_s below is a published measurement
+        force(self._embed(self.params, np.zeros((4, 32), np.int32)))
         self.compile_s = time.time() - t0
         compile_cache.commit()  # publish cache entries for the next replica
 

@@ -36,6 +36,8 @@ from ..ops import (
     flash_attention,
     paged_decode_attention,
     paged_decode_attention_inflight,
+    paged_decode_attention_ragged,
+    scatter_kv_pages,
 )
 from . import layers
 
@@ -383,7 +385,7 @@ def forward(
 def prefill(
     params: dict,
     tokens: jax.Array,  # [B, S] padded
-    k_pages: jax.Array,  # [L, n_pages, Hkv, page_size, hd]
+    k_pages: jax.Array,  # [L, n_pages, page_size, Hkv, hd]
     v_pages: jax.Array,
     page_tables: jax.Array,  # [B, pages_per_seq]
     seq_lens: jax.Array,  # [B] true lengths
@@ -393,7 +395,7 @@ def prefill(
     """Process prompts, filling the paged KV cache; returns (logits_last,
     k_pages, v_pages). Padded positions write to reserved trash page 0."""
     B, S = tokens.shape
-    page_size = k_pages.shape[3]
+    page_size = k_pages.shape[2]
     positions = jnp.broadcast_to(jnp.arange(S), (B, S))
     valid = positions < seq_lens[:, None]
     cos, sin = layers.rotary_embedding(
@@ -449,14 +451,14 @@ def prefill(
 
 
 def _scatter_pages(k_pages, v_pages, k_all, v_all, page_idx, slot):
-    """Write [L, Hkv, B, S, D] new KV into [L, P, Hkv, page_size, D] pages at
-    (page_idx[b,s], slot[b,s])."""
-    # advanced indices (page_idx, slot) at dims 1 and 3 move to the front:
-    # the target block is [B, S, L, Hkv, D]
-    upd_k = k_all.transpose(2, 3, 0, 1, 4)
-    upd_v = v_all.transpose(2, 3, 0, 1, 4)
-    k_pages = k_pages.at[:, page_idx, :, slot].set(upd_k)
-    v_pages = v_pages.at[:, page_idx, :, slot].set(upd_v)
+    """Write [L, Hkv, B, S, D] new KV into [L, P, page_size, Hkv, D] pages
+    at (page_idx[b,s], slot[b,s])."""
+    # adjacent advanced indices (page_idx, slot) at dims 1, 2 keep their
+    # position: the target block is [L, B, S, Hkv, D]
+    upd_k = k_all.transpose(0, 2, 3, 1, 4)
+    upd_v = v_all.transpose(0, 2, 3, 1, 4)
+    k_pages = k_pages.at[:, page_idx, slot].set(upd_k)
+    v_pages = v_pages.at[:, page_idx, slot].set(upd_v)
     return k_pages, v_pages
 
 
@@ -478,7 +480,7 @@ def prefill_chunk(
     the chunked-prefill half of the serving engine (vLLM chunked prefill
     analog). Returns (last_logits [B, vocab], k_pages, v_pages)."""
     B, C = tokens.shape
-    page_size = k_pages.shape[3]
+    page_size = k_pages.shape[2]
     positions = q_offset + jnp.broadcast_to(jnp.arange(C), (B, C))
     valid = jnp.arange(C)[None, :] < chunk_lens[:, None]
     cos, sin = layers.rotary_embedding(
@@ -498,7 +500,7 @@ def prefill_chunk(
 
     def layer_fn(carry, layer_with_pages):
         x = carry
-        layer, k_pg, v_pg = layer_with_pages  # [Hkv, P, ps, D]
+        layer, k_pg, v_pg = layer_with_pages  # [P, ps, Hkv, D]
         D = cfg.head_dim
         h = layers.rms_norm(x, layer["attn_norm"], cfg.norm_eps)
         q = layers.mm(h, layer["wq"]).astype(x.dtype)
@@ -511,11 +513,11 @@ def prefill_chunk(
         k = layers.apply_rope(k, cos, sin)
 
         if n_prefix_pages:
-            # [B, n_pp, Hkv, ps, D] -> [B, Hkv, prefix, D]
-            pk = k_pg[prefix_tables].transpose(0, 2, 1, 3, 4).reshape(
+            # [B, n_pp, ps, Hkv, D] -> [B, Hkv, prefix, D]
+            pk = k_pg[prefix_tables].transpose(0, 3, 1, 2, 4).reshape(
                 B, cfg.n_kv_heads, n_prefix_pages * page_size, D
             )
-            pv = v_pg[prefix_tables].transpose(0, 2, 1, 3, 4).reshape(
+            pv = v_pg[prefix_tables].transpose(0, 3, 1, 2, 4).reshape(
                 B, cfg.n_kv_heads, n_prefix_pages * page_size, D
             )
             k_full = jnp.concatenate([pk, k], axis=2)
@@ -556,16 +558,24 @@ def decode_step(
     params: dict,
     tokens: jax.Array,  # [B] int32 — current token per slot
     positions: jax.Array,  # [B] int32 — its position
-    k_pages: jax.Array,  # [L, P, Hkv, page_size, hd]
+    k_pages: jax.Array,  # [L, P, page_size, Hkv, hd]
     v_pages: jax.Array,
     page_tables: jax.Array,  # [B, pages_per_seq]
     active: jax.Array,  # [B] bool — live slots (dead slots write trash page 0)
     cfg: LlamaConfig,
+    impl: str | None = None,  # None: MTPU_PAGED_IMPL env (read at TRACE time)
+    scatter_impl: str | None = None,  # None: MTPU_SCATTER_IMPL env (trace time)
 ):
     """One token of batched decode against the paged cache.
 
     Returns (logits [B, vocab], k_pages, v_pages). Pass donated pages for
     in-place updates under jit.
+
+    ``impl`` selects the decode structure ("xla" default, "pallas",
+    "xla-writeback"). Callers that jit this (the engine) must resolve it
+    ONCE and pass it explicitly: the env fallback is read at trace time and
+    is not part of any jit cache key, so toggling the env after a trace
+    silently keeps the previously compiled implementation (ADVICE r3).
 
     Structure (round-3 rework): the page arrays are READ-ONLY inside the
     layer scan — attention sees the cached prefix via a fused gather plus
@@ -577,21 +587,43 @@ def decode_step(
     every step — the main gap between the measured 28 ms decode step and the
     16.5 ms weight-streaming floor (NOTES.md round 2).
 
-    The Pallas-kernel path (``MTPU_PAGED_IMPL=pallas``) keeps the
-    write-then-attend formulation: the kernel reads the current token from
-    the cache, so its KV must land in the pages before attention
-    (``MTPU_PAGED_IMPL=xla-writeback`` keeps that structure but with the XLA
-    attention — the A/B lever for benchmarks/decode_micro.py).
+    impl="pallas" (round 4) keeps this same read-only structure but swaps
+    the attention for the v3 ragged kernel (ops.paged_decode_attention_ragged)
+    — it reads exactly ceil(ctx/page_size) pages per sequence where the XLA
+    gather reads and materializes ALL pages_per_seq pages (measured as the
+    dominant, superlinear-in-slots step cost: benchmarks/decode_ablate.py).
+    ``impl="xla-writeback"`` keeps the round-2 write-then-attend structure
+    as the A/B lever for benchmarks/decode_micro.py.
     """
     import os
 
-    if os.environ.get("MTPU_PAGED_IMPL", "xla") in ("pallas", "xla-writeback"):
+    if impl is None:
+        impl = os.environ.get("MTPU_PAGED_IMPL", "xla")
+    if scatter_impl is None:
+        scatter_impl = os.environ.get("MTPU_SCATTER_IMPL", "xla")
+    if impl in ("xla-writeback", "pallas-writeback"):
         return _decode_step_writeback(
             params, tokens, positions, k_pages, v_pages, page_tables, active,
-            cfg,
+            cfg, impl=impl,
         )
     B = tokens.shape[0]
-    page_size = k_pages.shape[3]
+    page_size = k_pages.shape[2]
+    # "pallas" = the v3 ragged kernel in the SAME read-only-pages structure
+    # as the default path (in-flight token as an extra softmax column, one
+    # scatter after the scan). Mosaic tiling needs D%128 / page_size%16, and
+    # the kernel's free (ps, Hkv, D) -> (ps*Hkv, D) flatten needs Hkv%16
+    # (sub-16 head counts pad sublanes; merging padded tiles relayouts).
+    # Sub-tile shapes (tiny test models, GQA Hkv=8) silently take the XLA
+    # path — GQA caches are Hkv/Hq-fraction sized, so the gather the kernel
+    # exists to kill is proportionally cheaper there.
+    use_ragged = impl == "pallas" and (
+        jax.default_backend() != "tpu"
+        or (
+            cfg.head_dim % 128 == 0
+            and page_size % 16 == 0
+            and cfg.n_kv_heads % 16 == 0
+        )
+    )
     x = params["embed"][tokens]  # [B, D]
     cos, sin = layers.rotary_embedding(
         positions[:, None], cfg.head_dim, cfg.rope_theta, dtype=jnp.float32,
@@ -620,13 +652,23 @@ def decode_step(
         q = layers.apply_rope(q, cos, sin)
         k = layers.apply_rope(k, cos, sin)
         k_tok, v_tok = k[:, :, 0], v[:, :, 0]  # [B, Hkv, D]
-        # one gather from the full [L, P, ...] arrays (layer scalar + table
-        # array fuse into a single XLA gather — no per-layer slice copy)
-        ks = k_pages[li, page_tables]  # [B, pp, Hkv, ps, D]
-        vs = v_pages[li, page_tables]
-        o = paged_decode_attention_inflight(
-            q[:, :, 0], ks, vs, prefix_lens, k_tok, v_tok
-        )  # [B, H, D]
+        if use_ragged:
+            # kernel reads exactly ceil(prefix/ps) pages straight from the
+            # full [L, P, ...] cache (layer via scalar prefetch — no slice
+            # copy, no gather materialization)
+            o = paged_decode_attention_ragged(
+                q[:, :, 0], k_pages, v_pages, li, page_tables, prefix_lens,
+                k_tok, v_tok,
+            )  # [B, H, D]
+        else:
+            # one gather from the full [L, P, ...] arrays (layer scalar +
+            # table array fuse into a single XLA gather — no per-layer slice
+            # copy)
+            ks = k_pages[li, page_tables]  # [B, pp, ps, Hkv, D]
+            vs = v_pages[li, page_tables]
+            o = paged_decode_attention_inflight(
+                q[:, :, 0], ks, vs, prefix_lens, k_tok, v_tok
+            )  # [B, H, D]
         o = o.reshape(B, cfg.n_heads * D)
         x = x + layers.mm(o, layer["wo"]).astype(x.dtype)
         h = layers.rms_norm(x, layer["mlp_norm"], cfg.norm_eps)
@@ -636,11 +678,23 @@ def decode_step(
     x, (k_all, v_all) = jax.lax.scan(
         layer_fn, x, (_layer_stack(params), jnp.arange(L))
     )
-    # k_all: [L, B, Hkv, D] -> one scatter for every layer's token. Advanced
-    # indices at dims 1 (page_idx [B]) and 3 (slot [B]) are separated by a
-    # slice, so the batch dim moves to the front: update is [B, L, Hkv, D].
-    k_pages = k_pages.at[:, page_idx, :, slot].set(k_all.transpose(1, 0, 2, 3))
-    v_pages = v_pages.at[:, page_idx, :, slot].set(v_all.transpose(1, 0, 2, 3))
+    # k_all: [L, B, Hkv, D] -> one scatter for every layer's token.
+    # The pallas scatter (in-place strided DMAs; XLA's scatter for this
+    # update measured 4.8 ms/step at 7B/32 slots, decode_ablate.py) is
+    # opt-in (scatter_impl="pallas", resolved above — callers that jit must
+    # pass it explicitly, same trap as impl=) until it is revalidated on a
+    # healthy chip: its first on-chip run this round wedged the device
+    # mid-compile, and a wedged chip poisons every later bench config.
+    if use_ragged and scatter_impl == "pallas":
+        k_pages, v_pages = scatter_kv_pages(
+            k_pages, v_pages, k_all, v_all, page_idx, slot
+        )
+    else:
+        # XLA scatter: adjacent advanced indices (dims 1, 2) keep their
+        # position, so the [L, B, Hkv, D] scan ys line up directly.
+        # Auto-partitionable (TP serving).
+        k_pages = k_pages.at[:, page_idx, slot].set(k_all)
+        v_pages = v_pages.at[:, page_idx, slot].set(v_all)
     x = layers.rms_norm(x, params["final_norm"], cfg.norm_eps)
     head = params["embed"].T if cfg.tie_embeddings else params["lm_head"]
     logits = layers.mm(x, head)
@@ -648,14 +702,15 @@ def decode_step(
 
 
 def _decode_step_writeback(
-    params, tokens, positions, k_pages, v_pages, page_tables, active, cfg
+    params, tokens, positions, k_pages, v_pages, page_tables, active, cfg,
+    impl: str = "xla-writeback",
 ):
     """Write-then-attend decode (Pallas paged kernel path): each layer lands
     its KV in the pages before calling the kernel, which reads the current
     token back from the cache. See ``decode_step`` for why the default path
     avoids threading the caches through the scan."""
     B = tokens.shape[0]
-    page_size = k_pages.shape[3]
+    page_size = k_pages.shape[2]
     x = params["embed"][tokens]  # [B, D]
     cos, sin = layers.rotary_embedding(
         positions[:, None], cfg.head_dim, cfg.rope_theta, dtype=jnp.float32,
@@ -682,12 +737,13 @@ def _decode_step_writeback(
         v = v.reshape(B, 1, cfg.n_kv_heads, D).transpose(0, 2, 1, 3)
         q = layers.apply_rope(q, cos, sin)
         k = layers.apply_rope(k, cos, sin)
-        # write this token's KV into the page cache ([P, Hkv, ps, D] layout:
-        # advanced indices at dims 0 and 2 land the [B, Hkv, D] update)
-        k_pg = k_pg.at[page_idx, :, slot].set(k[:, :, 0])
-        v_pg = v_pg.at[page_idx, :, slot].set(v[:, :, 0])
+        # write this token's KV into the page cache ([P, ps, Hkv, D] layout:
+        # adjacent advanced indices at dims 0, 1 land the [B, Hkv, D] update)
+        k_pg = k_pg.at[page_idx, slot].set(k[:, :, 0])
+        v_pg = v_pg.at[page_idx, slot].set(v[:, :, 0])
         o = paged_decode_attention(
-            q[:, :, 0], k_pg, v_pg, page_tables, ctx_lens
+            q[:, :, 0], k_pg, v_pg, page_tables, ctx_lens,
+            impl="pallas" if impl == "pallas-writeback" else "xla",
         )  # [B, H, D]
         o = o.reshape(B, cfg.n_heads * D)
         x = x + layers.mm(o, layer["wo"]).astype(x.dtype)
@@ -708,7 +764,7 @@ def verify_step(
     params: dict,
     tokens: jax.Array,  # [B, T] int32 — chain: committed token then proposals
     positions0: jax.Array,  # [B] int32 — global position of tokens[:, 0]
-    k_pages: jax.Array,  # [L, P, Hkv, page_size, hd]
+    k_pages: jax.Array,  # [L, P, page_size, Hkv, hd]
     v_pages: jax.Array,
     page_tables: jax.Array,  # [B, pages_per_seq]
     active: jax.Array,  # [B] bool
@@ -727,7 +783,7 @@ def verify_step(
     from ..ops import reference as _ref
 
     B, T = tokens.shape
-    page_size = k_pages.shape[3]
+    page_size = k_pages.shape[2]
     cap = page_tables.shape[1] * page_size
     positions = positions0[:, None] + jnp.arange(T)[None, :]  # [B, T]
     # positions beyond the table capacity write to the trash page (a slot
@@ -746,7 +802,7 @@ def verify_step(
 
     def layer_fn(carry, layer_with_pages):
         x = carry
-        layer, k_pg, v_pg = layer_with_pages  # [P, Hkv, ps, D]
+        layer, k_pg, v_pg = layer_with_pages  # [P, ps, Hkv, D]
         D = cfg.head_dim
         h = layers.rms_norm(x, layer["attn_norm"], cfg.norm_eps)
         q = layers.mm(h, layer["wq"]).astype(x.dtype)
@@ -758,9 +814,10 @@ def verify_step(
         q = layers.apply_rope(q, cos, sin)
         k = layers.apply_rope(k, cos, sin)
         # write the whole chain's KV, then attend (the per-t causal mask in
-        # the verify attention keeps token t from seeing tokens > t)
-        k_pg = k_pg.at[page_idx, :, slot].set(k.transpose(0, 2, 1, 3))
-        v_pg = v_pg.at[page_idx, :, slot].set(v.transpose(0, 2, 1, 3))
+        # the verify attention keeps token t from seeing tokens > t).
+        # Adjacent advanced indices (dims 0, 1): result is [B, T, Hkv, D].
+        k_pg = k_pg.at[page_idx, slot].set(k.transpose(0, 2, 1, 3))
+        v_pg = v_pg.at[page_idx, slot].set(v.transpose(0, 2, 1, 3))
         o = _ref.paged_verify_attention(
             q.transpose(0, 2, 1, 3), k_pg, v_pg, page_tables, positions
         )  # [B, T, Hq, D]
@@ -802,10 +859,9 @@ def load_hf_weights(
     if quantization == "int8":
         from .quantize import LLAMA_TARGETS, quantize_weight_host
 
-        # router stays high precision (tiny, routing-critical); so do norms
-        quant_targets = set(LLAMA_TARGETS) | {
-            "lm_head", "moe_gate", "moe_up", "moe_down",
-        }
+        # the ONE shared target set (models.quantize.LLAMA_TARGETS) plus the
+        # head; router/norms stay high precision (tiny, precision-critical)
+        quant_targets = set(LLAMA_TARGETS) | {"lm_head"}
 
     model_dir = Path(model_dir)
     dt = dtype or cfg.jnp_dtype

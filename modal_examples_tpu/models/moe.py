@@ -125,11 +125,22 @@ def moe_mlp(
     return out.reshape(T, D), jnp.mean(aux)
 
 
+def _mm(h, w):
+    """h @ w where w may be an int8 QuantizedWeight (serving decode streams
+    every expert's weights; int8 halves that HBM traffic exactly like the
+    dense matmuls — models.quantize.LLAMA_TARGETS includes moe_gate/up/down).
+    Delegates to layers.mm (the one quantized-matmul dispatch) and rounds
+    back to h's dtype."""
+    from .layers import mm
+
+    return mm(h, w).astype(h.dtype)
+
+
 def _swiglu_expert(w_gate, w_up, w_down, h):
     """SwiGLU expert FFN (Mixtral w1/w3/w2): h [T, D] -> [T, D]."""
-    a = jnp.einsum("td,df->tf", h, w_gate)
-    b = jnp.einsum("td,df->tf", h, w_up)
-    return jnp.einsum("tf,fd->td", jax.nn.silu(a) * b, w_down)
+    a = _mm(h, w_gate)
+    b = _mm(h, w_up)
+    return _mm(jax.nn.silu(a) * b, w_down)
 
 
 def moe_swiglu_nodrop(
@@ -197,6 +208,15 @@ def moe_swiglu_capacity(
     formulation for compute-bound prefill/training at scale (tokens over
     capacity are dropped, so it is NOT bit-identical to the no-drop serving
     path). Returns (out [T, D] float32, aux load-balance loss)."""
+    from .quantize import QuantizedWeight, dequantize_weight
+
+    # the capacity path is compute-bound (training/prefill scale): int8
+    # weights buy nothing here, so materialize bf16 instead of threading
+    # QuantizedWeight through the batched dispatch einsums
+    w_gate, w_up, w_down = (
+        dequantize_weight(w) if isinstance(w, QuantizedWeight) else w
+        for w in (w_gate, w_up, w_down)
+    )
     E, D, F = w_gate.shape
     cfg = MoEConfig(
         n_experts=E, top_k=top_k, capacity_factor=capacity_factor,

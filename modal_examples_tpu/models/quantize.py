@@ -48,39 +48,33 @@ def dequantize_weight(qw: QuantizedWeight, dtype=jnp.bfloat16) -> jax.Array:
     return (qw.q.astype(jnp.float32) * qw.scale).astype(dtype)
 
 
-#: the matmul weights worth quantizing in a llama tree (norms/embeddings stay
-#: high precision — tiny, and precision-critical)
-LLAMA_TARGETS = ("wq", "wk", "wv", "wo", "gate", "up", "down")
+#: the matmul weights worth quantizing in a llama tree — dense AND MoE expert
+#: matmuls (norms/embeddings/router stay high precision: tiny, and
+#: precision-critical). ONE list shared by every quantization entry point
+#: (quantize_llama, init_quantized_llama, llama.load_hf_weights) so
+#: quantization="int8" means the same precision tree no matter how the
+#: params arrive (ADVICE r3).
+LLAMA_TARGETS = (
+    "wq", "wk", "wv", "wo", "gate", "up", "down",
+    "moe_gate", "moe_up", "moe_down",
+)
 
 
-def quantize_llama(
-    params: dict, targets=LLAMA_TARGETS, *, delete_source: bool = False
-) -> dict:
+def quantize_llama(params: dict, targets=LLAMA_TARGETS) -> dict:
     """Quantize the layer matmuls (and lm_head) of a llama param tree.
 
-    ``delete_source=True`` donates each source buffer into a jitted
-    quantize, so the runtime frees every bf16 leaf the moment its int8
-    replacement exists — only for trees the caller owns outright (the
-    engine's init path). Without it, peak HBM is bf16 + int8 together
-    (~20 GB at 7B), which is what pushed the 32-slot bench config over the
-    edge on a 16 GB v5e. Donation (not ``block_until_ready`` + ``delete``)
-    is load-bearing: on the tunneled axon backend execution is deferred and
-    ``block_until_ready`` returns immediately, so an eager delete would not
-    reduce the peak of the eventually-forced queue.
+    Device-side path for caller-provided trees. Peak HBM is bf16 + int8
+    together; callers that own the tree outright should random-init via
+    ``init_quantized_llama`` (fused, no bf16 peak) or load checkpoints via
+    ``llama.load_hf_weights(quantization="int8")`` (host-side quantize).
     """
-    donate = delete_source and jax.default_backend() != "cpu"
-    _jq = jax.jit(quantize_weight, donate_argnums=(0,) if donate else ())
-
-    def _q(w):
-        return _jq(w) if delete_source else quantize_weight(w)
-
     out = dict(params)
     out["layers"] = {
-        name: _q(w) if name in targets else w
+        name: quantize_weight(w) if name in targets else w
         for name, w in params["layers"].items()
     }
     if "lm_head" in params:
-        out["lm_head"] = _q(params["lm_head"])
+        out["lm_head"] = quantize_weight(params["lm_head"])
     return out
 
 

@@ -14,6 +14,8 @@ from .flash_attention import (
 from .paged_attention import (
     paged_decode_attention,
     paged_decode_attention_inflight,
+    paged_decode_attention_ragged,
+    scatter_kv_pages,
 )
 from .quantized_matmul import dequantize_int8, quantize_int8, quantized_matmul
 from .ring_attention import (
@@ -31,6 +33,8 @@ __all__ = [
     "flash_attention_with_lse",
     "paged_decode_attention",
     "paged_decode_attention_inflight",
+    "paged_decode_attention_ragged",
+    "scatter_kv_pages",
     "quantize_int8",
     "quantized_matmul",
     "reference",

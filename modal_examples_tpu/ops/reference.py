@@ -102,8 +102,8 @@ def attention_chunked(
 
 def paged_decode_attention(
     q: jax.Array,  # [B, Hq, D] — one new token per sequence
-    k_pages: jax.Array,  # [n_pages, Hkv, page_size, D]
-    v_pages: jax.Array,  # [n_pages, Hkv, page_size, D]
+    k_pages: jax.Array,  # [n_pages, page_size, Hkv, D]
+    v_pages: jax.Array,  # [n_pages, page_size, Hkv, D]
     page_tables: jax.Array,  # [B, pages_per_seq] int32 — physical page ids
     context_lens: jax.Array,  # [B] int32 — tokens already in cache (incl. new)
     *,
@@ -112,7 +112,7 @@ def paged_decode_attention(
     """Decode-step attention over a paged KV cache (vLLM-semantics ground
     truth for the Pallas ragged kernel)."""
     B, Hq, D = q.shape
-    _, Hkv, page_size, _ = k_pages.shape
+    _, page_size, Hkv, _ = k_pages.shape
     group = Hq // Hkv
     pages_per_seq = page_tables.shape[1]
     S = pages_per_seq * page_size
@@ -120,10 +120,10 @@ def paged_decode_attention(
         sm_scale = D**-0.5
 
     # gather each sequence's logical KV [B, Hkv, S, D]
-    ks = k_pages[page_tables]  # [B, pages, Hkv, page_size, D]
+    ks = k_pages[page_tables]  # [B, pages, page_size, Hkv, D]
     vs = v_pages[page_tables]
-    ks = ks.transpose(0, 2, 1, 3, 4).reshape(B, Hkv, S, D)
-    vs = vs.transpose(0, 2, 1, 3, 4).reshape(B, Hkv, S, D)
+    ks = ks.transpose(0, 3, 1, 2, 4).reshape(B, Hkv, S, D)
+    vs = vs.transpose(0, 3, 1, 2, 4).reshape(B, Hkv, S, D)
 
     qg = q.reshape(B, Hkv, group, D)
     s = jnp.einsum("bhgd,bhkd->bhgk", qg, ks, preferred_element_type=jnp.float32)
@@ -138,8 +138,8 @@ def paged_decode_attention(
 
 def paged_verify_attention(
     q: jax.Array,  # [B, T, Hq, D] — a short chain of new tokens per sequence
-    k_pages: jax.Array,  # [n_pages, Hkv, page_size, D]
-    v_pages: jax.Array,  # [n_pages, Hkv, page_size, D]
+    k_pages: jax.Array,  # [n_pages, page_size, Hkv, D]
+    v_pages: jax.Array,  # [n_pages, page_size, Hkv, D]
     page_tables: jax.Array,  # [B, pages_per_seq] int32
     positions: jax.Array,  # [B, T] int32 — global position of each query
     *,
@@ -152,17 +152,17 @@ def paged_verify_attention(
     (the reference ships spec decode engine-side, vllm_inference.py:196-205).
     """
     B, T, Hq, D = q.shape
-    _, Hkv, page_size, _ = k_pages.shape
+    _, page_size, Hkv, _ = k_pages.shape
     group = Hq // Hkv
     pages_per_seq = page_tables.shape[1]
     S = pages_per_seq * page_size
     if sm_scale is None:
         sm_scale = D**-0.5
 
-    ks = k_pages[page_tables]  # [B, pages, Hkv, page_size, D]
+    ks = k_pages[page_tables]  # [B, pages, page_size, Hkv, D]
     vs = v_pages[page_tables]
-    ks = ks.transpose(0, 2, 1, 3, 4).reshape(B, Hkv, S, D)
-    vs = vs.transpose(0, 2, 1, 3, 4).reshape(B, Hkv, S, D)
+    ks = ks.transpose(0, 3, 1, 2, 4).reshape(B, Hkv, S, D)
+    vs = vs.transpose(0, 3, 1, 2, 4).reshape(B, Hkv, S, D)
 
     qg = q.transpose(0, 2, 1, 3).reshape(B, Hkv, group, T, D)
     s = jnp.einsum(

@@ -180,10 +180,27 @@ class LLMEngine:
         draft_model_dir: str | None = None,
         decode_block: int = 8,  # decode steps rolled into one dispatch
         mesh=None,  # jax Mesh with a "tensor" axis: tensor-parallel serving
+        paged_impl: str | None = None,  # decode structure; None: env/default
     ):
+        import os as _os
+
         from ..utils.compile_cache import enable_compile_cache
 
         enable_compile_cache()  # warm restarts hit disk, not the compiler
+        # resolved ONCE here and passed explicitly into every jitted decode:
+        # the env vars are not part of any jit cache key (ADVICE r3)
+        self.paged_impl = paged_impl or _os.environ.get("MTPU_PAGED_IMPL", "xla")
+        _known_impls = ("xla", "pallas", "xla-writeback", "pallas-writeback")
+        if self.paged_impl not in _known_impls:
+            raise ValueError(
+                f"unknown paged_impl {self.paged_impl!r}; known: {_known_impls}"
+            )
+        self.scatter_impl = _os.environ.get("MTPU_SCATTER_IMPL", "xla")
+        if self.scatter_impl not in ("xla", "pallas"):
+            raise ValueError(
+                f"unknown MTPU_SCATTER_IMPL {self.scatter_impl!r}; "
+                "known: xla, pallas"
+            )
         self.cfg = cfg
         self.tokenizer = load_tokenizer(model_dir)
         if quantization not in (None, "int8"):
@@ -353,13 +370,13 @@ class LLMEngine:
             self._draft_prefill_jits: dict[object, object] = {}
 
     def _shard_cache(self, cache) -> None:
-        """Shard page arrays [L, P, Hkv, ps, D] by kv head over ``tensor`` —
+        """Shard page arrays [L, P, ps, Hkv, D] by kv head over ``tensor`` —
         every cache byte and its attention math stay on the chip owning the
         head; page tables/ids remain host-global."""
         from jax.sharding import NamedSharding
         from jax.sharding import PartitionSpec as P
 
-        sh = NamedSharding(self.mesh, P(None, None, "tensor", None, None))
+        sh = NamedSharding(self.mesh, P(None, None, None, "tensor", None))
         cache.k_pages = jax.device_put(cache.k_pages, sh)
         cache.v_pages = jax.device_put(cache.v_pages, sh)
 
@@ -380,7 +397,8 @@ class LLMEngine:
         def body(carry, k_i):
             tok, pos, kp, vp = carry
             logits, kp, vp = llama.decode_step(
-                params, tok, pos, kp, vp, page_tables, active, self.cfg
+                params, tok, pos, kp, vp, page_tables, active, self.cfg,
+                impl=self.paged_impl, scatter_impl=self.scatter_impl,
             )
             nxt = sample(
                 logits, k_i, temps, top_ps, top_ks, seeds=seeds, step_ids=pos
@@ -459,7 +477,8 @@ class LLMEngine:
             tok, pos, dk, dv = carry
             step_active = active & (pos < cap)
             logits, dk, dv = llama.decode_step(
-                d_params, tok, pos, dk, dv, page_tables, step_active, dcfg
+                d_params, tok, pos, dk, dv, page_tables, step_active, dcfg,
+                impl=self.paged_impl, scatter_impl=self.scatter_impl,
             )
             scaled = logits / jnp.maximum(temps, 1e-6)[:, None]
             proposed = jnp.where(
@@ -481,7 +500,8 @@ class LLMEngine:
         # collapsing the acceptance rate (logits discarded; draft is small)
         _, dk, dv = llama.decode_step(
             d_params, last_d, last_pos, dk, dv, page_tables,
-            active & (last_pos < cap), dcfg,
+            active & (last_pos < cap), dcfg, impl=self.paged_impl,
+            scatter_impl=self.scatter_impl,
         )
         draft_toks = draft_toks.T  # [B, gamma]
         draft_logps = draft_logps.transpose(1, 0, 2)  # [B, gamma, V]

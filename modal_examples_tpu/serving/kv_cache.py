@@ -2,7 +2,7 @@
 
 The TPU analog of vLLM's PagedAttention block manager (the engine inside the
 reference's vllm_inference.py). Device side: two arrays
-``[n_layers, n_pages, n_kv_heads, page_size, head_dim]`` living in HBM — a
+``[n_layers, n_pages, page_size, n_kv_heads, head_dim]`` living in HBM — a
 page holds all kv heads contiguously so the decode kernel moves one fat DMA
 per page — with page 0 reserved as the trash page (padded/dead slots write
 there). Host side: a
@@ -52,7 +52,7 @@ class PageAllocator:
 
 @dataclasses.dataclass
 class PagedKVCache:
-    k_pages: object  # [L, P, Hkv, page_size, hd]
+    k_pages: object  # [L, P, page_size, Hkv, hd]
     v_pages: object
     page_size: int
     allocator: PageAllocator
@@ -69,7 +69,7 @@ class PagedKVCache:
         dtype=jnp.bfloat16,
         prefer_native: bool = True,
     ) -> "PagedKVCache":
-        shape = (n_layers, n_pages, n_kv_heads, page_size, head_dim)
+        shape = (n_layers, n_pages, page_size, n_kv_heads, head_dim)
         allocator = None
         if prefer_native:
             try:  # C++ free list (native/mtpu_host.cpp); same semantics

@@ -41,12 +41,20 @@ def enable_compile_cache(path: str | None = None) -> str | None:
     Returns the cache dir in use, or None when disabled. Safe to call
     before or after backend init; entries are keyed by HLO + compile flags,
     so CPU and TPU runs coexist in one directory.
+
+    A cache dir the user already configured via ``jax.config`` directly is
+    respected (ADVICE r3): only an explicit ``path=`` argument or
+    ``MTPU_COMPILE_CACHE`` env overrides it; the built-in default never does.
     """
     import jax
 
+    explicit = path is not None or bool(os.environ.get("MTPU_COMPILE_CACHE"))
     path = path or cache_dir()
     if path is None:
         return None
+    current = getattr(jax.config, "jax_compilation_cache_dir", None)
+    if current and not explicit:
+        return current
     try:
         Path(path).mkdir(parents=True, exist_ok=True)
         jax.config.update("jax_compilation_cache_dir", path)

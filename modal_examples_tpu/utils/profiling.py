@@ -40,9 +40,12 @@ class ProfileResult:
 
 
 def _sync(x):
-    import jax
+    # utils.sync.force, NOT jax.block_until_ready: on the tunneled axon
+    # backend block_until_ready returns while execution is still queued, so
+    # every timing here would under-measure (round-4 audit, VERDICT r3 #9)
+    from .sync import force
 
-    jax.block_until_ready(x)
+    force(x)
     return x
 
 

@@ -7,9 +7,12 @@ import subprocess
 import sys
 from pathlib import Path
 
+import pytest
+
 REPO = Path(__file__).resolve().parents[1]
 
 
+@pytest.mark.slow
 def test_bench_emits_schema_json():
     out = subprocess.run(
         [sys.executable, str(REPO / "bench.py")],

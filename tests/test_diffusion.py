@@ -1,7 +1,10 @@
 """DiT diffusion + MMDiT (SD3-class) + PNG utility tests."""
 
-import numpy as np
 import pytest
+
+pytestmark = pytest.mark.slow  # heavyweight: excluded from the fast tier
+
+import numpy as np
 
 
 @pytest.fixture(scope="module")

@@ -20,6 +20,7 @@ class TestGRPOMechanics:
         assert float(adv.mean()) == pytest.approx(0.0, abs=1e-6)
         assert float(adv[2]) > 0 > float(adv[0])
 
+    @pytest.mark.slow
     def test_policy_learns_rewarded_token(self, jax):
         import jax.numpy as jnp
 

@@ -2,8 +2,11 @@
 delta == merged-weight equivalence (the property that licenses on-the-fly
 application during training and merged weights for serving)."""
 
-import numpy as np
 import pytest
+
+pytestmark = pytest.mark.slow  # heavyweight: excluded from the fast tier
+
+import numpy as np
 
 
 @pytest.fixture(scope="module")

@@ -59,7 +59,7 @@ class TestLlama:
 
         page_size, pages_per_seq = 16, 16
         n_pages = 1 + B * pages_per_seq
-        shape = (cfg.n_layers, n_pages, cfg.n_kv_heads, page_size, cfg.head_dim)
+        shape = (cfg.n_layers, n_pages, page_size, cfg.n_kv_heads, cfg.head_dim)
         k_pages = jnp.zeros(shape, jnp.float32)
         v_pages = jnp.zeros(shape, jnp.float32)
         pt = (1 + jnp.arange(B * pages_per_seq, dtype=jnp.int32)).reshape(B, -1)

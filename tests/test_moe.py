@@ -2,8 +2,11 @@
 the sharded all_to_all path must reproduce the single-device ground truth
 exactly (same groups => same capacities => same drops)."""
 
-import numpy as np
 import pytest
+
+pytestmark = pytest.mark.slow  # heavyweight: excluded from the fast tier
+
+import numpy as np
 
 
 @pytest.fixture(scope="module")
@@ -194,7 +197,7 @@ class TestMoEServing:
         cfg, params = served
         B, ps, pps = 2, 16, 4
         n_pages = 1 + B * pps
-        shape = (cfg.n_layers, n_pages, cfg.n_kv_heads, ps, cfg.head_dim)
+        shape = (cfg.n_layers, n_pages, ps, cfg.n_kv_heads, cfg.head_dim)
         pt = (1 + jnp.arange(B * pps, dtype=jnp.int32)).reshape(B, pps)
         active = jnp.ones((B,), bool)
 
@@ -244,7 +247,7 @@ class TestMoEServing:
         cfg, params = served
         B, ps, pps = 2, 16, 4
         n_pages = 1 + B * pps
-        shape = (cfg.n_layers, n_pages, cfg.n_kv_heads, ps, cfg.head_dim)
+        shape = (cfg.n_layers, n_pages, ps, cfg.n_kv_heads, cfg.head_dim)
         pt = (1 + jnp.arange(B * pps, dtype=jnp.int32)).reshape(B, pps)
         active = jnp.ones((B,), bool)
         prompt = jnp.array([[1, 2, 3, 5], [7, 8, 9, 11]], jnp.int32)

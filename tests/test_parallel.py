@@ -3,8 +3,11 @@ under shard_map on the 8-device CPU mesh, and gang-scheduled @clustered
 execution with real cross-process jax.distributed collectives (the multi-host
 simulation SURVEY.md §4 calls for)."""
 
-import numpy as np
 import pytest
+
+pytestmark = pytest.mark.slow  # heavyweight: excluded from the fast tier
+
+import numpy as np
 
 import modal_examples_tpu as mtpu
 

@@ -96,7 +96,7 @@ class TestQuantizedLlama:
         logits_full = llama.forward(qparams, tokens, cfg, attn_impl="xla")
 
         page_size, pages_per_seq = 16, 4
-        shape = (cfg.n_layers, 1 + B * pages_per_seq, cfg.n_kv_heads, page_size, cfg.head_dim)
+        shape = (cfg.n_layers, 1 + B * pages_per_seq, page_size, cfg.n_kv_heads, cfg.head_dim)
         k_pages = jnp.zeros(shape, jnp.float32)
         v_pages = jnp.zeros_like(k_pages)
         pt = (1 + jnp.arange(B * pages_per_seq, dtype=jnp.int32)).reshape(B, -1)

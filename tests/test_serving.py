@@ -55,6 +55,7 @@ class TestSampling:
         )
         assert out.tolist() == [1, 0]
 
+    @pytest.mark.slow
     def test_top_k_masks_tail(self, jax):
         import jax.numpy as jnp
 
@@ -72,6 +73,7 @@ class TestSampling:
         }
         assert outs <= {0, 1}
 
+    @pytest.mark.slow
     def test_top_p_keeps_nucleus(self, jax):
         import jax.numpy as jnp
 

@@ -2,8 +2,11 @@
 model's greedy decode token-for-token; self-drafting must accept everything;
 stochastic mode must produce a full-length sample."""
 
-import numpy as np
 import pytest
+
+pytestmark = pytest.mark.slow  # heavyweight: excluded from the fast tier
+
+import numpy as np
 
 
 @pytest.fixture(scope="module")
@@ -215,7 +218,7 @@ class TestVerifyStep:
         params = llama.init_params(jax.random.PRNGKey(0), cfg)
         B, T, ps, pps = 2, 4, 16, 4
         n_pages = 1 + B * pps
-        shape = (cfg.n_layers, n_pages, cfg.n_kv_heads, ps, cfg.head_dim)
+        shape = (cfg.n_layers, n_pages, ps, cfg.n_kv_heads, cfg.head_dim)
         pt = (1 + jnp.arange(B * pps, dtype=jnp.int32)).reshape(B, pps)
         active = jnp.ones((B,), bool)
 

@@ -1,8 +1,11 @@
 """Tensor-parallel serving tests: the sharded dense-cache decode must equal
 the single-device full forward, and params/cache must actually shard."""
 
-import numpy as np
 import pytest
+
+pytestmark = pytest.mark.slow  # heavyweight: excluded from the fast tier
+
+import numpy as np
 
 
 @pytest.fixture(scope="module")

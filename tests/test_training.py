@@ -1,8 +1,11 @@
 """Training tests: sharded train step converges on a tiny overfit task;
 checkpoint save/restore round-trips; graft dryrun path compiles and runs."""
 
-import numpy as np
 import pytest
+
+pytestmark = pytest.mark.slow  # heavyweight: excluded from the fast tier
+
+import numpy as np
 
 
 @pytest.fixture(scope="module")

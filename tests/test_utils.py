@@ -37,6 +37,7 @@ class TestPrometheus:
 
 
 class TestTracking:
+    @pytest.mark.slow
     def test_jsonl_roundtrip_and_tb_files(self, tmp_path):
         from modal_examples_tpu.utils.tracking import RunLogger
 

@@ -3,8 +3,11 @@ train, and the diffusers-name HF loader roundtrip (the zero-egress proof
 that a real `vae/diffusion_pytorch_model.safetensors` drops in —
 text_to_image.py:99-137's pipeline VAE)."""
 
-import numpy as np
 import pytest
+
+pytestmark = pytest.mark.slow  # heavyweight: excluded from the fast tier
+
+import numpy as np
 
 
 @pytest.fixture(scope="module")

@@ -4,8 +4,11 @@ sam/segment_anything.py inference service). e2e contract: a train step
 decreases the loss, and a short fine-tune on synthetic shapes localizes an
 easy box with IoU > 0.5."""
 
-import numpy as np
 import pytest
+
+pytestmark = pytest.mark.slow  # heavyweight: excluded from the fast tier
+
+import numpy as np
 
 
 @pytest.fixture(scope="module")
