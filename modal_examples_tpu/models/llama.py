@@ -337,12 +337,19 @@ def forward(
     lora_scale: float = 1.0,
     return_aux: bool = False,  # MoE: also return the mean load-balance loss
     moe_impl: str = "nodrop",  # "capacity": GShard dispatch (training scale)
+    input_embeds: jax.Array | None = None,  # [B, P, D]: multimodal prefix
 ):  # [B, S, vocab] (, aux)
-    """Full-sequence forward with causal attention (flash or xla impl)."""
+    """Full-sequence forward with causal attention (flash or xla impl).
+
+    ``input_embeds`` replaces the embedding lookup for the first P
+    positions (same contract as ``prefill`` — the multimodal path)."""
     B, S = tokens.shape
     if positions is None:
         positions = jnp.broadcast_to(jnp.arange(S), (B, S))
     x = params["embed"][tokens]  # [B, S, D]
+    if input_embeds is not None:
+        P = input_embeds.shape[1]
+        x = jnp.concatenate([input_embeds.astype(x.dtype), x[:, P:]], axis=1)
     cos, sin = layers.rotary_embedding(
         positions, cfg.head_dim, cfg.rope_theta, dtype=jnp.float32,
         rope_scaling=dict(cfg.rope_scaling) if cfg.rope_scaling else None,
@@ -391,9 +398,18 @@ def prefill(
     seq_lens: jax.Array,  # [B] true lengths
     cfg: LlamaConfig,
     attn_impl: str = "flash",  # "xla": auto-partitionable (TP prefill)
+    input_embeds: jax.Array | None = None,  # [B, P, D]: multimodal prefix
 ):
     """Process prompts, filling the paged KV cache; returns (logits_last,
-    k_pages, v_pages). Padded positions write to reserved trash page 0."""
+    k_pages, v_pages). Padded positions write to reserved trash page 0.
+
+    ``input_embeds`` replaces the embedding lookup for the FIRST P
+    positions — the multimodal path (models.vlm image tokens occupy
+    positions 0..P-1; tokens[:, :P] are placeholders). Everything after the
+    embedding — RoPE positions, causal attention, page scatter — already
+    operates on the full sequence, so image tokens become ordinary KV cache
+    entries and decode needs no changes at all (the LLaVA recipe, serving
+    the reference's sglang_vlm.py workload)."""
     B, S = tokens.shape
     page_size = k_pages.shape[2]
     positions = jnp.broadcast_to(jnp.arange(S), (B, S))
@@ -403,6 +419,11 @@ def prefill(
         rope_scaling=dict(cfg.rope_scaling) if cfg.rope_scaling else None,
     )
     x = params["embed"][tokens]
+    if input_embeds is not None:
+        P = input_embeds.shape[1]
+        x = jnp.concatenate(
+            [input_embeds.astype(x.dtype), x[:, P:]], axis=1
+        )
 
     page_idx = jnp.take_along_axis(
         page_tables, positions // page_size, axis=1
@@ -685,7 +706,12 @@ def decode_step(
     # pass it explicitly, same trap as impl=) until it is revalidated on a
     # healthy chip: its first on-chip run this round wedged the device
     # mid-compile, and a wedged chip poisons every later bench config.
-    if use_ragged and scatter_impl == "pallas":
+    # Independent of the attention impl — both structures end in the same
+    # post-scan scatter; only the (Hkv, D) minor-dim tile legality gates it.
+    use_pallas_scatter = scatter_impl == "pallas" and (
+        jax.default_backend() != "tpu" or cfg.head_dim % 128 == 0
+    )
+    if use_pallas_scatter:
         k_pages, v_pages = scatter_kv_pages(
             k_pages, v_pages, k_all, v_all, page_idx, slot
         )

@@ -1,0 +1,290 @@
+"""Vision-language model: CLIP-style ViT tower + projector over the llama
+decoder (LLaVA-family architecture) — the TPU-native counterpart of the
+reference's VLM serving examples, which delegate to SGLang/vLLM CUDA engines
+(/root/reference/06_gpu_and_ml/llm-serving/sglang_vlm.py — Qwen-VL behind an
+OpenAI endpoint; chat_with_pdf_vision.py — image+text RAG chat).
+
+TPU-first design:
+- the vision tower is a pre-LN ViT over non-overlapping patches: the patch
+  embedding is ONE matmul of [B, n_patches, p*p*3] against [p*p*3, D] (an
+  unfold + MXU contraction — no conv shapes for XLA to rewrite), and the
+  encoder blocks are the same scanned-layer structure every other model in
+  the package uses (one compiled block regardless of depth);
+- a 2-layer MLP projector maps patch states into the LLM embedding space
+  (the LLaVA recipe);
+- the language model IS ``models.llama`` — multimodal prompts enter the
+  serving engine as ``input_embeds`` for the first ``n_patches`` positions
+  of an ordinary prefill (llama.prefill), after which paged decode is
+  completely unchanged: image tokens are just cache entries.
+
+``encode_image`` is jittable and fuses into the engine's multimodal prefill
+program, so image encoding rides the same dispatch as the prefill itself.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from pathlib import Path
+
+import jax
+import jax.numpy as jnp
+
+from . import layers
+
+
+@dataclasses.dataclass(frozen=True)
+class ViTConfig:
+    image_size: int = 224
+    patch_size: int = 14
+    dim: int = 1024
+    n_layers: int = 24
+    n_heads: int = 16
+    mlp_dim: int = 4096
+    norm_eps: float = 1e-5
+    dtype: str = "float32"
+
+    @property
+    def n_patches(self) -> int:
+        return (self.image_size // self.patch_size) ** 2
+
+    @property
+    def jnp_dtype(self):
+        return jnp.dtype(self.dtype)
+
+    @staticmethod
+    def clip_vit_l_14() -> "ViTConfig":
+        """openai/clip-vit-large-patch14 — the LLaVA-1.5 vision tower."""
+        return ViTConfig()
+
+    @staticmethod
+    def tiny(image_size: int = 16, patch_size: int = 8) -> "ViTConfig":
+        """Test-tier config (cheap-mode switch, SURVEY.md §4)."""
+        return ViTConfig(
+            image_size=image_size, patch_size=patch_size, dim=32,
+            n_layers=2, n_heads=2, mlp_dim=64,
+        )
+
+
+@dataclasses.dataclass(frozen=True)
+class VLMConfig:
+    """Vision tower + projector + the llama language model it feeds."""
+
+    vision: ViTConfig
+    llm_dim: int  # == LlamaConfig.dim of the paired language model
+
+    @property
+    def n_image_tokens(self) -> int:
+        return self.vision.n_patches
+
+
+def init_vision_params(key: jax.Array, cfg: VLMConfig) -> dict:
+    v = cfg.vision
+    dt = v.jnp_dtype
+    D, L = v.dim, v.n_layers
+    patch_in = v.patch_size * v.patch_size * 3
+    ks = iter(jax.random.split(key, 16))
+
+    def dense(*shape):
+        return layers.init_dense(next(ks), shape, dtype=dt)
+
+    return {
+        "patch_proj": dense(patch_in, D),
+        "pos_emb": layers.init_dense(
+            next(ks), (v.n_patches, D), scale=0.02, dtype=dt
+        ),
+        "pre_ln_scale": jnp.ones((D,), dt),
+        "pre_ln_bias": jnp.zeros((D,), dt),
+        "layers": {
+            "ln1_scale": jnp.ones((L, D), dt),
+            "ln1_bias": jnp.zeros((L, D), dt),
+            "wq": dense(L, D, D), "bq": jnp.zeros((L, D), dt),
+            "wk": dense(L, D, D), "bk": jnp.zeros((L, D), dt),
+            "wv": dense(L, D, D), "bv": jnp.zeros((L, D), dt),
+            "wo": dense(L, D, D), "bo": jnp.zeros((L, D), dt),
+            "ln2_scale": jnp.ones((L, D), dt),
+            "ln2_bias": jnp.zeros((L, D), dt),
+            "fc1": dense(L, D, v.mlp_dim),
+            "fc1_b": jnp.zeros((L, v.mlp_dim), dt),
+            "fc2": dense(L, v.mlp_dim, D),
+            "fc2_b": jnp.zeros((L, D), dt),
+        },
+        # LLaVA-style 2-layer GELU projector into the LLM embedding space
+        "proj1": dense(D, cfg.llm_dim),
+        "proj1_b": jnp.zeros((cfg.llm_dim,), dt),
+        "proj2": dense(cfg.llm_dim, cfg.llm_dim),
+        "proj2_b": jnp.zeros((cfg.llm_dim,), dt),
+    }
+
+
+def _ln(x, scale, bias, eps):
+    mu = jnp.mean(x, axis=-1, keepdims=True)
+    var = jnp.var(x, axis=-1, keepdims=True)
+    return (x - mu) * jax.lax.rsqrt(var + eps) * scale + bias
+
+
+def patchify(images: jax.Array, patch: int) -> jax.Array:
+    """[B, S, S, 3] -> [B, n_patches, patch*patch*3] (row-major patches)."""
+    B, H, W, C = images.shape
+    gh, gw = H // patch, W // patch
+    x = images.reshape(B, gh, patch, gw, patch, C)
+    x = x.transpose(0, 1, 3, 2, 4, 5)  # [B, gh, gw, p, p, C]
+    return x.reshape(B, gh * gw, patch * patch * C)
+
+
+def encode_image(
+    params: dict,
+    images: jax.Array,  # [B, S, S, 3] float in [0, 1]
+    cfg: VLMConfig,
+) -> jax.Array:  # [B, n_patches, llm_dim]
+    """ViT encode + project: image -> LLM-space prefix embeddings."""
+    v = cfg.vision
+    B = images.shape[0]
+    x = patchify(images.astype(v.jnp_dtype), v.patch_size)
+    x = layers.mm(x, params["patch_proj"]).astype(v.jnp_dtype)
+    x = x + params["pos_emb"][None]
+    x = _ln(x, params["pre_ln_scale"], params["pre_ln_bias"], v.norm_eps)
+    S = v.n_patches
+    hd = v.dim // v.n_heads
+
+    def layer_fn(x, l):
+        h = _ln(x, l["ln1_scale"], l["ln1_bias"], v.norm_eps)
+        q = (h @ l["wq"] + l["bq"]).reshape(B, S, v.n_heads, hd)
+        k = (h @ l["wk"] + l["bk"]).reshape(B, S, v.n_heads, hd)
+        val = (h @ l["wv"] + l["bv"]).reshape(B, S, v.n_heads, hd)
+        q, k, val = (t.transpose(0, 2, 1, 3) for t in (q, k, val))
+        s = jnp.einsum(
+            "bhqd,bhkd->bhqk", q, k, preferred_element_type=jnp.float32
+        ) * hd**-0.5  # bidirectional: no mask
+        a = jax.nn.softmax(s, axis=-1).astype(val.dtype)
+        o = jnp.einsum("bhqk,bhkd->bhqd", a, val)
+        o = o.transpose(0, 2, 1, 3).reshape(B, S, v.dim)
+        x = x + (o @ l["wo"] + l["bo"])
+        h = _ln(x, l["ln2_scale"], l["ln2_bias"], v.norm_eps)
+        h = jax.nn.gelu(h @ l["fc1"] + l["fc1_b"]) @ l["fc2"] + l["fc2_b"]
+        return x + h, None
+
+    x, _ = jax.lax.scan(layer_fn, x, params["layers"])
+    # LLaVA projects the (un-normed) penultimate patch states; with the
+    # scanned-stack structure the final states stand in — the projector is
+    # trained against whatever the tower emits
+    h = jax.nn.gelu(x @ params["proj1"] + params["proj1_b"])
+    return (h @ params["proj2"] + params["proj2_b"]).astype(jnp.float32)
+
+
+def preprocess_image(img, image_size: int):
+    """PIL image / ndarray -> [S, S, 3] float32 in [0, 1] (host-side)."""
+    import numpy as np
+
+    if hasattr(img, "convert"):  # PIL
+        img = img.convert("RGB").resize((image_size, image_size))
+        arr = np.asarray(img, dtype=np.float32) / 255.0
+    else:
+        src = np.asarray(img)
+        arr = src.astype(np.float32)
+        # integer dtypes are 0..255 by definition; float inputs are taken
+        # as already-normalized [0, 1] (a max()-based heuristic would send
+        # a near-black uint8 image through un-scaled)
+        if np.issubdtype(src.dtype, np.integer):
+            arr = arr / 255.0
+        if arr.shape[:2] != (image_size, image_size):
+            try:
+                from PIL import Image
+
+                arr = np.asarray(
+                    Image.fromarray((arr * 255).astype(np.uint8)).resize(
+                        (image_size, image_size)
+                    ),
+                    dtype=np.float32,
+                ) / 255.0
+            except Exception as e:
+                raise ValueError(
+                    f"image shape {arr.shape} != {(image_size, image_size, 3)} "
+                    "and PIL resize unavailable"
+                ) from e
+    if arr.ndim == 2:
+        arr = np.repeat(arr[:, :, None], 3, axis=2)
+    return arr[:, :, :3]
+
+
+# -- HF (transformers CLIPVisionModel) interop -------------------------------
+
+
+def load_hf_vision_weights(
+    model_dir: str | Path, cfg: VLMConfig, dtype=None
+) -> dict:
+    """Map a transformers CLIPVisionModel safetensors checkpoint
+    (vision_model.* naming) + a LLaVA-style mm projector
+    (multi_modal_projector.linear_1/linear_2) into this tree.
+
+    The CLIP conv1 patch embedding [D, 3, p, p] flattens to our
+    [p*p*3, D] matmul ordering (patch pixels row-major, channels minor —
+    matching ``patchify``). The class token is dropped: the projector
+    consumes patch states only (the LLaVA recipe).
+    """
+    import numpy as np
+    from safetensors import safe_open
+
+    v = cfg.vision
+    dt = dtype or v.jnp_dtype
+    raw: dict[str, np.ndarray] = {}
+    for f in sorted(Path(model_dir).glob("*.safetensors")):
+        with safe_open(str(f), framework="np") as sf:
+            for name in sf.keys():
+                raw[name] = sf.get_tensor(name)
+
+    P = "vision_model."
+    E = P + "encoder.layers.{}."
+
+    def stack(fmt, transpose=True):
+        mats = [
+            raw.pop(fmt.format(i)).T if transpose else raw.pop(fmt.format(i))
+            for i in range(v.n_layers)
+        ]
+        return jnp.asarray(np.stack(mats), dt)
+
+    # conv1 [D, 3, p, p] -> [p, p, 3, D] -> [p*p*3, D] (pixels row-major,
+    # channels innermost — the patchify() ordering)
+    conv = raw.pop(P + "embeddings.patch_embedding.weight")
+    patch_proj = jnp.asarray(
+        conv.transpose(2, 3, 1, 0).reshape(-1, v.dim), dt
+    )
+    # position embedding row 0 is the class token — dropped
+    pos = raw.pop(P + "embeddings.position_embedding.weight")[1:]
+
+    params = {
+        "patch_proj": patch_proj,
+        "pos_emb": jnp.asarray(pos, dt),
+        "pre_ln_scale": jnp.asarray(raw.pop(P + "pre_layrnorm.weight"), dt),
+        "pre_ln_bias": jnp.asarray(raw.pop(P + "pre_layrnorm.bias"), dt),
+        "layers": {
+            "ln1_scale": stack(E + "layer_norm1.weight", False),
+            "ln1_bias": stack(E + "layer_norm1.bias", False),
+            "wq": stack(E + "self_attn.q_proj.weight"),
+            "bq": stack(E + "self_attn.q_proj.bias", False),
+            "wk": stack(E + "self_attn.k_proj.weight"),
+            "bk": stack(E + "self_attn.k_proj.bias", False),
+            "wv": stack(E + "self_attn.v_proj.weight"),
+            "bv": stack(E + "self_attn.v_proj.bias", False),
+            "wo": stack(E + "self_attn.out_proj.weight"),
+            "bo": stack(E + "self_attn.out_proj.bias", False),
+            "ln2_scale": stack(E + "layer_norm2.weight", False),
+            "ln2_bias": stack(E + "layer_norm2.bias", False),
+            "fc1": stack(E + "mlp.fc1.weight"),
+            "fc1_b": stack(E + "mlp.fc1.bias", False),
+            "fc2": stack(E + "mlp.fc2.weight"),
+            "fc2_b": stack(E + "mlp.fc2.bias", False),
+        },
+        "proj1": jnp.asarray(
+            raw.pop("multi_modal_projector.linear_1.weight").T, dt
+        ),
+        "proj1_b": jnp.asarray(
+            raw.pop("multi_modal_projector.linear_1.bias"), dt
+        ),
+        "proj2": jnp.asarray(
+            raw.pop("multi_modal_projector.linear_2.weight").T, dt
+        ),
+        "proj2_b": jnp.asarray(
+            raw.pop("multi_modal_projector.linear_2.bias"), dt
+        ),
+    }
+    return params

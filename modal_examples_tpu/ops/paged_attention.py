@@ -684,9 +684,13 @@ def paged_decode_attention(
 
     # Mosaic DMA units are (sublane, lane) tiles — a page must be a whole
     # number of (16, 128) bf16 tiles or the HBM→VMEM copies fail to lower
-    # (observed on-chip with head_dim 32). Sub-tile shapes (tiny/test models)
-    # take the XLA path regardless of impl.
-    if impl != "pallas" or (not interpret and (D % 128 or page_size % 16)):
+    # (observed on-chip with head_dim 32), and the kernel's (ps, Hkv, D) ->
+    # (ps*Hkv, D) flatten needs Hkv % 16 (sub-16 head counts pad sublanes;
+    # merging padded tiles relayouts). Sub-tile shapes (tiny/test models,
+    # GQA) take the XLA path regardless of impl.
+    if impl != "pallas" or (
+        not interpret and (D % 128 or page_size % 16 or Hkv % 16)
+    ):
         return _paged_decode_xla(
             q, k_pages, v_pages, page_tables, context_lens, sm_scale
         )

@@ -59,6 +59,9 @@ class Request:
     # depend on scheduler timing (explicit seed= there is rejected up front
     # by validate_params; see _spec_propose_verify's docstring).
     auto_seed: int | None = None
+    # multimodal: preprocessed [S, S, 3] float image (models.vlm); its
+    # n_image_tokens placeholder ids lead prompt_tokens
+    image: object | None = None
 
 
 @dataclasses.dataclass
@@ -181,6 +184,7 @@ class LLMEngine:
         decode_block: int = 8,  # decode steps rolled into one dispatch
         mesh=None,  # jax Mesh with a "tensor" axis: tensor-parallel serving
         paged_impl: str | None = None,  # decode structure; None: env/default
+        vision: tuple | None = None,  # (models.vlm.VLMConfig, vision_params)
     ):
         import os as _os
 
@@ -268,6 +272,34 @@ class LLMEngine:
             if enable_prefix_cache
             else None
         )
+
+        # multimodal serving (models.vlm; the reference's sglang_vlm.py
+        # workload): image requests prefill with the vision tower's
+        # projected patch embeddings as the first n_image_tokens positions.
+        self.vision_cfg = None
+        self.vision_params = None
+        if vision is not None:
+            self.vision_cfg, self.vision_params = vision
+            if self.vision_cfg.llm_dim != cfg.dim:
+                raise ValueError(
+                    f"vision projector dim {self.vision_cfg.llm_dim} != "
+                    f"model dim {cfg.dim}"
+                )
+            if self.vision_cfg.n_image_tokens >= self.prefill_buckets[-1]:
+                raise ValueError(
+                    f"n_image_tokens {self.vision_cfg.n_image_tokens} must "
+                    f"be < the largest prefill bucket "
+                    f"{self.prefill_buckets[-1]} (multimodal prompts do not "
+                    "chunk)"
+                )
+            if mesh is not None:
+                raise ValueError("vision= with mesh= (TP) is not supported yet")
+            if speculative is not None:
+                raise ValueError(
+                    "vision= with speculative= is not supported: the draft "
+                    "model's cache would miss the image-token KV"
+                )
+        self._prefill_mm_jits: dict[object, object] = {}
 
         self.slots = [_Slot() for _ in range(max_slots)]
         self.waiting: queue.Queue[Request] = queue.Queue()
@@ -433,6 +465,32 @@ class LLMEngine:
             self._prefill_jits[bucket] = fn
         return fn
 
+    def _prefill_and_sample_mm(
+        self, params, vparams, k_pages, v_pages, images, tokens, page_tables,
+        seq_lens, key, temps, top_ps, top_ks, seeds,
+    ):
+        """Multimodal prefill: vision encode fused into the prefill program
+        (one dispatch); projected patch embeddings occupy the first
+        n_image_tokens positions via llama.prefill(input_embeds=...)."""
+        from ..models import vlm
+
+        embeds = vlm.encode_image(vparams, images, self.vision_cfg)
+        logits, k_pages, v_pages = llama.prefill(
+            params, tokens, k_pages, v_pages, page_tables, seq_lens, self.cfg,
+            attn_impl=self._attn_impl, input_embeds=embeds,
+        )
+        next_tokens = sample(
+            logits, key, temps, top_ps, top_ks, seeds=seeds, step_ids=seq_lens
+        )
+        return next_tokens, k_pages, v_pages
+
+    def _prefill_mm_jit(self, bucket_key):
+        fn = self._prefill_mm_jits.get(bucket_key)
+        if fn is None:
+            fn = jax.jit(self._prefill_and_sample_mm, donate_argnums=(2, 3))
+            self._prefill_mm_jits[bucket_key] = fn
+        return fn
+
     def _draft_prefill_jit(self, key):
         fn = self._draft_prefill_jits.get(key)
         if fn is None:
@@ -586,7 +644,12 @@ class LLMEngine:
                 "implemented in the spec accept/reject kernel)"
             )
 
-    def submit(self, prompt: str, params: SamplingParams | None = None) -> Request:
+    def submit(
+        self,
+        prompt: str,
+        params: SamplingParams | None = None,
+        image=None,  # PIL image or [H, W, 3] array: multimodal request
+    ) -> Request:
         req = Request(prompt=prompt, params=params or SamplingParams())
         self.validate_params(req.params)
         if req.params.seed is None:
@@ -595,9 +658,31 @@ class LLMEngine:
                 req.auto_seed = (
                     self._seed_base * 1_000_003 + self._submit_seq
                 ) % (2**31 - 1)
-        # prompts longer than the largest bucket prefill in chunks; the hard
-        # cap is the model length (minus >=1 decode slot)
-        req.prompt_tokens = self.tokenizer.encode(prompt)[: self.max_model_len - 1]
+        if image is not None:
+            if self.vision_cfg is None:
+                raise ValueError(
+                    "engine was built without vision=; cannot take images"
+                )
+            from ..models import vlm
+
+            req.image = vlm.preprocess_image(
+                image, self.vision_cfg.vision.image_size
+            )
+            n_img = self.vision_cfg.n_image_tokens
+            # image tokens lead; text budget = largest bucket minus them
+            # (multimodal prompts do not take the chunked-prefill path)
+            text_budget = min(
+                self.prefill_buckets[-1] - n_img, self.max_model_len - 1 - n_img
+            )
+            text = self.tokenizer.encode(prompt)[:text_budget]
+            pad = self.tokenizer.pad_id % self.cfg.vocab_size
+            req.prompt_tokens = [pad] * n_img + text
+        else:
+            # prompts longer than the largest bucket prefill in chunks; the
+            # hard cap is the model length (minus >=1 decode slot)
+            req.prompt_tokens = self.tokenizer.encode(prompt)[
+                : self.max_model_len - 1
+            ]
         self.waiting.put(req)
         return req
 
@@ -641,6 +726,29 @@ class LLMEngine:
                 jnp.zeros((B, bucket), jnp.int32),
                 jnp.zeros((B, self.pages_per_slot), jnp.int32),
                 jnp.ones((B,), jnp.int32),
+                self._next_key(),
+                jnp.ones((B,), jnp.float32),
+                jnp.ones((B,), jnp.float32),
+                jnp.zeros((B,), jnp.int32),
+                jnp.full((B,), -1, jnp.int32),
+            )
+        if self.vision_cfg is not None:
+            # one compiled multimodal prefill shape: the bucket that fits
+            # image tokens + text (bigger buckets compile on first use)
+            S = self.vision_cfg.vision.image_size
+            B = self.prefill_batch
+            mm_bucket = self._bucket_for(self.vision_cfg.n_image_tokens + 1)
+            _tok, self.cache.k_pages, self.cache.v_pages = self._prefill_mm_jit(
+                (mm_bucket, B)
+            )(
+                self.params,
+                self.vision_params,
+                self.cache.k_pages,
+                self.cache.v_pages,
+                jnp.zeros((B, S, S, 3), jnp.float32),
+                jnp.zeros((B, mm_bucket), jnp.int32),
+                jnp.zeros((B, self.pages_per_slot), jnp.int32),
+                jnp.full((B,), self.vision_cfg.n_image_tokens + 1, jnp.int32),
                 self._next_key(),
                 jnp.ones((B,), jnp.float32),
                 jnp.ones((B,), jnp.float32),
@@ -801,7 +909,9 @@ class LLMEngine:
             assignments.append((free_slot, req, claim))
 
         long_ones = [
-            a for a in assignments if a[2]["n_prompt"] > self.prefill_buckets[-1]
+            a for a in assignments
+            if a[2]["n_prompt"] > self.prefill_buckets[-1]
+            and a[1].image is None  # mm prompts are capped at submit()
         ]
         assignments = [a for a in assignments if a not in long_ones]
         for a in long_ones:
@@ -816,15 +926,16 @@ class LLMEngine:
 
                 traceback.print_exc()
                 self._fail_claims([a])
-        by_bucket: dict[int, list] = {}
+        by_bucket: dict[tuple, list] = {}
         for a in assignments:
-            by_bucket.setdefault(self._bucket_for(a[2]["n_prompt"]), []).append(a)
-        for bucket, group in by_bucket.items():
+            key = (self._bucket_for(a[2]["n_prompt"]), a[1].image is not None)
+            by_bucket.setdefault(key, []).append(a)
+        for (bucket, is_mm), group in by_bucket.items():
             # chunk to the ONE compiled batch shape per bucket
             for i in range(0, len(group), self.prefill_batch):
                 chunk = group[i : i + self.prefill_batch]
                 try:
-                    self._prefill_group(bucket, chunk)
+                    self._prefill_group(bucket, chunk, is_mm=is_mm)
                 except Exception:
                     # a failed prefill must not leak claims, hang callers, or
                     # leave never-written KV pages in the prefix trie
@@ -861,7 +972,10 @@ class LLMEngine:
         n_prompt = len(req.prompt_tokens)
         max_total = min(n_prompt + req.params.max_tokens, self.max_model_len)
         n_pages = self.cache.pages_for(max_total)
-        pc = self.prefix_cache
+        # multimodal requests bypass the prefix trie: their leading token ids
+        # are placeholders identical across DIFFERENT images, so trie sharing
+        # would serve one image's KV for another's prompt
+        pc = self.prefix_cache if req.image is None else None
         shared: list[int] = []
         if pc is not None:
             shared, _ = pc.acquire(req.prompt_tokens)
@@ -980,7 +1094,7 @@ class LLMEngine:
         slot.fresh = True
         self._accept_token(slot_idx, slot.last_token)
 
-    def _prefill_group(self, bucket: int, group: list) -> None:
+    def _prefill_group(self, bucket: int, group: list, is_mm: bool = False) -> None:
         B = self.prefill_batch  # fixed compile shape; short groups pad
         pad_tok = self.tokenizer.pad_id % self.cfg.vocab_size
         tokens = np.full((B, bucket), pad_tok, np.int32)
@@ -990,6 +1104,10 @@ class LLMEngine:
         top_ps = np.ones((B,), np.float32)
         top_ks = np.zeros((B,), np.int32)
         seeds = np.full((B,), -1, np.int32)
+        images = None
+        if is_mm:
+            S = self.vision_cfg.vision.image_size
+            images = np.zeros((B, S, S, 3), np.float32)
         for i, (slot_idx, req, claim) in enumerate(group):
             pages, n_prompt = claim["pages"], claim["n_prompt"]
             slot = self.slots[slot_idx]
@@ -1008,22 +1126,43 @@ class LLMEngine:
             p = req.params
             temps[i], top_ps[i], top_ks[i] = p.temperature, p.top_p, p.top_k
             seeds[i] = _req_seed(req)
+            if is_mm:
+                images[i] = req.image
 
-        next_tok, self.cache.k_pages, self.cache.v_pages = self._prefill_jit(
-            (bucket, B)
-        )(
-            self.params,
-            self.cache.k_pages,
-            self.cache.v_pages,
-            jnp.asarray(tokens),
-            jnp.asarray(tables),
-            jnp.asarray(seq_lens),
-            self._next_key(),
-            jnp.asarray(temps),
-            jnp.asarray(top_ps),
-            jnp.asarray(top_ks),
-            jnp.asarray(seeds),
-        )
+        if is_mm:
+            next_tok, self.cache.k_pages, self.cache.v_pages = (
+                self._prefill_mm_jit((bucket, B))(
+                    self.params,
+                    self.vision_params,
+                    self.cache.k_pages,
+                    self.cache.v_pages,
+                    jnp.asarray(images),
+                    jnp.asarray(tokens),
+                    jnp.asarray(tables),
+                    jnp.asarray(seq_lens),
+                    self._next_key(),
+                    jnp.asarray(temps),
+                    jnp.asarray(top_ps),
+                    jnp.asarray(top_ks),
+                    jnp.asarray(seeds),
+                )
+            )
+        else:
+            next_tok, self.cache.k_pages, self.cache.v_pages = self._prefill_jit(
+                (bucket, B)
+            )(
+                self.params,
+                self.cache.k_pages,
+                self.cache.v_pages,
+                jnp.asarray(tokens),
+                jnp.asarray(tables),
+                jnp.asarray(seq_lens),
+                self._next_key(),
+                jnp.asarray(temps),
+                jnp.asarray(top_ps),
+                jnp.asarray(top_ks),
+                jnp.asarray(seeds),
+            )
         if self.spec_gamma:
             # fill the draft model's cache over the same pages (same tables:
             # page ids are shared between the two caches)

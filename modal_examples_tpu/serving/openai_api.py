@@ -19,6 +19,59 @@ from .engine import LLMEngine
 from .sampling import SamplingParams
 
 
+def _extract_images(messages: list) -> tuple[list, object]:
+    """OpenAI multimodal content parts -> (text-flattened messages, image).
+
+    Accepts ``content`` as a list of parts ({"type": "text"} /
+    {"type": "image_url", "image_url": {"url": "data:image/..;base64,.."}}),
+    the shape the reference serves via SGLang (sglang_vlm.py) and queries in
+    chat_with_pdf_vision.py. Only data: URIs are accepted — this image has
+    zero egress, and fetching remote URLs server-side is a SSRF hazard
+    anyway. Single-image prompts only (v1 limit): a second image is a 400.
+    """
+    import base64
+    import io
+
+    image = None
+    flat = []
+    for m in messages:
+        content = m.get("content")
+        if not isinstance(content, list):
+            flat.append(m)
+            continue
+        texts = []
+        for part in content:
+            ptype = part.get("type")
+            if ptype == "text":
+                texts.append(part.get("text", ""))
+            elif ptype == "image_url":
+                url = (part.get("image_url") or {}).get("url", "")
+                if not url.startswith("data:"):
+                    raise ValueError(
+                        "only data: URIs are supported for image_url "
+                        "(inline base64; this server does not fetch URLs)"
+                    )
+                if image is not None:
+                    # silently answering about only the first image would
+                    # return a confidently wrong result for "compare these"
+                    raise ValueError(
+                        "multiple images per request are not supported"
+                    )
+                b64 = url.split(",", 1)[1] if "," in url else ""
+                raw = base64.b64decode(b64)
+                try:
+                    from PIL import Image
+
+                    image = Image.open(io.BytesIO(raw))
+                    image.load()
+                except Exception as e:
+                    raise ValueError(f"could not decode image: {e}") from e
+            else:
+                raise ValueError(f"unsupported content part type {ptype!r}")
+        flat.append({**m, "content": "\n".join(t for t in texts if t)})
+    return flat, image
+
+
 def _params_from_body(body: dict) -> SamplingParams:
     return SamplingParams(
         temperature=float(body.get("temperature", 1.0)),
@@ -118,12 +171,19 @@ class _Handler(BaseHTTPRequestHandler):
 
     def _completions(self, body: dict, chat: bool) -> None:
         srv = self.server_ref
-        if chat:
-            messages = body.get("messages") or []
-            prompt = srv.engine.tokenizer.apply_chat_template(messages)
-        else:
-            prompt = body.get("prompt") or ""
+        image = None
         try:
+            if chat:
+                messages = body.get("messages") or []
+                messages, image = _extract_images(messages)
+                prompt = srv.engine.tokenizer.apply_chat_template(messages)
+            else:
+                prompt = body.get("prompt") or ""
+            if image is not None and srv.engine.vision_cfg is None:
+                raise ValueError(
+                    "this model does not accept images (engine has no "
+                    "vision tower)"
+                )
             params = _params_from_body(body)
             srv.engine.validate_params(params)
         except ValueError as e:
@@ -160,6 +220,7 @@ class _Handler(BaseHTTPRequestHandler):
                     _dc.replace(params, seed=params.seed + i)
                     if params.seed is not None
                     else params,
+                    image=image,
                 )
                 for i in range(n)
             ]
@@ -199,7 +260,7 @@ class _Handler(BaseHTTPRequestHandler):
             )
             return
 
-        req = srv.engine.submit(prompt, params)
+        req = srv.engine.submit(prompt, params, image=image)
         if stream:
             self.send_response(200)
             self.send_header("content-type", "text/event-stream")
