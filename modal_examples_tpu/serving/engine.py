@@ -162,6 +162,12 @@ MODEL_PRESETS = {
 
 
 class LLMEngine:
+    #: every scheduler-loop traceback from ANY engine in this process,
+    #: recorded eagerly (survives engine GC) — the test suite's session-end
+    #: sentinel asserts this stays empty, so a swallowed scheduler
+    #: exception anywhere is a loud failure. Capped at 50.
+    _error_reports: list = []
+
     def __init__(
         self,
         cfg: llama.LlamaConfig,
@@ -306,6 +312,14 @@ class LLMEngine:
         self.stats = EngineStats()
         self.error_log: list[str] = []  # recent scheduler tracebacks
         self.error_count = 0  # monotonic (error_log is capped at 20)
+        # MTPU_ENGINE_STRICT=1 (the test suite's default, conftest.py): a
+        # scheduler-loop exception STOPS the engine and releases callers
+        # with finish_reason="error" instead of being swallowed — closing
+        # the round-2 "intermittent flake consistent with a swallowed
+        # scheduler exception" loop (NOTES.md). Production default keeps
+        # the loop alive (availability) but still records + counts.
+        self.strict = _os.environ.get("MTPU_ENGINE_STRICT", "") not in ("", "0")
+        self._stopped_on_error = False
         self._key = jax.random.PRNGKey(seed)
         self._seed_base = int(seed)
         self._submit_seq = 0  # feeds auto_seed: deterministic per submission
@@ -823,6 +837,11 @@ class LLMEngine:
 
     def start(self) -> "LLMEngine":
         with self._lock:
+            if self._stopped_on_error:
+                raise RuntimeError(
+                    "engine stopped after a scheduler error (strict mode); "
+                    f"last traceback:\n{(self.error_log or ['?'])[-1]}"
+                )
             if self._running:
                 return self
             self._running = True
@@ -835,21 +854,9 @@ class LLMEngine:
         requests get their terminal _FINISH so stream()/generate() return
         (partial output for in-flight ones) instead of blocking forever."""
         self._running = False
-        if self._thread:
+        if self._thread and self._thread is not threading.current_thread():
             self._thread.join(timeout=10)
-        self._inflight.clear()
-        self._device_tokens = None
-        for slot in self.slots:
-            if not slot.free:
-                slot.request.out_queue.put(_FINISH)
-                self._release_slot_pages(slot)
-                slot.request = None
-        while True:
-            try:
-                req = self.waiting.get_nowait()
-            except queue.Empty:
-                break
-            req.out_queue.put(_FINISH)
+        self._release_all(_FINISH)
 
     # -- scheduler loop ------------------------------------------------------
 
@@ -860,17 +867,46 @@ class LLMEngine:
             try:
                 worked = self.step()
             except Exception:
-                # a poisoned request must not kill the serving loop; keep the
-                # traceback on the engine so intermittent scheduler failures
-                # are diagnosable after the fact (surfaced in /metrics)
+                # Per-REQUEST failures never reach here: bad params are
+                # rejected at submit() and failed prefills unwind their
+                # claims inside _admit (_fail_claims). Anything caught here
+                # is a scheduler-logic error. Keep the traceback on the
+                # engine so it is diagnosable after the fact (surfaced in
+                # /metrics as mtpu_scheduler_errors_total).
                 tb = traceback.format_exc()
                 self.error_log.append(tb)
                 self.error_count += 1
                 del self.error_log[:-20]
+                LLMEngine._error_reports.append(tb[-800:])
+                del LLMEngine._error_reports[:-50]
                 print(tb, flush=True)
+                if self.strict:
+                    # tests must fail loudly, not generate corrupt output:
+                    # poison the engine (start() refuses to resurrect it —
+                    # a racing stream() would otherwise spawn a second
+                    # scheduler thread mid-teardown), then release callers
+                    self._stopped_on_error = True
+                    self._running = False
+                    self._release_all(_Finish("error"))
+                    return
                 worked = False
             if not worked:
                 time.sleep(0.002)
+
+    def _release_all(self, marker: "_Finish") -> None:
+        self._inflight.clear()
+        self._device_tokens = None
+        for slot in self.slots:
+            if not slot.free:
+                slot.request.out_queue.put(marker)
+                self._release_slot_pages(slot)
+                slot.request = None
+        while True:
+            try:
+                req = self.waiting.get_nowait()
+            except queue.Empty:
+                break
+            req.out_queue.put(marker)
 
     def step(self) -> bool:
         """One scheduler tick: admit -> decode -> emit. Returns True if any

@@ -28,6 +28,11 @@ if "xla_force_host_platform_device_count" not in flags:
 _state_tmp = tempfile.mkdtemp(prefix="mtpu-test-state-")
 os.environ.setdefault("MTPU_STATE_DIR", _state_tmp)
 
+# Engine strict mode: a scheduler-loop exception stops the engine and
+# releases callers with finish_reason="error" instead of being swallowed
+# (the round-2 flake postmortem — NOTES.md "engine flake closeout").
+os.environ.setdefault("MTPU_ENGINE_STRICT", "1")
+
 # Persistent XLA compile cache (utils/compile_cache.py): the suite is
 # compile-bound on CPU, so warm runs trade recompiles for disk hits. jax
 # reads these env vars natively, including in executor child processes.
@@ -62,3 +67,19 @@ def force_cpu_jax():
 @pytest.fixture(scope="session")
 def jax_cpu():
     return force_cpu_jax()
+
+
+@pytest.fixture(scope="session", autouse=True)
+def _engine_error_sentinel():
+    """Assert that NO engine anywhere in the suite recorded a scheduler
+    exception — the regression net for the round-2 intermittent
+    output-mismatch flake (NOTES.md). Reads the eagerly-recorded class-level
+    report list, so engines garbage-collected mid-session are still
+    covered."""
+    yield
+    try:
+        from modal_examples_tpu.serving.engine import LLMEngine
+    except Exception:
+        return
+    reports = list(LLMEngine._error_reports)
+    assert not reports, f"engines recorded scheduler errors: {reports}"
