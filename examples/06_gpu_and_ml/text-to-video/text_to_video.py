@@ -1,0 +1,229 @@
+# # Text-to-video: a two-stage spawn-chained pipeline
+#
+# TPU-native counterpart of the reference's video/world-generation tier:
+# 06_gpu_and_ml/world-models/text_to_world.py (a two-stage pipeline where
+# stage 1 generates a reference video/frame and *spawns* stage 2 to lift
+# it), text-to-video/ltx.py & ltx2_two_stage.py, and
+# image-to-video/image_to_video.py — all of which delegate to torch/
+# diffusers CUDA pipelines. Here both stages are the framework's own
+# models:
+#
+#   1. **keyframe**: the image DiT (models.diffusion) generates a keyframe
+#      from the prompt and writes it to a Volume, then `.spawn()`s stage 2
+#      (fire-and-forget chaining — the text_to_world.py:9-12 shape);
+#   2. **animate**: the latent video DiT (models.video, factorized
+#      space-time attention) generates the remaining frames with frame 0
+#      PINNED to the keyframe (image-to-video conditioning), and the
+#      result is stored as an .npz on the output Volume.
+#
+# Both models train from scratch on a synthetic moving-square corpus in
+# cheap mode (zero egress — the dummy-weights dev pattern). The chaining,
+# conditioning, volumes, and spawn/poll surfaces are the real thing.
+#
+# Run: tpurun run examples/06_gpu_and_ml/text-to-video/text_to_video.py
+
+import os
+import time
+
+import modal_examples_tpu as mtpu
+
+TPU = os.environ.get("MTPU_TPU", "") or None
+STEPS = int(os.environ.get("MTPU_TRAIN_STEPS", "60"))
+
+app = mtpu.App("example-text-to-video")
+weights_vol = mtpu.Volume.from_name("video-dit-weights", create_if_missing=True)
+output_vol = mtpu.Volume.from_name("video-outputs", create_if_missing=True)
+
+TEXT_DIM, TEXT_LEN = 32, 8
+
+
+def encode_text(texts: list[str]):
+    """Toy hashed-byte text states (the T5/CLIP stand-in; swap in
+    models.bert against real weights)."""
+    import numpy as np
+
+    out = np.zeros((len(texts), TEXT_LEN, TEXT_DIM), np.float32)
+    for i, t in enumerate(texts):
+        for j, ch in enumerate(t.encode()[:TEXT_LEN]):
+            rng = np.random.default_rng(ch)
+            out[i, j] = rng.standard_normal(TEXT_DIM) * 0.5
+    return out
+
+
+def _square_video(key, cfg):
+    """Synthetic corpus: a bright square drifting across dark frames."""
+    import jax
+    import jax.numpy as jnp
+
+    S, T = cfg.img_size, cfg.frames
+    k1, k2, k3 = jax.random.split(key, 3)
+    x0 = jax.random.randint(k1, (), 0, S - 3)
+    y0 = jax.random.randint(k2, (), 0, S - 3)
+    dx = jax.random.randint(k3, (), -1, 2)
+    frames = []
+    for t in range(T):
+        xs = jnp.clip(x0 + t * dx, 0, S - 3)
+        col = jnp.arange(S)
+        mask = (
+            ((col >= xs) & (col < xs + 3))[None, :]
+            & ((col >= y0) & (col < y0 + 3))[:, None]
+        )
+        frames.append(jnp.where(mask[:, :, None], 1.0, -1.0))
+    return jnp.stack(frames)  # [T, S, S, 1] -> broadcast to channels
+
+
+@app.function(tpu=TPU, volumes={"/models": weights_vol}, timeout=3600)
+def train(steps: int = STEPS) -> dict:
+    """Train BOTH stages on the synthetic corpus and save to the Volume."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from modal_examples_tpu.models import diffusion, video
+    from modal_examples_tpu.training import Trainer, make_optimizer
+
+    vcfg = video.VideoDiTConfig.tiny()
+    icfg = diffusion.DiTConfig(
+        img_size=vcfg.img_size, channels=vcfg.channels, patch=vcfg.patch,
+        dim=96, n_layers=3, n_heads=4, text_dim=TEXT_DIM, text_len=TEXT_LEN,
+    )
+
+    prompts = ["a square drifting right", "a square holding still"]
+    text = jnp.asarray(encode_text(prompts))
+
+    def make_batch(key, bs=8):
+        ks = jax.random.split(key, bs + 1)
+        vids = jnp.stack([_square_video(k, vcfg) for k in ks[:bs]])
+        vids = jnp.repeat(vids, vcfg.channels, axis=-1)[..., : vcfg.channels]
+        idx = jax.random.randint(ks[-1], (bs,), 0, len(prompts))
+        return vids, text[idx]
+
+    # stage-2 video model
+    vparams = video.init_params(jax.random.PRNGKey(0), vcfg)
+
+    def vloss(p, batch):
+        return video.flow_loss(p, batch["rng"], batch["v"], batch["t"], vcfg)
+
+    vtrainer = Trainer(vloss, make_optimizer(2e-3))
+    vstate = vtrainer.init_state(vparams)
+    key = jax.random.PRNGKey(1)
+    for _ in range(steps):
+        key, k1, k2 = jax.random.split(key, 3)
+        vids, txt = make_batch(k1)
+        vstate, metrics = vtrainer.train_step(
+            vstate, {"v": vids, "t": txt, "rng": k2}
+        )
+
+    # stage-1 keyframe model trains on FIRST frames
+    iparams = diffusion.init_params(jax.random.PRNGKey(2), icfg)
+
+    def iloss(p, batch):
+        return diffusion.flow_loss(
+            p, batch["rng"], batch["v"][:, 0], batch["t"], icfg
+        )
+
+    itrainer = Trainer(iloss, make_optimizer(2e-3))
+    istate = itrainer.init_state(iparams)
+    for _ in range(steps):
+        key, k1, k2 = jax.random.split(key, 3)
+        vids, txt = make_batch(k1)
+        istate, imetrics = itrainer.train_step(
+            istate, {"v": vids, "t": txt, "rng": k2}
+        )
+
+    # portable save: both trees as host arrays in one pickle
+    import pickle
+
+    with open("/models/video_pipeline.pkl", "wb") as f:
+        pickle.dump(
+            {
+                "video": jax.tree.map(np.asarray, vstate.params),
+                "image": jax.tree.map(np.asarray, istate.params),
+            },
+            f,
+        )
+    weights_vol.commit()
+    return {
+        "video_loss": float(metrics["loss"]),
+        "image_loss": float(imetrics["loss"]),
+    }
+
+
+@app.function(
+    tpu=TPU,
+    volumes={"/models": weights_vol, "/outputs": output_vol},
+    timeout=1800,
+)
+def animate(prompt: str, keyframe_path: str) -> str:
+    """Stage 2: latent video DiT with frame 0 pinned to the keyframe."""
+    import pickle
+
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from modal_examples_tpu.models import video
+
+    vcfg = video.VideoDiTConfig.tiny()
+    with open("/models/video_pipeline.pkl", "rb") as f:
+        params = jax.tree.map(jnp.asarray, pickle.load(f)["video"])
+    keyframe = jnp.asarray(np.load(keyframe_path)["frame"])
+    text = jnp.asarray(encode_text([prompt]))
+    out = video.sample(
+        params, jax.random.PRNGKey(7), text, vcfg,
+        first_frame=keyframe[None], steps=8, guidance=2.0,
+    )
+    out_path = f"/outputs/video-{int(time.time())}.npz"
+    np.savez(out_path, video=np.asarray(out[0]), prompt=prompt)
+    output_vol.commit()
+    print(f"stage 2 done: {out_path} frames={out.shape[1]}")
+    return out_path
+
+
+@app.function(
+    tpu=TPU,
+    volumes={"/models": weights_vol, "/outputs": output_vol},
+    timeout=1800,
+)
+def generate_keyframe(prompt: str):
+    """Stage 1: image DiT keyframe, then SPAWN stage 2 (fire-and-forget
+    chaining across containers — text_to_world.py:9-12's shape)."""
+    import pickle
+
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from modal_examples_tpu.models import diffusion, video
+
+    vcfg = video.VideoDiTConfig.tiny()
+    icfg = diffusion.DiTConfig(
+        img_size=vcfg.img_size, channels=vcfg.channels, patch=vcfg.patch,
+        dim=96, n_layers=3, n_heads=4, text_dim=TEXT_DIM, text_len=TEXT_LEN,
+    )
+    with open("/models/video_pipeline.pkl", "rb") as f:
+        params = jax.tree.map(jnp.asarray, pickle.load(f)["image"])
+    text = jnp.asarray(encode_text([prompt]))
+    frame = diffusion.sample(
+        params, jax.random.PRNGKey(3), text, icfg, steps=8, guidance=2.0
+    )[0]
+    key_path = f"/outputs/keyframe-{int(time.time())}.npz"
+    np.savez(key_path, frame=np.asarray(frame), prompt=prompt)
+    output_vol.commit()
+    print(f"stage 1 done: {key_path}")
+    call = animate.spawn(prompt, key_path)
+    return {"keyframe": key_path, "stage2_call_id": call.object_id}
+
+
+@app.local_entrypoint()
+def main(prompt: str = "a square drifting right"):
+    print("training both stages (cheap mode)...")
+    losses = train.remote()
+    print("train:", losses)
+    out = generate_keyframe.remote(prompt)
+    print("stage 1:", out)
+    # poll the spawned stage-2 call to completion (FunctionCall.from_id —
+    # the poll_delayed_result pattern)
+    call = mtpu.FunctionCall.from_id(out["stage2_call_id"])
+    video_path = call.get(timeout=600)
+    print("pipeline complete:", video_path)

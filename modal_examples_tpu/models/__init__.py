@@ -6,6 +6,20 @@ CUDA engines (SURVEY.md §2.2): llama (LLM serving + fine-tuning), gpt
 whisper (ASR).
 """
 
-from . import bert, diffusion, gpt, layers, llama, lora, moe, vlm
+from . import (
+    bert,
+    diffusion,
+    gpt,
+    layers,
+    llama,
+    lora,
+    moe,
+    video,
+    vlm,
+    whisper,
+)
 
-__all__ = ["bert", "diffusion", "gpt", "layers", "llama", "lora", "moe", "vlm"]
+__all__ = [
+    "bert", "diffusion", "gpt", "layers", "llama", "lora", "moe",
+    "video", "vlm", "whisper",
+]

@@ -1,0 +1,298 @@
+"""Latent video generation: factorized space-time DiT + first-frame
+conditioning — the TPU-native counterpart of the reference's video/world
+generation tier, which delegates to torch/diffusers CUDA pipelines
+(/root/reference/06_gpu_and_ml/world-models/text_to_world.py — a two-stage
+spawn-chained pipeline; text-to-video/ltx.py, mochi.py,
+ltx2_two_stage.py; image-to-video/image_to_video.py).
+
+TPU-first design:
+- video lives as latents [B, T, S, S, C] (per-frame VAE latents — the same
+  ``models.vae`` the image pipelines use, vmapped over time);
+- the denoiser is a DiT with FACTORIZED space-time attention: each block
+  runs spatial attention (tokens within a frame, batched over frames) then
+  temporal attention (same patch position across frames, batched over
+  positions) — both are dense, mask-free MXU matmuls with static shapes,
+  which is exactly what XLA tiles best; full 3D attention costs
+  (T*N)^2 while factorized costs T*N^2 + N*T^2;
+- first-frame conditioning (the image-to-video / two-stage recipe): frame 0
+  is pinned to a clean keyframe latent during training AND sampling, with a
+  per-frame conditioning indicator folded into the adaLN signal, so one
+  model serves text-to-video (frame 0 from the image DiT) and
+  image-to-video (frame 0 from a user image);
+- rectified-flow training + few-step Euler sampling with classifier-free
+  guidance, matching ``models.diffusion``'s conventions.
+
+Demo-scale like the rest of the diffusion tier: the architecture is the
+real one (the same structure scales by config), proven on synthetic data in
+tests; no published video checkpoint is loadable here (zero egress).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+
+from . import layers
+from .diffusion import timestep_embedding
+
+
+@dataclasses.dataclass(frozen=True)
+class VideoDiTConfig:
+    frames: int = 8  # T
+    img_size: int = 16  # latent spatial side
+    channels: int = 4  # latent channels (VAE z)
+    patch: int = 2
+    dim: int = 256
+    n_layers: int = 6
+    n_heads: int = 8
+    text_dim: int = 64
+    text_len: int = 16
+    norm_eps: float = 1e-6
+    dtype: str = "float32"
+
+    @property
+    def n_patches(self) -> int:  # spatial tokens per frame
+        return (self.img_size // self.patch) ** 2
+
+    @property
+    def patch_dim(self) -> int:
+        return self.patch * self.patch * self.channels
+
+    @property
+    def jnp_dtype(self):
+        return jnp.dtype(self.dtype)
+
+    @staticmethod
+    def tiny() -> "VideoDiTConfig":
+        return VideoDiTConfig(
+            frames=4, img_size=8, channels=4, patch=2, dim=96, n_layers=3,
+            n_heads=4, text_dim=32, text_len=8,
+        )
+
+
+def init_params(key: jax.Array, cfg: VideoDiTConfig) -> dict:
+    dt = cfg.jnp_dtype
+    D, L = cfg.dim, cfg.n_layers
+    ks = iter(jax.random.split(key, 24))
+
+    def dense(*shape, scale=None):
+        return layers.init_dense(next(ks), shape, scale=scale, dtype=dt)
+
+    return {
+        "patch_proj": dense(cfg.patch_dim, D, scale=0.02),
+        "pos_emb": dense(cfg.n_patches, D, scale=0.02),  # spatial
+        "frame_emb": dense(cfg.frames, D, scale=0.02),  # temporal
+        "t_mlp1": dense(D, D),
+        "t_mlp2": dense(D, D),
+        # conditioning indicator (is this frame pinned?) joins adaLN
+        "cond_emb": dense(2, D, scale=0.02),
+        "text_proj": dense(cfg.text_dim, D, scale=0.02),
+        "null_text": dense(cfg.text_len, cfg.text_dim, scale=0.02),
+        "layers": {
+            # adaLN-zero: 9 modulation vectors per block (3 per branch:
+            # spatial attn, temporal attn, MLP), zero-init gates
+            "mod_w": jnp.zeros((L, D, 9 * D), dt),
+            "mod_b": jnp.zeros((L, 9 * D), dt),
+            "s_wq": dense(L, D, D), "s_wk": dense(L, D, D),
+            "s_wv": dense(L, D, D), "s_wo": dense(L, D, D),
+            "t_wq": dense(L, D, D), "t_wk": dense(L, D, D),
+            "t_wv": dense(L, D, D), "t_wo": dense(L, D, D),
+            "xwq": dense(L, D, D), "xwk": dense(L, D, D),
+            "xwv": dense(L, D, D),
+            "xwo": jnp.zeros((L, D, D), dt),  # zero-init cross-attn out
+            "fc_w": dense(L, D, 4 * D),
+            "fc_b": jnp.zeros((L, 4 * D), dt),
+            "proj_w": dense(L, 4 * D, D),
+            "proj_b": jnp.zeros((L, D), dt),
+        },
+        "final_mod_w": jnp.zeros((D, 2 * D), dt),
+        "final_mod_b": jnp.zeros((2 * D,), dt),
+        "final_proj": jnp.zeros((D, cfg.patch_dim), dt),
+    }
+
+
+def patchify(x: jax.Array, cfg: VideoDiTConfig) -> jax.Array:
+    """[B, T, H, W, C] -> [B, T, n_patches, patch_dim]."""
+    B, T, H, W, C = x.shape
+    p = cfg.patch
+    x = x.reshape(B, T, H // p, p, W // p, p, C)
+    x = x.transpose(0, 1, 2, 4, 3, 5, 6)
+    return x.reshape(B, T, (H // p) * (W // p), p * p * C)
+
+
+def unpatchify(x: jax.Array, cfg: VideoDiTConfig) -> jax.Array:
+    B, T = x.shape[:2]
+    p, C = cfg.patch, cfg.channels
+    hw = cfg.img_size // p
+    x = x.reshape(B, T, hw, hw, p, p, C)
+    x = x.transpose(0, 1, 2, 4, 3, 5, 6)
+    return x.reshape(B, T, cfg.img_size, cfg.img_size, C)
+
+
+def _mha(q, k, v, n_heads):
+    B, Sq, D = q.shape
+    Sk = k.shape[1]
+    hd = D // n_heads
+    q = q.reshape(B, Sq, n_heads, hd).transpose(0, 2, 1, 3)
+    k = k.reshape(B, Sk, n_heads, hd).transpose(0, 2, 1, 3)
+    v = v.reshape(B, Sk, n_heads, hd).transpose(0, 2, 1, 3)
+    s = jnp.einsum("bhqd,bhkd->bhqk", q, k, preferred_element_type=jnp.float32)
+    p = jax.nn.softmax(s * hd**-0.5, axis=-1).astype(v.dtype)
+    o = jnp.einsum("bhqk,bhkd->bhqd", p, v)
+    return o.transpose(0, 2, 1, 3).reshape(B, Sq, D)
+
+
+def forward(
+    params: dict,
+    x_t: jax.Array,  # [B, T, S, S, C] noised latents (frame 0 may be clean)
+    t: jax.Array,  # [B] flow time in [0, 1]
+    cond_mask: jax.Array,  # [B, T] 1.0 where the frame is PINNED (clean)
+    text_states: jax.Array,  # [B, S_text, text_dim]
+    cfg: VideoDiTConfig,
+) -> jax.Array:  # predicted velocity [B, T, S, S, C]
+    B, T = x_t.shape[:2]
+    N, D = cfg.n_patches, cfg.dim
+    h = patchify(x_t, cfg) @ params["patch_proj"]  # [B, T, N, D]
+    h = h + params["pos_emb"][None, None] + params["frame_emb"][None, :, None]
+    temb = timestep_embedding(t, D)
+    temb = jnp.dot(jax.nn.silu(temb @ params["t_mlp1"]), params["t_mlp2"])
+    text = text_states @ params["text_proj"]  # [B, S_text, D]
+    # conditioning signal: per-FRAME (pinned frames get the "clean" row)
+    cemb = params["cond_emb"][cond_mask.astype(jnp.int32)]  # [B, T, D]
+    cond = temb[:, None] + text.mean(axis=1)[:, None] + cemb  # [B, T, D]
+
+    def norm(v):
+        mu = jnp.mean(v, axis=-1, keepdims=True)
+        var = jnp.var(v, axis=-1, keepdims=True)
+        return (v - mu) * jax.lax.rsqrt(var + cfg.norm_eps)
+
+    def layer_fn(h, l):
+        # h: [B, T, N, D]; per-frame modulation [B, T, 9D]
+        mod = jax.nn.silu(cond) @ l["mod_w"] + l["mod_b"]
+        (s1, sc1, g1, s2, sc2, g2, s3, sc3, g3) = jnp.split(mod, 9, axis=-1)
+
+        def modulate(v, shift, scale):
+            return v * (1 + scale[:, :, None]) + shift[:, :, None]
+
+        # spatial attention: tokens within a frame, frames batched
+        a = modulate(norm(h), s1, sc1).reshape(B * T, N, D)
+        a = _mha(a @ l["s_wq"], a @ l["s_wk"], a @ l["s_wv"], cfg.n_heads)
+        a = a.reshape(B, T, N, D) @ l["s_wo"]
+        h = h + g1[:, :, None] * a
+
+        # temporal attention: same patch position across frames, positions
+        # batched — [B, T, N, D] -> [B*N, T, D]
+        a = modulate(norm(h), s2, sc2).transpose(0, 2, 1, 3).reshape(
+            B * N, T, D
+        )
+        a = _mha(a @ l["t_wq"], a @ l["t_wk"], a @ l["t_wv"], cfg.n_heads)
+        a = a.reshape(B, N, T, D).transpose(0, 2, 1, 3) @ l["t_wo"]
+        h = h + g2[:, :, None] * a
+
+        # cross-attention to text over the flattened space-time tokens
+        xq = norm(h).reshape(B, T * N, D) @ l["xwq"]
+        xk, xv = text @ l["xwk"], text @ l["xwv"]
+        x = _mha(xq, xk, xv, cfg.n_heads).reshape(B, T, N, D) @ l["xwo"]
+        h = h + x
+
+        # MLP
+        m = modulate(norm(h), s3, sc3)
+        m = jax.nn.gelu(m @ l["fc_w"] + l["fc_b"]) @ l["proj_w"] + l["proj_b"]
+        return h + g3[:, :, None] * m, None
+
+    h, _ = jax.lax.scan(layer_fn, h, params["layers"])
+    fmod = jax.nn.silu(cond) @ params["final_mod_w"] + params["final_mod_b"]
+    shift, scale = jnp.split(fmod, 2, axis=-1)
+    h = (norm(h) * (1 + scale[:, :, None]) + shift[:, :, None]) @ params[
+        "final_proj"
+    ]
+    return unpatchify(h, cfg)
+
+
+def _null_text(params: dict, shape: tuple) -> jax.Array:
+    B, S, Dt = shape
+    stored = params["null_text"]
+    n = min(S, stored.shape[0])
+    base = jnp.zeros((S, Dt), stored.dtype).at[:n].set(stored[:n])
+    return jnp.broadcast_to(base[None], (B, S, Dt))
+
+
+def flow_loss(
+    params: dict,
+    key: jax.Array,
+    video: jax.Array,  # [B, T, S, S, C] clean latents
+    text_states: jax.Array,
+    cfg: VideoDiTConfig,
+    *,
+    null_prob: float = 0.1,
+    first_frame_prob: float = 0.7,
+) -> jax.Array:
+    """Rectified-flow loss with first-frame conditioning: with probability
+    ``first_frame_prob`` frame 0 stays clean (cond_mask=1) and is excluded
+    from the loss — teaching the model to propagate a pinned keyframe, the
+    image-to-video / two-stage training recipe."""
+    B, T = video.shape[:2]
+    k1, k2, k3, k4 = jax.random.split(key, 4)
+    t = jax.random.uniform(k1, (B,))
+    eps = jax.random.normal(k2, video.shape)
+    tb = t[:, None, None, None, None]
+    x_t = (1 - tb) * video + tb * eps
+    target_v = eps - video
+
+    pin = jax.random.bernoulli(k3, first_frame_prob, (B,))
+    cond_mask = jnp.zeros((B, T)).at[:, 0].set(pin.astype(jnp.float32))
+    # pinned frame 0 is presented clean
+    x_t = x_t.at[:, 0].set(
+        jnp.where(pin[:, None, None, None], video[:, 0], x_t[:, 0])
+    )
+
+    drop = jax.random.bernoulli(k4, null_prob, (B,))
+    null = _null_text(params, text_states.shape)
+    text_in = jnp.where(drop[:, None, None], null, text_states)
+
+    pred = forward(params, x_t, t, cond_mask, text_in, cfg)
+    # pinned frames don't contribute loss (their input was clean)
+    w = 1.0 - cond_mask[:, :, None, None, None]
+    return jnp.sum(w * (pred - target_v) ** 2) / jnp.maximum(
+        jnp.sum(w) * video[0, 0].size, 1.0
+    )
+
+
+def sample(
+    params: dict,
+    key: jax.Array,
+    text_states: jax.Array,  # [B, S_text, text_dim]
+    cfg: VideoDiTConfig,
+    *,
+    first_frame: jax.Array | None = None,  # [B, S, S, C] keyframe latent
+    steps: int = 8,
+    guidance: float = 3.0,
+) -> jax.Array:  # [B, T, S, S, C]
+    """Euler flow sampling; when ``first_frame`` is given, frame 0 is held
+    fixed at every step (the two-stage text->image->video chain,
+    text_to_world.py's stage-2 shape)."""
+    B = text_states.shape[0]
+    shape = (B, cfg.frames, cfg.img_size, cfg.img_size, cfg.channels)
+    x = jax.random.normal(key, shape)
+    cond_mask = jnp.zeros((B, cfg.frames))
+    if first_frame is not None:
+        x = x.at[:, 0].set(first_frame)
+        cond_mask = cond_mask.at[:, 0].set(1.0)
+    null = _null_text(params, text_states.shape)
+    ts = jnp.linspace(1.0, 0.0, steps + 1)
+
+    def step_fn(x, i):
+        t_cur, t_nxt = ts[i], ts[i + 1]
+        tb = jnp.full((B,), t_cur)
+        v_cond = forward(params, x, tb, cond_mask, text_states, cfg)
+        v_null = forward(params, x, tb, cond_mask, null, cfg)
+        v = v_null + guidance * (v_cond - v_null)
+        x = x + (t_nxt - t_cur) * v
+        if first_frame is not None:
+            x = x.at[:, 0].set(first_frame)  # re-pin after the step
+        return x, None
+
+    x, _ = jax.lax.scan(step_fn, x, jnp.arange(steps))
+    return x

@@ -1,0 +1,164 @@
+"""Latent video DiT: shapes, factorized attention actually mixes time,
+first-frame pinning, and flow-loss training signal (the reference's
+text-to-video / world-models tier, served CUDA-side there)."""
+
+import numpy as np
+import pytest
+
+pytestmark = pytest.mark.slow  # heavyweight: excluded from the fast tier
+
+
+@pytest.fixture(scope="module")
+def jax(jax_cpu):
+    return jax_cpu
+
+
+@pytest.fixture(scope="module")
+def jnp(jax):
+    import jax.numpy as jnp
+
+    return jnp
+
+
+@pytest.fixture(scope="module")
+def setup(jax):
+    from modal_examples_tpu.models import video
+
+    cfg = video.VideoDiTConfig.tiny()
+    params = video.init_params(jax.random.PRNGKey(0), cfg)
+    return cfg, params
+
+
+class TestVideoDiT:
+    def test_forward_shapes_and_finite(self, jax, jnp, setup):
+        from modal_examples_tpu.models import video
+
+        cfg, params = setup
+        B = 2
+        x = jax.random.normal(
+            jax.random.PRNGKey(1),
+            (B, cfg.frames, cfg.img_size, cfg.img_size, cfg.channels),
+        )
+        t = jnp.array([0.3, 0.9])
+        mask = jnp.zeros((B, cfg.frames))
+        text = jax.random.normal(
+            jax.random.PRNGKey(2), (B, cfg.text_len, cfg.text_dim)
+        )
+        v = video.forward(params, x, t, mask, text, cfg)
+        assert v.shape == x.shape
+        assert np.isfinite(np.asarray(v)).all()
+
+    def test_patchify_roundtrip(self, jax, jnp, setup):
+        from modal_examples_tpu.models import video
+
+        cfg, _ = setup
+        x = jax.random.normal(
+            jax.random.PRNGKey(3),
+            (1, cfg.frames, cfg.img_size, cfg.img_size, cfg.channels),
+        )
+        rt = video.unpatchify(video.patchify(x, cfg), cfg)
+        np.testing.assert_allclose(np.asarray(rt), np.asarray(x), atol=1e-6)
+
+    def test_temporal_attention_mixes_frames(self, jax, jnp, setup):
+        """Perturbing frame 3's input must change frame 0's output — the
+        temporal attention path actually crosses frames (a spatial-only
+        model would be frame-local)."""
+        from modal_examples_tpu.models import video
+
+        cfg, params = setup
+        # gates are zero-init (adaLN-zero), so train-free params give no
+        # cross-frame signal; force the temporal gates non-zero via mod_b
+        import jax.numpy as jnp2
+
+        p = dict(params)
+        layers = dict(p["layers"])
+        D = cfg.dim
+        mod_b = np.asarray(layers["mod_b"]).copy()
+        mod_b[:, 5 * D : 6 * D] = 1.0  # g2: temporal-attention gate
+        layers["mod_b"] = jnp2.asarray(mod_b)
+        p["layers"] = layers
+        # the output head is zero-init (adaLN-zero): un-zero it so the
+        # probe is visible at the output at all
+        p["final_proj"] = (
+            jax.random.normal(jax.random.PRNGKey(99), p["final_proj"].shape)
+            * 0.1
+        )
+
+        x = jax.random.normal(
+            jax.random.PRNGKey(4),
+            (1, cfg.frames, cfg.img_size, cfg.img_size, cfg.channels),
+        )
+        t = jnp.array([0.5])
+        mask = jnp.zeros((1, cfg.frames))
+        text = jax.random.normal(
+            jax.random.PRNGKey(5), (1, cfg.text_len, cfg.text_dim)
+        )
+        base = video.forward(p, x, t, mask, text, cfg)
+        x2 = x.at[:, 3].add(1.0)
+        pert = video.forward(p, x2, t, mask, text, cfg)
+        delta0 = float(jnp.max(jnp.abs(pert[:, 0] - base[:, 0])))
+        assert delta0 > 1e-6, "temporal attention did not propagate"
+
+    def test_sample_pins_first_frame(self, jax, jnp, setup):
+        from modal_examples_tpu.models import video
+
+        cfg, params = setup
+        text = jax.random.normal(
+            jax.random.PRNGKey(6), (1, cfg.text_len, cfg.text_dim)
+        )
+        key_frame = jax.random.normal(
+            jax.random.PRNGKey(7), (1, cfg.img_size, cfg.img_size, cfg.channels)
+        )
+        out = video.sample(
+            params, jax.random.PRNGKey(8), text, cfg,
+            first_frame=key_frame, steps=3, guidance=1.5,
+        )
+        assert out.shape == (
+            1, cfg.frames, cfg.img_size, cfg.img_size, cfg.channels
+        )
+        np.testing.assert_allclose(
+            np.asarray(out[:, 0]), np.asarray(key_frame), atol=1e-6
+        )
+        assert np.isfinite(np.asarray(out)).all()
+
+    def test_flow_loss_decreases_with_training(self, jax, jnp, setup):
+        """A few optimizer steps on a fixed synthetic batch must reduce the
+        flow loss — the training signal is real (same proof style as the
+        image DiT / whisper fine-tune tests)."""
+        import optax
+
+        from modal_examples_tpu.models import video
+
+        cfg, _ = setup
+        params = video.init_params(jax.random.PRNGKey(10), cfg)
+        B = 4
+        vid = jax.random.normal(
+            jax.random.PRNGKey(11),
+            (B, cfg.frames, cfg.img_size, cfg.img_size, cfg.channels),
+        ) * 0.5
+        text = jax.random.normal(
+            jax.random.PRNGKey(12), (B, cfg.text_len, cfg.text_dim)
+        )
+        opt = optax.adam(1e-3)
+        opt_state = opt.init(params)
+
+        import jax as j
+
+        @j.jit
+        def step(params, opt_state, key):
+            loss, grads = j.value_and_grad(video.flow_loss)(
+                params, key, vid, text, cfg
+            )
+            updates, opt_state = opt.update(grads, opt_state)
+            return optax.apply_updates(params, updates), opt_state, loss
+
+        key = jax.random.PRNGKey(13)
+        first = None
+        last = None
+        for i in range(30):
+            key, sub = jax.random.split(key)
+            params, opt_state, loss = step(params, opt_state, sub)
+            if i == 0:
+                first = float(loss)
+            last = float(loss)
+        assert last < first * 0.9, (first, last)
