@@ -49,6 +49,7 @@ from .web.endpoints import (
     fastapi_endpoint,
     web_endpoint,
     web_server,
+    websocket_endpoint,
     wsgi_app,
 )
 
@@ -106,6 +107,7 @@ __all__ = [
     "parse_tpu_spec",
     "web_endpoint",
     "web_server",
+    "websocket_endpoint",
     "wsgi_app",
 ]
 

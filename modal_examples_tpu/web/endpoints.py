@@ -56,3 +56,15 @@ def web_server(
     port: int, *, startup_timeout: float = 30.0, label: str | None = None
 ) -> Callable:
     return _mark("web_server", port=port, startup_timeout=startup_timeout, label=label)
+
+
+def websocket_endpoint(*, label: str | None = None) -> Callable:
+    """Websocket handler: ``fn(ws, **query_params)`` receives a live
+    ``web.websocket.WebSocket`` (blocking receive/send) after the RFC 6455
+    handshake. The reference's streaming-ASR tier serves this shape via
+    fastapi websockets (streaming_kyutai_stt.py); here the stdlib gateway
+    speaks the protocol itself. Handlers run in the gateway process (a
+    live socket cannot cross the container boundary) — keep them thin and
+    call ``.remote`` for heavy work, or keep model state in the module.
+    """
+    return _mark("websocket_endpoint", label=label)

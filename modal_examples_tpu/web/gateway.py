@@ -51,6 +51,10 @@ class _Handler(BaseHTTPRequestHandler):
     def log_message(self, fmt, *args):  # quiet by default; logs go to stdout
         pass
 
+    def _query_kwargs(self, fn, parsed) -> dict:
+        raw = {k: v[-1] for k, v in urllib.parse.parse_qs(parsed.query).items()}
+        return _coerce_kwargs(fn.raw_f, raw)
+
     def _route(self):
         path = urllib.parse.urlparse(self.path)
         label = path.path.strip("/").split("/")[0]
@@ -171,6 +175,30 @@ class _Handler(BaseHTTPRequestHandler):
             return
         fn = route["function"]
         web = fn.spec.web
+        if web["type"] == "websocket_endpoint":
+            if (self.headers.get("Upgrade") or "").lower() != "websocket":
+                self._respond_json(
+                    426, {"error": "websocket endpoint: upgrade required"}
+                )
+                return
+            from .websocket import ConnectionClosed, perform_handshake
+
+            ws = perform_handshake(self)
+            if ws is None:
+                return
+            kwargs = self._query_kwargs(fn, parsed)
+            try:
+                # in-process: the live socket cannot cross the container
+                # boundary (see endpoints.websocket_endpoint docstring)
+                fn.raw_f(ws, **kwargs)
+            except ConnectionClosed:
+                pass
+            except BaseException as e:
+                print(f"[gateway] websocket handler error: {type(e).__name__}: {e}")
+            finally:
+                ws.close()
+                self.close_connection = True
+            return
         if web["type"] in ("wsgi_app", "asgi_app"):
             # the function returns an app object, built once (under the
             # route lock: concurrent first requests must not double-build)
@@ -208,7 +236,7 @@ class _Handler(BaseHTTPRequestHandler):
                 except json.JSONDecodeError:
                     self._respond_json(400, {"error": "invalid JSON body"})
                     return
-        kwargs = _coerce_kwargs(fn.raw_f, kwargs)
+        kwargs = _coerce_kwargs(fn.raw_f, kwargs)  # noqa: E501 — POST merges body first; websocket path uses _query_kwargs
         headers_sent = False
         try:
             if fn.spec.is_generator:

@@ -1,0 +1,247 @@
+"""Websocket layer (RFC 6455 codec, handshake, gateway routing) and the
+streaming transcriber (LocalAgreement commitment semantics) — the
+reference's streaming-ASR tier (streaming_kyutai_stt.py et al.)."""
+
+import threading
+
+import numpy as np
+import pytest
+
+
+@pytest.fixture(scope="module")
+def jax(jax_cpu):
+    return jax_cpu
+
+
+class TestFrameCodec:
+    def test_masked_roundtrip_all_sizes(self):
+        """Client-masked frames decode exactly at every length-encoding
+        tier (7-bit, 16-bit, 64-bit)."""
+        import socket
+
+        from modal_examples_tpu.web.websocket import (
+            OP_BINARY, WebSocket, build_masked_frame,
+        )
+
+        a, b = socket.socketpair()
+        try:
+            server = WebSocket(a)
+            for size in (5, 200, 70_000):
+                payload = bytes(range(256)) * (size // 256 + 1)
+                payload = payload[:size]
+                b.sendall(build_masked_frame(OP_BINARY, payload))
+                kind, got = server.receive()
+                assert kind == "binary" and got == payload, size
+        finally:
+            a.close()
+            b.close()
+
+    def test_unmasked_client_frame_rejected(self):
+        import socket
+
+        from modal_examples_tpu.web.websocket import (
+            OP_TEXT, ConnectionClosed, WebSocket, build_frame,
+        )
+
+        a, b = socket.socketpair()
+        try:
+            server = WebSocket(a)
+            b.sendall(build_frame(OP_TEXT, b"unmasked"))  # protocol error
+            with pytest.raises(ConnectionClosed) as e:
+                server.receive()
+            assert e.value.code == 1002
+        finally:
+            a.close()
+            b.close()
+
+    def test_fragmented_message_reassembled(self):
+        import socket
+        import struct
+
+        from modal_examples_tpu.web.websocket import (
+            OP_CONT, OP_TEXT, WebSocket,
+        )
+
+        def masked(opcode, payload, fin):
+            head = bytes([(0x80 if fin else 0) | opcode, 0x80 | len(payload)])
+            mask = b"\x01\x02\x03\x04"
+            body = bytes(
+                c ^ mask[i % 4] for i, c in enumerate(payload)
+            )
+            return head + mask + body
+
+        a, b = socket.socketpair()
+        try:
+            server = WebSocket(a)
+            b.sendall(masked(OP_TEXT, b"hel", fin=False))
+            b.sendall(masked(OP_CONT, b"lo", fin=True))
+            assert server.receive() == ("text", b"hello")
+        finally:
+            a.close()
+            b.close()
+
+    def test_ping_answered_with_pong(self):
+        import socket
+
+        from modal_examples_tpu.web.websocket import (
+            OP_PING, OP_PONG, OP_TEXT, WebSocket, build_masked_frame,
+        )
+
+        a, b = socket.socketpair()
+        try:
+            server = WebSocket(a)
+            b.sendall(build_masked_frame(OP_PING, b"hb"))
+            b.sendall(build_masked_frame(OP_TEXT, b"x"))
+            assert server.receive() == ("text", b"x")  # ping handled inline
+            # the pong went back to the client side
+            client = WebSocket(b, client=True)
+            opcode, fin, payload = client._read_frame()
+            assert opcode == OP_PONG and payload == b"hb"
+        finally:
+            a.close()
+            b.close()
+
+
+class TestGatewayWebsocket:
+    def test_echo_through_gateway(self, state_dir):
+        import modal_examples_tpu as mtpu
+        from modal_examples_tpu.web.gateway import Gateway
+        from modal_examples_tpu.web.websocket import connect
+
+        app = mtpu.App("ws-test-echo")
+
+        @app.function()
+        @mtpu.websocket_endpoint()
+        def echo(ws, prefix: str = ">"):
+            while True:
+                kind, payload = ws.receive()
+                if payload == b"quit":
+                    ws.send_text("bye")
+                    return
+                ws.send_text(prefix + payload.decode())
+
+        with app.run():
+            gw = Gateway(app).start()
+            host, port = gw.httpd.server_address[:2]
+            ws = connect(host, port, "/echo?prefix=%23")
+            ws.send_text("one")
+            assert ws.receive() == ("text", b"#one")
+            ws.send_text("quit")
+            assert ws.receive() == ("text", b"bye")
+            ws.close()
+            gw.stop()
+
+    def test_plain_get_rejected_with_426(self, state_dir):
+        import json
+        import urllib.error
+        import urllib.request
+
+        import modal_examples_tpu as mtpu
+        from modal_examples_tpu.web.gateway import Gateway
+
+        app = mtpu.App("ws-test-426")
+
+        @app.function()
+        @mtpu.websocket_endpoint()
+        def sock(ws):
+            pass
+
+        with app.run():
+            gw = Gateway(app).start()
+            try:
+                urllib.request.urlopen(f"{gw.base_url}/sock", timeout=10)
+                assert False, "expected 426"
+            except urllib.error.HTTPError as e:
+                assert e.code == 426
+                assert "upgrade" in json.load(e)["error"]
+            finally:
+                gw.stop()
+
+
+@pytest.fixture(scope="module")
+def transcriber_setup(jax):
+    from modal_examples_tpu.models import whisper
+
+    cfg = whisper.WhisperConfig.test_tiny()
+    params = whisper.init_params(jax.random.PRNGKey(0), cfg)
+    return cfg, params
+
+
+def _make(params, cfg, **kw):
+    from modal_examples_tpu.serving.streaming_asr import StreamingTranscriber
+
+    kw.setdefault("window_s", 2.0)
+    kw.setdefault("hop_s", 0.5)
+    kw.setdefault("max_tokens", 12)
+    return StreamingTranscriber(params, cfg, bos_id=0, eos_id=1, **kw)
+
+
+class TestStreamingTranscriber:
+    def test_chunk_size_invariance(self, jax, transcriber_setup):
+        """The final committed transcript must not depend on how the PCM
+        was chunked on the way in."""
+        from modal_examples_tpu.utils.audio import synth_tone_audio
+
+        cfg, params = transcriber_setup
+        audio = synth_tone_audio([440.0, 660.0], 3.0)
+        finals = []
+        for chunk in (1600, 4000, 16000):
+            t = _make(params, cfg)
+            for i in range(0, len(audio), chunk):
+                t.feed(audio[i : i + chunk])
+            finals.append(t.flush().committed_text)
+        assert finals[0] == finals[1] == finals[2]
+        assert finals[0]
+
+    def test_committed_text_never_retracts(self, jax, transcriber_setup):
+        """LocalAgreement contract: committed_text only ever grows by
+        appending — earlier commitments are final."""
+        from modal_examples_tpu.utils.audio import synth_tone_audio
+
+        cfg, params = transcriber_setup
+        audio = synth_tone_audio([440.0, 880.0], 3.0)
+        t = _make(params, cfg)
+        seen = ""
+        for i in range(0, len(audio), 2000):
+            r = t.feed(audio[i : i + 2000])
+            if r is not None:
+                assert r.committed_text.startswith(seen)
+                seen = r.committed_text
+        r = t.flush()
+        assert r.committed_text.startswith(seen)
+
+    def test_single_segment_flush_matches_offline(self, jax, transcriber_setup):
+        """For audio shorter than one window, flush() must equal the
+        offline transcription of the same (padded) audio — streaming adds
+        no transcription error, only incremental delivery."""
+        import jax.numpy as jnp
+
+        from modal_examples_tpu.models import whisper
+        from modal_examples_tpu.utils.audio import (
+            log_mel_spectrogram, synth_tone_audio,
+        )
+
+        cfg, params = transcriber_setup
+        audio = synth_tone_audio([550.0], 1.5)
+        t = _make(params, cfg)
+        for i in range(0, len(audio), 4000):
+            t.feed(audio[i : i + 4000])
+        final = t.flush().committed_text
+
+        padded = np.concatenate(
+            [audio.astype(np.float32),
+             np.zeros(t.window - len(audio), np.float32)]
+        )
+        mel = log_mel_spectrogram(padded, n_mels=cfg.n_mels)[None]
+        toks = np.asarray(
+            whisper.greedy_transcribe(
+                params, jnp.asarray(mel), cfg, bos_id=0, eos_id=1,
+                max_tokens=12,
+            )
+        )[0]
+        want = []
+        for x in toks.tolist():
+            if x == 1:
+                break
+            want.append(x)
+        assert final == "".join(chr(x) for x in want)
