@@ -35,6 +35,11 @@ CONFIGS = {
     # halves weight-streaming bytes AND frees HBM for slots — the bf16 8-slot
     # config's ceiling is ~486 tok/s (8 tok per 16.5 ms weight read), so the
     # quantized high-slot configs are the only road to the 1400 target.
+    "llama2-7b-int4-s36": dict(
+        # int4 weights: ~3.5 GB floor (4.2 ms/step) — the unsloth 4-bit
+        # load path analog (unsloth_finetune.py:187-197)
+        slots=36, max_len=256, max_tokens=128, timeout=1200, quant="int4"
+    ),
     "llama2-7b-int8-s36": dict(
         # 36 slots is the measured sweet spot with the ragged kernel; the
         # remote-compile helper crashes somewhere past ~40 (round-4 sweep)
@@ -261,6 +266,7 @@ def main() -> int:
         # the strongest measured number on the table.
         order = [
             "tiny",
+            "llama2-7b-int4-s36",
             "llama2-7b-int8-s36",
             "llama2-7b-int8-s32",
             "llama2-7b-int8-s16",

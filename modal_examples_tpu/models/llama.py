@@ -872,19 +872,20 @@ def load_hf_weights(
     """Stream HF llama safetensors into this tree (no 2x RAM: tensors are
     read file-by-file and stacked per layer).
 
-    ``quantization="int8"`` quantizes each matmul weight ON THE HOST before
-    the device transfer (models.quantize.quantize_weight_host), so a 7B
-    load costs ~7 GB of HBM — the bf16 tensors never exist on device.
+    ``quantization="int8"`` / ``"int4"`` quantizes each matmul weight ON
+    THE HOST before the device transfer (models.quantize.
+    quantize_weight_host), so a 7B load costs ~7 GB (int8) / ~3.5 GB (int4)
+    of HBM — the bf16 tensors never exist on device.
     """
     import numpy as np
     from safetensors import safe_open
 
-    if quantization not in (None, "int8"):
-        raise ValueError(f"unknown quantization {quantization!r}")
     quant_targets = set()
-    if quantization == "int8":
-        from .quantize import LLAMA_TARGETS, quantize_weight_host
+    quant_bits = 8
+    if quantization is not None:
+        from .quantize import LLAMA_TARGETS, bits_of, quantize_weight_host
 
+        quant_bits = bits_of(quantization)
         # the ONE shared target set (models.quantize.LLAMA_TARGETS) plus the
         # head; router/norms stay high precision (tiny, precision-critical)
         quant_targets = set(LLAMA_TARGETS) | {"lm_head"}
@@ -903,7 +904,7 @@ def load_hf_weights(
 
     def dev(arr: np.ndarray, target: str):
         if target in quant_targets:
-            return quantize_weight_host(arr)
+            return quantize_weight_host(arr, bits=quant_bits)
         return jnp.asarray(arr, dtype=dt)
 
     def t(name, target="_"):  # HF stores [out, in]; we use [in, out]

@@ -1,13 +1,17 @@
-"""Weight-only int8 quantization for serving.
+"""Weight-only int8/int4 quantization for serving.
 
 The reference's quantized-LLM story is bitsandbytes 4/8-bit (unsloth loads
 4-bit, unsloth_finetune.py:187-197; misc/falcon_bitsandbytes.py is the
-negative baseline). TPU-native: weights live in HBM as int8 with per-output-
-channel f32 scales (symmetric, AQT-style) — HALVING weight HBM traffic and
-footprint vs bf16 (a 7B llama drops to ~7GB, fitting a 16GB v5e with room
-for KV) — and matmuls upcast tiles to bf16 on the way into the MXU (XLA
-fuses the cast; ops.quantized_matmul is the Pallas alternative when
-profiling says so).
+negative baseline). TPU-native: weights live in HBM as int8 (or packed
+int4) with per-output-channel f32 scales (symmetric, AQT-style) — halving
+(quartering) weight HBM traffic and footprint vs bf16 — and matmuls upcast
+tiles to bf16 on the way into the MXU (XLA fuses the cast;
+ops.quantized_matmul is the Pallas alternative when profiling says so).
+
+int4 uses the native ``jnp.int4`` dtype (XLA packs two nibbles per byte in
+TPU HBM); per-output-channel symmetric scaling is cruder than the
+group-wise schemes real 4-bit checkpoints use (AWQ/GPTQ group 128), which
+is acceptable for the bench's random weights and documented for real ones.
 
 ``QuantizedWeight`` is a pytree node, so quantized params flow through
 scan/jit/sharding like any other weights.
@@ -36,12 +40,27 @@ class QuantizedWeight:
         return self.q.dtype
 
 
-def quantize_weight(w: jax.Array) -> QuantizedWeight:
-    """Symmetric per-output-channel int8 over the contraction dim (-2)."""
+#: quantization modes every entry point accepts (engine, loaders, CLI)
+SUPPORTED = (None, "int8", "int4")
+
+
+def _qmax(bits: int) -> float:
+    if bits == 8:
+        return 127.0
+    if bits == 4:
+        return 7.0
+    raise ValueError(f"unsupported quantization bits {bits!r} (4 or 8)")
+
+
+def quantize_weight(w: jax.Array, bits: int = 8) -> QuantizedWeight:
+    """Symmetric per-output-channel int8/int4 over the contraction dim (-2)."""
+    qmax = _qmax(bits)
     amax = jnp.max(jnp.abs(w.astype(jnp.float32)), axis=-2, keepdims=True)
-    scale = jnp.where(amax > 0, amax / 127.0, 1.0)
-    q = jnp.round(w.astype(jnp.float32) / scale).astype(jnp.int8)
-    return QuantizedWeight(q=q, scale=scale)
+    scale = jnp.where(amax > 0, amax / qmax, 1.0)
+    q = jnp.clip(jnp.round(w.astype(jnp.float32) / scale), -qmax, qmax)
+    return QuantizedWeight(
+        q=q.astype(jnp.int8 if bits == 8 else jnp.int4), scale=scale
+    )
 
 
 def dequantize_weight(qw: QuantizedWeight, dtype=jnp.bfloat16) -> jax.Array:
@@ -60,55 +79,76 @@ LLAMA_TARGETS = (
 )
 
 
-def quantize_llama(params: dict, targets=LLAMA_TARGETS) -> dict:
+def bits_of(quantization: str) -> int:
+    if quantization not in ("int8", "int4"):
+        raise ValueError(f"unknown quantization {quantization!r}")
+    return 8 if quantization == "int8" else 4
+
+
+def quantize_llama(
+    params: dict, targets=LLAMA_TARGETS, *, bits: int = 8
+) -> dict:
     """Quantize the layer matmuls (and lm_head) of a llama param tree.
 
-    Device-side path for caller-provided trees. Peak HBM is bf16 + int8
+    Device-side path for caller-provided trees. Peak HBM is bf16 + int
     together; callers that own the tree outright should random-init via
     ``init_quantized_llama`` (fused, no bf16 peak) or load checkpoints via
-    ``llama.load_hf_weights(quantization="int8")`` (host-side quantize).
+    ``llama.load_hf_weights(quantization=...)`` (host-side quantize).
     """
     out = dict(params)
     out["layers"] = {
-        name: quantize_weight(w) if name in targets else w
+        name: quantize_weight(w, bits) if name in targets else w
         for name, w in params["layers"].items()
     }
     if "lm_head" in params:
-        out["lm_head"] = quantize_weight(params["lm_head"])
+        out["lm_head"] = quantize_weight(params["lm_head"], bits)
     return out
 
 
-def init_quantized_llama(key, cfg) -> dict:
-    """Random-init an int8-quantized llama tree in ONE jitted program.
+def init_quantized_llama(key, cfg, *, bits: int = 8) -> dict:
+    """Random-init a quantized llama tree in ONE jitted program.
 
-    init -> quantize as separate device steps peaks at bf16 + int8 together
+    init -> quantize as separate device steps peaks at bf16 + int together
     (~20 GB at 7B — over the v5e ceiling, and the tunneled backend does not
     reliably reclaim deleted buffers across queued ops). Fusing both into a
     single executable makes every bf16 leaf an XLA-internal temporary: the
-    compiler frees it inside the program, so peak HBM is the int8 tree plus
-    one transient leaf.
+    compiler frees it inside the program, so peak HBM is the quantized tree
+    plus one transient leaf.
     """
     from . import llama
 
-    return jax.jit(lambda k: quantize_llama(llama.init_params(k, cfg)))(key)
+    return jax.jit(
+        lambda k: quantize_llama(llama.init_params(k, cfg), bits=bits)
+    )(key)
 
 
-def quantize_weight_host(w: "np.ndarray") -> QuantizedWeight:
+def quantize_weight_host(w: "np.ndarray", bits: int = 8) -> QuantizedWeight:
     """Host-side (numpy) quantization: the checkpoint-load path. The bf16
-    tensor never touches the device — only the int8 payload and scales are
-    transferred, so loading a 7B model costs ~7 GB of HBM, not 20."""
+    tensor never touches the device — only the int payload and scales are
+    transferred, so loading a 7B model costs ~7 GB (int8) / ~3.5 GB (int4)
+    of HBM, not 20."""
+    import ml_dtypes
     import numpy as np
 
+    qmax = _qmax(bits)
     wf = np.asarray(w, dtype=np.float32)
     amax = np.max(np.abs(wf), axis=-2, keepdims=True)
-    scale = np.where(amax > 0, amax / 127.0, 1.0).astype(np.float32)
-    q = np.clip(np.round(wf / scale), -127, 127).astype(np.int8)
+    scale = np.where(amax > 0, amax / qmax, 1.0).astype(np.float32)
+    q = np.clip(np.round(wf / scale), -qmax, qmax)
+    q = q.astype(np.int8 if bits == 8 else ml_dtypes.int4)
     return QuantizedWeight(q=jnp.asarray(q), scale=jnp.asarray(scale))
 
 
 def param_bytes(params) -> int:
-    return sum(
-        x.size * x.dtype.itemsize
-        for x in jax.tree.leaves(params)
-        if hasattr(x, "size")
-    )
+    """True HBM bytes of a param tree; int4 counts as 4 bits per element
+    (XLA packs two nibbles per byte on TPU even though ml_dtypes reports
+    itemsize 1)."""
+    total = 0
+    for x in jax.tree.leaves(params):
+        if not hasattr(x, "size"):
+            continue
+        if str(x.dtype) == "int4":
+            total += (x.size + 1) // 2
+        else:
+            total += x.size * x.dtype.itemsize
+    return total

@@ -213,27 +213,35 @@ class LLMEngine:
             )
         self.cfg = cfg
         self.tokenizer = load_tokenizer(model_dir)
-        if quantization not in (None, "int8"):
-            raise ValueError(f"unknown quantization {quantization!r}")
+        from ..models.quantize import SUPPORTED as _QUANT_MODES
+
+        if quantization not in _QUANT_MODES:
+            raise ValueError(
+                f"unknown quantization {quantization!r}; "
+                f"supported: {_QUANT_MODES}"
+            )
         if params is None:
             if model_dir is not None:
                 # checkpoint loads quantize on the HOST (the bf16 tensors
-                # never reach the device: ~7 GB HBM for a 7B int8 model)
+                # never reach the device: ~7 GB HBM for a 7B int8 model,
+                # ~3.5 GB int4)
                 params = llama.load_hf_weights(
                     model_dir, cfg, quantization=quantization
                 )
-            elif quantization == "int8":
+            elif quantization is not None:
                 # init+quantize fused into ONE program so the bf16 tree is
                 # an XLA-internal temporary, not a 13.5 GB resident peak
-                from ..models.quantize import init_quantized_llama
+                from ..models.quantize import bits_of, init_quantized_llama
 
-                params = init_quantized_llama(jax.random.PRNGKey(seed), cfg)
+                params = init_quantized_llama(
+                    jax.random.PRNGKey(seed), cfg, bits=bits_of(quantization)
+                )
             else:
                 params = llama.init_params(jax.random.PRNGKey(seed), cfg)
-        elif quantization == "int8":
-            from ..models.quantize import quantize_llama
+        elif quantization is not None:
+            from ..models.quantize import bits_of, quantize_llama
 
-            params = quantize_llama(params)
+            params = quantize_llama(params, bits=bits_of(quantization))
 
         # tensor parallelism is ONE ENGINE FLAG, not a separate code path
         # (matching vllm_inference.py:180's --tensor-parallel-size): weights
