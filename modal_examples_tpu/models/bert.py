@@ -132,7 +132,8 @@ def forward(
             x + o, layer["attn_norm_w"], layer["attn_norm_b"], cfg.norm_eps
         )
         h = layers.gelu_mlp(
-            {n: layer[n] for n in ("fc_w", "fc_b", "proj_w", "proj_b")}, x
+            {n: layer[n] for n in ("fc_w", "fc_b", "proj_w", "proj_b")}, x,
+            exact=True,  # BERT uses erf-GELU
         )
         return layers.layer_norm(
             x + h, layer["mlp_norm_w"], layer["mlp_norm_b"], cfg.norm_eps

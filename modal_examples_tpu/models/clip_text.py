@@ -86,8 +86,7 @@ def _ln(x, scale, bias, eps):
     return (x - mu) * jax.lax.rsqrt(var + eps) * scale + bias
 
 
-def _quick_gelu(x):
-    return x * jax.nn.sigmoid(1.702 * x)
+
 
 
 def forward(
@@ -117,7 +116,7 @@ def forward(
         o = o.transpose(0, 2, 1, 3).reshape(B, S, cfg.dim)
         x = x + (o @ l["wo"] + l["bo"])
         h = _ln(x, l["ln2_scale"], l["ln2_bias"], cfg.norm_eps)
-        h = _quick_gelu(h @ l["fc1"] + l["fc1_b"]) @ l["fc2"] + l["fc2_b"]
+        h = layers.quick_gelu(h @ l["fc1"] + l["fc1_b"]) @ l["fc2"] + l["fc2_b"]
         return x + h, None
 
     x, _ = jax.lax.scan(layer_fn, x, params["layers"])

@@ -137,12 +137,21 @@ def swiglu_mlp(
     return _proj(h, params["down"], "down", lora, lora_scale)
 
 
-def gelu_mlp(params: dict, x: jax.Array) -> jax.Array:
-    """GELU feed-forward with biases (GPT-2/BERT style)."""
+def quick_gelu(x: jax.Array) -> jax.Array:
+    """CLIP's activation: x * sigmoid(1.702 x) (published CLIP towers and
+    text encoders use this, not tanh/erf GELU)."""
+    return x * jax.nn.sigmoid(1.702 * x)
+
+
+def gelu_mlp(params: dict, x: jax.Array, *, exact: bool = False) -> jax.Array:
+    """GELU feed-forward with biases. ``exact`` selects erf-GELU (BERT/
+    Whisper convention) vs the default tanh approximation (GPT-2's
+    gelu_new) — the flavors differ by ~1e-3 and published checkpoints mix
+    them, so the model picks."""
     h = jnp.dot(x, params["fc_w"], preferred_element_type=jnp.float32) + params[
         "fc_b"
     ].astype(jnp.float32)
-    h = jax.nn.gelu(h).astype(x.dtype)
+    h = jax.nn.gelu(h, approximate=not exact).astype(x.dtype)
     return (
         jnp.dot(h, params["proj_w"], preferred_element_type=jnp.float32)
         + params["proj_b"].astype(jnp.float32)

@@ -89,8 +89,12 @@ def init_vision_params(key: jax.Array, cfg: VLMConfig) -> dict:
 
     return {
         "patch_proj": dense(patch_in, D),
+        # class token: real CLIP prepends it and it PARTICIPATES in
+        # attention (every patch state depends on it); the projector
+        # consumes patch states only, but the token must be in the tower
+        "class_emb": layers.init_dense(next(ks), (D,), scale=0.02, dtype=dt),
         "pos_emb": layers.init_dense(
-            next(ks), (v.n_patches, D), scale=0.02, dtype=dt
+            next(ks), (v.n_patches + 1, D), scale=0.02, dtype=dt
         ),
         "pre_ln_scale": jnp.ones((D,), dt),
         "pre_ln_bias": jnp.zeros((D,), dt),
@@ -122,6 +126,9 @@ def _ln(x, scale, bias, eps):
     return (x - mu) * jax.lax.rsqrt(var + eps) * scale + bias
 
 
+
+
+
 def patchify(images: jax.Array, patch: int) -> jax.Array:
     """[B, S, S, 3] -> [B, n_patches, patch*patch*3] (row-major patches)."""
     B, H, W, C = images.shape
@@ -141,9 +148,11 @@ def encode_image(
     B = images.shape[0]
     x = patchify(images.astype(v.jnp_dtype), v.patch_size)
     x = layers.mm(x, params["patch_proj"]).astype(v.jnp_dtype)
+    cls = jnp.broadcast_to(params["class_emb"][None, None], (B, 1, v.dim))
+    x = jnp.concatenate([cls, x], axis=1)  # [B, 1 + n_patches, D]
     x = x + params["pos_emb"][None]
     x = _ln(x, params["pre_ln_scale"], params["pre_ln_bias"], v.norm_eps)
-    S = v.n_patches
+    S = v.n_patches + 1
     hd = v.dim // v.n_heads
 
     def layer_fn(x, l):
@@ -160,14 +169,17 @@ def encode_image(
         o = o.transpose(0, 2, 1, 3).reshape(B, S, v.dim)
         x = x + (o @ l["wo"] + l["bo"])
         h = _ln(x, l["ln2_scale"], l["ln2_bias"], v.norm_eps)
-        h = jax.nn.gelu(h @ l["fc1"] + l["fc1_b"]) @ l["fc2"] + l["fc2_b"]
+        h = layers.quick_gelu(h @ l["fc1"] + l["fc1_b"]) @ l["fc2"] + l["fc2_b"]
         return x + h, None
 
     x, _ = jax.lax.scan(layer_fn, x, params["layers"])
+    x = x[:, 1:]  # drop the class token: the projector eats patch states
     # LLaVA projects the (un-normed) penultimate patch states; with the
     # scanned-stack structure the final states stand in — the projector is
     # trained against whatever the tower emits
-    h = jax.nn.gelu(x @ params["proj1"] + params["proj1_b"])
+    h = jax.nn.gelu(
+        x @ params["proj1"] + params["proj1_b"], approximate=False
+    )  # LLaVA's projector uses exact GELU
     return (h @ params["proj2"] + params["proj2_b"]).astype(jnp.float32)
 
 
@@ -218,8 +230,9 @@ def load_hf_vision_weights(
 
     The CLIP conv1 patch embedding [D, 3, p, p] flattens to our
     [p*p*3, D] matmul ordering (patch pixels row-major, channels minor —
-    matching ``patchify``). The class token is dropped: the projector
-    consumes patch states only (the LLaVA recipe).
+    matching ``patchify``). The class token rides through the tower (it
+    participates in attention) and is dropped before the projector (the
+    LLaVA recipe).
     """
     import numpy as np
     from safetensors import safe_open
@@ -248,11 +261,13 @@ def load_hf_vision_weights(
     patch_proj = jnp.asarray(
         conv.transpose(2, 3, 1, 0).reshape(-1, v.dim), dt
     )
-    # position embedding row 0 is the class token — dropped
-    pos = raw.pop(P + "embeddings.position_embedding.weight")[1:]
+    pos = raw.pop(P + "embeddings.position_embedding.weight")
 
     params = {
         "patch_proj": patch_proj,
+        "class_emb": jnp.asarray(
+            raw.pop(P + "embeddings.class_embedding"), dt
+        ),
         "pos_emb": jnp.asarray(pos, dt),
         "pre_ln_scale": jnp.asarray(raw.pop(P + "pre_layrnorm.weight"), dt),
         "pre_ln_bias": jnp.asarray(raw.pop(P + "pre_layrnorm.bias"), dt),

@@ -134,14 +134,18 @@ def _mha(q, k, v, n_heads, causal: bool) -> jax.Array:
 def encode(params: dict, mel: jax.Array, cfg: WhisperConfig) -> jax.Array:
     """log-mel [B, T, n_mels] -> audio states [B, T//2, D]."""
     dn = ("NWC", "WIO", "NWC")
+    # explicit (1, 1) padding, NOT "SAME": for the stride-2 conv XLA's SAME
+    # resolves to (0, 1), shifting every window one frame versus torch's
+    # padding=1 — caught by the transformers cross-implementation test
+    # (tests/test_hf_cross_impl.py; encoder max-abs error 0.23 -> 1e-5)
     x = jax.lax.conv_general_dilated(
-        mel, params["conv1_w"], (1,), "SAME", dimension_numbers=dn
+        mel, params["conv1_w"], (1,), [(1, 1)], dimension_numbers=dn
     ) + params["conv1_b"]
-    x = jax.nn.gelu(x)
+    x = jax.nn.gelu(x, approximate=False)
     x = jax.lax.conv_general_dilated(
-        x, params["conv2_w"], (2,), "SAME", dimension_numbers=dn
+        x, params["conv2_w"], (2,), [(1, 1)], dimension_numbers=dn
     ) + params["conv2_b"]
-    x = jax.nn.gelu(x)
+    x = jax.nn.gelu(x, approximate=False)
     x = x + _sinusoids(x.shape[1], cfg.dim).astype(x.dtype)[None]
 
     def layer_fn(x, l):
@@ -153,7 +157,8 @@ def encode(params: dict, mel: jax.Array, cfg: WhisperConfig) -> jax.Array:
         x = x + jnp.dot(o, l["wo"]) + l["bo"]
         h = layers.layer_norm(x, l["ln2_w"], l["ln2_b"], cfg.norm_eps)
         h = layers.gelu_mlp(
-            {n: l[n] for n in ("fc_w", "fc_b", "proj_w", "proj_b")}, h
+            {n: l[n] for n in ("fc_w", "fc_b", "proj_w", "proj_b")}, h,
+            exact=True,  # whisper uses erf-GELU
         )
         return x + h, None
 
@@ -187,7 +192,8 @@ def decode(
         ) + l["xbo"]
         h = layers.layer_norm(x, l["ln2_w"], l["ln2_b"], cfg.norm_eps)
         h = layers.gelu_mlp(
-            {n: l[n] for n in ("fc_w", "fc_b", "proj_w", "proj_b")}, h
+            {n: l[n] for n in ("fc_w", "fc_b", "proj_w", "proj_b")}, h,
+            exact=True,  # whisper uses erf-GELU
         )
         return x + h, None
 

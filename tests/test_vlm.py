@@ -72,6 +72,9 @@ class TestViT:
         raw[P + "embeddings.position_embedding.weight"] = rng.randn(
             v.n_patches + 1, v.dim
         ).astype(np.float32)
+        raw[P + "embeddings.class_embedding"] = rng.randn(v.dim).astype(
+            np.float32
+        )
         raw[P + "pre_layrnorm.weight"] = rng.randn(v.dim).astype(np.float32)
         raw[P + "pre_layrnorm.bias"] = rng.randn(v.dim).astype(np.float32)
         for i in range(v.n_layers):
