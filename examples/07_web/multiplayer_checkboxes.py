@@ -1,0 +1,163 @@
+# # Multiplayer checkboxes: shared Dict state under concurrent writers
+#
+# TPU-native counterpart of the reference's
+# 07_web/fasthtml-checkboxes/fasthtml_checkboxes.py — "deploy 100,000
+# multiplayer checkboxes": a Dict-backed shared board that many clients
+# mutate concurrently, with state surviving container restarts
+# (fasthtml_checkboxes.py:30,52-60 keeps the board in a modal.Dict and
+# restores it on boot). The reference renders FastHTML; per
+# OUT_OF_SCOPE.md, UIs are cosmetic here — the API returns JSON and the
+# *state semantics* (atomic toggles, diff polling, persistence,
+# concurrent-writer correctness) are the point.
+#
+# Run: tpurun run examples/07_web/multiplayer_checkboxes.py
+
+import os
+
+import modal_examples_tpu as mtpu
+
+N_CHECKBOXES = int(os.environ.get("MTPU_N_CHECKBOXES", "512"))
+
+app = mtpu.App("example-multiplayer-checkboxes")
+db = mtpu.Dict.from_name("checkboxes-db", create_if_missing=True)
+
+
+def _board() -> list:
+    """The board, restored from the Dict (the restart-survival path)."""
+    board = db.get("board")
+    if board is None or len(board) != N_CHECKBOXES:
+        board = [False] * N_CHECKBOXES
+        db.put("board", board)
+        db.put("version", 0)
+    return board
+
+
+@app.function()
+@mtpu.fastapi_endpoint()
+def board() -> dict:
+    """Full board state + version (clients diff-poll from here)."""
+    return {
+        "version": db.get("version", 0),
+        "checked": [i for i, v in enumerate(_board()) if v],
+        "n": N_CHECKBOXES,
+    }
+
+
+@app.function()
+@mtpu.fastapi_endpoint(method="POST")
+def toggle(i: int, client: str = "anon") -> dict:
+    """Atomically toggle one checkbox; the Dict's put_if_absent-based lock
+    serializes writers (many containers may run this concurrently)."""
+    if not 0 <= i < N_CHECKBOXES:
+        return {"error": f"index {i} out of range", "n": N_CHECKBOXES}
+    # spin-lock via put_if_absent: the Dict is the only shared medium
+    # between containers, so it is also the mutex
+    import time as _t
+
+    while not db.put_if_absent("lock", client):
+        _t.sleep(0.001)
+    try:
+        board = _board()
+        board[i] = not board[i]
+        version = db.get("version", 0) + 1
+        db.put("board", board)
+        db.put("version", version)
+        db.put(f"last_writer:{i}", client)
+    finally:
+        db.pop("lock", None)  # release (Dict.delete removes a whole dict)
+    return {"i": i, "checked": board[i], "version": version}
+
+
+@app.function()
+@mtpu.fastapi_endpoint()
+def stats() -> dict:
+    board = _board()
+    return {
+        "version": db.get("version", 0),
+        "n_checked": sum(board),
+        "n": N_CHECKBOXES,
+    }
+
+
+@app.local_entrypoint()
+def main(clients: int = 8, toggles_per_client: int = 40):
+    import json
+    import threading
+    import urllib.request
+
+    from modal_examples_tpu.web.gateway import Gateway
+
+    # fresh board per invocation: the Dict is a persistent named store
+    # (that's the point of the restart test below), so the deterministic
+    # assertions reset it up front
+    db.put("board", [False] * N_CHECKBOXES)
+    db.put("version", 0)
+
+    with app.run():
+        gw = Gateway(app).start()
+        base = gw.base_url
+
+        def post(path):
+            req = urllib.request.Request(base + path, data=b"{}")
+            with urllib.request.urlopen(req, timeout=60) as r:
+                return json.load(r)
+
+        def get(path):
+            with urllib.request.urlopen(base + path, timeout=60) as r:
+                return json.load(r)
+
+        # concurrent writers: each client toggles a deterministic set, so
+        # the final board state is exactly predictable regardless of
+        # interleaving — every index i gets toggled count(i) times, and
+        # checked(i) == count(i) % 2 == 1
+        counts = [0] * N_CHECKBOXES
+        plans = []
+        for c in range(clients):
+            plan = [(c * 7 + 3 * k) % N_CHECKBOXES
+                    for k in range(toggles_per_client)]
+            plans.append(plan)
+            for i in plan:
+                counts[i] += 1
+
+        errors = []
+
+        def run_client(c):
+            try:
+                for i in plans[c]:
+                    post(f"/toggle?i={i}&client=client-{c}")
+            except Exception as e:  # noqa: BLE001
+                errors.append(e)
+
+        threads = [
+            threading.Thread(target=run_client, args=(c,))
+            for c in range(clients)
+        ]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        assert not errors, errors
+
+        state = get("/board")
+        want = {i for i, n in enumerate(counts) if n % 2 == 1}
+        got = set(state["checked"])
+        assert got == want, (
+            f"lost updates: {len(want ^ got)} boxes diverged "
+            f"(version={state['version']})"
+        )
+        assert state["version"] == clients * toggles_per_client
+        print(
+            f"{clients} concurrent clients x {toggles_per_client} toggles: "
+            f"board consistent, version={state['version']}, "
+            f"{len(got)} boxes checked"
+        )
+        gw.stop()
+
+    # persistence across app runs: the Dict outlives the run context
+    with app.run():
+        gw = Gateway(app).start()
+        with urllib.request.urlopen(gw.base_url + "/stats", timeout=60) as r:
+            stats2 = json.load(r)
+        assert stats2["n_checked"] == len(want)
+        print(f"state survived restart: {stats2['n_checked']} still checked")
+        gw.stop()

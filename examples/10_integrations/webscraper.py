@@ -1,0 +1,144 @@
+# # Web scraper: Queue-driven BFS crawl with link extraction
+#
+# TPU-native counterpart of the reference's 10_integrations/webscraper.py
+# (317 LoC): fetch pages, extract links, store what you found, fan the
+# frontier out through a Queue, and dedupe with a Dict so every page is
+# scraped exactly once — the crawler shape 09_job_queues/
+# dicts_and_queues.py sketches, upgraded with real HTTP fetching and HTML
+# parsing (stdlib html.parser; the reference uses playwright/bs4).
+#
+# Zero egress: the app SERVES its own multi-page site (a tiny generated
+# wiki with deterministic cross-links) and then crawls it over real HTTP
+# through the gateway — fetch, parse, frontier, and storage are all the
+# real mechanics.
+#
+# Run: tpurun run examples/10_integrations/webscraper.py
+
+import modal_examples_tpu as mtpu
+
+app = mtpu.App("example-webscraper")
+pages_db = mtpu.Dict.from_name("scraper-results", create_if_missing=True)
+seen = mtpu.Dict.from_name("scraper-seen", create_if_missing=True)
+frontier = mtpu.Queue.from_name("scraper-frontier", create_if_missing=True)
+
+N_PAGES = 24
+
+
+@app.function()
+@mtpu.fastapi_endpoint()
+def wiki(page: int = 0) -> bytes:
+    """The site under test: page i links to 2i+1, 2i+2 (a binary tree) and
+    back to its parent — deterministic reachability for the assertion.
+    Returned as bytes so the gateway serves raw HTML, not a JSON string."""
+    links = [n for n in (2 * page + 1, 2 * page + 2) if n < N_PAGES]
+    if page > 0:
+        links.append((page - 1) // 2)
+    body = "".join(
+        f'<li><a href="/wiki?page={n}">node {n}</a></li>' for n in links
+    )
+    return (
+        f"<html><head><title>Node {page}</title></head>"
+        f"<body><h1>Node {page}</h1><p>content of node {page}</p>"
+        f"<ul>{body}</ul></body></html>"
+    ).encode()
+
+
+class _LinkParser:
+    """Extract hrefs + title with stdlib html.parser (no bs4 needed)."""
+
+    def __init__(self):
+        from html.parser import HTMLParser
+
+        outer = self
+
+        class P(HTMLParser):
+            def handle_starttag(self, tag, attrs):
+                if tag == "a":
+                    href = dict(attrs).get("href")
+                    if href:
+                        outer.links.append(href)
+                outer._tag = tag
+
+            def handle_data(self, data):
+                if getattr(outer, "_tag", None) == "title":
+                    outer.title += data
+
+        self.links: list[str] = []
+        self.title = ""
+        self._parser = P()
+
+    def feed(self, html: str):
+        self._parser.feed(html)
+        return self
+
+
+@app.function(max_containers=4)
+def scrape(url: str, depth: int, max_depth: int) -> None:
+    """Fetch one page, record it, and push unseen links onto the frontier.
+    Exactly-once claiming rides Dict.put_if_absent (the dicts_and_queues
+    crawler primitive)."""
+    import urllib.request
+
+    with urllib.request.urlopen(url, timeout=30) as r:
+        html = r.read().decode()
+    parsed = _LinkParser().feed(html)
+    pages_db.put(url, {
+        "title": parsed.title.strip(),
+        "n_links": len(parsed.links),
+        "depth": depth,
+    })
+    if depth >= max_depth:
+        return
+    from urllib.parse import urljoin
+
+    for href in parsed.links:
+        nxt = urljoin(url, href)
+        if seen.put_if_absent(nxt, True):  # first claim wins
+            frontier.put((nxt, depth + 1))
+
+
+@app.local_entrypoint()
+def main(max_depth: int = 8):
+    from modal_examples_tpu.web.gateway import Gateway
+
+    with app.run():
+        gw = Gateway(app).start()
+        root = f"{gw.base_url}/wiki?page=0"
+
+        seen.put_if_absent(root, True)
+        frontier.put((root, 0))
+        # BFS pump: drain the frontier into a wave, fan it out with .map
+        # (the grid-search fan-out shape), and loop — each wave's link
+        # pushes refill the frontier until the whole tree is claimed
+        from modal_examples_tpu.storage.dict_queue import Empty
+
+        while True:
+            wave = []
+            while True:
+                try:
+                    url, depth = frontier.get(block=False)
+                except Empty:
+                    break
+                wave.append((url, depth, max_depth))
+            if not wave:
+                break
+            list(scrape.starmap(wave))
+
+        results = {k: pages_db.get(k) for k in pages_db.keys()}
+        got_pages = {
+            int(k.split("page=")[1]) for k in results
+        }
+        assert got_pages == set(range(N_PAGES)), (
+            f"missed pages: {set(range(N_PAGES)) - got_pages}"
+        )
+        titles = {v["title"] for v in results.values()}
+        assert f"Node {N_PAGES - 1}" in titles
+        by_depth = {}
+        for v in results.values():
+            by_depth.setdefault(v["depth"], 0)
+            by_depth[v["depth"]] += 1
+        print(
+            f"crawled {len(results)} pages exactly once "
+            f"(depths: {dict(sorted(by_depth.items()))})"
+        )
+        gw.stop()

@@ -86,9 +86,6 @@ def _ln(x, scale, bias, eps):
     return (x - mu) * jax.lax.rsqrt(var + eps) * scale + bias
 
 
-
-
-
 def forward(
     params: dict,
     tokens: jax.Array,  # [B, S] int32 (padded to max_len or shorter)

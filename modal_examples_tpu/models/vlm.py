@@ -126,9 +126,6 @@ def _ln(x, scale, bias, eps):
     return (x - mu) * jax.lax.rsqrt(var + eps) * scale + bias
 
 
-
-
-
 def patchify(images: jax.Array, patch: int) -> jax.Array:
     """[B, S, S, 3] -> [B, n_patches, patch*patch*3] (row-major patches)."""
     B, H, W, C = images.shape
