@@ -14,6 +14,8 @@ from . import (
     llama,
     lora,
     moe,
+    ocr,
+    segmentation,
     video,
     vlm,
     whisper,
@@ -21,5 +23,5 @@ from . import (
 
 __all__ = [
     "bert", "diffusion", "gpt", "layers", "llama", "lora", "moe",
-    "video", "vlm", "whisper",
+    "ocr", "segmentation", "video", "vlm", "whisper",
 ]

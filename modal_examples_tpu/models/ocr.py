@@ -1,0 +1,221 @@
+"""Optical character recognition: conv + transformer + CTC, TPU-first.
+
+The reference's OCR job queue runs the Marker/Datalab model stack on CUDA
+(/root/reference/09_job_queues/doc_ocr_jobs.py:38 — marker-pdf downloads
+torch checkpoints). This module is the TPU-native counterpart at the
+architecture level the field actually uses for text-line recognition
+(CRNN/TrOCR family): a strided conv stem collapses the image height into a
+width-wise sequence of visual features, a bidirectional transformer
+encoder contextualizes it, and CTC aligns the unsegmented character
+sequence — no bounding boxes, no per-character labels.
+
+TPU-first: NHWC convs (channels-last keeps the MXU contraction on the
+minor dim), one static input shape per config (lines are padded to
+``width``), scanned encoder layers, and ``optax.ctc_loss`` for training.
+Zero egress means no published OCR checkpoint exists here: the example
+trains this model from scratch on synthetically RENDERED text (PIL
+rasterizes strings; the model genuinely learns glyphs — the same
+train-on-rendered-text recipe synthetic-data OCR systems use).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+
+from . import layers
+
+#: recognized alphabet; index 0 is the CTC blank
+CHARSET = " ABCDEFGHIJKLMNOPQRSTUVWXYZ0123456789.$:-/#"
+
+
+@dataclasses.dataclass(frozen=True)
+class OCRConfig:
+    height: int = 32
+    width: int = 256
+    channels: int = 1
+    dim: int = 128  # encoder width
+    n_layers: int = 2
+    n_heads: int = 4
+    n_classes: int = len(CHARSET) + 1  # + blank at index 0
+    norm_eps: float = 1e-6
+    dtype: str = "float32"
+
+    @property
+    def seq_len(self) -> int:  # width positions after the conv stem
+        return self.width // 4
+
+    @property
+    def jnp_dtype(self):
+        return jnp.dtype(self.dtype)
+
+
+def encode_text(s: str) -> list[int]:
+    """chars -> label ids (1-based; 0 is the CTC blank)."""
+    return [CHARSET.index(c) + 1 for c in s.upper() if c in CHARSET]
+
+
+def decode_labels(ids) -> str:
+    return "".join(CHARSET[i - 1] for i in ids if 1 <= i <= len(CHARSET))
+
+
+def init_params(key: jax.Array, cfg: OCRConfig) -> dict:
+    dt = cfg.jnp_dtype
+    D, L = cfg.dim, cfg.n_layers
+    ks = iter(jax.random.split(key, 16))
+
+    def dense(*shape, scale=None):
+        return layers.init_dense(next(ks), shape, scale=scale, dtype=dt)
+
+    def conv(k, cin, cout):
+        return dense(k, k, cin, cout, scale=(k * k * cin) ** -0.5)
+
+    # stem: H x W -> (H/8) x (W/4); the residual height collapses into the
+    # feature dim so each width position sees the full glyph column
+    c1, c2, c3 = 32, 64, D
+    return {
+        "conv1": conv(3, cfg.channels, c1),  # stride (2, 2)
+        "conv2": conv(3, c1, c2),  # stride (2, 2)
+        "conv3": conv(3, c2, c3),  # stride (2, 1)
+        "col_proj": dense((cfg.height // 8) * c3, D),
+        "pos_emb": dense(cfg.seq_len, D, scale=0.02),
+        "layers": {
+            "ln1_s": jnp.ones((L, D), dt), "ln1_b": jnp.zeros((L, D), dt),
+            "wq": dense(L, D, D), "wk": dense(L, D, D),
+            "wv": dense(L, D, D), "wo": dense(L, D, D),
+            "ln2_s": jnp.ones((L, D), dt), "ln2_b": jnp.zeros((L, D), dt),
+            "fc": dense(L, D, 4 * D), "fc_b": jnp.zeros((L, 4 * D), dt),
+            "proj": dense(L, 4 * D, D), "proj_b": jnp.zeros((L, D), dt),
+        },
+        "head": dense(D, cfg.n_classes),
+        "head_b": jnp.zeros((cfg.n_classes,), dt),
+    }
+
+
+def _conv2d(x, w, stride):
+    return jax.lax.conv_general_dilated(
+        x, w, stride, "SAME", dimension_numbers=("NHWC", "HWIO", "NHWC")
+    )
+
+
+def forward(params: dict, images: jax.Array, cfg: OCRConfig) -> jax.Array:
+    """[B, H, W, 1] in [0, 1] -> CTC logits [B, seq_len, n_classes]."""
+    B = images.shape[0]
+    x = jax.nn.relu(_conv2d(images.astype(cfg.jnp_dtype), params["conv1"], (2, 2)))
+    x = jax.nn.relu(_conv2d(x, params["conv2"], (2, 2)))
+    x = jax.nn.relu(_conv2d(x, params["conv3"], (2, 1)))  # [B, H/8, W/4, D]
+    # width becomes the sequence; the glyph column flattens into features
+    x = x.transpose(0, 2, 1, 3).reshape(B, cfg.seq_len, -1)
+    h = x @ params["col_proj"] + params["pos_emb"][None]
+
+    def norm(v, s, b):
+        mu = jnp.mean(v, axis=-1, keepdims=True)
+        var = jnp.var(v, axis=-1, keepdims=True)
+        return (v - mu) * jax.lax.rsqrt(var + cfg.norm_eps) * s + b
+
+    hd = cfg.dim // cfg.n_heads
+
+    def layer_fn(h, l):
+        a = norm(h, l["ln1_s"], l["ln1_b"])
+        q = (a @ l["wq"]).reshape(B, cfg.seq_len, cfg.n_heads, hd)
+        k = (a @ l["wk"]).reshape(B, cfg.seq_len, cfg.n_heads, hd)
+        v = (a @ l["wv"]).reshape(B, cfg.seq_len, cfg.n_heads, hd)
+        q, k, v = (t.transpose(0, 2, 1, 3) for t in (q, k, v))
+        s = jnp.einsum(
+            "bhqd,bhkd->bhqk", q, k, preferred_element_type=jnp.float32
+        ) * hd**-0.5  # bidirectional: CTC needs context from both sides
+        p = jax.nn.softmax(s, axis=-1).astype(v.dtype)
+        o = jnp.einsum("bhqk,bhkd->bhqd", p, v)
+        o = o.transpose(0, 2, 1, 3).reshape(B, cfg.seq_len, cfg.dim)
+        h = h + o @ l["wo"]
+        a = norm(h, l["ln2_s"], l["ln2_b"])
+        a = jax.nn.relu(a @ l["fc"] + l["fc_b"]) @ l["proj"] + l["proj_b"]
+        return h + a, None
+
+    h, _ = jax.lax.scan(layer_fn, h, params["layers"])
+    return h @ params["head"] + params["head_b"]  # [B, T, n_classes]
+
+
+def ctc_loss(
+    params: dict,
+    images: jax.Array,  # [B, H, W, 1]
+    labels: jax.Array,  # [B, N] int32, 0-padded (0 is also the blank)
+    cfg: OCRConfig,
+) -> jax.Array:
+    import optax
+
+    logits = forward(params, images, cfg)
+    B, T, _ = logits.shape
+    logit_pad = jnp.zeros((B, T), jnp.float32)  # full width always valid
+    label_pad = (labels == 0).astype(jnp.float32)
+    per_seq = optax.ctc_loss(logits, logit_pad, labels, label_pad, blank_id=0)
+    return jnp.mean(per_seq)
+
+
+def greedy_decode(params: dict, images: jax.Array, cfg: OCRConfig) -> list[str]:
+    """Argmax CTC decode: collapse repeats, drop blanks (host-side)."""
+    import numpy as np
+
+    logits = forward(params, images, cfg)
+    best = np.asarray(jnp.argmax(logits, axis=-1))  # [B, T]
+    out = []
+    for row in best:
+        chars = []
+        prev = -1
+        for t in row.tolist():
+            if t != prev and t != 0:
+                chars.append(t)
+            prev = t
+        out.append(decode_labels(chars))
+    return out
+
+
+# -- synthetic rendered-text data -------------------------------------------
+
+
+def render_line(text: str, cfg: OCRConfig, *, jitter_rng=None):
+    """Rasterize one text line to [H, W, 1] float32 in [0, 1] (ink = 1)."""
+    import numpy as np
+    from PIL import Image, ImageDraw, ImageFont
+
+    img = Image.new("L", (cfg.width, cfg.height), 0)
+    draw = ImageDraw.Draw(img)
+    font = ImageFont.load_default()
+    x, y = 4, cfg.height // 2 - 6
+    if jitter_rng is not None:
+        x += int(jitter_rng.integers(0, 8))
+        y += int(jitter_rng.integers(-3, 4))
+    draw.text((x, y), text.upper(), font=font, fill=255)
+    arr = np.asarray(img, np.float32) / 255.0
+    if jitter_rng is not None:
+        arr = np.clip(
+            arr + jitter_rng.normal(0, 0.05, arr.shape).astype(np.float32),
+            0.0, 1.0,
+        )
+    return arr[:, :, None]
+
+
+def synthetic_batch(np_rng, batch: int, cfg: OCRConfig, *, max_len: int = 12):
+    """Random rendered lines + padded labels (the training corpus)."""
+    import numpy as np
+
+    texts = []
+    for _ in range(batch):
+        n = int(np_rng.integers(3, max_len))
+        # sample over the FULL charset including spaces (index 0) — the
+        # documents the recognizer will read contain them; edge spaces are
+        # stripped (they render as nothing), with a fallback for all-space
+        texts.append(
+            "".join(
+                CHARSET[int(np_rng.integers(0, len(CHARSET)))]
+                for _ in range(n)
+            ).strip() or "A"
+        )
+    images = np.stack([render_line(t, cfg, jitter_rng=np_rng) for t in texts])
+    labels = np.zeros((batch, max_len + 2), np.int32)
+    for i, t in enumerate(texts):
+        ids = encode_text(t)
+        labels[i, : len(ids)] = ids
+    return images, labels, texts
