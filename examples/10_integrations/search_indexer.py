@@ -1,0 +1,148 @@
+# # Site search indexer: scheduled crawl -> full-text index -> search API
+#
+# TPU-native counterpart of the reference's
+# 10_integrations/algolia_indexer.py ("we run the same code in production
+# to power search on this page"): a crawler walks a site, pushes every
+# page into a search index, and a search endpoint serves ranked queries.
+# The reference delegates indexing to Algolia's hosted crawler; zero
+# egress, so the index is SQLite FTS5 (BM25 ranking, stdlib) persisted on
+# a Volume — the cron_sqlite_dashboard.py storage pattern — and the site
+# being indexed is served by THIS app (the webscraper.py trick).
+#
+# The pieces: a `Cron`-schedulable `reindex` function (the reference
+# deploys its crawler on a schedule), the crawl fan-out, the FTS index on
+# a Volume with commit/reload, and a `/search` endpoint with snippets.
+#
+# Run: tpurun run examples/10_integrations/search_indexer.py
+
+import modal_examples_tpu as mtpu
+
+app = mtpu.App("example-search-indexer")
+index_vol = mtpu.Volume.from_name("search-index", create_if_missing=True)
+
+DB = "/index/site.db"
+N_PAGES = 12
+
+TOPICS = {
+    0: ("home", "welcome to the tpu framework documentation portal"),
+    1: ("serving", "continuous batching paged attention decode engine"),
+    2: ("training", "lora fine tuning optimizer checkpoints resume"),
+    3: ("kernels", "pallas flash attention mosaic ragged paged kernel"),
+    4: ("sharding", "tensor parallel mesh collectives ici psum"),
+    5: ("volumes", "persistent storage commit reload snapshots"),
+    6: ("quantization", "int8 int4 weight only quantized matmul"),
+    7: ("whisper", "speech recognition streaming transcription audio"),
+    8: ("diffusion", "rectified flow text to image sampling guidance"),
+    9: ("clusters", "multi host gang scheduling jax distributed"),
+    10: ("webhooks", "discord interactions signed endpoints deferred"),
+    11: ("search", "full text index bm25 snippets ranking"),
+}
+
+
+@app.function()
+@mtpu.fastapi_endpoint()
+def docs(page: int = 0) -> bytes:
+    """The site under index: each page covers one topic and links onward."""
+    title, body = TOPICS.get(page, ("void", ""))
+    nxt = (page + 1) % N_PAGES
+    return (
+        f"<html><head><title>{title}</title></head><body>"
+        f"<h1>{title}</h1><p>{body}</p>"
+        f'<a href="/docs?page={nxt}">next</a></body></html>'
+    ).encode()
+
+
+@app.function(volumes={"/index": index_vol}, timeout=600)
+def reindex(base_url: str) -> dict:
+    """Crawl the site and rebuild the FTS index (schedule with
+    mtpu.Cron('0 * * * *') on deploy — the reference runs its crawler on
+    exactly this kind of schedule)."""
+    import re
+    import sqlite3
+    import urllib.request
+
+    con = sqlite3.connect(DB)
+    con.execute("DROP TABLE IF EXISTS pages")
+    con.execute(
+        "CREATE VIRTUAL TABLE pages USING fts5(url, title, body)"
+    )
+    n = 0
+    for page in range(N_PAGES):
+        url = f"{base_url}/docs?page={page}"
+        with urllib.request.urlopen(url, timeout=30) as r:
+            html = r.read().decode()
+        title = re.search(r"<title>(.*?)</title>", html).group(1)
+        body = re.sub(r"<[^>]+>", " ", html)
+        con.execute(
+            "INSERT INTO pages VALUES (?, ?, ?)", (url, title, body)
+        )
+        n += 1
+    con.commit()
+    con.close()
+    index_vol.commit()
+    return {"indexed": n}
+
+
+@app.function(volumes={"/index": index_vol})
+@mtpu.fastapi_endpoint()
+def search(q: str, limit: int = 5) -> dict:
+    """BM25-ranked search with snippets (the Algolia query surface)."""
+    import sqlite3
+
+    # FTS5 MATCH has its own query syntax: quote each term so user
+    # punctuation (hyphens, colons, quotes) can't crash the endpoint
+    terms = [t.replace('"', "") for t in q.split()]
+    match = " ".join(f'"{t}"' for t in terms if t)
+    if not match:
+        return {"query": q, "hits": []}
+
+    index_vol.reload()
+    con = sqlite3.connect(DB)
+    rows = con.execute(
+        "SELECT url, title, snippet(pages, 2, '[', ']', '…', 8), bm25(pages) "
+        "FROM pages WHERE pages MATCH ? ORDER BY bm25(pages) LIMIT ?",
+        (match, limit),
+    ).fetchall()
+    con.close()
+    return {
+        "query": q,
+        "hits": [
+            {"url": u, "title": t, "snippet": s, "score": -b}
+            for u, t, s, b in rows
+        ],
+    }
+
+
+@app.local_entrypoint()
+def main():
+    import json
+    import urllib.parse
+    import urllib.request
+
+    from modal_examples_tpu.web.gateway import Gateway
+
+    with app.run():
+        gw = Gateway(app).start()
+        stats = reindex.remote(gw.base_url)
+        print(f"indexed {stats['indexed']} pages")
+
+        def query(q):
+            qs = urllib.parse.urlencode({"q": q})
+            with urllib.request.urlopen(
+                f"{gw.base_url}/search?{qs}", timeout=60
+            ) as r:
+                return json.load(r)
+
+        out = query("paged attention")
+        assert out["hits"], "no hits for an indexed phrase"
+        top = out["hits"][0]
+        print(f"'paged attention' -> {top['title']} ({top['snippet']!r})")
+        assert top["title"] in ("serving", "kernels")
+
+        out2 = query("lora checkpoints")
+        assert out2["hits"][0]["title"] == "training"
+        print(f"'lora checkpoints' -> {out2['hits'][0]['title']}")
+
+        assert not query("zebra unicorns")["hits"]
+        print("absent terms return no hits; search index OK")
+        gw.stop()

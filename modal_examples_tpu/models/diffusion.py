@@ -51,6 +51,10 @@ class DiTConfig:
     text_len: int = 16
     norm_eps: float = 1e-6
     dtype: str = "float32"
+    # ControlNet-style spatial conditioning: adds the zero-init
+    # control_proj leaf. OPT-IN so pre-existing checkpoints (whose trees
+    # lack the leaf) keep restoring against init_params templates.
+    control: bool = False
 
     @property
     def n_patches(self) -> int:
@@ -87,6 +91,16 @@ def init_params(key: jax.Array, cfg: DiTConfig) -> dict:
 
     return {
         "patch_proj": dense(cfg.patch_dim, D, scale=0.02),
+        # spatial conditioning (ControlNet analog, cfg.control=True): the
+        # control map patchifies like the image and enters through a
+        # ZERO-INIT projection, so a fresh model ignores it and training
+        # grows the conditioning pathway from the unconditional behavior
+        # (controlnet_gradio_demos.py serves this capability via diffusers)
+        **(
+            {"control_proj": jnp.zeros((cfg.patch_dim, D), dt)}
+            if cfg.control
+            else {}
+        ),
         "pos_emb": dense(cfg.n_patches, D, scale=0.02),
         "t_mlp1": dense(D, D),
         "t_mlp2": dense(D, D),
@@ -141,9 +155,20 @@ def forward(
     t: jax.Array,  # [B] in [0, 1]
     text_states: jax.Array,  # [B, S, text_dim]
     cfg: DiTConfig,
+    control: jax.Array | None = None,  # [B, H, W, C] spatial conditioning
+    control_tokens: jax.Array | None = None,  # precomputed (sample() hoists)
 ) -> jax.Array:  # predicted velocity [B, H, W, C]
     B = x_t.shape[0]
     h = patchify(x_t, cfg) @ params["patch_proj"] + params["pos_emb"][None]
+    if control_tokens is not None:
+        h = h + control_tokens
+    elif control is not None:
+        if "control_proj" not in params:
+            raise ValueError(
+                "control= given but params have no control_proj leaf — "
+                "train with DiTConfig(control=True)"
+            )
+        h = h + patchify(control, cfg) @ params["control_proj"]
     temb = timestep_embedding(t, cfg.dim)
     temb = jnp.dot(jax.nn.silu(temb @ params["t_mlp1"]), params["t_mlp2"])
     text = text_states @ params["text_proj"]  # [B, S, D]
@@ -211,6 +236,7 @@ def flow_loss(
     cfg: DiTConfig,
     *,
     null_prob: float = 0.1,
+    control: jax.Array | None = None,  # spatial conditioning (ControlNet)
 ) -> jax.Array:
     """Rectified-flow matching loss with classifier-free-guidance dropout."""
     B = images.shape[0]
@@ -223,7 +249,7 @@ def flow_loss(
     drop = jax.random.bernoulli(k3, null_prob, (B,))
     null = _null_text(params, text_states.shape)
     text_in = jnp.where(drop[:, None, None], null, text_states)
-    pred = forward(params, x_t, t, text_in, cfg)
+    pred = forward(params, x_t, t, text_in, cfg, control=control)
     return jnp.mean((pred - target_v) ** 2)
 
 
@@ -235,6 +261,7 @@ def sample(
     *,
     steps: int = 8,
     guidance: float = 3.0,
+    control: jax.Array | None = None,  # spatial conditioning (ControlNet)
 ) -> jax.Array:  # [B, H, W, C] in [-1, 1]
     """Euler integration of the learned flow from noise (t=1) to data (t=0),
     with classifier-free guidance — the few-step regime the served Turbo
@@ -243,12 +270,26 @@ def sample(
     x = jax.random.normal(key, (B, cfg.img_size, cfg.img_size, cfg.channels))
     null = _null_text(params, text_states.shape)
     ts = jnp.linspace(1.0, 0.0, steps + 1)
+    ctrl_tokens = None
+    if control is not None:
+        if "control_proj" not in params:
+            raise ValueError(
+                "control= given but params have no control_proj leaf — "
+                "train with DiTConfig(control=True)"
+            )
+        # loop-invariant: computed ONCE, not 2x per Euler step (XLA does
+        # not hoist out of scan bodies)
+        ctrl_tokens = patchify(control, cfg) @ params["control_proj"]
 
     def step_fn(x, i):
         t_cur, t_nxt = ts[i], ts[i + 1]
         tb = jnp.full((B,), t_cur)
-        v_cond = forward(params, x, tb, text_states, cfg)
-        v_null = forward(params, x, tb, null, cfg)
+        v_cond = forward(
+            params, x, tb, text_states, cfg, control_tokens=ctrl_tokens
+        )
+        v_null = forward(
+            params, x, tb, null, cfg, control_tokens=ctrl_tokens
+        )
         v = v_null + guidance * (v_cond - v_null)
         x = x + (t_nxt - t_cur) * v  # dx/dt = v; integrating t: 1 -> 0
         return x, None
