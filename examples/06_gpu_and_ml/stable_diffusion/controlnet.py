@@ -1,3 +1,7 @@
+# ---
+# env: {"MTPU_TRAIN_STEPS": "400"}
+# timeout: 800
+# ---
 # # ControlNet-style structure-conditioned generation
 #
 # TPU-native counterpart of the reference's

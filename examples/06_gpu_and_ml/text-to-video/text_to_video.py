@@ -1,3 +1,7 @@
+# ---
+# env: {"MTPU_TRAIN_STEPS": "25"}
+# timeout: 700
+# ---
 # # Text-to-video: a two-stage spawn-chained pipeline
 #
 # TPU-native counterpart of the reference's video/world-generation tier:

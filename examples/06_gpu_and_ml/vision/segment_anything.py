@@ -1,3 +1,7 @@
+# ---
+# env: {"MTPU_TRAIN_STEPS": "500"}
+# timeout: 1000
+# ---
 # # Promptable segmentation service: embed once, segment per click
 #
 # TPU-native counterpart of the reference's 06_gpu_and_ml/sam/

@@ -1,3 +1,7 @@
+# ---
+# env: {"MTPU_TRAIN_STEPS": "900"}
+# timeout: 900
+# ---
 # # Document OCR job queue: a REAL recognizer behind spawn/poll
 #
 # TPU-native counterpart of the reference's 09_job_queues/doc_ocr_jobs.py

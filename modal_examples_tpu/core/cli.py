@@ -192,7 +192,8 @@ def cmd_examples(argv: list[str]) -> int:
         import tempfile
 
         timeout = 600.0
-        if "--timeout" in argv:
+        cli_timeout = "--timeout" in argv
+        if cli_timeout:
             timeout = float(argv[argv.index("--timeout") + 1])
         pattern = argv[1] if len(argv) > 1 and not argv[1].startswith("-") else ""
         targets = [e for e in examples if pattern in str(e.path)]
@@ -205,18 +206,22 @@ def cmd_examples(argv: list[str]) -> int:
             # cheap-mode defaults (the reference's frontmatter env overrides,
             # SURVEY §4): CI runs on CPU unless the caller opts into a chip
             env.setdefault("MTPU_TPU", "")
+            for k, v in e.env.items():  # per-example frontmatter env
+                env.setdefault(k, str(v))
+            # precedence: explicit CLI flag > frontmatter > default
+            eff_timeout = timeout if cli_timeout else (e.timeout or timeout)
             print(f"=== {e.path} ===", flush=True)
             try:
                 proc = subprocess.run(
                     [sys.executable, "-m", "modal_examples_tpu", "run",
                      str(repo_root() / e.path)],
-                    timeout=timeout,
+                    timeout=eff_timeout,
                     env=env,
                 )
                 if proc.returncode != 0:
                     failures.append(str(e.path))
             except subprocess.TimeoutExpired:
-                failures.append(f"{e.path} (timeout {timeout}s)")
+                failures.append(f"{e.path} (timeout {eff_timeout}s)")
         if failures:
             print(f"FAILED ({len(failures)}/{len(targets)}):")
             for f in failures:

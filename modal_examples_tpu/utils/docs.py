@@ -20,10 +20,50 @@ class Example:
     path: Path
     module_name: str
     category: str  # e.g. "01_getting_started"
+    # frontmatter knobs (the reference's jupytext frontmatter — cmd/env/
+    # timeout per example, internal/utils.py:115-140): a leading block of
+    #   # ---
+    #   # env: {"MTPU_TRAIN_STEPS": "300"}
+    #   # timeout: 800
+    #   # ---
+    # sets per-example cheap-mode env defaults and the runner's bound
+    env: dict = dataclasses.field(default_factory=dict)
+    timeout: float | None = None
 
     @property
     def repo_relative(self) -> str:
         return str(self.path)
+
+
+def _parse_frontmatter(py: Path) -> tuple[dict, float | None]:
+    """Read the optional leading `# ---` frontmatter block."""
+    import json
+
+    env: dict = {}
+    timeout = None
+    try:
+        lines = py.read_text().splitlines()[:12]
+    except OSError:
+        return env, timeout
+    if not lines or lines[0].strip() != "# ---":
+        return env, timeout
+    for line in lines[1:]:
+        stripped = line.strip()
+        if stripped == "# ---":
+            break
+        if stripped.startswith("# env:"):
+            try:
+                parsed = json.loads(stripped[len("# env:"):].strip())
+            except json.JSONDecodeError:
+                parsed = None
+            if isinstance(parsed, dict):
+                env = parsed
+        elif stripped.startswith("# timeout:"):
+            try:
+                timeout = float(stripped[len("# timeout:"):].strip())
+            except ValueError:
+                pass
+    return env, timeout
 
 
 def repo_root() -> Path:
@@ -42,11 +82,14 @@ def get_examples(root: Path | None = None) -> list[Example]:
         for py in sorted(cat_dir.rglob("*.py")):
             if py.name.startswith("_") or "__pycache__" in py.parts:
                 continue
+            env, timeout = _parse_frontmatter(py)
             out.append(
                 Example(
                     path=py.relative_to(root.parent),
                     module_name=py.stem,
                     category=cat_dir.name,
+                    env=env,
+                    timeout=timeout,
                 )
             )
     return out
@@ -54,8 +97,16 @@ def get_examples(root: Path | None = None) -> list[Example]:
 
 def render_example_md(source: str) -> str:
     """Render a literate example: `# ` comment blocks become prose, code
-    becomes fenced blocks. The `# # Title` convention maps to headings."""
+    becomes fenced blocks. The `# # Title` convention maps to headings.
+    A leading frontmatter block (`# ---` ... `# ---`) is metadata for the
+    example runner, not prose — stripped before rendering (the reference's
+    renderer does the same with its jupytext frontmatter)."""
     lines = source.splitlines()
+    if lines and lines[0].strip() == "# ---":
+        for i in range(1, min(len(lines), 12)):
+            if lines[i].strip() == "# ---":
+                lines = lines[i + 1:]
+                break
     out: list[str] = []
     code_buf: list[str] = []
 
