@@ -217,6 +217,33 @@ class TestPagedAttention:
         np.testing.assert_allclose(outs["xla"][1], outs["pallas"][1], atol=3e-5)
         np.testing.assert_allclose(outs["xla"][2], outs["pallas"][2], atol=3e-5)
 
+    def test_decode_step_writeback_matches_default(self, jax, jnp):
+        """The write-then-attend A/B structure (impl='xla-writeback') must
+        produce the same logits and cache as the default read-only path —
+        kept as the benchmark lever, so it must not rot (it went through
+        the round-4 layout migration too)."""
+        from modal_examples_tpu.models import llama
+
+        cfg = llama.LlamaConfig.tiny()
+        params = llama.init_params(jax.random.PRNGKey(2), cfg)
+        B, ps, pp = 2, 16, 4
+        n_pages = 1 + B * pp
+        kp = jnp.zeros((cfg.n_layers, n_pages, ps, cfg.n_kv_heads,
+                        cfg.head_dim), jnp.float32)
+        vp = jnp.zeros_like(kp)
+        tables = jnp.asarray(1 + np.arange(B * pp).reshape(B, pp), jnp.int32)
+        toks = jnp.asarray([5, 11], jnp.int32)
+        pos = jnp.asarray([7, 30], jnp.int32)
+        active = jnp.ones((B,), bool)
+        outs = {}
+        for impl in ("xla", "xla-writeback"):
+            lg, k2, v2 = llama.decode_step(
+                params, toks, pos, kp, vp, tables, active, cfg, impl=impl
+            )
+            outs[impl] = (np.asarray(lg), np.asarray(k2), np.asarray(v2))
+        for a, b in zip(outs["xla"], outs["xla-writeback"]):
+            np.testing.assert_allclose(a, b, atol=3e-5)
+
     def test_mha_group_of_one(self, jax, jnp):
         from modal_examples_tpu.ops import paged_decode_attention, reference
 
