@@ -1,0 +1,136 @@
+# # Semantic vector search: embed a corpus, serve top-k queries
+#
+# TPU-native counterpart of the reference's vector-search tier:
+# 06_gpu_and_ml/embeddings/qdrant.py (a hosted vector DB fed by TEI
+# embeddings) and embeddings/wikipedia/main.py (embed a corpus at scale,
+# then query it). Zero egress and no vector-DB binary, so the index IS
+# the TPU-friendly thing: an [N, D] matrix of normalized embeddings on a
+# Volume, and top-k search is ONE batched matmul + top_k — exactly the
+# shape the MXU wants (a brute-force exact search outperforms ANN up to
+# millions of vectors on this hardware class).
+#
+# The embedder is the framework's own models.bert encoder (the
+# BGE/TEI-analog the embeddings examples serve).
+#
+# Run: tpurun run examples/06_gpu_and_ml/embeddings/vector_search.py
+
+import os
+import pickle
+
+import modal_examples_tpu as mtpu
+
+TPU = os.environ.get("MTPU_TPU", "") or None
+
+app = mtpu.App("example-vector-search")
+index_vol = mtpu.Volume.from_name("vector-index", create_if_missing=True)
+
+CORPUS = [
+    "the serving engine batches decode steps across fixed slots",
+    "paged attention reads exactly the context pages it needs",
+    "lora adapters fine tune attention projections cheaply",
+    "checkpoints resume training after interruptions",
+    "the flash attention kernel tiles queries into vmem blocks",
+    "tensor parallel sharding splits matmuls across chips",
+    "volumes persist model weights between containers",
+    "the scheduler scales containers with request load",
+    "speculative decoding drafts tokens and verifies in one pass",
+    "whisper transcribes audio with an encoder decoder transformer",
+    "rectified flow generates images in a few euler steps",
+    "the prefix cache shares prompt kv across requests",
+]
+
+
+def _embedder():
+    """Tokenize-and-embed through models.bert with the framework's
+    deterministic fallback tokenizer (utils.tokenizer.load_tokenizer —
+    the same one the sibling embeddings example uses; swap
+    load_hf_weights + a real WordPiece tokenizer for production).
+    bert.embed returns L2-normalized vectors."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from modal_examples_tpu.models import bert
+    from modal_examples_tpu.utils.tokenizer import load_tokenizer
+
+    import dataclasses
+
+    # mean pooling: with RANDOM weights the CLS state barely depends on
+    # the input (cosine ~0.9999 between any two texts); mean-over-tokens
+    # keeps cheap mode discriminative. Real BGE checkpoints use cls — set
+    # it back when loading real weights.
+    cfg = dataclasses.replace(bert.BertConfig.tiny(), pooling="mean")
+    params = bert.init_params(jax.random.PRNGKey(0), cfg)
+    tok = load_tokenizer(None)
+    embed = jax.jit(lambda t, m: bert.embed(params, t, m, cfg))
+
+    def encode(texts: list[str], max_len: int = 64):
+        ids, mask = tok.encode_batch(texts, max_len)
+        ids = np.asarray(ids) % cfg.vocab_size
+        return np.asarray(embed(jnp.asarray(ids), jnp.asarray(mask)))
+
+    return encode
+
+
+@app.function(tpu=TPU, volumes={"/index": index_vol}, timeout=600)
+def build_index() -> dict:
+    """Embed the corpus into the [N, D] matrix (wikipedia/main.py's
+    embed-everything job, minus the 575k tok/s fleet)."""
+    encode = _embedder()
+    vecs = encode(CORPUS)
+    with open("/index/vectors.pkl", "wb") as f:
+        pickle.dump({"vectors": vecs, "texts": CORPUS}, f)
+    index_vol.commit()
+    return {"indexed": len(CORPUS), "dim": int(vecs.shape[1])}
+
+
+@app.cls(tpu=TPU, volumes={"/index": index_vol}, scaledown_window=300)
+class VectorSearch:
+    @mtpu.enter()
+    def load(self):
+        import jax
+
+        if not TPU:
+            try:
+                jax.config.update("jax_platforms", "cpu")
+            except Exception:
+                pass
+        index_vol.reload()
+        with open("/index/vectors.pkl", "rb") as f:
+            idx = pickle.load(f)
+        self.vectors = idx["vectors"]  # [N, D] normalized
+        self.texts = idx["texts"]
+        self.encode = _embedder()
+
+    @mtpu.method()
+    def search(self, query: str, k: int = 3) -> list[dict]:
+        """Cosine top-k: one matvec against the whole index."""
+        import numpy as np
+
+        q = self.encode([query])[0]
+        scores = self.vectors @ q  # [N] — the MXU-shaped search
+        top = np.argsort(-scores)[:k]
+        return [
+            {"text": self.texts[i], "score": float(scores[i])} for i in top
+        ]
+
+
+@app.local_entrypoint()
+def main():
+    print("building index:", build_index.remote())
+    vs = VectorSearch()
+    # cheap mode runs RANDOM weights, so similarity reflects token and
+    # word-order overlap rather than meaning — real semantic neighbors
+    # need bert.load_hf_weights with a published BGE checkpoint (the
+    # pipeline is identical either way)
+    for query, expect_word in [
+        ("whisper transcribes audio", "whisper"),
+        ("rectified flow euler steps images", "images"),
+        ("tensor parallel sharding chips", "sharding"),
+    ]:
+        hits = vs.search.remote(query, k=3)
+        print(f"{query!r}:")
+        for h in hits:
+            print(f"   {h['score']:.3f}  {h['text']}")
+        assert any(expect_word in h["text"] for h in hits), (query, hits)
+    print("semantic neighbors retrieved for all queries")
