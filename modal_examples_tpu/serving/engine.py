@@ -134,16 +134,38 @@ def _req_seed(req: "Request") -> int:
 
 def _shard_params(params, cfg, mesh):
     """Place a llama param tree with its Megatron partition specs — one
-    implementation for target and draft so the paths can't drift."""
+    implementation for target and draft so the paths can't drift.
+
+    Quantized trees shard too (vLLM serves quantized TP the same way):
+    the int payload takes the weight's spec; the per-output-channel scale
+    keeps the OUTPUT dim's sharding but never the contraction dim's (its
+    contraction axis has size 1). layers.mm multiplies the scale after the
+    dot, so row-parallel partial sums are all-reduced before rescaling —
+    the math is exact under auto-partitioning.
+    """
     from jax.sharding import NamedSharding
     from jax.sharding import PartitionSpec as P
 
+    from ..models.quantize import QuantizedWeight
+
     specs = llama.partition_specs(cfg)
+
+    def place(p, s):
+        if isinstance(p, QuantizedWeight):
+            scale_spec = (
+                P(*(tuple(s[:-2]) + (None, s[-1]))) if len(s) >= 2 else s
+            )
+            return QuantizedWeight(
+                q=jax.device_put(p.q, NamedSharding(mesh, s)),
+                scale=jax.device_put(p.scale, NamedSharding(mesh, scale_spec)),
+            )
+        return jax.device_put(p, NamedSharding(mesh, s))
+
     return jax.tree.map(
-        lambda p, s: jax.device_put(p, NamedSharding(mesh, s)),
+        place,
         params,
         specs,
-        is_leaf=lambda x: isinstance(x, P),
+        is_leaf=lambda x: isinstance(x, (P, QuantizedWeight)),
     )
 
 
@@ -253,11 +275,6 @@ class LLMEngine:
         self.mesh = mesh
         self._attn_impl = "flash" if mesh is None else "xla"
         if mesh is not None:
-            if quantization is not None:
-                raise ValueError(
-                    "mesh= (tensor parallel) with quantization is not yet "
-                    "supported"
-                )
             params = _shard_params(params, cfg, mesh)
         self.params = params
         self.max_slots = max_slots

@@ -129,6 +129,46 @@ class TestEngineTP:
             single.stop()
             tp.stop()
 
+    def test_quantized_engine_tp2_exact_match(self, jax):
+        """int8 weight-only quantization composes with tensor parallelism
+        (vLLM serves quantized TP): TP engine output must equal the
+        single-device quantized engine token-for-token; the QuantizedWeight
+        payload AND its per-channel scales actually shard."""
+        import jax.numpy as jnp
+
+        from modal_examples_tpu.models import llama
+        from modal_examples_tpu.models.quantize import QuantizedWeight
+        from modal_examples_tpu.parallel import make_mesh
+        from modal_examples_tpu.serving import LLMEngine, SamplingParams
+
+        cfg = llama.LlamaConfig(
+            vocab_size=128, dim=64, n_layers=2, n_heads=4, n_kv_heads=2,
+            ffn_dim=128, max_seq_len=128, dtype="float32",
+        )
+        params = llama.init_params(jax.random.PRNGKey(4), cfg)
+        mesh = make_mesh({"tensor": 2}, devices=jax.devices()[:2])
+
+        kw = dict(
+            max_slots=2, max_model_len=64, page_size=16,
+            prefill_buckets=(32,), seed=0, kv_dtype=jnp.float32,
+            quantization="int8",
+        )
+        single = LLMEngine(cfg, params, **kw)
+        tp = LLMEngine(cfg, params, mesh=mesh, **kw)
+        try:
+            prompts = ["quantized sharded decode", "int8 over two chips"]
+            sp = SamplingParams(max_tokens=12, temperature=0.0)
+            want = [single.generate(p, sp) for p in prompts]
+            got = [tp.generate(p, sp) for p in prompts]
+            assert want == got
+            wq = tp.params["layers"]["wq"]
+            assert isinstance(wq, QuantizedWeight)
+            assert len(wq.q.sharding.device_set) == 2
+            assert len(wq.scale.sharding.device_set) == 2
+        finally:
+            single.stop()
+            tp.stop()
+
     def test_spec_decode_under_tp(self, jax):
         """Speculative decoding composes with tensor parallelism: the spec
         program runs under the same sharded jit."""
