@@ -30,7 +30,7 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 def main() -> int:
     ap = argparse.ArgumentParser()
     ap.add_argument("--model", default="llama2-7b")
-    ap.add_argument("--quant", default=None, choices=[None, "int8"])
+    ap.add_argument("--quant", default=None, choices=[None, "int8", "int4"])
     ap.add_argument("--slots", default="8,16,32")
     ap.add_argument("--variants", default="full,nosample,noattn,noscatter")
     ap.add_argument("--steps", type=int, default=8)
@@ -45,6 +45,11 @@ def main() -> int:
     enable_compile_cache()
 
     import jax
+
+    if os.environ.get("BENCH_CPU"):
+        # CPU smoke mode (the env-var platform route is unreliable once
+        # the axon plugin is importable — pin explicitly)
+        jax.config.update("jax_platforms", "cpu")
     import jax.numpy as jnp
     import numpy as np
 
@@ -60,10 +65,14 @@ def main() -> int:
             llama.LlamaConfig, args.model.replace("-", "_").replace(".", "")
         )()
     )
-    if args.quant == "int8":
-        from modal_examples_tpu.models.quantize import init_quantized_llama
+    if args.quant:
+        from modal_examples_tpu.models.quantize import (
+            bits_of, init_quantized_llama,
+        )
 
-        params = init_quantized_llama(jax.random.PRNGKey(0), cfg)
+        params = init_quantized_llama(
+            jax.random.PRNGKey(0), cfg, bits=bits_of(args.quant)
+        )
     else:
         params = llama.init_params(jax.random.PRNGKey(0), cfg)
     force(params)

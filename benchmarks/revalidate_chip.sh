@@ -1,0 +1,18 @@
+#!/bin/bash
+# Round-4 chip revalidation (NOTES.md "Chip incident"): run ON A HEALTHY
+# CHIP, in this order, each step in its own process so a wedge is
+# attributable. Stop at the first hang and treat that step as the trigger.
+set -x
+cd "$(dirname "$0")/.."
+# 0. health
+timeout 120 python -c "import jax, jax.numpy as jnp; print(jax.devices()); print(float(jnp.ones(3).sum()))" || exit 1
+# 1. pure-XLA decode path on the token-major layout
+timeout 900 python benchmarks/decode_micro.py --quant int8 --slots 8 --impl xla || exit 2
+# 2. ragged attention kernel (v3)
+timeout 900 python benchmarks/decode_micro.py --quant int8 --slots 8,36 --impl pallas || exit 3
+# 3. the pallas scatter kernel — the suspected round-4 wedge trigger
+MTPU_SCATTER_IMPL=pallas timeout 900 python benchmarks/decode_micro.py --quant int8 --slots 8 --impl pallas || exit 4
+# 4. int4 weights
+timeout 900 python benchmarks/decode_micro.py --quant int4 --slots 8,36 --impl pallas || exit 5
+# 5. full bench
+timeout 1500 python bench.py || exit 6

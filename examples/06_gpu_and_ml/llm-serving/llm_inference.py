@@ -39,6 +39,10 @@ TP = int(os.environ.get("MTPU_TP", "1"))
 SPEC_GAMMA = int(os.environ.get("MTPU_SPEC_GAMMA", "0"))
 SPEC_DRAFT = os.environ.get("MTPU_SPEC_DRAFT", "tiny")
 SPEC_DRAFT_DIR = os.environ.get("MTPU_SPEC_DRAFT_DIR")
+# weight-only quantization (the bitsandbytes/unsloth 4-bit analog):
+# MTPU_QUANT=int8|int4 halves/quarters weight HBM traffic and composes
+# with MTPU_TP (quantized trees shard under tensor parallelism)
+QUANT = os.environ.get("MTPU_QUANT") or None
 MINUTES = 60
 
 app = mtpu.App("example-llm-inference")
@@ -99,6 +103,7 @@ class LLMServer:
             model_dir=MODEL_DIR,
             max_slots=8 if MODEL != "tiny" else 4,
             max_model_len=1024 if MODEL != "tiny" else 128,
+            quantization=QUANT,
             **engine_kw,
         )
         self.server = OpenAIServer(engine, model_name=MODEL, port=PORT)
