@@ -30,7 +30,9 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 def main() -> int:
     ap = argparse.ArgumentParser()
     ap.add_argument("--model", default="llama2-7b")
-    ap.add_argument("--quant", default=None, choices=[None, "int8", "int4"])
+    from modal_examples_tpu.models.quantize import SUPPORTED
+
+    ap.add_argument("--quant", default=None, choices=list(SUPPORTED))
     ap.add_argument("--slots", default="8,16,32")
     ap.add_argument("--variants", default="full,nosample,noattn,noscatter")
     ap.add_argument("--steps", type=int, default=8)
