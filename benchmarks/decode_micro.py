@@ -41,7 +41,32 @@ def main() -> int:
     ap.add_argument("--max-len", type=int, default=256)
     ap.add_argument("--page-size", type=int, default=16)
     ap.add_argument("--iters", type=int, default=20)
+    ap.add_argument(
+        "--probe", action="store_true",
+        help="bring up the needed Pallas kernels in killable subprocesses "
+        "first (the wedge-proof rule — run this for any first-on-chip "
+        "compile of a new/changed kernel)",
+    )
     args = ap.parse_args()
+
+    if args.probe:
+        # probes claim the chip from their own subprocesses, so they must
+        # finish before this process attaches (single tunneled chip)
+        from modal_examples_tpu.utils.kernel_probe import run_probes
+
+        # only the kernels this bench will actually trace: the quantized
+        # decode path upcasts through plain jnp.dot (layers.mm), so no
+        # int8_matmul probe is needed for --quant
+        needed = []
+        if "pallas" in args.impl:
+            needed.append("ragged_decode")
+        if os.environ.get("MTPU_SCATTER_IMPL") == "pallas":
+            needed.append("scatter_kv")
+        results = run_probes(needed, timeout_s=600)
+        bad = {k: r.status for k, r in results.items() if not r.ok}
+        if bad:
+            print(json.dumps({"probe_failed": bad}), flush=True)
+            return 2
 
     from modal_examples_tpu.utils.compile_cache import enable_compile_cache
 

@@ -1,18 +1,23 @@
 #!/bin/bash
-# Round-4 chip revalidation (NOTES.md "Chip incident"): run ON A HEALTHY
-# CHIP, in this order, each step in its own process so a wedge is
-# attributable. Stop at the first hang and treat that step as the trigger.
+# Round-5 chip revalidation (NOTES.md "Chip incident"): run ON A HEALTHY
+# CHIP. Every Pallas kernel's first Mosaic compile goes through the
+# kernel_probe harness (killable subprocess + hard timeout + result file),
+# so a hang is killed and attributed instead of wedging the device claim.
+# Stop at the first failing stage and treat it as the trigger.
 set -x
 cd "$(dirname "$0")/.."
 # 0. health
 timeout 120 python -c "import jax, jax.numpy as jnp; print(jax.devices()); print(float(jnp.ones(3).sum()))" || exit 1
-# 1. pure-XLA decode path on the token-major layout
-timeout 900 python benchmarks/decode_micro.py --quant int8 --slots 8 --impl xla || exit 2
-# 2. ragged attention kernel (v3)
-timeout 900 python benchmarks/decode_micro.py --quant int8 --slots 8,36 --impl pallas || exit 3
-# 3. the pallas scatter kernel — the suspected round-4 wedge trigger
-MTPU_SCATTER_IMPL=pallas timeout 900 python benchmarks/decode_micro.py --quant int8 --slots 8 --impl pallas || exit 4
-# 4. int4 weights
-timeout 900 python benchmarks/decode_micro.py --quant int4 --slots 8,36 --impl pallas || exit 5
-# 5. full bench
-timeout 1500 python bench.py || exit 6
+# 1. every kernel, tiny shapes, one killable subprocess each; registry
+#    order puts the round-4 wedge suspect (scatter_kv) LAST
+python -m modal_examples_tpu.utils.kernel_probe --all --timeout 600 || exit 2
+# 2. pure-XLA decode path on the token-major layout
+timeout 900 python benchmarks/decode_micro.py --quant int8 --slots 8 --impl xla || exit 3
+# 3. ragged attention kernel (v3) at real shapes
+timeout 900 python benchmarks/decode_micro.py --quant int8 --slots 8,36 --impl pallas || exit 4
+# 4. the pallas scatter at real shapes
+MTPU_SCATTER_IMPL=pallas timeout 900 python benchmarks/decode_micro.py --quant int8 --slots 8 --impl pallas || exit 5
+# 5. int4 weights
+timeout 900 python benchmarks/decode_micro.py --quant int4 --slots 8,36 --impl pallas || exit 6
+# 6. full bench
+timeout 1500 python bench.py || exit 7

@@ -60,6 +60,18 @@ def timeit(fn, *args, iters=20):
 
 
 def main():
+    if "--no-probe" not in sys.argv:
+        # wedge-proof rule: every kernel's first Mosaic compile happens in
+        # a killable subprocess (utils/kernel_probe) BEFORE this process
+        # attaches the chip; a hang is killed and attributed instead of
+        # wedging the session's device claim (rounds 1 + 4 postmortem)
+        from modal_examples_tpu.utils.kernel_probe import run_probes
+
+        results = run_probes(timeout_s=600)
+        bad = {k: r.status for k, r in results.items() if not r.ok}
+        if bad:
+            print(json.dumps({"probe_failed": bad}), flush=True)
+            return 2
     assert jax.default_backend() == "tpu", jax.default_backend()
     print("device:", jax.devices()[0], flush=True)
 

@@ -1,0 +1,234 @@
+"""Per-kernel bring-up probes for the wedge-proof compile harness.
+
+Each probe compiles ONE Pallas kernel on the smallest Mosaic-legal shapes
+(D=128 lanes, page_size%16 sublanes, Hkv%16 for the flattened page
+matmuls), checks numerics against the pure-XLA references, and returns a
+small dict of floats. Probes are run by
+``modal_examples_tpu.utils.kernel_probe`` in a killable subprocess — see
+that module for why first compiles are treated as hostile (two rounds of
+chip-claim wedges). On CPU the same probes run in Pallas interpreter mode,
+so the fast test tier exercises probe plumbing end to end.
+
+Keep this registry in sync with the kernels: a test
+(tests/test_kernel_probe.py) asserts every ops/ module that calls
+``pl.pallas_call`` has at least one probe here.
+"""
+
+from __future__ import annotations
+
+# probe name -> "module:function", in bring-up order: known-good kernels
+# first, the riskiest (in-place DMA scatter, the round-4 wedge suspect)
+# last so a wedge doesn't block validating everything else.
+KERNEL_PROBES: dict[str, str] = {
+    "flash_fwd": "modal_examples_tpu.ops.probes:probe_flash_fwd",
+    "flash_bwd": "modal_examples_tpu.ops.probes:probe_flash_bwd",
+    "flash_chunked": "modal_examples_tpu.ops.probes:probe_flash_chunked",
+    "int8_matmul": "modal_examples_tpu.ops.probes:probe_int8_matmul",
+    "paged_decode": "modal_examples_tpu.ops.probes:probe_paged_decode",
+    "ragged_decode": "modal_examples_tpu.ops.probes:probe_ragged_decode",
+    "scatter_kv": "modal_examples_tpu.ops.probes:probe_scatter_kv",
+}
+
+# which probes cover which pallas_call-bearing module; a test asserts this
+# stays in sync with the set of modules that actually call pl.pallas_call,
+# so a new kernel module cannot land without a bring-up probe.
+PROBED_MODULES: dict[str, list[str]] = {
+    "modal_examples_tpu.ops.flash_attention": [
+        "flash_fwd", "flash_bwd", "flash_chunked",
+    ],
+    "modal_examples_tpu.ops.paged_attention": [
+        "paged_decode", "ragged_decode", "scatter_kv",
+    ],
+    "modal_examples_tpu.ops.quantized_matmul": ["int8_matmul"],
+}
+
+
+def _err(a, b) -> float:
+    import jax.numpy as jnp
+
+    return float(
+        jnp.max(jnp.abs(a.astype(jnp.float32) - b.astype(jnp.float32)))
+    )
+
+
+def probe_flash_fwd() -> dict:
+    import jax
+    import jax.numpy as jnp
+
+    from modal_examples_tpu import ops
+    from modal_examples_tpu.ops import reference
+
+    B, Hq, Hkv, S, D = 1, 8, 4, 256, 128
+    q = jax.random.normal(jax.random.PRNGKey(0), (B, Hq, S, D), jnp.bfloat16)
+    k = jax.random.normal(jax.random.PRNGKey(1), (B, Hkv, S, D), jnp.bfloat16)
+    v = jax.random.normal(jax.random.PRNGKey(2), (B, Hkv, S, D), jnp.bfloat16)
+    o = jax.jit(ops.flash_attention)(q, k, v)
+    ref = jax.jit(reference.attention)(q, k, v)
+    err = _err(o, ref)
+    assert err < 0.06, err
+    return {"max_err": round(err, 4)}
+
+
+def probe_flash_bwd() -> dict:
+    import jax
+    import jax.numpy as jnp
+
+    from modal_examples_tpu import ops
+    from modal_examples_tpu.ops import reference
+
+    B, Hq, Hkv, S, D = 1, 8, 4, 256, 128
+    q = jax.random.normal(jax.random.PRNGKey(0), (B, Hq, S, D), jnp.bfloat16)
+    k = jax.random.normal(jax.random.PRNGKey(1), (B, Hkv, S, D), jnp.bfloat16)
+    v = jax.random.normal(jax.random.PRNGKey(2), (B, Hkv, S, D), jnp.bfloat16)
+
+    def loss(fn):
+        return lambda q, k, v: jax.numpy.sum(fn(q, k, v).astype(jnp.float32))
+
+    g1 = jax.jit(jax.grad(loss(ops.flash_attention), argnums=(0, 1, 2)))(
+        q, k, v
+    )
+    g2 = jax.jit(jax.grad(loss(reference.attention), argnums=(0, 1, 2)))(
+        q, k, v
+    )
+    errs = [_err(a, b) for a, b in zip(g1, g2)]
+    assert max(errs) < 0.5, errs  # sum-of-S grad scale
+    return {"max_err": round(max(errs), 4)}
+
+
+def probe_flash_chunked() -> dict:
+    import jax
+    import jax.numpy as jnp
+
+    from modal_examples_tpu import ops
+    from modal_examples_tpu.ops import reference
+
+    B, Hq, Hkv, S, D, C, off = 1, 8, 4, 256, 128, 128, 128
+    q = jax.random.normal(jax.random.PRNGKey(0), (B, Hq, S, D), jnp.bfloat16)
+    k = jax.random.normal(jax.random.PRNGKey(1), (B, Hkv, S, D), jnp.bfloat16)
+    v = jax.random.normal(jax.random.PRNGKey(2), (B, Hkv, S, D), jnp.bfloat16)
+    qc = q[:, :, :C, :]
+    o = jax.jit(
+        lambda qc, k, v: ops.flash_attention_chunked(qc, k, v, q_offset=off)
+    )(qc, k, v)
+    qfull = q.at[:, :, off : off + C, :].set(qc)
+    ref = jax.jit(reference.attention)(qfull, k, v)[:, :, off : off + C, :]
+    err = _err(o, ref)
+    assert err < 0.06, err
+    return {"max_err": round(err, 4)}
+
+
+def probe_int8_matmul() -> dict:
+    import jax
+    import jax.numpy as jnp
+
+    from modal_examples_tpu import ops
+
+    M, K, N = 256, 512, 512
+    x = jax.random.normal(jax.random.PRNGKey(0), (M, K), jnp.bfloat16)
+    w = jax.random.normal(jax.random.PRNGKey(1), (K, N), jnp.float32)
+    w_q, w_scale = ops.quantize_int8(w)
+    o = jax.jit(ops.quantized_matmul)(x, w_q, w_scale)
+    ref = jnp.dot(
+        x.astype(jnp.float32), ops.dequantize_int8(w_q, w_scale)
+    )
+    err = _err(o, ref)
+    rel = err / (float(jnp.max(jnp.abs(ref))) + 1e-6)
+    assert rel < 0.05, (err, rel)
+    return {"rel_err": round(rel, 4)}
+
+
+def probe_paged_decode() -> dict:
+    import functools
+
+    import jax
+    import jax.numpy as jnp
+
+    from modal_examples_tpu import ops
+    from modal_examples_tpu.ops import reference
+
+    B, Hq, Hkv, D, ps, pp = 2, 16, 16, 128, 16, 4
+    n_pages = B * pp + 2
+    kp = jax.random.normal(
+        jax.random.PRNGKey(0), (n_pages, ps, Hkv, D), jnp.bfloat16
+    )
+    vp = jax.random.normal(
+        jax.random.PRNGKey(1), (n_pages, ps, Hkv, D), jnp.bfloat16
+    )
+    pt = jax.random.permutation(jax.random.PRNGKey(2), n_pages)[
+        : B * pp
+    ].reshape(B, pp).astype(jnp.int32)
+    lens = jnp.array([30, 57], jnp.int32)
+    q = jax.random.normal(jax.random.PRNGKey(3), (B, Hq, D), jnp.bfloat16)
+    o = jax.jit(functools.partial(ops.paged_decode_attention, impl="pallas"))(
+        q, kp, vp, pt, lens
+    )
+    ref = jax.jit(reference.paged_decode_attention)(q, kp, vp, pt, lens)
+    err = _err(o, ref)
+    assert err < 0.06, err
+    return {"max_err": round(err, 4)}
+
+
+def probe_ragged_decode() -> dict:
+    import jax
+    import jax.numpy as jnp
+
+    from modal_examples_tpu import ops
+
+    L, B, Hq, Hkv, D, ps, pp = 2, 2, 16, 16, 128, 16, 4
+    n_pages = B * pp + 1
+    kp = jax.random.normal(
+        jax.random.PRNGKey(0), (L, n_pages, ps, Hkv, D), jnp.bfloat16
+    )
+    vp = jax.random.normal(
+        jax.random.PRNGKey(1), (L, n_pages, ps, Hkv, D), jnp.bfloat16
+    )
+    pt = (1 + jnp.arange(B * pp, dtype=jnp.int32)).reshape(B, pp)
+    prefix = jnp.array([19, 44], jnp.int32)
+    q = jax.random.normal(jax.random.PRNGKey(2), (B, Hq, D), jnp.bfloat16)
+    k_new = jax.random.normal(jax.random.PRNGKey(3), (B, Hkv, D), jnp.bfloat16)
+    v_new = jax.random.normal(jax.random.PRNGKey(4), (B, Hkv, D), jnp.bfloat16)
+    layer = jnp.int32(1)
+    o = jax.jit(ops.paged_decode_attention_ragged)(
+        q, kp, vp, layer, pt, prefix, k_new, v_new
+    )
+    ks = kp[1][pt]  # [B, pp, ps, Hkv, D]
+    vs = vp[1][pt]
+    ref = jax.jit(ops.paged_decode_attention_inflight)(
+        q, ks, vs, prefix, k_new, v_new
+    )
+    err = _err(o, ref)
+    assert err < 0.06, err
+    return {"max_err": round(err, 4)}
+
+
+def probe_scatter_kv() -> dict:
+    """The round-4 wedge suspect: in-place strided HBM->HBM DMA scatter.
+    Runs LAST in the registry; always bring this up through the probe
+    harness, never in-process."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from modal_examples_tpu import ops
+
+    L, P, ps, Hkv, D, B = 2, 6, 16, 16, 128, 3
+    kp = jax.random.normal(
+        jax.random.PRNGKey(0), (L, P, ps, Hkv, D), jnp.bfloat16
+    )
+    vp = jax.random.normal(jax.random.PRNGKey(1), kp.shape, jnp.bfloat16)
+    k_all = jax.random.normal(
+        jax.random.PRNGKey(2), (L, B, Hkv, D), jnp.bfloat16
+    )
+    v_all = jax.random.normal(jax.random.PRNGKey(3), k_all.shape, jnp.bfloat16)
+    page_idx = jnp.array([1, 3, 5], jnp.int32)
+    slot = jnp.array([0, 7, 15], jnp.int32)
+    ref_k = kp.at[:, page_idx, slot].set(k_all)
+    ref_v = vp.at[:, page_idx, slot].set(v_all)
+    ok, ov = jax.jit(ops.scatter_kv_pages, donate_argnums=(0, 1))(
+        kp, vp, k_all, v_all, page_idx, slot
+    )
+    err = max(_err(ok, ref_k), _err(ov, ref_v))
+    assert err == 0.0, err
+    # every non-target entry untouched
+    assert bool(np.asarray(jnp.all(ok[:, 0] == ref_k[:, 0])))
+    return {"max_err": err}

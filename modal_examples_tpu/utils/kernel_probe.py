@@ -1,0 +1,254 @@
+"""Wedge-proof kernel bring-up: run first-on-chip Mosaic compiles in a
+killable subprocess with a hard timeout.
+
+Why this exists: twice (rounds 1 and 4) an in-process first compile of a
+new Pallas kernel hung inside the remote compile/claim path and wedged the
+single tunneled TPU's device claim for the rest of the session — the
+process could not be interrupted from Python, and the claim followed the
+process. The standing rule this module enforces: **the first Mosaic
+compile of any new or modified kernel never runs in a process you care
+about.** A probe child claims the chip, compiles the kernel on tiny legal
+shapes, checks numerics against the XLA reference, writes a JSON result
+file, and exits — releasing the claim. On a hang the parent SIGKILLs the
+whole process group before the timeout can become a session wedge.
+
+Replaces the ad-hoc ``timeout ...`` wrappers in revalidate_chip.sh with an
+importable API (`run_probe`, `run_probes`) + CLI:
+
+    python -m modal_examples_tpu.utils.kernel_probe ragged_decode
+    python -m modal_examples_tpu.utils.kernel_probe --all
+
+Probe targets are ``"module:function"`` strings; the per-kernel registry
+lives in ``modal_examples_tpu.ops.probes.KERNEL_PROBES``. Reference analog:
+the reference's serving stacks AOT-build engines in a separate build step
+(TRT-LLM engine build, SURVEY §2.4) for the same reason — compile is the
+dangerous phase and must be isolable.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import os
+import signal
+import subprocess
+import sys
+import tempfile
+import time
+
+
+@dataclasses.dataclass
+class ProbeResult:
+    target: str
+    status: str  # "ok" | "fail" | "timeout" | "crash"
+    elapsed_s: float
+    payload: dict | None = None  # probe fn's returned dict (status ok/fail)
+    error: str | None = None
+    log_tail: str = ""
+
+    @property
+    def ok(self) -> bool:
+        return self.status == "ok"
+
+    def to_json(self) -> dict:
+        return dataclasses.asdict(self)
+
+
+def resolve_target(target: str):
+    """``"name"`` (registry key) or ``"pkg.mod:fn"`` -> callable."""
+    if ":" not in target:
+        from modal_examples_tpu.ops.probes import KERNEL_PROBES
+
+        if target not in KERNEL_PROBES:
+            raise KeyError(
+                f"unknown probe {target!r}; registered: "
+                f"{sorted(KERNEL_PROBES)}"
+            )
+        target = KERNEL_PROBES[target]
+    mod_name, fn_name = target.split(":")
+    import importlib
+
+    mod = importlib.import_module(mod_name)
+    return getattr(mod, fn_name)
+
+
+def run_probe(
+    target: str,
+    *,
+    timeout_s: float = 300.0,
+    env: dict | None = None,
+) -> ProbeResult:
+    """Run one probe target in a fresh subprocess; SIGKILL its whole
+    process group on timeout (SIGTERM is not enough — the round-4 hang sat
+    in native code and shrugged it off)."""
+    t0 = time.time()
+    with tempfile.TemporaryDirectory(prefix="kprobe_") as td:
+        result_file = os.path.join(td, "result.json")
+        log_file = os.path.join(td, "probe.log")
+        child_env = dict(os.environ)
+        # the package is run from a source tree, not an install: the child
+        # must find it regardless of the parent's cwd
+        repo_root = os.path.dirname(
+            os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+        )
+        child_env["PYTHONPATH"] = (
+            repo_root + os.pathsep + child_env["PYTHONPATH"]
+            if child_env.get("PYTHONPATH")
+            else repo_root
+        )
+        if env:
+            child_env.update(env)
+        cmd = [
+            sys.executable, "-m", "modal_examples_tpu.utils.kernel_probe",
+            "--child", target, "--result-file", result_file,
+        ]
+        with open(log_file, "wb") as lf:
+            proc = subprocess.Popen(
+                cmd, stdout=lf, stderr=subprocess.STDOUT,
+                env=child_env, start_new_session=True,
+            )
+            try:
+                code = proc.wait(timeout=timeout_s)
+            except subprocess.TimeoutExpired:
+                try:
+                    os.killpg(proc.pid, signal.SIGKILL)
+                except ProcessLookupError:
+                    pass
+                proc.wait()
+                return ProbeResult(
+                    target, "timeout", round(time.time() - t0, 1),
+                    error=f"no result after {timeout_s}s; process group killed",
+                    log_tail=_tail(log_file),
+                )
+        elapsed = round(time.time() - t0, 1)
+        if os.path.exists(result_file):
+            with open(result_file) as f:
+                rec = json.load(f)
+            status = "ok" if rec.get("ok") else "fail"
+            return ProbeResult(
+                target, status, elapsed,
+                payload=rec.get("payload"), error=rec.get("error"),
+                log_tail="" if status == "ok" else _tail(log_file),
+            )
+        return ProbeResult(
+            target, "crash", elapsed,
+            error=f"exit code {code}, no result file",
+            log_tail=_tail(log_file),
+        )
+
+
+def run_probes(
+    targets: list[str] | None = None,
+    *,
+    timeout_s: float = 300.0,
+    stop_on_timeout: bool = True,
+) -> dict[str, ProbeResult]:
+    """Run probes in registry order. A *timeout* stops the sequence by
+    default — it means the chip claim may now be wedged and every further
+    probe would hang the same way; the caller should check chip health
+    before anything else touches the device. A mere numeric failure
+    continues."""
+    if targets is None:
+        from modal_examples_tpu.ops.probes import KERNEL_PROBES
+
+        targets = list(KERNEL_PROBES)
+    results: dict[str, ProbeResult] = {}
+    for t in targets:
+        r = run_probe(t, timeout_s=timeout_s)
+        results[t] = r
+        print(f"[probe {t}] {r.status} {r.elapsed_s}s "
+              f"{r.payload or r.error or ''}", flush=True)
+        if r.status == "timeout" and stop_on_timeout:
+            break
+    return results
+
+
+def _tail(path: str, n: int = 2000) -> str:
+    try:
+        with open(path, "rb") as f:
+            f.seek(0, os.SEEK_END)
+            size = f.tell()
+            f.seek(max(0, size - n))
+            return f.read().decode(errors="replace")
+    except OSError:
+        return ""
+
+
+def _child_main(target: str, result_file: str) -> int:
+    rec: dict = {"ok": False}
+    try:
+        if (
+            os.environ.get("JAX_PLATFORMS", "").lower() == "cpu"
+            or os.environ.get("BENCH_CPU")
+        ):
+            # the env-var platform route is unreliable once the axon TPU
+            # plugin is importable (it still dials the chip — observed
+            # blocking 5 min on a wedged claim); pin explicitly. BENCH_CPU
+            # is the benchmarks' off-chip smoke switch — honor it here so
+            # a CPU bench run never dials the chip from probe children.
+            import jax
+
+            jax.config.update("jax_platforms", "cpu")
+        fn = resolve_target(target)
+        payload = fn() or {}
+        rec = {"ok": True, "payload": payload}
+    except Exception as e:  # noqa: BLE001 — the whole point is to report it
+        import traceback
+
+        traceback.print_exc()
+        rec = {"ok": False, "error": f"{type(e).__name__}: {e}"}
+    tmp = result_file + ".tmp"
+    with open(tmp, "w") as f:
+        json.dump(rec, f)
+    os.replace(tmp, result_file)
+    return 0 if rec["ok"] else 1
+
+
+# --- harness self-test targets (used by tests/test_kernel_probe.py) -----
+def _selftest_ok() -> dict:
+    return {"answer": 42}
+
+
+def _selftest_fail() -> dict:
+    raise AssertionError("deliberate numeric failure")
+
+
+def _selftest_crash() -> dict:
+    os._exit(3)  # simulates a segfaulting compile
+
+
+def _selftest_hang() -> dict:
+    while True:  # simulates the round-1/round-4 claim wedge
+        time.sleep(60)
+
+
+def main(argv: list[str] | None = None) -> int:
+    import argparse
+
+    ap = argparse.ArgumentParser(description=__doc__.split("\n")[0])
+    ap.add_argument("target", nargs="?", help="probe name or module:function")
+    ap.add_argument("--all", action="store_true", help="run full registry")
+    ap.add_argument("--timeout", type=float, default=300.0)
+    ap.add_argument("--child", metavar="TARGET",
+                    help="(internal) run TARGET in-process")
+    ap.add_argument("--result-file", help="(internal) child result path")
+    args = ap.parse_args(argv)
+
+    if args.child:
+        return _child_main(args.child, args.result_file)
+    if args.all:
+        results = run_probes(timeout_s=args.timeout)
+        summary = {k: v.status for k, v in results.items()}
+        n_ok = sum(1 for v in results.values() if v.ok)
+        print(json.dumps({"probes": summary, "ok": n_ok,
+                          "total": len(results)}), flush=True)
+        return 0 if n_ok == len(results) else 1
+    if not args.target:
+        ap.error("give a probe target or --all")
+    r = run_probe(args.target, timeout_s=args.timeout)
+    print(json.dumps(r.to_json()), flush=True)
+    return 0 if r.ok else 1
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())
