@@ -113,15 +113,19 @@ def main() -> int:
 
     K = args.steps
 
+    # impls resolved ONCE here and passed explicitly — decode_step has no
+    # env fallback (an env read at trace time is not part of any jit cache
+    # key; ADVICE r3/r4)
+    scatter_impl = os.environ.get("MTPU_SCATTER_IMPL", "xla")
+
     def make_block(impl):
-        # impl passed explicitly (NOT via MTPU_PAGED_IMPL): the env var is
-        # read at trace time and is not part of any jit cache key (ADVICE r3)
         def block(params, k_pages, v_pages, prev, positions, tables, active,
                   key, temps, top_ps, top_ks, seeds):
             def body(carry, k_i):
                 tok, pos, kp, vp = carry
                 logits, kp, vp = llama.decode_step(
-                    params, tok, pos, kp, vp, tables, active, cfg, impl=impl
+                    params, tok, pos, kp, vp, tables, active, cfg, impl=impl,
+                    scatter_impl=scatter_impl,
                 )
                 nxt = sample(
                     logits, k_i, temps, top_ps, top_ks, seeds=seeds,

@@ -575,6 +575,74 @@ def prefill_chunk(
     return logits, k_pages, v_pages
 
 
+_impl_downgrades_warned: set = set()
+
+
+def paged_impl_plan(
+    cfg: LlamaConfig,
+    page_size: int,
+    impl: str = "xla",
+    scatter_impl: str = "xla",
+    *,
+    warn: bool = True,
+) -> dict:
+    """Resolve the decode structure that will ACTUALLY run for these shapes
+    on the current backend — the single source of truth shared by
+    ``decode_step`` and the engine's stats/metrics, so a requested pallas
+    impl that gets shape-downgraded (GQA Hkv<16, sub-128 head_dim) is
+    visible instead of silently benchmarking the XLA path (ADVICE r4).
+
+    Returns ``{"attention": "ragged"|"xla-gather"|"writeback",
+    "scatter": "pallas"|"xla", "downgraded": [...]}``.
+    """
+    on_tpu = jax.default_backend() == "tpu"
+    downgraded = []
+    if impl in ("xla-writeback", "pallas-writeback"):
+        attention = "writeback"
+    elif impl == "pallas":
+        # Mosaic tiling needs D%128 / page_size%16, and the kernel's free
+        # (ps, Hkv, D) -> (ps*Hkv, D) flatten needs Hkv%16 (sub-16 head
+        # counts pad sublanes; merging padded tiles relayouts). Sub-tile
+        # shapes (tiny test models, GQA Hkv=8) take the XLA path — GQA
+        # caches are Hkv/Hq-fraction sized, so the gather the kernel
+        # exists to kill is proportionally cheaper there.
+        ok = not on_tpu or (
+            cfg.head_dim % 128 == 0
+            and page_size % 16 == 0
+            and cfg.n_kv_heads % 16 == 0
+        )
+        attention = "ragged" if ok else "xla-gather"
+        if not ok:
+            downgraded.append(
+                f"paged_impl=pallas -> xla-gather (head_dim={cfg.head_dim}, "
+                f"page_size={page_size}, n_kv_heads={cfg.n_kv_heads} fail "
+                "D%128/ps%16/Hkv%16 Mosaic tiling)"
+            )
+    else:
+        attention = "xla-gather"
+    scatter = "xla"
+    if scatter_impl == "pallas":
+        if not on_tpu or cfg.head_dim % 128 == 0:
+            scatter = "pallas"
+        else:
+            downgraded.append(
+                f"scatter_impl=pallas -> xla (head_dim={cfg.head_dim} "
+                "fails D%128 tiling)"
+            )
+    if warn and downgraded:
+        import warnings
+
+        for msg in downgraded:
+            if msg not in _impl_downgrades_warned:
+                _impl_downgrades_warned.add(msg)
+                warnings.warn(
+                    "requested Pallas impl downgraded: " + msg, stacklevel=2
+                )
+    return {
+        "attention": attention, "scatter": scatter, "downgraded": downgraded,
+    }
+
+
 def decode_step(
     params: dict,
     tokens: jax.Array,  # [B] int32 — current token per slot
@@ -584,8 +652,8 @@ def decode_step(
     page_tables: jax.Array,  # [B, pages_per_seq]
     active: jax.Array,  # [B] bool — live slots (dead slots write trash page 0)
     cfg: LlamaConfig,
-    impl: str | None = None,  # None: MTPU_PAGED_IMPL env (read at TRACE time)
-    scatter_impl: str | None = None,  # None: MTPU_SCATTER_IMPL env (trace time)
+    impl: str = "xla",
+    scatter_impl: str = "xla",
 ):
     """One token of batched decode against the paged cache.
 
@@ -593,10 +661,13 @@ def decode_step(
     in-place updates under jit.
 
     ``impl`` selects the decode structure ("xla" default, "pallas",
-    "xla-writeback"). Callers that jit this (the engine) must resolve it
-    ONCE and pass it explicitly: the env fallback is read at trace time and
-    is not part of any jit cache key, so toggling the env after a trace
-    silently keeps the previously compiled implementation (ADVICE r3).
+    "xla-writeback"). There is deliberately NO env-var fallback here: this
+    function is jitted by its callers, an env read would happen at trace
+    time and not be part of any jit cache key, so toggling the env after a
+    trace would silently keep the previously compiled implementation
+    (ADVICE r3/r4). The engine resolves MTPU_PAGED_IMPL once in
+    ``LLMEngine.__init__`` and passes it explicitly; use
+    ``paged_impl_plan`` to see what will actually run for given shapes.
 
     Structure (round-3 rework): the page arrays are READ-ONLY inside the
     layer scan — attention sees the cached prefix via a fused gather plus
@@ -616,12 +687,6 @@ def decode_step(
     ``impl="xla-writeback"`` keeps the round-2 write-then-attend structure
     as the A/B lever for benchmarks/decode_micro.py.
     """
-    import os
-
-    if impl is None:
-        impl = os.environ.get("MTPU_PAGED_IMPL", "xla")
-    if scatter_impl is None:
-        scatter_impl = os.environ.get("MTPU_SCATTER_IMPL", "xla")
     if impl in ("xla-writeback", "pallas-writeback"):
         return _decode_step_writeback(
             params, tokens, positions, k_pages, v_pages, page_tables, active,
@@ -631,20 +696,10 @@ def decode_step(
     page_size = k_pages.shape[2]
     # "pallas" = the v3 ragged kernel in the SAME read-only-pages structure
     # as the default path (in-flight token as an extra softmax column, one
-    # scatter after the scan). Mosaic tiling needs D%128 / page_size%16, and
-    # the kernel's free (ps, Hkv, D) -> (ps*Hkv, D) flatten needs Hkv%16
-    # (sub-16 head counts pad sublanes; merging padded tiles relayouts).
-    # Sub-tile shapes (tiny test models, GQA Hkv=8) silently take the XLA
-    # path — GQA caches are Hkv/Hq-fraction sized, so the gather the kernel
-    # exists to kill is proportionally cheaper there.
-    use_ragged = impl == "pallas" and (
-        jax.default_backend() != "tpu"
-        or (
-            cfg.head_dim % 128 == 0
-            and page_size % 16 == 0
-            and cfg.n_kv_heads % 16 == 0
-        )
-    )
+    # scatter after the scan); shape legality + downgrade reporting live in
+    # paged_impl_plan (single source of truth with the engine's stats).
+    plan = paged_impl_plan(cfg, page_size, impl, scatter_impl)
+    use_ragged = plan["attention"] == "ragged"
     x = params["embed"][tokens]  # [B, D]
     cos, sin = layers.rotary_embedding(
         positions[:, None], cfg.head_dim, cfg.rope_theta, dtype=jnp.float32,
@@ -708,10 +763,7 @@ def decode_step(
     # mid-compile, and a wedged chip poisons every later bench config.
     # Independent of the attention impl — both structures end in the same
     # post-scan scatter; only the (Hkv, D) minor-dim tile legality gates it.
-    use_pallas_scatter = scatter_impl == "pallas" and (
-        jax.default_backend() != "tpu" or cfg.head_dim % 128 == 0
-    )
-    if use_pallas_scatter:
+    if plan["scatter"] == "pallas":
         k_pages, v_pages = scatter_kv_pages(
             k_pages, v_pages, k_all, v_all, page_idx, slot
         )

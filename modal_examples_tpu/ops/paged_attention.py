@@ -445,6 +445,18 @@ def paged_decode_attention_ragged(
         sm_scale = D**-0.5
     if interpret is None:
         interpret = jax.default_backend() != "tpu"
+    if not interpret and (D % 128 or page_size % 16 or Hkv % 16):
+        # fail with the constraint instead of an opaque Mosaic lowering
+        # error: pages must be whole (16, 128) bf16 tiles and the kernel's
+        # (ps, Hkv, D) -> (ps*Hkv, D) flatten needs Hkv%16. Callers wanting
+        # an automatic fallback for these shapes (common GQA Hkv=8) should
+        # go through llama.decode_step / paged_impl_plan, which downgrade
+        # to the XLA gather path.
+        raise ValueError(
+            f"paged_decode_attention_ragged needs head_dim%128==0, "
+            f"page_size%16==0, n_kv_heads%16==0 on TPU; got D={D}, "
+            f"page_size={page_size}, Hkv={Hkv}"
+        )
 
     # DMA ring depth: enough in-flight pages to hide issue latency (measured
     # ~2.3 us/page at depth 2), capped so K+V scratch stays ~<=4 MB of VMEM
@@ -601,6 +613,13 @@ def scatter_kv_pages(
     if interpret is None:
         interpret = jax.default_backend() != "tpu"
     L, B, Hkv, D = k_all.shape
+    if not interpret and D % 128:
+        raise ValueError(
+            f"scatter_kv_pages needs head_dim%128==0 on TPU for the "
+            f"strided (Hkv, D) minor-dim DMAs; got D={D}. Use "
+            f"llama.decode_step / paged_impl_plan for automatic fallback "
+            "to the XLA scatter."
+        )
     if interpret:
         # interpreter-mode DMAs of doubly-indexed HBM views are flaky; the
         # XLA scatter is exact and CPU tests only check semantics. Adjacent

@@ -275,6 +275,17 @@ class LLMEngine:
         self.mesh = mesh
         self._attn_impl = "flash" if mesh is None else "xla"
         if mesh is not None:
+            # a pallas_call cannot be auto-partitioned: under a sharded jit
+            # the ragged/scatter kernels would fail to compile (or force a
+            # full-cache gather per device). Same reason prefill switches
+            # to the XLA attention path above; fail loudly instead.
+            if "pallas" in self.paged_impl or self.scatter_impl == "pallas":
+                raise ValueError(
+                    f"paged_impl={self.paged_impl!r} / scatter_impl="
+                    f"{self.scatter_impl!r} cannot run under mesh= tensor "
+                    "parallelism (pallas_call is not auto-partitionable); "
+                    "use the XLA impls for TP serving"
+                )
             params = _shard_params(params, cfg, mesh)
         self.params = params
         self.max_slots = max_slots
@@ -292,6 +303,13 @@ class LLMEngine:
         )
         if mesh is not None:
             self._shard_cache(self.cache)
+        # what will ACTUALLY run for these shapes on this backend — a
+        # requested pallas impl can be shape-downgraded (GQA Hkv<16,
+        # sub-128 head_dim); record it so benches/metrics report the real
+        # path instead of the requested one (ADVICE r4)
+        self.impl_plan = llama.paged_impl_plan(
+            cfg, page_size, self.paged_impl, self.scatter_impl
+        )
         self.prefill_buckets = tuple(
             b for b in sorted(prefill_buckets) if b <= max_model_len
         ) or (max_model_len,)
@@ -324,7 +342,19 @@ class LLMEngine:
                     "chunk)"
                 )
             if mesh is not None:
-                raise ValueError("vision= with mesh= (TP) is not supported yet")
+                # TP × vision (sglang_vlm.py serves VLMs with --tp-size):
+                # image tokens are ordinary KV entries, so decode needs
+                # nothing; the ViT tower is einsum-only (partitionable) and
+                # small, so its weights replicate over the mesh and every
+                # chip encodes the (shared) image — the LLM prefill behind
+                # it runs sharded exactly like the text path.
+                from jax.sharding import NamedSharding
+                from jax.sharding import PartitionSpec as P
+
+                rep = NamedSharding(mesh, P())
+                self.vision_params = jax.tree.map(
+                    lambda x: jax.device_put(x, rep), self.vision_params
+                )
             if speculative is not None:
                 raise ValueError(
                     "vision= with speculative= is not supported: the draft "

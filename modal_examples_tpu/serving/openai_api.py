@@ -132,6 +132,9 @@ class _Handler(BaseHTTPRequestHandler):
                 f"mtpu_waiting_requests {eng.waiting.qsize()}\n"
                 f"mtpu_kv_pages_free {eng.cache.allocator.available}\n"
                 f"mtpu_scheduler_errors_total {eng.error_count}\n"
+                f'mtpu_decode_impl{{attention="'
+                f'{eng.impl_plan["attention"]}",scatter='
+                f'"{eng.impl_plan["scatter"]}"}} 1\n'
                 + (
                     f"mtpu_spec_proposed_total {s.spec_proposed}\n"
                     f"mtpu_spec_accepted_total {s.spec_accepted}\n"

@@ -59,6 +59,11 @@ class WebSocket:
     Server side by default (unmasked sends, requires masked receives);
     ``client=True`` flips both directions per RFC 6455 §5.1."""
 
+    #: total assembled-message cap (close 1009 beyond it): the gateway
+    #: buffers one message per handler thread, so this bounds per-client
+    #: memory the way the reference's ASGI servers cap request bodies
+    MAX_MESSAGE_BYTES = 32 * 1024 * 1024
+
     def __init__(self, sock: socket.socket, *, client: bool = False):
         self._sock = sock
         self._buf = b""
@@ -87,6 +92,12 @@ class WebSocket:
             (n,) = struct.unpack("!H", self._read_exact(2))
         elif n == 127:
             (n,) = struct.unpack("!Q", self._read_exact(8))
+        if n > self.MAX_MESSAGE_BYTES:
+            # enforce on the DECLARED length before buffering the payload —
+            # checking the assembled message only would let one huge frame
+            # grow the buffer unbounded first
+            self.close(1009)
+            raise ConnectionClosed(1009)
         if self._client:
             # server frames are unmasked (a masked one is a protocol error
             # we tolerate by unmasking anyway)
@@ -132,10 +143,30 @@ class WebSocket:
                     self.closed = True
                 raise ConnectionClosed(code)
             if opcode in (OP_TEXT, OP_BINARY):
+                if msg_op is not None:
+                    # RFC 6455 §5.4: a new data frame while a fragmented
+                    # message is open is a protocol violation
+                    self.close(1002)
+                    raise ConnectionClosed(1002)
                 msg_op = opcode
                 message = payload
             elif opcode == OP_CONT:
+                if msg_op is None:
+                    # continuation with no message in progress: without
+                    # this check a malicious client could grow `message`
+                    # unboundedly in the gateway process
+                    self.close(1002)
+                    raise ConnectionClosed(1002)
                 message += payload
+            else:
+                # RFC 6455 §5.2: reserved opcodes fail the connection —
+                # falling through could return a truncated fragmented
+                # message as complete
+                self.close(1002)
+                raise ConnectionClosed(1002)
+            if len(message) > self.MAX_MESSAGE_BYTES:
+                self.close(1009)  # message too big
+                raise ConnectionClosed(1009)
             if fin and msg_op is not None:
                 kind = "text" if msg_op == OP_TEXT else "binary"
                 return kind, message

@@ -80,6 +80,70 @@ class TestFrameCodec:
             a.close()
             b.close()
 
+    def test_orphan_continuation_rejected(self):
+        """ADVICE r4: an initial OP_CONT (no message in progress) must fail
+        the connection (1002), not accumulate payload forever."""
+        import socket
+
+        from modal_examples_tpu.web.websocket import (
+            OP_CONT, ConnectionClosed, WebSocket, build_masked_frame,
+        )
+
+        a, b = socket.socketpair()
+        try:
+            server = WebSocket(a)
+            b.sendall(build_masked_frame(OP_CONT, b"orphan"))
+            with pytest.raises(ConnectionClosed) as e:
+                server.receive()
+            assert e.value.code == 1002
+        finally:
+            a.close()
+            b.close()
+
+    def test_new_data_frame_inside_fragmented_message_rejected(self):
+        import socket
+
+        from modal_examples_tpu.web.websocket import (
+            OP_TEXT, ConnectionClosed, WebSocket,
+        )
+
+        def masked(opcode, payload, fin):
+            head = bytes([(0x80 if fin else 0) | opcode, 0x80 | len(payload)])
+            mask = b"\x01\x02\x03\x04"
+            body = bytes(c ^ mask[i % 4] for i, c in enumerate(payload))
+            return head + mask + body
+
+        a, b = socket.socketpair()
+        try:
+            server = WebSocket(a)
+            b.sendall(masked(OP_TEXT, b"first", fin=False))
+            b.sendall(masked(OP_TEXT, b"second", fin=True))  # RFC 6455 §5.4
+            with pytest.raises(ConnectionClosed) as e:
+                server.receive()
+            assert e.value.code == 1002
+        finally:
+            a.close()
+            b.close()
+
+    def test_oversized_message_closed_1009(self, monkeypatch):
+        import socket
+
+        from modal_examples_tpu.web.websocket import (
+            OP_BINARY, ConnectionClosed, WebSocket, build_masked_frame,
+        )
+
+        monkeypatch.setattr(WebSocket, "MAX_MESSAGE_BYTES", 100)
+        a, b = socket.socketpair()
+        try:
+            server = WebSocket(a)
+            b.sendall(build_masked_frame(OP_BINARY, b"x" * 101))
+            with pytest.raises(ConnectionClosed) as e:
+                server.receive()
+            assert e.value.code == 1009
+        finally:
+            a.close()
+            b.close()
+
     def test_ping_answered_with_pong(self):
         import socket
 
