@@ -52,6 +52,14 @@ CONFIGS = {
         slots=16, max_len=384, max_tokens=128, timeout=1200, quant="int8"
     ),
     "llama2-7b": dict(slots=8, max_len=256, max_tokens=128, timeout=1200),
+    "llama3.1-8b-int8-s32": dict(
+        # GQA on the fast path (VERDICT r4 #4): Hkv=8 runs the v4 "grouped"
+        # ragged kernel (per-kv-head contraction — no Hkv%16 flatten). The
+        # reference's serving targets are GQA-era (vllm_inference.py:54-58);
+        # not baseline-comparable (different model) but must carry its own
+        # on-chip number in all_configs.
+        slots=32, max_len=256, max_tokens=128, timeout=1500, quant="int8"
+    ),
     "llama-1b": dict(slots=16, max_len=512, max_tokens=128, timeout=900),
     "tiny": dict(slots=4, max_len=128, max_tokens=16, timeout=420),
 }
@@ -72,6 +80,8 @@ def _child(model: str) -> None:
     spec = CONFIGS[model]
     if model.startswith("llama2-7b"):
         cfg = llama.LlamaConfig.llama2_7b()
+    elif model.startswith("llama3.1-8b"):
+        cfg = llama.LlamaConfig.llama31_8b()
     elif model == "llama-1b":
         cfg = llama.LlamaConfig(
             vocab_size=32000, dim=2048, n_layers=16, n_heads=16, n_kv_heads=8,
@@ -272,6 +282,7 @@ def main() -> int:
             "llama2-7b-int8-s36",
             "llama2-7b-int8-s32",
             "llama2-7b-int8-s16",
+            "llama3.1-8b-int8-s32",
             "llama2-7b",
             "llama-1b",
         ]

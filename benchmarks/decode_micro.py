@@ -37,6 +37,11 @@ def main() -> int:
     ap.add_argument("--quant", default=None, choices=list(SUPPORTED))
     ap.add_argument("--slots", default="8,16,32")
     ap.add_argument("--impl", default="xla,xla-writeback")
+    ap.add_argument(
+        "--variant", default=None, choices=[None, "flat", "grouped"],
+        help="ragged-kernel formulation A/B (impl=pallas): flat = v3 "
+        "all-heads matmul, grouped = v4 per-kv-head (GQA-capable)",
+    )
     ap.add_argument("--steps", type=int, default=8, help="decode_block")
     ap.add_argument("--max-len", type=int, default=256)
     ap.add_argument("--page-size", type=int, default=16)
@@ -56,10 +61,27 @@ def main() -> int:
 
         # only the kernels this bench will actually trace: the quantized
         # decode path upcasts through plain jnp.dot (layers.mm), so no
-        # int8_matmul probe is needed for --quant
+        # int8_matmul probe is needed for --quant. The ragged probe must
+        # match the VARIANT this model's head geometry selects — probing
+        # flat for a GQA run would leave the grouped kernel's first Mosaic
+        # compile in-process, defeating the wedge-proof rule.
+        from modal_examples_tpu.models import llama as _llama
+        from modal_examples_tpu.ops.paged_attention import ragged_variant_for
+
+        _cfg = (
+            _llama.LlamaConfig.tiny()
+            if args.model == "tiny"
+            else getattr(
+                _llama.LlamaConfig,
+                args.model.replace("-", "_").replace(".", ""),
+            )()
+        )
         needed = []
         if "pallas" in args.impl:
-            needed.append("ragged_decode")
+            variant = args.variant or ragged_variant_for(_cfg.n_kv_heads)
+            needed.append(
+                "ragged_decode" if variant == "flat" else "ragged_decode_gqa"
+            )
         if os.environ.get("MTPU_SCATTER_IMPL") == "pallas":
             needed.append("scatter_kv")
         results = run_probes(needed, timeout_s=600)
@@ -125,7 +147,7 @@ def main() -> int:
                 tok, pos, kp, vp = carry
                 logits, kp, vp = llama.decode_step(
                     params, tok, pos, kp, vp, tables, active, cfg, impl=impl,
-                    scatter_impl=scatter_impl,
+                    scatter_impl=scatter_impl, ragged_variant=args.variant,
                 )
                 nxt = sample(
                     logits, k_i, temps, top_ps, top_ks, seeds=seeds,

@@ -19,5 +19,9 @@ timeout 900 python benchmarks/decode_micro.py --quant int8 --slots 8,36 --impl p
 MTPU_SCATTER_IMPL=pallas timeout 900 python benchmarks/decode_micro.py --quant int8 --slots 8 --impl pallas || exit 5
 # 5. int4 weights
 timeout 900 python benchmarks/decode_micro.py --quant int4 --slots 8,36 --impl pallas || exit 6
-# 6. full bench
-timeout 1500 python bench.py || exit 7
+# 6. GQA on the grouped ragged kernel (llama-3.1 head geometry) + the
+#    flat-vs-grouped A/B at the 7B MHA shape
+timeout 1500 python benchmarks/decode_micro.py --model llama3.1-8b --quant int8 --slots 8,32 --impl pallas || exit 7
+timeout 900 python benchmarks/decode_micro.py --quant int8 --slots 32 --impl pallas --variant grouped || exit 8
+# 7. full bench
+timeout 1500 python bench.py || exit 9

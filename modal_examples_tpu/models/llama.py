@@ -593,36 +593,38 @@ def paged_impl_plan(
     visible instead of silently benchmarking the XLA path (ADVICE r4).
 
     Returns ``{"attention": "ragged"|"xla-gather"|"writeback",
-    "scatter": "pallas"|"xla", "downgraded": [...]}``.
+    "ragged_variant": "flat"|"grouped"|None, "scatter": "pallas"|"xla",
+    "downgraded": [...]}``.
     """
     on_tpu = jax.default_backend() == "tpu"
     downgraded = []
+    ragged_variant = None
     if impl in ("xla-writeback", "pallas-writeback"):
         attention = "writeback"
     elif impl == "pallas":
-        # Mosaic tiling needs D%128 / page_size%16, and the kernel's free
-        # (ps, Hkv, D) -> (ps*Hkv, D) flatten needs Hkv%16 (sub-16 head
-        # counts pad sublanes; merging padded tiles relayouts). Sub-tile
-        # shapes (tiny test models, GQA Hkv=8) take the XLA path — GQA
-        # caches are Hkv/Hq-fraction sized, so the gather the kernel
-        # exists to kill is proportionally cheaper there.
-        ok = not on_tpu or (
-            cfg.head_dim % 128 == 0
-            and page_size % 16 == 0
-            and cfg.n_kv_heads % 16 == 0
-        )
+        # legality predicates live with the kernels (ops.paged_attention)
+        # so the plan and the wrappers cannot drift. Hkv no longer gates
+        # the kernel (round 5): Hkv%16 shapes take the "flat" all-heads
+        # formulation, others (GQA Hkv=8, the llama-3-era serving targets)
+        # the "grouped" per-kv-head one.
+        from ..ops.paged_attention import ragged_shapes_ok, ragged_variant_for
+
+        ok = not on_tpu or ragged_shapes_ok(cfg.head_dim, page_size)
         attention = "ragged" if ok else "xla-gather"
-        if not ok:
+        if ok:
+            ragged_variant = ragged_variant_for(cfg.n_kv_heads)
+        else:
             downgraded.append(
                 f"paged_impl=pallas -> xla-gather (head_dim={cfg.head_dim}, "
-                f"page_size={page_size}, n_kv_heads={cfg.n_kv_heads} fail "
-                "D%128/ps%16/Hkv%16 Mosaic tiling)"
+                f"page_size={page_size} fail D%128/ps%16 Mosaic tiling)"
             )
     else:
         attention = "xla-gather"
     scatter = "xla"
     if scatter_impl == "pallas":
-        if not on_tpu or cfg.head_dim % 128 == 0:
+        from ..ops.paged_attention import scatter_shapes_ok
+
+        if not on_tpu or scatter_shapes_ok(cfg.head_dim):
             scatter = "pallas"
         else:
             downgraded.append(
@@ -639,7 +641,8 @@ def paged_impl_plan(
                     "requested Pallas impl downgraded: " + msg, stacklevel=2
                 )
     return {
-        "attention": attention, "scatter": scatter, "downgraded": downgraded,
+        "attention": attention, "ragged_variant": ragged_variant,
+        "scatter": scatter, "downgraded": downgraded,
     }
 
 
@@ -654,6 +657,7 @@ def decode_step(
     cfg: LlamaConfig,
     impl: str = "xla",
     scatter_impl: str = "xla",
+    ragged_variant: str | None = None,  # None: auto (flat | grouped by Hkv)
 ):
     """One token of batched decode against the paged cache.
 
@@ -734,7 +738,7 @@ def decode_step(
             # copy, no gather materialization)
             o = paged_decode_attention_ragged(
                 q[:, :, 0], k_pages, v_pages, li, page_tables, prefix_lens,
-                k_tok, v_tok,
+                k_tok, v_tok, variant=ragged_variant,
             )  # [B, H, D]
         else:
             # one gather from the full [L, P, ...] arrays (layer scalar +

@@ -26,6 +26,7 @@ KERNEL_PROBES: dict[str, str] = {
     "int8_matmul": "modal_examples_tpu.ops.probes:probe_int8_matmul",
     "paged_decode": "modal_examples_tpu.ops.probes:probe_paged_decode",
     "ragged_decode": "modal_examples_tpu.ops.probes:probe_ragged_decode",
+    "ragged_decode_gqa": "modal_examples_tpu.ops.probes:probe_ragged_decode_gqa",
     "scatter_kv": "modal_examples_tpu.ops.probes:probe_scatter_kv",
 }
 
@@ -37,7 +38,7 @@ PROBED_MODULES: dict[str, list[str]] = {
         "flash_fwd", "flash_bwd", "flash_chunked",
     ],
     "modal_examples_tpu.ops.paged_attention": [
-        "paged_decode", "ragged_decode", "scatter_kv",
+        "paged_decode", "ragged_decode", "ragged_decode_gqa", "scatter_kv",
     ],
     "modal_examples_tpu.ops.quantized_matmul": ["int8_matmul"],
 }
@@ -195,6 +196,41 @@ def probe_ragged_decode() -> dict:
     vs = vp[1][pt]
     ref = jax.jit(ops.paged_decode_attention_inflight)(
         q, ks, vs, prefix, k_new, v_new
+    )
+    err = _err(o, ref)
+    assert err < 0.06, err
+    return {"max_err": round(err, 4)}
+
+
+def probe_ragged_decode_gqa() -> dict:
+    """The v4 "grouped" per-kv-head formulation at a GQA shape (Hkv=8,
+    G=4 — the llama-3.1 head geometry): no (ps*Hkv) flatten, so Hkv%16
+    doesn't apply. First-compile risk: the per-head strided VMEM slices."""
+    import jax
+    import jax.numpy as jnp
+
+    from modal_examples_tpu import ops
+
+    L, B, Hq, Hkv, D, ps, pp = 2, 2, 32, 8, 128, 16, 4
+    n_pages = B * pp + 1
+    kp = jax.random.normal(
+        jax.random.PRNGKey(0), (L, n_pages, ps, Hkv, D), jnp.bfloat16
+    )
+    vp = jax.random.normal(
+        jax.random.PRNGKey(1), (L, n_pages, ps, Hkv, D), jnp.bfloat16
+    )
+    pt = (1 + jnp.arange(B * pp, dtype=jnp.int32)).reshape(B, pp)
+    prefix = jnp.array([23, 61], jnp.int32)
+    q = jax.random.normal(jax.random.PRNGKey(2), (B, Hq, D), jnp.bfloat16)
+    k_new = jax.random.normal(jax.random.PRNGKey(3), (B, Hkv, D), jnp.bfloat16)
+    v_new = jax.random.normal(jax.random.PRNGKey(4), (B, Hkv, D), jnp.bfloat16)
+    import functools
+
+    o = jax.jit(functools.partial(
+        ops.paged_decode_attention_ragged, variant="grouped"
+    ))(q, kp, vp, jnp.int32(1), pt, prefix, k_new, v_new)
+    ref = jax.jit(ops.paged_decode_attention_inflight)(
+        q, kp[1][pt], vp[1][pt], prefix, k_new, v_new
     )
     err = _err(o, ref)
     assert err < 0.06, err

@@ -186,6 +186,44 @@ class TestPagedAttention:
                 err_msg=f"layer {li}",
             )
 
+    def test_ragged_variants_agree(self, jax, jnp):
+        """flat (v3 all-heads matmul) and grouped (v4 per-kv-head, the GQA
+        path — round 5) are interchangeable formulations of the same math:
+        both must match the XLA inflight reference at MHA and GQA shapes."""
+        from modal_examples_tpu.ops import (
+            paged_decode_attention_inflight,
+            paged_decode_attention_ragged,
+        )
+
+        page_size, pages_per_seq = 16, 3
+        for Hq, Hkv in [(4, 4), (8, 2)]:  # MHA and GQA (G=4)
+            L, B, D = 2, 3, 64
+            n_pages = 1 + B * pages_per_seq
+            ks = jax.random.split(jax.random.PRNGKey(11), 6)
+            q = jax.random.normal(ks[0], (B, Hq, D), jnp.float32)
+            kp = jax.random.normal(
+                ks[1], (L, n_pages, page_size, Hkv, D), jnp.float32
+            )
+            vp = jax.random.normal(ks[2], kp.shape, jnp.float32)
+            pt = (1 + jnp.arange(B * pages_per_seq, dtype=jnp.int32)).reshape(
+                B, pages_per_seq
+            )
+            k_new = jax.random.normal(ks[3], (B, Hkv, D), jnp.float32)
+            v_new = jax.random.normal(ks[4], (B, Hkv, D), jnp.float32)
+            prefix = jnp.array([0, 17, 48], jnp.int32)
+            want = paged_decode_attention_inflight(
+                q, kp[1][pt], vp[1][pt], prefix, k_new, v_new
+            )
+            for variant in ("flat", "grouped"):
+                got = paged_decode_attention_ragged(
+                    q, kp, vp, jnp.int32(1), pt, prefix, k_new, v_new,
+                    variant=variant,
+                )
+                np.testing.assert_allclose(
+                    np.asarray(got), np.asarray(want), atol=2e-5,
+                    err_msg=f"Hq={Hq} Hkv={Hkv} variant={variant}",
+                )
+
     def test_decode_step_pallas_structure_matches_xla(self, jax, jnp):
         """decode_step(impl='pallas') (ragged-kernel read-only structure)
         must produce the same logits and cache writes as the default path."""

@@ -104,20 +104,14 @@ class TestFrameCodec:
         import socket
 
         from modal_examples_tpu.web.websocket import (
-            OP_TEXT, ConnectionClosed, WebSocket,
+            OP_TEXT, ConnectionClosed, WebSocket, build_masked_frame,
         )
-
-        def masked(opcode, payload, fin):
-            head = bytes([(0x80 if fin else 0) | opcode, 0x80 | len(payload)])
-            mask = b"\x01\x02\x03\x04"
-            body = bytes(c ^ mask[i % 4] for i, c in enumerate(payload))
-            return head + mask + body
 
         a, b = socket.socketpair()
         try:
             server = WebSocket(a)
-            b.sendall(masked(OP_TEXT, b"first", fin=False))
-            b.sendall(masked(OP_TEXT, b"second", fin=True))  # RFC 6455 §5.4
+            b.sendall(build_masked_frame(OP_TEXT, b"first", fin=False))
+            b.sendall(build_masked_frame(OP_TEXT, b"second"))  # RFC 6455 §5.4
             with pytest.raises(ConnectionClosed) as e:
                 server.receive()
             assert e.value.code == 1002
