@@ -220,6 +220,20 @@ def main() -> int:
                     json.dumps(
                         {
                             "impl": impl,
+                            # what actually ran, incl. the flat/grouped
+                            # ragged formulation — the A/B lines must be
+                            # attributable in captured logs
+                            "plan": {
+                                k: v
+                                for k, v in llama.paged_impl_plan(
+                                    cfg, args.page_size, impl, scatter_impl,
+                                    warn=False,
+                                ).items()
+                                if k != "downgraded"
+                            } | (
+                                {"ragged_variant": args.variant}
+                                if args.variant else {}
+                            ),
                             "slots": slots,
                             "step_ms": round(step_ms, 2),
                             "tok_s": round(slots / step_ms * 1e3, 1),

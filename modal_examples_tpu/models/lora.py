@@ -70,3 +70,90 @@ def merge(params: dict, lora_params: dict, lcfg: LoRAConfig) -> dict:
 
 def param_count(lora_params: dict) -> int:
     return sum(x.size for x in jax.tree.leaves(lora_params))
+
+
+# -- generic tree LoRA (diffusion / any model) -------------------------------
+
+#: the MMDiT attention + MLP projections — the dreambooth target set
+#: (diffusers_lora_finetune.py:205-213 targets to_q/to_k/to_v/to_out +
+#: ff projections; these are their names in models.diffusion.mmdit_init)
+DIT_TARGETS = (
+    "img_wq", "img_wk", "img_wv", "img_wo",
+    "ctx_wq", "ctx_wk", "ctx_wv", "ctx_wo",
+    "img_fc1", "img_fc2", "ctx_fc1", "ctx_fc2",
+)
+
+
+def init_lora_tree(
+    key: jax.Array, params: dict, lcfg: LoRAConfig
+) -> dict:
+    """Adapters for an ARBITRARY nested param dict: every leaf whose dict
+    key is in ``lcfg.targets`` and has >= 2 dims gets an (a, b) pair with
+    any leading (stack) dims preserved — ``[..., din, dout]`` becomes
+    ``a [..., din, r]`` + ``b [..., r, dout]``. The returned tree mirrors
+    the nesting, so it checkpoints/commits like any param tree.
+
+    This is the diffusion-model fine-tuning path (dreambooth,
+    diffusers_lora_finetune.py): llama has its own dedicated
+    ``init_lora`` whose adapters feed the on-the-fly ``delta`` inside the
+    jitted forward; diffusion training merges per step instead
+    (``merge_tree``) — cheap at DiT scale, zero changes to the forward.
+    """
+    flat = []
+
+    def walk(node, out):
+        for name, v in node.items():
+            if isinstance(v, dict):
+                sub: dict = {}
+                walk(v, sub)
+                if sub:
+                    out[name] = sub
+            elif name in lcfg.targets and getattr(v, "ndim", 0) >= 2:
+                flat.append((out, name, v))
+                out[name] = None  # placeholder, filled below
+        return out
+
+    tree: dict = {}
+    walk(params, tree)
+    if not flat:
+        raise ValueError(
+            f"no leaves matched targets {lcfg.targets!r}; check the names "
+            "against the model's param tree"
+        )
+    keys = jax.random.split(key, len(flat))
+    for k, (parent, name, w) in zip(keys, flat):
+        *stack, din, dout = w.shape
+        parent[name] = {
+            "a": (
+                jax.random.normal(k, (*stack, din, lcfg.rank), jnp.float32)
+                / lcfg.rank
+            ).astype(w.dtype),
+            "b": jnp.zeros((*stack, lcfg.rank, dout), w.dtype),
+        }
+    return tree
+
+
+def merge_tree(params: dict, lora_tree: dict, lcfg: LoRAConfig) -> dict:
+    """Base tree + low-rank deltas, structure-preserving. Inside a jitted
+    loss this is how diffusion LoRA trains: grads flow only to the (a, b)
+    leaves, the base stays a constant — XLA fuses the a@b expansion into
+    the consuming matmuls, so no persistent merged copy exists."""
+
+    def walk(p_node, l_node):
+        out = {}
+        for name, v in p_node.items():
+            l_v = l_node.get(name) if isinstance(l_node, dict) else None
+            if isinstance(v, dict):
+                out[name] = walk(v, l_v or {})
+            elif isinstance(l_v, dict) and "a" in l_v:
+                a = l_v["a"].astype(jnp.float32)
+                b = l_v["b"].astype(jnp.float32)
+                out[name] = (
+                    v.astype(jnp.float32)
+                    + jnp.einsum("...ir,...ro->...io", a, b) * lcfg.scale
+                ).astype(v.dtype)
+            else:
+                out[name] = v
+        return out
+
+    return walk(params, lora_tree)

@@ -304,9 +304,10 @@ class LLMEngine:
         if mesh is not None:
             self._shard_cache(self.cache)
         # what will ACTUALLY run for these shapes on this backend — a
-        # requested pallas impl can be shape-downgraded (GQA Hkv<16,
-        # sub-128 head_dim); record it so benches/metrics report the real
-        # path instead of the requested one (ADVICE r4)
+        # requested pallas impl can be shape-downgraded (sub-128 head_dim /
+        # unaligned page_size; GQA runs the "grouped" ragged variant since
+        # round 5); record it so benches/metrics report the real path
+        # instead of the requested one (ADVICE r4)
         self.impl_plan = llama.paged_impl_plan(
             cfg, page_size, self.paged_impl, self.scatter_impl
         )

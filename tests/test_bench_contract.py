@@ -32,6 +32,28 @@ def test_bench_emits_schema_json():
     assert payload["unit"] == "tok/s"
 
 
+@pytest.mark.slow
+def test_image_child_emits_schema_json():
+    """The images/sec secondary metric (BASELINE.json: 'SDXL images/sec'):
+    the txt2img pipeline child must print one JSON line; the tiny CPU
+    path-proof must never claim the SD baseline."""
+    out = subprocess.run(
+        [sys.executable, str(REPO / "bench.py"), "--child-image"],
+        capture_output=True,
+        text=True,
+        timeout=500,
+        env={**os.environ, "BENCH_CPU": "1", "BENCH_IMAGE_TINY": "1"},
+        cwd=str(REPO),
+    )
+    assert out.returncode == 0, out.stderr[-500:]
+    lines = [l for l in out.stdout.strip().splitlines() if l.strip()]
+    payload = json.loads(lines[-1])
+    assert payload["unit"] == "img/s"
+    assert payload["value"] > 0
+    assert payload["vs_baseline"] == 0.0  # tiny path-proof: no baseline claim
+    assert payload["sec_per_image"] > 0
+
+
 def test_bench_supervisor_degrades_on_bad_model():
     """An impossible child must yield the error JSON line, not a hang."""
     out = subprocess.run(

@@ -368,3 +368,131 @@ class TestControlNet:
         in_mean = float(bright[m].mean())
         out_mean = float(bright[~m].mean())
         assert in_mean > out_mean + 0.25, (in_mean, out_mean)
+
+
+class TestDiffusionLoRA:
+    """Dreambooth analog (diffusers_lora_finetune.py): subject
+    personalization via adapters on the MMDiT attention/MLP projections —
+    adapter-only training must move the model's denoising toward the
+    subject while the base weights stay bitwise frozen."""
+
+    def _pretrained(self, jax):
+        import jax.numpy as jnp
+        import optax
+
+        from modal_examples_tpu.models import diffusion
+
+        cfg = diffusion.MMDiTConfig(
+            img_size=16, channels=8, patch=2, dim=128, n_layers=2,
+            n_heads=4, text_dim=32, pooled_dim=32,
+        )
+        base = diffusion.mmdit_init(jax.random.PRNGKey(0), cfg)
+        # dreambooth personalizes a PRETRAINED model — and the raw tree
+        # couldn't learn through adapters anyway: its output head is
+        # adaLN-zero (final_proj == 0) and adapters never touch it.
+        opt = optax.adam(2e-3)
+        o = opt.init(base)
+
+        @jax.jit
+        def prestep(params, o, key):
+            k1, k2 = jax.random.split(key)
+            lat = jnp.tanh(
+                jax.random.normal(
+                    k1, (8, cfg.img_size, cfg.img_size, cfg.channels)
+                )
+            )
+            loss, g = jax.value_and_grad(diffusion.mmdit_flow_loss)(
+                params, k2, lat, jnp.zeros((8, 4, cfg.text_dim)),
+                jnp.zeros((8, cfg.pooled_dim)), cfg,
+            )
+            upd, o = opt.update(g, o)
+            return optax.apply_updates(params, upd), o, loss
+
+        for i in range(300):
+            base, o, _ = prestep(base, o, jax.random.PRNGKey(100 + i))
+        return cfg, base
+
+    def test_adapter_training_personalizes_denoising(self, jax):
+        import jax.numpy as jnp
+        import optax
+
+        from modal_examples_tpu.models import diffusion, lora
+
+        cfg, base = self._pretrained(jax)
+        base_snapshot = [np.asarray(x).copy() for x in jax.tree.leaves(base)]
+
+        lcfg = lora.LoRAConfig(rank=16, alpha=32.0, targets=lora.DIT_TARGETS)
+        adapters = lora.init_lora_tree(jax.random.PRNGKey(1), base, lcfg)
+        n_ad = lora.param_count(adapters)
+        n_base = sum(x.size for x in jax.tree.leaves(base))
+        assert 0 < n_ad < n_base * 0.5, (n_ad, n_base)
+
+        # the "subject" bound to a subject-token embedding (the sks-token
+        # recipe at demo scale)
+        subject = jnp.tanh(
+            jax.random.normal(
+                jax.random.PRNGKey(3), (cfg.img_size, cfg.img_size,
+                                        cfg.channels)
+            ) * 2.0
+        )
+        subj_txt = jax.random.normal(
+            jax.random.PRNGKey(4), (1, 4, cfg.text_dim)
+        )
+
+        def denoise_err(params):
+            """One-step rectified-flow denoise x_hat = x_t - t*v at fixed
+            (eps, t): the quantity personalization optimizes."""
+            t = 0.7
+            eps = jax.random.normal(jax.random.PRNGKey(77), (4, *subject.shape))
+            x_t = (1 - t) * subject[None] + t * eps
+            ts = jnp.broadcast_to(subj_txt, (4, 4, cfg.text_dim))
+            v = diffusion.mmdit_forward(
+                params, x_t, jnp.full((4,), t), ts,
+                jnp.zeros((4, cfg.pooled_dim)), cfg,
+            )
+            return float(jnp.mean((x_t - t * v - subject[None]) ** 2))
+
+        # b = 0 at init: merged tree IS the base
+        merged0 = lora.merge_tree(base, adapters, lcfg)
+        assert abs(denoise_err(merged0) - denoise_err(base)) < 1e-6
+
+        opt = optax.adam(1e-2)
+        opt_state = opt.init(adapters)
+
+        @jax.jit
+        def step(adapters, opt_state, key):
+            def loss_fn(ad):
+                merged = lora.merge_tree(base, ad, lcfg)
+                lat = jnp.broadcast_to(subject[None], (8, *subject.shape))
+                ts = jnp.broadcast_to(subj_txt, (8, 4, cfg.text_dim))
+                return diffusion.mmdit_flow_loss(
+                    merged, key, lat, ts, jnp.zeros((8, cfg.pooled_dim)), cfg
+                )
+
+            loss, g = jax.value_and_grad(loss_fn)(adapters)
+            upd, opt_state = opt.update(g, opt_state)
+            return optax.apply_updates(adapters, upd), opt_state, loss
+
+        err_before = denoise_err(base)
+        for i in range(300):
+            adapters, opt_state, _ = step(
+                adapters, opt_state, jax.random.PRNGKey(10 + i)
+            )
+        err_after = denoise_err(lora.merge_tree(base, adapters, lcfg))
+        # measured: 0.599 -> 0.238 at these settings; 0.6x is a safe gate
+        assert err_after < err_before * 0.6, (err_before, err_after)
+
+        # the base tree is untouched by adapter training
+        for leaf, ref in zip(jax.tree.leaves(base), base_snapshot):
+            np.testing.assert_array_equal(np.asarray(leaf), ref)
+
+    def test_init_lora_tree_rejects_no_match(self, jax):
+        from modal_examples_tpu.models import diffusion, lora
+
+        cfg = diffusion.MMDiTConfig.tiny()
+        base = diffusion.mmdit_init(jax.random.PRNGKey(0), cfg)
+        with pytest.raises(ValueError, match="no leaves matched"):
+            lora.init_lora_tree(
+                jax.random.PRNGKey(1), base,
+                lora.LoRAConfig(targets=("nonexistent",)),
+            )
