@@ -1,0 +1,240 @@
+# ---
+# env: {"MTPU_TRAIN_STEPS": "40"}
+# timeout: 800
+# ---
+# # Animate a user-supplied image into a video
+#
+# TPU-native counterpart of the reference's
+# 06_gpu_and_ml/image-to-video/image_to_video.py: take an IMAGE the user
+# provides (plus a prompt), animate it into a short video, and expose the
+# capability three ways like the reference does — a CLI entrypoint, a
+# callable class method, and a web API (POST /animate with a base64
+# image). The reference runs Lightricks LTX-Video through diffusers on
+# CUDA; here the generator is the framework's own latent video DiT
+# (models.video, factorized space-time attention) with the user image
+# PINNED as frame 0 at every sampling step — the same
+# conditioning-by-inpainting recipe LTX uses for its image conditioning.
+#
+# Cheap mode trains the tiny video DiT on a synthetic moving-square
+# corpus first (zero egress — no published checkpoints), then animates a
+# NEVER-SEEN user image. The conditioning proof is exact: frame 0 of the
+# output IS the input image; later frames move.
+#
+# Run: tpurun run examples/06_gpu_and_ml/image-to-video/image_to_video.py
+
+import base64
+import os
+import pickle
+
+import modal_examples_tpu as mtpu
+
+TPU = os.environ.get("MTPU_TPU", "") or None
+STEPS = int(os.environ.get("MTPU_TRAIN_STEPS", "40"))
+
+app = mtpu.App("example-image-to-video")
+weights_vol = mtpu.Volume.from_name("i2v-weights", create_if_missing=True)
+output_vol = mtpu.Volume.from_name("i2v-outputs", create_if_missing=True)
+
+TEXT_DIM, TEXT_LEN = 32, 8
+
+
+def encode_text(texts: list[str]):
+    """Toy hashed-byte text states (T5/CLIP stand-in; swap models.bert +
+    real weights in production)."""
+    import numpy as np
+
+    out = np.zeros((len(texts), TEXT_LEN, TEXT_DIM), np.float32)
+    for i, t in enumerate(texts):
+        for j, ch in enumerate(t.encode()[:TEXT_LEN]):
+            rng = np.random.default_rng(ch)
+            out[i, j] = rng.standard_normal(TEXT_DIM) * 0.5
+    return out
+
+
+def _square_video(key, cfg):
+    """Synthetic corpus: a bright square drifting across dark frames."""
+    import jax
+    import jax.numpy as jnp
+
+    S, T = cfg.img_size, cfg.frames
+    k1, k2, k3 = jax.random.split(key, 3)
+    x0 = jax.random.randint(k1, (), 0, S - 3)
+    y0 = jax.random.randint(k2, (), 0, S - 3)
+    dx = jax.random.randint(k3, (), -1, 2)
+    frames = []
+    for t in range(T):
+        xs = jnp.clip(x0 + t * dx, 0, S - 3)
+        col = jnp.arange(S)
+        mask = (
+            ((col >= xs) & (col < xs + 3))[None, :]
+            & ((col >= y0) & (col < y0 + 3))[:, None]
+        )
+        frames.append(jnp.where(mask[:, :, None], 1.0, -1.0))
+    return jnp.stack(frames)  # [T, S, S, 1]
+
+
+@app.function(tpu=TPU, volumes={"/models": weights_vol}, timeout=1800)
+def train(steps: int = STEPS) -> dict:
+    """Cheap-mode stand-in for pulling LTX weights: train the video DiT on
+    the synthetic corpus (with first-frame conditioning in the loss) and
+    publish it to the Volume."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from modal_examples_tpu.models import video
+    from modal_examples_tpu.training import Trainer, make_optimizer
+
+    if os.path.exists("/models/i2v.pkl"):
+        return {"trained": False}
+
+    cfg = video.VideoDiTConfig.tiny()
+    prompts = ["drift right", "hold still"]
+    text = jnp.asarray(encode_text(prompts))
+
+    def make_batch(key, bs=8):
+        ks = jax.random.split(key, bs + 1)
+        vids = jnp.stack([_square_video(k, cfg) for k in ks[:bs]])
+        vids = jnp.repeat(vids, cfg.channels, axis=-1)[..., : cfg.channels]
+        idx = jax.random.randint(ks[-1], (bs,), 0, len(prompts))
+        return vids, text[idx]
+
+    params = video.init_params(jax.random.PRNGKey(0), cfg)
+
+    def loss(p, batch):
+        return video.flow_loss(p, batch["rng"], batch["v"], batch["t"], cfg)
+
+    trainer = Trainer(loss, make_optimizer(2e-3))
+    state = trainer.init_state(params)
+    key = jax.random.PRNGKey(1)
+    metrics = {}
+    for _ in range(steps):
+        key, k1, k2 = jax.random.split(key, 3)
+        vids, txt = make_batch(k1)
+        state, metrics = trainer.train_step(
+            state, {"v": vids, "t": txt, "rng": k2}
+        )
+
+    with open("/models/i2v.pkl", "wb") as f:
+        pickle.dump(jax.tree.map(np.asarray, state.params), f)
+    weights_vol.commit()
+    return {"trained": True, "loss": float(metrics["loss"])}
+
+
+@app.cls(
+    tpu=TPU,
+    volumes={"/models": weights_vol, "/outputs": output_vol},
+    scaledown_window=300,
+)
+class ImageToVideo:
+    @mtpu.enter()
+    def load(self):
+        import jax
+
+        if not TPU:
+            try:
+                jax.config.update("jax_platforms", "cpu")
+            except Exception:
+                pass
+        import jax.numpy as jnp
+
+        from modal_examples_tpu.models import video
+
+        weights_vol.reload()
+        self.cfg = video.VideoDiTConfig.tiny()
+        with open("/models/i2v.pkl", "rb") as f:
+            self.params = jax.tree.map(jnp.asarray, pickle.load(f))
+        self.video = video
+        self.jax, self.jnp = jax, jnp
+
+    def _animate(self, image, prompt: str, seed: int = 0):
+        import numpy as np
+
+        jnp = self.jnp
+        img = jnp.asarray(np.asarray(image, np.float32))[None]
+        text = jnp.asarray(encode_text([prompt]))
+        out = self.video.sample(
+            self.params, self.jax.random.PRNGKey(seed), text, self.cfg,
+            first_frame=img, steps=8, guidance=2.0,
+        )
+        return np.asarray(out[0])
+
+    @mtpu.method()
+    def animate(self, image, prompt: str = "drift right", seed: int = 0):
+        """image [S, S, C] float in [-1, 1] -> video [T, S, S, C]; frame 0
+        is the input image, held fixed at every sampling step (the
+        reference pipeline's image conditioning)."""
+        return self._animate(image, prompt, seed)
+
+    @mtpu.method()
+    def animate_to_volume(self, image, prompt: str, name: str) -> dict:
+        """The reference's output-directory flow: write the result as an
+        .npz plus a film-strip PNG on the outputs Volume."""
+        import numpy as np
+
+        from modal_examples_tpu.utils.images import to_png
+
+        frames = self._animate(image, prompt)
+        np.savez_compressed(f"/outputs/{name}.npz", video=frames)
+        strip = np.concatenate(list(frames[..., :3]), axis=1)
+        with open(f"/outputs/{name}.png", "wb") as f:
+            f.write(to_png(strip))
+        output_vol.commit()
+        return {
+            "frames": int(frames.shape[0]),
+            "npz": f"{name}.npz",
+            "strip_png": f"{name}.png",
+        }
+
+
+@app.function()
+@mtpu.fastapi_endpoint(method="POST")
+def animate(image_b64: str, prompt: str = "drift right") -> dict:
+    """POST /animate {image_b64, prompt} — the reference's fastapi
+    endpoint shape (image_to_video.py `/generate`). The image is a
+    base64 .npy payload; the video comes back the same way."""
+    import io
+
+    import numpy as np
+
+    arr = np.load(io.BytesIO(base64.b64decode(image_b64)), allow_pickle=False)
+    frames = ImageToVideo().animate.remote(arr, prompt)
+    buf = io.BytesIO()
+    np.save(buf, frames)
+    return {
+        "video_b64": base64.b64encode(buf.getvalue()).decode(),
+        "frames": int(frames.shape[0]),
+    }
+
+
+@app.local_entrypoint()
+def main(prompt: str = "drift right"):
+    import numpy as np
+
+    print("train:", train.remote())
+
+    # a NEVER-SEEN user image: square at a position the corpus RNG never
+    # produced, plus a corner notch
+    from modal_examples_tpu.models.video import VideoDiTConfig
+
+    cfg = VideoDiTConfig.tiny()
+    S = cfg.img_size
+    img = -np.ones((S, S, cfg.channels), np.float32)
+    img[2:5, 9:12] = 1.0
+    img[0, 0] = 0.5
+
+    i2v = ImageToVideo()
+    frames = i2v.animate.remote(img, prompt)
+    assert frames.shape == (cfg.frames, S, S, cfg.channels), frames.shape
+    # exact conditioning: frame 0 IS the input image
+    np.testing.assert_array_equal(frames[0], img.astype(frames.dtype))
+    # and the video actually moves: later frames differ from frame 0
+    deltas = [float(np.abs(frames[t] - frames[0]).mean()) for t in range(1, cfg.frames)]
+    assert max(deltas) > 0.01, deltas
+    assert np.isfinite(frames).all()
+    print(f"animated: {frames.shape}, mean frame-0 delta {deltas}")
+
+    out = i2v.animate_to_volume.remote(img, prompt, "demo")
+    print("volume outputs:", out)
+    assert out["frames"] == cfg.frames
+    print("image-to-video: conditioning exact, motion present, outputs saved")

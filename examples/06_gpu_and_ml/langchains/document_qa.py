@@ -1,0 +1,223 @@
+# ---
+# timeout: 700
+# ---
+# # Retrieval-augmented document Q&A with sources
+#
+# TPU-native counterpart of the reference's
+# 06_gpu_and_ml/langchains/potus_speech_qanda.py: ingest one document,
+# chunk it, embed the chunks into a vector index, and answer questions by
+# retrieving the top-k chunks and generating an answer that cites them.
+# The reference wires LangChain + FAISS + the OpenAI API; here every
+# stage is the framework's own machinery:
+#
+# - chunking: plain Python (the RecursiveCharacterTextSplitter analog);
+# - embeddings: models.bert (the TEI/BGE analog), L2-normalized;
+# - index: an [N, D] matrix on a Volume — top-k is ONE matvec, the
+#   MXU-shaped exact search (see embeddings/vector_search.py);
+# - answering: the continuous-batching LLMEngine with the retrieved
+#   chunks packed into the prompt, sources returned alongside.
+#
+# Like the reference it exposes both a CLI entrypoint (--query) and a web
+# endpoint (GET /qanda?query=...). Zero egress: the "speech" is inline,
+# and cheap mode runs tiny random-weight models — retrieval quality
+# assertions are by construction (token overlap with mean pooling), and
+# swapping in real BGE + Llama checkpoints via model_dir changes no code.
+#
+# Run: tpurun run examples/06_gpu_and_ml/langchains/document_qa.py \
+#        --query "How many oil barrels were released from reserves?"
+
+import os
+import pickle
+
+import modal_examples_tpu as mtpu
+
+TPU = os.environ.get("MTPU_TPU", "") or None
+
+app = mtpu.App("example-document-qa")
+index_vol = mtpu.Volume.from_name("document-qa-index", create_if_missing=True)
+
+# the knowledge base: one address, distinct facts per paragraph (the
+# reference scrapes the 2022 State of the Union; zero egress keeps it
+# inline — same single-document shape)
+DOCUMENT = """
+Tonight I can announce that the United States has worked with thirty
+countries to release sixty million barrels of oil from reserves around
+the world.
+
+We are providing more than one billion dollars in direct assistance to
+Ukraine and will continue to aid the Ukrainian people as they defend
+their country.
+
+The American Rescue Plan helped create over six million new jobs last
+year, more jobs created in one year than ever before in the history of
+our country.
+
+Our infrastructure law will rebuild four thousand miles of highway and
+repair ten thousand bridges across the nation over the coming decade.
+
+We will cut the cost of insulin so that no family pays more than
+thirty five dollars a month for the medicine their loved ones need.
+
+I am announcing a crackdown on shipping companies that overcharge
+American businesses and consumers, cutting ocean freight costs.
+
+Tonight we launch a new initiative to end cancer as we know it, aiming
+to cut cancer death rates by half over the next twenty five years.
+"""
+
+
+def chunk_document(text: str, max_chars: int = 240) -> list[str]:
+    """Paragraph-first splitting with a size cap — the text-splitter
+    stage of the reference chain."""
+    chunks = []
+    for para in text.split("\n\n"):
+        para = " ".join(para.split())
+        if not para:
+            continue
+        while len(para) > max_chars:
+            cut = para.rfind(" ", 0, max_chars)
+            cut = cut if cut > 0 else max_chars
+            chunks.append(para[:cut])
+            para = para[cut:].strip()
+        chunks.append(para)
+    return chunks
+
+
+def _embedder():
+    """models.bert mean-pooled normalized sentence embeddings (cheap mode:
+    tiny random weights — see embeddings/vector_search.py for why mean
+    pooling keeps that discriminative; real BGE loads via
+    bert.load_hf_weights with identical code)."""
+    import dataclasses
+
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from modal_examples_tpu.models import bert
+    from modal_examples_tpu.utils.tokenizer import load_tokenizer
+
+    cfg = dataclasses.replace(bert.BertConfig.tiny(), pooling="mean")
+    params = bert.init_params(jax.random.PRNGKey(0), cfg)
+    tok = load_tokenizer(None)
+    embed = jax.jit(lambda t, m: bert.embed(params, t, m, cfg))
+
+    def encode(texts: list[str], max_len: int = 256):
+        ids, mask = tok.encode_batch(texts, max_len)
+        ids = np.asarray(ids) % cfg.vocab_size
+        return np.asarray(embed(jnp.asarray(ids), jnp.asarray(mask)))
+
+    return encode
+
+
+@app.function(tpu=TPU, volumes={"/index": index_vol}, timeout=600)
+def ingest() -> dict:
+    """Chunk + embed the document into the Volume index (the reference's
+    scrape -> split -> FAISS.from_texts stage)."""
+    chunks = chunk_document(DOCUMENT)
+    vecs = _embedder()(chunks)
+    with open("/index/index.pkl", "wb") as f:
+        pickle.dump({"vectors": vecs, "chunks": chunks}, f)
+    index_vol.commit()
+    return {"chunks": len(chunks), "dim": int(vecs.shape[1])}
+
+
+@app.cls(tpu=TPU, volumes={"/index": index_vol}, scaledown_window=300)
+class DocQA:
+    @mtpu.enter()
+    def load(self):
+        import jax
+
+        if not TPU:
+            try:
+                jax.config.update("jax_platforms", "cpu")
+            except Exception:
+                pass
+        import jax.numpy as jnp
+
+        from modal_examples_tpu.models import llama
+        from modal_examples_tpu.serving import LLMEngine
+
+        index_vol.reload()
+        with open("/index/index.pkl", "rb") as f:
+            idx = pickle.load(f)
+        self.vectors = idx["vectors"]
+        self.chunks = idx["chunks"]
+        self.encode = _embedder()
+        # cheap mode: tiny random-weight llama; production passes
+        # model_dir= / a MODEL_PRESETS name exactly like the llm-serving
+        # examples (the chain does not care which)
+        self.engine = LLMEngine(
+            llama.LlamaConfig.tiny(),
+            max_slots=2, max_model_len=512, page_size=16,
+            prefill_buckets=(128, 256, 512), kv_dtype=jnp.float32,
+        )
+        self.engine.start()
+
+    @mtpu.method()
+    def answer(self, query: str, k: int = 3, max_tokens: int = 48) -> dict:
+        """Retrieve top-k chunks, answer with sources — the reference's
+        RetrievalQA.from_chain_type(..., return_source_documents=True)."""
+        import numpy as np
+
+        from modal_examples_tpu.serving import SamplingParams
+
+        q = self.encode([query])[0]
+        scores = self.vectors @ q
+        top = np.argsort(-scores)[:k]
+        sources = [
+            {"id": int(i), "score": float(scores[i]), "text": self.chunks[i]}
+            for i in top
+        ]
+        context = "\n".join(f"[{n + 1}] {s['text']}" for n, s in enumerate(sources))
+        prompt = (
+            "Answer the question using only the sources; cite like [1].\n"
+            f"Sources:\n{context}\nQuestion: {query}\nAnswer:"
+        )
+        req = self.engine.submit(
+            prompt, SamplingParams(max_tokens=max_tokens, temperature=0.0)
+        )
+        return {"answer": "".join(self.engine.stream(req)), "sources": sources}
+
+
+@app.function()
+@mtpu.fastapi_endpoint()
+def qanda(query: str, k: int = 3) -> dict:
+    """GET /qanda?query=... — the reference's web_endpoint shape
+    (potus_speech_qanda.py `web`)."""
+    return DocQA().answer.remote(query, int(k))
+
+
+@app.local_entrypoint()
+def main(query: str = "How many oil barrels were released from reserves?"):
+    print("ingest:", ingest.remote())
+    qa = DocQA()
+
+    result = qa.answer.remote(query)
+    print(f"Q: {query}")
+    print("A:", result["answer"][:200])
+    for s in result["sources"]:
+        print(f"   [{s['id']}] {s['score']:.3f} {s['text'][:70]}...")
+    # retrieval correctness (by construction in cheap mode: token overlap)
+    assert any("barrels" in s["text"] for s in result["sources"]), result
+
+    spot_checks = [
+        ("What will the infrastructure law rebuild?", "highway"),
+        ("What is the monthly cap on insulin costs?", "insulin"),
+        ("How many jobs did the American Rescue Plan create?", "jobs"),
+        ("What happens to shipping companies that overcharge?", "shipping"),
+    ]
+    for q, must_cite in spot_checks:
+        r = qa.answer.remote(q)
+        # cheap mode runs a RANDOM-weight tiny llama: the generated text is
+        # noise (can even decode to ""), so the contract checked here is
+        # the CHAIN — retrieval cites the right evidence and the request
+        # completes; answer quality needs real checkpoints (model_dir=)
+        assert "answer" in r, r
+        assert any(must_cite in s["text"] for s in r["sources"]), (q, r["sources"])
+        print(f"ok: {q!r} -> cites a chunk containing {must_cite!r}")
+    # different questions retrieve different evidence
+    a = qa.answer.remote(spot_checks[0][0])["sources"][0]["id"]
+    b = qa.answer.remote(spot_checks[1][0])["sources"][0]["id"]
+    assert a != b, (a, b)
+    print("document QA chain: ingest -> retrieve -> cite -> answer all green")

@@ -332,15 +332,15 @@ class _Container:
         # persistent XLA compile cache for every container (jax reads the
         # env var natively, keeping core/ jax-free); MTPU_COMPILE_CACHE=0
         # opts out, a path overrides (utils/compile_cache.py is the policy)
-        cache = os.environ.get("MTPU_COMPILE_CACHE", "")
-        if cache.lower() not in ("0", "off", "none"):
-            env.setdefault(
-                "JAX_COMPILATION_CACHE_DIR",
-                cache
-                or str(
-                    Path.home() / ".cache" / "modal_examples_tpu" / "xla-cache"
-                ),
-            )
+        # cache_dir() is jax-free (jax only loads inside
+        # enable_compile_cache), so core/ stays jax-free importing it; it
+        # also segments the default path by host-CPU fingerprint so foreign
+        # AOT entries never load (SIGILL warnings)
+        from ..utils.compile_cache import cache_dir as _cache_dir
+
+        cache = _cache_dir()
+        if cache is not None:
+            env.setdefault("JAX_COMPILATION_CACHE_DIR", cache)
             env.setdefault("JAX_PERSISTENT_CACHE_MIN_COMPILE_TIME_SECS", "0.5")
             env.setdefault("JAX_PERSISTENT_CACHE_MIN_ENTRY_SIZE_BYTES", "0")
         env.update(self.extra_env)

@@ -25,6 +25,34 @@ from pathlib import Path
 _DISABLED = ("0", "off", "none")
 
 
+import functools
+
+
+@functools.lru_cache(maxsize=1)
+def _machine_tag() -> str:
+    """Short fingerprint of the host CPU. XLA:CPU AOT entries bake in the
+    compile machine's feature set; loading them on a different microarch
+    logs 'could lead to execution errors such as SIGILL' per entry (seen
+    when this image migrated hosts between rounds). Segmenting the default
+    cache dir by CPU features keeps foreign AOT results out. Covers x86
+    ('flags', 'model name') and arm ('Features', 'CPU part') cpuinfo keys."""
+    import hashlib
+    import platform
+
+    parts = set()
+    try:
+        with open("/proc/cpuinfo") as f:
+            for line in f:
+                key = line.split(":", 1)[0].strip()
+                if key in ("flags", "Features", "model name", "CPU part"):
+                    parts.add(" ".join(line.split(":", 1)[1].split()))
+    except OSError:
+        pass
+    return hashlib.md5(
+        (platform.machine() + ":" + "|".join(sorted(parts))).encode()
+    ).hexdigest()[:8]
+
+
 def cache_dir() -> str | None:
     """The resolved cache directory, or None when disabled."""
     env = os.environ.get("MTPU_COMPILE_CACHE", "")
@@ -32,7 +60,10 @@ def cache_dir() -> str | None:
         return None
     if env:
         return env
-    return str(Path.home() / ".cache" / "modal_examples_tpu" / "xla-cache")
+    return str(
+        Path.home() / ".cache" / "modal_examples_tpu"
+        / f"xla-cache-{_machine_tag()}"
+    )
 
 
 def enable_compile_cache(path: str | None = None) -> str | None:

@@ -36,10 +36,10 @@ os.environ.setdefault("MTPU_ENGINE_STRICT", "1")
 # Persistent XLA compile cache (utils/compile_cache.py): the suite is
 # compile-bound on CPU, so warm runs trade recompiles for disk hits. jax
 # reads these env vars natively, including in executor child processes.
-if os.environ.get("MTPU_COMPILE_CACHE", "").lower() not in ("0", "off", "none"):
-    _cache = os.environ.get("MTPU_COMPILE_CACHE") or str(
-        Path.home() / ".cache" / "modal_examples_tpu" / "xla-cache"
-    )
+from modal_examples_tpu.utils.compile_cache import cache_dir as _cache_dir
+
+_cache = _cache_dir()  # None = disabled via MTPU_COMPILE_CACHE; owns policy
+if _cache is not None:
     Path(_cache).mkdir(parents=True, exist_ok=True)
     os.environ.setdefault("JAX_COMPILATION_CACHE_DIR", _cache)
     os.environ.setdefault("JAX_PERSISTENT_CACHE_MIN_COMPILE_TIME_SECS", "0.5")
