@@ -1,0 +1,95 @@
+"""TSAN/ASAN job for the C++ host library (PARITY.md §5.2).
+
+The reference's native components (vLLM C++ scheduler, TEI) rely on CI
+sanitizer runs; this is the framework's equivalent for
+native/mtpu_host.cpp: build the sanitizer harness
+(native/mtpu_host_test.cpp — every entry point, allocator under 8-thread
+contention) under AddressSanitizer+UBSan and ThreadSanitizer, run it, and
+require a clean exit with zero sanitizer reports.
+"""
+
+from __future__ import annotations
+
+import shutil
+import subprocess
+import sys
+from pathlib import Path
+
+import pytest
+
+pytestmark = pytest.mark.slow  # compiles twice; excluded from the fast tier
+
+NATIVE = Path(__file__).resolve().parent.parent / "modal_examples_tpu" / "native"
+SOURCES = [str(NATIVE / "mtpu_host.cpp"), str(NATIVE / "mtpu_host_test.cpp")]
+
+
+def _sanitizer_supported(tmp_path: Path, sanitize: str) -> bool:
+    """Probe the toolchain with a trivial TU so 'sanitizer runtime not
+    installed' skips but a REAL compile error in mtpu_host.cpp fails."""
+    probe = tmp_path / "probe.cpp"
+    probe.write_text("int main() { return 0; }\n")
+    r = subprocess.run(
+        ["g++", f"-fsanitize={sanitize}", str(probe), "-o",
+         str(tmp_path / "probe")],
+        capture_output=True, text=True, timeout=120,
+    )
+    return r.returncode == 0
+
+
+def _build_and_run(tmp_path: Path, name: str, sanitize: str, env: dict) -> str:
+    if shutil.which("g++") is None:
+        pytest.skip("g++ unavailable")
+    if not _sanitizer_supported(tmp_path, sanitize):
+        pytest.skip(f"toolchain lacks -fsanitize={sanitize}")
+    exe = tmp_path / name
+    build = subprocess.run(
+        ["g++", "-O1", "-g", f"-fsanitize={sanitize}", "-std=c++17",
+         *SOURCES, "-o", str(exe)],
+        capture_output=True, text=True, timeout=180,
+    )
+    # the toolchain probe passed, so a failure here is a genuine compile
+    # error in the sources — fail loudly, never skip
+    assert build.returncode == 0, build.stderr[-2000:]
+    run = subprocess.run(
+        [str(exe)], capture_output=True, text=True, timeout=300, env=env
+    )
+    out = run.stdout + run.stderr
+    assert run.returncode == 0, out[-2000:]
+    assert "mtpu_host sanitizer harness: OK" in out
+    return out
+
+
+def test_asan_ubsan_clean(tmp_path):
+    out = _build_and_run(
+        tmp_path, "mtpu_asan", "address,undefined",
+        env={"ASAN_OPTIONS": "detect_leaks=1", "PATH": "/usr/bin:/bin"},
+    )
+    assert "AddressSanitizer" not in out, out[-2000:]
+    assert "runtime error" not in out, out[-2000:]  # UBSan report marker
+
+
+def test_tsan_clean(tmp_path):
+    out = _build_and_run(
+        tmp_path, "mtpu_tsan", "thread",
+        env={"TSAN_OPTIONS": "halt_on_error=1", "PATH": "/usr/bin:/bin"},
+    )
+    assert "ThreadSanitizer" not in out, out[-2000:]
+
+
+def test_harness_covers_every_export():
+    """Every symbol mtpu_host.cpp exports must be CALLED in the harness
+    body (not merely declared in its extern block) — a new entry point
+    can't land unsanitized."""
+    import re
+
+    src = (NATIVE / "mtpu_host.cpp").read_text()
+    harness = (NATIVE / "mtpu_host_test.cpp").read_text()
+    exports = set(re.findall(r"^\w[\w\s\*]*?\b(mtpu_\w+)\s*\(", src, re.M))
+    assert exports, "no exports found — regex drifted?"
+    # drop the harness's own extern "C" declaration block, then require a
+    # call site for each export in what remains
+    body = re.sub(r'extern "C" \{.*?\n\}', "", harness, flags=re.S)
+    missing = {
+        e for e in exports if not re.search(rf"\b{e}\s*\(", body)
+    }
+    assert not missing, f"harness never calls: {missing}"
