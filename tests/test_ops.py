@@ -224,24 +224,43 @@ class TestPagedAttention:
                     err_msg=f"Hq={Hq} Hkv={Hkv} variant={variant}",
                 )
 
-    def test_decode_step_pallas_structure_matches_xla(self, jax, jnp):
+    @pytest.mark.parametrize(
+        "shape, positions",
+        [
+            ("mha-tiny", (9, 21)),  # LlamaConfig.tiny: Hq == n_kv_heads path
+            ("gqa-g4", (0, 17, 40)),  # llama-3.1 shape class, incl. fresh slot
+        ],
+    )
+    def test_decode_step_pallas_structure_matches_xla(
+        self, jax, jnp, shape, positions
+    ):
         """decode_step(impl='pallas') (ragged-kernel read-only structure)
-        must produce the same logits and cache writes as the default path."""
+        must produce the same logits and cache writes as the default path —
+        at MHA-style shapes AND GQA (G=4), where paged_impl_plan
+        auto-selects the round-5 grouped variant."""
         from modal_examples_tpu.models import llama
 
-        cfg = llama.LlamaConfig.tiny()
-        params = llama.init_params(jax.random.PRNGKey(0), cfg)
-        B, ps, pp = 2, 16, 4
+        if shape == "mha-tiny":
+            cfg = llama.LlamaConfig.tiny()
+        else:
+            cfg = llama.LlamaConfig(
+                vocab_size=256, dim=64, n_layers=2, n_heads=8, n_kv_heads=2,
+                ffn_dim=128, max_seq_len=128, dtype="float32",
+            )
+            plan = llama.paged_impl_plan(cfg, 16, "pallas", "xla")
+            assert plan["ragged_variant"] == "grouped", plan
+        params = llama.init_params(jax.random.PRNGKey(4), cfg)
+        B, ps, pp = len(positions), 16, 4
         n_pages = 1 + B * pp
-        kp = jnp.zeros((cfg.n_layers, n_pages, ps, cfg.n_kv_heads,
-                        cfg.head_dim), jnp.float32)
-        vp = jnp.zeros_like(kp)
-        # decode a few tokens with each impl from identical starting caches
-        tables = jnp.asarray(
-            1 + np.arange(B * pp).reshape(B, pp), jnp.int32
-        )
-        toks = jnp.asarray([3, 7], jnp.int32)
-        pos = jnp.asarray([9, 21], jnp.int32)
+        kp = jax.random.normal(
+            jax.random.PRNGKey(5),
+            (cfg.n_layers, n_pages, ps, cfg.n_kv_heads, cfg.head_dim),
+            jnp.float32,
+        ) * 0.1
+        vp = jax.random.normal(jax.random.PRNGKey(6), kp.shape, jnp.float32) * 0.1
+        tables = jnp.asarray(1 + np.arange(B * pp).reshape(B, pp), jnp.int32)
+        toks = jnp.asarray(np.arange(3, 3 + B), jnp.int32)
+        pos = jnp.asarray(positions, jnp.int32)
         active = jnp.ones((B,), bool)
         outs = {}
         for impl in ("xla", "pallas"):
@@ -249,11 +268,8 @@ class TestPagedAttention:
                 params, toks, pos, kp, vp, tables, active, cfg, impl=impl
             )
             outs[impl] = (np.asarray(lg), np.asarray(k2), np.asarray(v2))
-        np.testing.assert_allclose(
-            outs["xla"][0], outs["pallas"][0], atol=3e-5
-        )
-        np.testing.assert_allclose(outs["xla"][1], outs["pallas"][1], atol=3e-5)
-        np.testing.assert_allclose(outs["xla"][2], outs["pallas"][2], atol=3e-5)
+        for a, b in zip(outs["xla"], outs["pallas"]):
+            np.testing.assert_allclose(a, b, atol=3e-5)
 
     def test_decode_step_writeback_matches_default(self, jax, jnp):
         """The write-then-attend A/B structure (impl='xla-writeback') must
