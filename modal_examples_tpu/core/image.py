@@ -113,6 +113,14 @@ class Image:
 
     # -- introspection / application ---------------------------------------
 
+    def export_oci(self, dest: str, *, tag: str = "latest") -> dict:
+        """Serialize as a spec-valid OCI image layout at ``dest`` (local
+        content becomes real layer blobs; network steps become provenance
+        history). See :mod:`modal_examples_tpu.core.oci`."""
+        from .oci import export_oci
+
+        return export_oci(self, dest, tag=tag)
+
     @property
     def layers(self) -> tuple[ImageLayer, ...]:
         return self._layers

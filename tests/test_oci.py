@@ -1,0 +1,184 @@
+"""OCI image-layout export (core/oci.py) — the offline analog of the
+reference's server-side image builder (02_building_containers).
+
+Validates against the opencontainers image-spec with our own parser:
+blob digests match contents, diff_ids hash the uncompressed tars,
+manifests/config parse and cross-reference, local-content layers
+round-trip through extraction, and the whole layout is deterministic.
+"""
+
+from __future__ import annotations
+
+import gzip
+import hashlib
+import io
+import json
+import tarfile
+from pathlib import Path
+
+import modal_examples_tpu as mtpu
+
+
+def _build_image(tmp_path: Path):
+    src = tmp_path / "srcdir"
+    src.mkdir()
+    (src / "model.txt").write_text("weights v1")
+    (src / "sub").mkdir()
+    (src / "sub" / "cfg.json").write_text('{"a": 1}')
+    single = tmp_path / "start.sh"
+    single.write_text("#!/bin/sh\necho hi\n")
+    return (
+        mtpu.Image.debian_slim("3.12")
+        .apt_install("curl")
+        .pip_install("jax[tpu]")
+        .env({"MODEL": "llama", "PRECISION": "bf16"})
+        .add_local_dir(str(src), "/assets")
+        .add_local_file(str(single), "/start.sh")
+        .workdir("/app")
+        .entrypoint(["/start.sh"])
+    )
+
+
+def _read_blob(dest: Path, digest: str) -> bytes:
+    algo, hexd = digest.split(":")
+    data = (dest / "blobs" / algo / hexd).read_bytes()
+    assert hashlib.sha256(data).hexdigest() == hexd  # content-addressed
+    return data
+
+
+def test_layout_is_spec_valid_and_digests_check(tmp_path):
+    img = _build_image(tmp_path)
+    dest = tmp_path / "oci"
+    summary = img.export_oci(str(dest), tag="v1")
+
+    assert json.loads((dest / "oci-layout").read_text()) == {
+        "imageLayoutVersion": "1.0.0"
+    }
+    index = json.loads((dest / "index.json").read_text())
+    (mdesc,) = index["manifests"]
+    assert mdesc["annotations"]["org.opencontainers.image.ref.name"] == "v1"
+    manifest = json.loads(_read_blob(dest, mdesc["digest"]))
+    assert mdesc["size"] == len(_read_blob(dest, mdesc["digest"]))
+    assert summary["manifest_digest"] == mdesc["digest"]
+
+    config = json.loads(_read_blob(dest, manifest["config"]["digest"]))
+    # config carries env/workdir/entrypoint
+    assert "MODEL=llama" in config["config"]["Env"]
+    assert config["config"]["WorkingDir"] == "/app"
+    assert config["config"]["Entrypoint"] == ["/start.sh"]
+    # two content layers (dir + file); diff_ids hash the UNCOMPRESSED tar
+    assert len(manifest["layers"]) == 2
+    assert len(config["rootfs"]["diff_ids"]) == 2
+    for ldesc, diff_id in zip(manifest["layers"], config["rootfs"]["diff_ids"]):
+        gz_bytes = _read_blob(dest, ldesc["digest"])
+        assert ldesc["size"] == len(gz_bytes)
+        tar_bytes = gzip.decompress(gz_bytes)
+        assert (
+            "sha256:" + hashlib.sha256(tar_bytes).hexdigest() == diff_id
+        )
+    # network steps preserved as empty_layer provenance
+    empties = [h for h in config["history"] if h.get("empty_layer")]
+    assert any("APT" in h["created_by"] for h in empties)
+    assert any("PIP" in h["created_by"] for h in empties)
+    # content layers count == non-empty history entries
+    assert len(config["history"]) - len(empties) == 2
+
+
+def test_layer_contents_roundtrip(tmp_path):
+    img = _build_image(tmp_path)
+    dest = tmp_path / "oci"
+    img.export_oci(str(dest))
+    index = json.loads((dest / "index.json").read_text())
+    manifest = json.loads(_read_blob(dest, index["manifests"][0]["digest"]))
+    files: dict[str, bytes] = {}
+    for ldesc in manifest["layers"]:
+        tar_bytes = gzip.decompress(_read_blob(dest, ldesc["digest"]))
+        with tarfile.open(fileobj=io.BytesIO(tar_bytes)) as tf:
+            for m in tf.getmembers():
+                if m.isfile():
+                    files[m.name] = tf.extractfile(m).read()
+    assert files["assets/model.txt"] == b"weights v1"
+    assert json.loads(files["assets/sub/cfg.json"]) == {"a": 1}
+    assert files["start.sh"].startswith(b"#!/bin/sh")
+
+
+def test_export_is_deterministic(tmp_path):
+    img = _build_image(tmp_path)
+    s1 = img.export_oci(str(tmp_path / "a"))
+    s2 = img.export_oci(str(tmp_path / "b"))
+    assert s1 == s2  # identical digests: content-addressed build cache
+    assert (tmp_path / "a" / "index.json").read_bytes() == (
+        tmp_path / "b" / "index.json"
+    ).read_bytes()
+
+
+def test_exec_bit_preserved(tmp_path):
+    """An executable entrypoint script must stay executable in the layer
+    tar or `podman run` would fail with permission denied."""
+    import os
+
+    script = tmp_path / "run.sh"
+    script.write_text("#!/bin/sh\n")
+    script.chmod(0o755)
+    plain = tmp_path / "data.txt"
+    plain.write_text("x")
+    img = (
+        mtpu.Image.debian_slim()
+        .add_local_file(str(script), "/run.sh")
+        .add_local_file(str(plain), "/data.txt")
+    )
+    dest = tmp_path / "oci"
+    img.export_oci(str(dest))
+    index = json.loads((dest / "index.json").read_text())
+    manifest = json.loads(_read_blob(dest, index["manifests"][0]["digest"]))
+    modes = {}
+    for ldesc in manifest["layers"]:
+        tar_bytes = gzip.decompress(_read_blob(dest, ldesc["digest"]))
+        with tarfile.open(fileobj=io.BytesIO(tar_bytes)) as tf:
+            for m in tf.getmembers():
+                modes[m.name] = m.mode
+    assert modes["run.sh"] == 0o755
+    assert modes["data.txt"] == 0o644
+
+
+def test_missing_local_path_raises(tmp_path):
+    img = mtpu.Image.debian_slim().add_local_file(
+        str(tmp_path / "nope.bin"), "/model.bin"
+    )
+    import pytest
+
+    with pytest.raises(FileNotFoundError, match="does not exist"):
+        img.export_oci(str(tmp_path / "oci"))
+
+
+def test_no_content_image_gets_scratch_layer(tmp_path):
+    """image-spec manifests need >= 1 layer; a pure-recipe chain exports
+    an empty scratch layer rather than an invalid empty manifest."""
+    img = mtpu.Image.debian_slim().env({"A": "b"}).pip_install("jax")
+    dest = tmp_path / "oci"
+    summary = img.export_oci(str(dest))
+    assert summary["n_layers"] == 1
+    index = json.loads((dest / "index.json").read_text())
+    manifest = json.loads(_read_blob(dest, index["manifests"][0]["digest"]))
+    config = json.loads(_read_blob(dest, manifest["config"]["digest"]))
+    assert len(config["rootfs"]["diff_ids"]) == 1
+    tar_bytes = gzip.decompress(
+        _read_blob(dest, manifest["layers"][0]["digest"])
+    )
+    with tarfile.open(fileobj=io.BytesIO(tar_bytes)) as tf:
+        assert tf.getnames() == []  # scratch: valid, empty
+
+
+def test_python_source_layer(tmp_path):
+    img = mtpu.Image.debian_slim().add_local_python_source("json")
+    dest = tmp_path / "oci"
+    summary = img.export_oci(str(dest))
+    assert summary["n_layers"] == 1
+    index = json.loads((dest / "index.json").read_text())
+    manifest = json.loads(_read_blob(dest, index["manifests"][0]["digest"]))
+    tar_bytes = gzip.decompress(
+        _read_blob(dest, manifest["layers"][0]["digest"])
+    )
+    with tarfile.open(fileobj=io.BytesIO(tar_bytes)) as tf:
+        names = tf.getnames()
+    assert any(n.startswith("root/json") for n in names), names
