@@ -1,0 +1,264 @@
+# ---
+# env: {"MTPU_PRETRAIN_STEPS": "250", "MTPU_LORA_STEPS": "200"}
+# timeout: 900
+# ---
+# # LoRA playground: adapters in a bucket, chosen per request
+#
+# TPU-native counterpart of the reference's
+# 10_integrations/cloud_bucket_mount_loras.py ("LoRAs Galore"): a bucket
+# holds a library of LoRA adapters; the inference service mounts the
+# bucket, loads the adapter the REQUEST names, applies it to the shared
+# base diffusion model, and generates. Same architecture, framework
+# pieces: CloudBucketMount over the from-scratch GCS client (fake-GCS
+# server backend in this zero-egress demo), the generic tree-LoRA
+# (models.lora) on the MMDiT, and a web endpoint for the playground.
+#
+# The reference pulls published SDXL adapters from HuggingFace into S3;
+# here the "library" is two subject adapters personalized on-the-spot
+# (the dreambooth example's recipe) and pushed to the bucket — the
+# serving path (mount -> pick adapter -> merge -> generate) is identical.
+#
+# Run: tpurun run examples/10_integrations/lora_playground.py
+
+import io
+import os
+import pickle
+
+import modal_examples_tpu as mtpu
+
+TPU = os.environ.get("MTPU_TPU", "") or None
+PRETRAIN_STEPS = int(os.environ.get("MTPU_PRETRAIN_STEPS", "250"))
+LORA_STEPS = int(os.environ.get("MTPU_LORA_STEPS", "200"))
+
+app = mtpu.App("example-lora-playground")
+base_vol = mtpu.Volume.from_name("lora-playground-base", create_if_missing=True)
+
+SUBJECTS = ("sks-crystal", "sks-lava")  # the adapter library
+
+
+def _cfg():
+    from modal_examples_tpu.models import diffusion
+
+    return diffusion.MMDiTConfig(
+        img_size=16, channels=8, patch=2, dim=128, n_layers=2, n_heads=4,
+        text_dim=32, pooled_dim=32,
+    )
+
+
+def _lcfg():
+    from modal_examples_tpu.models import lora
+
+    return lora.LoRAConfig(rank=16, alpha=32.0, targets=lora.DIT_TARGETS)
+
+
+def _subject(jax, jnp, cfg, name: str):
+    import hashlib
+
+    # stable across processes (builtin hash() is salted per interpreter —
+    # the library builder and the serving container must agree)
+    seed = int.from_bytes(hashlib.md5(name.encode()).digest()[:4], "little")
+    pattern = jnp.tanh(
+        jax.random.normal(
+            jax.random.PRNGKey(seed),
+            (cfg.img_size, cfg.img_size, cfg.channels),
+        ) * 2.0
+    )
+    token = jax.random.normal(
+        jax.random.PRNGKey(seed + 1), (1, 4, cfg.text_dim)
+    )
+    return pattern, token
+
+
+def _denoise(diffusion, jax, jnp, params, cfg, token, seed=0):
+    """One-step preview generation at t=0.7 (cheap-mode image)."""
+    t = 0.7
+    eps = jax.random.normal(jax.random.PRNGKey(100 + seed),
+                            (1, cfg.img_size, cfg.img_size, cfg.channels))
+    x_t = t * eps  # noise-only start: the subject must come from the model
+    ts = jnp.broadcast_to(token, (1, 4, cfg.text_dim))
+    v = diffusion.mmdit_forward(
+        params, x_t, jnp.full((1,), t), ts, jnp.zeros((1, cfg.pooled_dim)),
+        cfg,
+    )
+    return x_t[0] - t * v[0]
+
+
+@app.function(tpu=TPU, volumes={"/base": base_vol}, timeout=900)
+def build_library(endpoint: str) -> dict:
+    """Pretrain the shared base, personalize one adapter per subject, and
+    push the adapters to the bucket (the reference's download-loras-to-S3
+    stage, with training standing in for the HF downloads)."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    import optax
+
+    from modal_examples_tpu.models import diffusion, lora
+    from modal_examples_tpu.storage.gcs import GCSClient
+
+    cfg, lcfg = _cfg(), _lcfg()
+    base = diffusion.mmdit_init(jax.random.PRNGKey(0), cfg)
+    opt = optax.adam(2e-3)
+    o = opt.init(base)
+
+    @jax.jit
+    def prestep(params, o, key):
+        k1, k2 = jax.random.split(key)
+        lat = jnp.tanh(jax.random.normal(
+            k1, (8, cfg.img_size, cfg.img_size, cfg.channels)))
+        loss, g = jax.value_and_grad(diffusion.mmdit_flow_loss)(
+            params, k2, lat, jnp.zeros((8, 4, cfg.text_dim)),
+            jnp.zeros((8, cfg.pooled_dim)), cfg,
+        )
+        upd, o = opt.update(g, o)
+        return optax.apply_updates(params, upd), o, loss
+
+    for i in range(PRETRAIN_STEPS):
+        base, o, _ = prestep(base, o, jax.random.PRNGKey(1000 + i))
+    with open("/base/base.pkl", "wb") as f:
+        pickle.dump(jax.tree.map(np.asarray, base), f)
+    base_vol.commit()
+
+    gcs = GCSClient(endpoint=endpoint)
+    for name in SUBJECTS:
+        pattern, token = _subject(jax, jnp, cfg, name)
+        adapters = lora.init_lora_tree(jax.random.PRNGKey(7), base, lcfg)
+        aopt = optax.adam(1e-2)
+        ao = aopt.init(adapters)
+
+        @jax.jit
+        def astep(adapters, ao, key, pattern=pattern, token=token):
+            def loss_fn(ad):
+                merged = lora.merge_tree(base, ad, lcfg)
+                lat = jnp.broadcast_to(pattern[None], (8, *pattern.shape))
+                ts = jnp.broadcast_to(token, (8, 4, cfg.text_dim))
+                return diffusion.mmdit_flow_loss(
+                    merged, key, lat, ts, jnp.zeros((8, cfg.pooled_dim)), cfg
+                )
+
+            loss, g = jax.value_and_grad(loss_fn)(adapters)
+            upd, ao = aopt.update(g, ao)
+            return optax.apply_updates(adapters, upd), ao, loss
+
+        for i in range(LORA_STEPS):
+            adapters, ao, _ = astep(adapters, ao, jax.random.PRNGKey(10 + i))
+        buf = io.BytesIO()
+        pickle.dump(jax.tree.map(np.asarray, adapters), buf)
+        gcs.put_object("loras", f"v1/{name}.pkl", buf.getvalue())
+    return {"adapters": list(SUBJECTS)}
+
+
+@app.cls(tpu=TPU, volumes={"/base": base_vol}, scaledown_window=300)
+class Playground:
+    endpoint: str = mtpu.parameter(default="")
+
+    @mtpu.enter()
+    def load(self):
+        import jax
+
+        if not TPU:
+            try:
+                jax.config.update("jax_platforms", "cpu")
+            except Exception:
+                pass
+        import jax.numpy as jnp
+
+        base_vol.reload()
+        with open("/base/base.pkl", "rb") as f:
+            self.base = jax.tree.map(jnp.asarray, pickle.load(f))
+        # mount the adapter library (cloud_bucket_mount_loras.py's
+        # LORAS_PATH) — pull-on-attach through the GCS client
+        mount = mtpu.CloudBucketMount(
+            "loras", key_prefix="v1", bucket_endpoint_url=self.endpoint
+        )
+        mount.pull()
+        self.mount_dir = str(mount.local_path)
+        self._adapters = {}  # name -> merged params (tiny; cache them all)
+
+    def _merged(self, name: str):
+        import jax
+        import jax.numpy as jnp
+
+        from modal_examples_tpu.models import lora
+
+        if name not in self._adapters:
+            path = os.path.join(self.mount_dir, f"{name}.pkl")
+            if not os.path.exists(path):
+                # the MOUNT is the source of truth for the library, not a
+                # constant: new adapters pushed to the bucket serve without
+                # code changes
+                have = sorted(
+                    f[:-4] for f in os.listdir(self.mount_dir)
+                    if f.endswith(".pkl")
+                )
+                raise ValueError(f"unknown LoRA {name!r}; have {have}")
+            with open(path, "rb") as f:
+                tree = jax.tree.map(jnp.asarray, pickle.load(f))
+            self._adapters[name] = lora.merge_tree(self.base, tree, _lcfg())
+        return self._adapters[name]
+
+    @mtpu.method()
+    def generate(self, lora_name: str, seed: int = 0) -> dict:
+        """The reference UI's request shape: pick an adapter, generate."""
+        import jax
+        import jax.numpy as jnp
+        import numpy as np
+
+        from modal_examples_tpu.models import diffusion
+        from modal_examples_tpu.utils.images import to_png
+
+        cfg = _cfg()
+        pattern, token = _subject(jax, jnp, cfg, lora_name)
+        img = _denoise(diffusion, jax, jnp, self._merged(lora_name), cfg,
+                       token, seed)
+        base_img = _denoise(diffusion, jax, jnp, self.base, cfg, token, seed)
+        d_lora = float(jnp.mean((img - pattern) ** 2))
+        d_base = float(jnp.mean((base_img - pattern) ** 2))
+        png = to_png(np.asarray(jnp.clip(img[..., :3], -1, 1)))
+        return {
+            "lora": lora_name,
+            "png_bytes": len(png),
+            "dist_to_subject": d_lora,
+            "dist_base_to_subject": d_base,
+        }
+
+
+@app.function()
+@mtpu.fastapi_endpoint()
+def generate(lora: str, seed: int = 0, endpoint: str = "") -> dict:
+    """GET /generate?lora=sks-crystal — the reference playground's request
+    shape (its Gradio UI posts the adapter choice; UIs are cosmetic per
+    OUT_OF_SCOPE.md). Unknown adapters surface as the error JSON/4xx."""
+    return Playground(endpoint=endpoint).generate.remote(lora, int(seed))
+
+
+@app.local_entrypoint()
+def main():
+    import sys
+    from pathlib import Path
+
+    sys.path.insert(0, str(Path(__file__).resolve().parents[2] / "tests"))
+    from test_gcs import _FakeGCS
+
+    srv = _FakeGCS()
+    try:
+        print("library:", build_library.remote(srv.endpoint))
+        pg = Playground(endpoint=srv.endpoint)
+        results = {}
+        for name in SUBJECTS:
+            r = pg.generate.remote(name)
+            results[name] = r
+            print(f"{name}: dist {r['dist_to_subject']:.3f} "
+                  f"(base {r['dist_base_to_subject']:.3f}), "
+                  f"{r['png_bytes']}B png")
+            # each adapter pulls generation toward ITS subject vs the base
+            assert r["dist_to_subject"] < r["dist_base_to_subject"], r
+        # unknown adapter -> clean error (the playground's 404 path)
+        try:
+            pg.generate.remote("sks-nonexistent")
+            raise AssertionError("expected unknown-LoRA error")
+        except Exception as e:
+            assert "unknown LoRA" in str(e), e
+        print("LoRA playground: bucket-mounted adapters serve per request")
+    finally:
+        srv.stop()
