@@ -168,11 +168,11 @@ class Playground:
             self.base = jax.tree.map(jnp.asarray, pickle.load(f))
         # mount the adapter library (cloud_bucket_mount_loras.py's
         # LORAS_PATH) — pull-on-attach through the GCS client
-        mount = mtpu.CloudBucketMount(
+        self._mount = mtpu.CloudBucketMount(
             "loras", key_prefix="v1", bucket_endpoint_url=self.endpoint
         )
-        mount.pull()
-        self.mount_dir = str(mount.local_path)
+        self._mount.pull()
+        self.mount_dir = str(self._mount.local_path)
         self._adapters = {}  # name -> merged params (tiny; cache them all)
 
     def _merged(self, name: str):
@@ -184,9 +184,11 @@ class Playground:
         if name not in self._adapters:
             path = os.path.join(self.mount_dir, f"{name}.pkl")
             if not os.path.exists(path):
-                # the MOUNT is the source of truth for the library, not a
-                # constant: new adapters pushed to the bucket serve without
-                # code changes
+                # the MOUNT is the source of truth for the library: on a
+                # miss, re-pull so adapters pushed after container start
+                # serve without a restart
+                self._mount.pull()
+            if not os.path.exists(path):
                 have = sorted(
                     f[:-4] for f in os.listdir(self.mount_dir)
                     if f.endswith(".pkl")

@@ -171,9 +171,19 @@ def decode(
     tokens: jax.Array,  # [B, S] int32
     audio_states: jax.Array,  # [B, Ta, D]
     cfg: WhisperConfig,
-) -> jax.Array:  # [B, S, vocab]
+    *,
+    return_cross_attn: bool = False,
+):
+    """Teacher-forced decoder. Returns logits ``[B, S, vocab]``; with
+    ``return_cross_attn`` also the per-layer HEAD-MEAN cross-attention
+    ``[L, B, S, Ta]`` (f32) — the word-timestamp alignment signal. One
+    implementation for both paths so transcription and timing can never
+    come from different models; the head mean is reduced INSIDE the scan
+    so the full [L, B, H, S, Ta] tensor never materializes (whisper-large
+    shapes would be GBs per batch element)."""
     B, S = tokens.shape
     x = params["tok_emb"][tokens] + params["pos_emb"][:S][None]
+    hd = cfg.dim // cfg.n_heads
 
     def layer_fn(x, l):
         h = layers.layer_norm(x, l["ln1_w"], l["ln1_b"], cfg.norm_eps)
@@ -187,24 +197,174 @@ def decode(
         xq = jnp.dot(h, l["xwq"]) + l["xbq"]
         xk = jnp.dot(audio_states, l["xwk"])
         xv = jnp.dot(audio_states, l["xwv"]) + l["xbv"]
-        x = x + jnp.dot(
-            _mha(xq, xk, xv, cfg.n_heads, causal=False), l["xwo"]
-        ) + l["xbo"]
+        Ta = audio_states.shape[1]
+        qh = xq.reshape(B, S, cfg.n_heads, hd).transpose(0, 2, 1, 3)
+        kh = xk.reshape(B, Ta, cfg.n_heads, hd).transpose(0, 2, 1, 3)
+        vh = xv.reshape(B, Ta, cfg.n_heads, hd).transpose(0, 2, 1, 3)
+        sc = jnp.einsum(
+            "bhqd,bhkd->bhqk", qh, kh, preferred_element_type=jnp.float32
+        ) * hd**-0.5
+        p = jax.nn.softmax(sc, axis=-1)  # [B, H, S, Ta] f32
+        o = jnp.einsum("bhqk,bhkd->bhqd", p.astype(vh.dtype), vh)
+        o = o.transpose(0, 2, 1, 3).reshape(B, S, cfg.dim)
+        x = x + jnp.dot(o, l["xwo"]) + l["xbo"]
         h = layers.layer_norm(x, l["ln2_w"], l["ln2_b"], cfg.norm_eps)
         h = layers.gelu_mlp(
             {n: l[n] for n in ("fc_w", "fc_b", "proj_w", "proj_b")}, h,
             exact=True,  # whisper uses erf-GELU
         )
-        return x + h, None
+        aux = jnp.mean(p, axis=1) if return_cross_attn else None
+        return x + h, aux
 
-    x, _ = jax.lax.scan(layer_fn, x, params["dec"])
+    x, attn = jax.lax.scan(layer_fn, x, params["dec"])
     x = layers.layer_norm(x, params["dec_ln_w"], params["dec_ln_b"], cfg.norm_eps)
-    return jnp.dot(x, params["tok_emb"].T, preferred_element_type=jnp.float32)
+    logits = jnp.dot(x, params["tok_emb"].T, preferred_element_type=jnp.float32)
+    if return_cross_attn:
+        return logits, attn
+    return logits
 
 
 def forward(params, mel, tokens, cfg: WhisperConfig) -> jax.Array:
     """Teacher-forced forward (the fine-tuning loss path)."""
     return decode(params, tokens, encode(params, mel, cfg), cfg)
+
+
+# -- word-level timestamp alignment ------------------------------------------
+#
+# The whisperx_transcribe.py capability (word timestamps) via Whisper's OWN
+# mechanism (openai/whisper's word_timestamps=True): the decoder's
+# cross-attention concentrates on the audio frames a token was read from, so
+# a monotonic DTW path through the token x audio-frame attention matrix
+# assigns each token a frame span. No second aligner model (whisperx bolts
+# on wav2vec2 because its backend discards attention; ours doesn't have to).
+# ``decode(return_cross_attn=True)`` supplies the signal.
+
+
+def dtw_path(cost) -> "np.ndarray":  # [S] -> frame index per row
+    """Monotonic DTW through a [S, T] cost matrix (lower = better match);
+    returns, per token row, the LAST audio frame on the optimal path —
+    the token's end frame. Plain numpy: alignment is offline per
+    utterance, not a jitted hot path."""
+    import numpy as np
+
+    S, T = cost.shape
+    D = np.full((S + 1, T + 1), np.inf, np.float64)
+    D[0, 0] = 0.0  # path runs corner to corner: the tokens COVER the audio
+    step = np.zeros((S + 1, T + 1), np.int8)
+    for i in range(1, S + 1):
+        for j in range(1, T + 1):
+            # moves: down (next token, same frame), diagonal, right (same
+            # token, next frame) — tokens advance monotonically in time
+            opts = (D[i - 1, j], D[i - 1, j - 1], D[i, j - 1])
+            a = int(np.argmin(opts))
+            D[i, j] = cost[i - 1, j - 1] + opts[a]
+            step[i, j] = a
+    ends = np.zeros((S,), np.int64)
+    i, j = S, T  # backtrack from the corner (whisper's timing DTW shape)
+    while i > 0:
+        ends[i - 1] = max(ends[i - 1], j - 1)
+        a = step[i, j]
+        if a == 0:
+            i -= 1
+        elif a == 1:
+            i -= 1
+            j -= 1
+        else:
+            j -= 1
+    return ends
+
+
+def align_tokens(
+    params: dict,
+    mel: jax.Array,  # [B, T, n_mels]
+    tokens: jax.Array,  # [B, S] int32 (the transcribed sequence)
+    cfg: WhisperConfig,
+    *,
+    frame_seconds: float = 0.02,  # 10 ms mel hop x2 encoder downsample
+    bos_id: int | None = None,
+):
+    """Per-token (start_s, end_s) via cross-attention DTW.
+
+    Returns ``times [B, S, 2]`` float seconds, row i for ``tokens[:, i]``.
+    Token ``i`` is aligned by the attention at the position that PREDICTED
+    it (teacher forcing offsets by one; same convention as openai/whisper's
+    timing pass). ``tokens`` should start with BOS; sequences WITHOUT it —
+    ``greedy_transcribe`` strips BOS from its output — pass ``bos_id=`` and
+    it is prepended internally (every returned row still matches the input
+    tokens). Uses the top half of the decoder layers' heads averaged (the
+    alignment signal concentrates in late layers; openai/whisper selects
+    per-model alignment heads — a per-checkpoint refinement that plugs in
+    here). Adjacent token spans TOUCH (end_k == start_{k+1}), the
+    openai/whisper boundary convention.
+    """
+    import numpy as np
+
+    stripped = bos_id is not None
+    if stripped:
+        B0 = tokens.shape[0]
+        tokens = jnp.concatenate(
+            [jnp.full((B0, 1), bos_id, tokens.dtype), tokens], axis=1
+        )
+
+    def _attn_mean(params, mel, tokens):
+        audio_states = encode(params, mel, cfg)
+        _, attn = decode(
+            params, tokens, audio_states, cfg, return_cross_attn=True
+        )
+        L = attn.shape[0]
+        return jnp.mean(attn[L // 2 :], axis=0)  # [B, S, Ta]
+
+    # jitted so the unused logits head (a [B, S, vocab] matmul) is DCE'd
+    w = np.asarray(jax.jit(_attn_mean)(params, mel, tokens), np.float64)
+    B, S, Ta = w.shape
+    times = np.zeros((B, S, 2), np.float32)
+    for b in range(B):
+        # rows 0..S-2 predicted tokens 1..S-1; normalize, cost = -log p
+        rows = w[b, :-1]
+        rows = rows / np.maximum(rows.sum(-1, keepdims=True), 1e-9)
+        ends = dtw_path(-np.log(np.maximum(rows, 1e-9)))
+        starts = np.concatenate([[0], ends[:-1] + 1])  # touching boundaries
+        times[b, 1:, 0] = starts * frame_seconds
+        times[b, 1:, 1] = (ends + 1) * frame_seconds
+        times[b, 0] = 0.0  # BOS carries no audio span
+    return times[:, 1:] if stripped else times
+
+
+def words_with_times(
+    token_ids, times, decode_fn, *, space_ids=(32,), eos_ids=()
+) -> list[dict]:
+    """Group one sequence's token times into word spans.
+
+    ``decode_fn(ids) -> str`` is the tokenizer; ``space_ids`` mark word
+    boundaries (byte tokenizer: the space byte); processing stops at the
+    first id in ``eos_ids`` (``greedy_transcribe`` output is eos-padded).
+    Returns ``[{"word", "start", "end"}]`` — the whisperx output shape."""
+    words: list[dict] = []
+    cur: list[int] = []
+    t0 = None
+    last = len(token_ids)
+    for i, tok in enumerate(token_ids):
+        tok = int(tok)
+        if tok in eos_ids:
+            last = i
+            break
+        if tok in space_ids:
+            if cur:
+                words.append(
+                    {"word": decode_fn(cur), "start": float(t0),
+                     "end": float(times[i - 1][1])}
+                )
+                cur, t0 = [], None
+            continue
+        if t0 is None:
+            t0 = times[i][0]
+        cur.append(tok)
+    if cur:
+        words.append(
+            {"word": decode_fn(cur), "start": float(t0),
+             "end": float(times[last - 1][1])}
+        )
+    return words
 
 
 def load_hf_weights(model_dir, cfg: WhisperConfig, dtype=None) -> dict:
