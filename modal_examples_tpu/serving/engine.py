@@ -419,57 +419,82 @@ class LLMEngine:
         # target's, so allocation, prefix sharing, and slot recycling are
         # managed once.
         self.spec_gamma = 0
+        self.spec_mode: str | None = None  # "draft" | "ngram"
         self.draft_cfg = None
         if speculative is not None:
             draft, gamma = speculative
-            if isinstance(draft, str):
-                if draft not in MODEL_PRESETS:
-                    raise ValueError(
-                        f"unknown draft preset {draft!r}; "
-                        f"known: {sorted(MODEL_PRESETS)}"
-                    )
-                draft = MODEL_PRESETS[draft]()
-            if draft_model_dir is not None:
-                # the checkout's own config describes the draft weights (the
-                # preset name is then just a default for when no dir is given)
-                draft = llama.LlamaConfig.from_hf_config(
-                    f"{draft_model_dir}/config.json"
-                )
-            self.draft_cfg = draft
             self.spec_gamma = int(gamma)
             if self.spec_gamma < 1:
                 raise ValueError("speculative gamma must be >= 1")
-            if draft.vocab_size != cfg.vocab_size:
-                raise ValueError(
-                    f"draft vocab_size {draft.vocab_size} != target "
-                    f"{cfg.vocab_size}: speculative accept/reject compares "
-                    "token distributions and requires a shared vocabulary"
-                )
-            if draft_params is None:
-                if draft_model_dir is not None:
-                    draft_params = llama.load_hf_weights(draft_model_dir, draft)
-                else:
-                    draft_params = llama.init_params(
-                        jax.random.PRNGKey(seed + 1), draft
+            if draft == "ngram":
+                # prompt-lookup decoding (vLLM's --speculative-model
+                # [ngram] analog): proposals come from matching the
+                # sequence's trailing n-gram against its OWN history — no
+                # second model, no draft HBM, no draft cache. The target
+                # verifies the proposed continuation in one pass exactly
+                # like draft-model mode.
+                if draft_model_dir is not None or draft_params is not None:
+                    raise ValueError(
+                        "draft_model_dir/draft_params are incompatible with "
+                        "speculative=('ngram', ...): prompt lookup uses no "
+                        "draft model — drop them or pick a draft preset"
                     )
-            if mesh is not None:
-                draft_params = _shard_params(draft_params, draft, mesh)
-            self.draft_params = draft_params
-            self.draft_cache = PagedKVCache.create(
-                n_layers=draft.n_layers,
-                n_kv_heads=draft.n_kv_heads,
-                head_dim=draft.head_dim,
-                n_pages=n_pages,
-                page_size=page_size,
-                dtype=kv_dtype,
-                prefer_native=False,  # page ids come from the target's allocator
-            )
-            if mesh is not None:
-                self._shard_cache(self.draft_cache)
-            self._spec_jit = jax.jit(
-                self._spec_propose_verify, donate_argnums=(2, 3, 4, 5)
-            )
-            self._draft_prefill_jits: dict[object, object] = {}
+                self.spec_mode = "ngram"
+                self.ngram_n = 2  # trailing-bigram lookup (prompt-lookup)
+                self._ngram_jit = jax.jit(
+                    self._ngram_verify, donate_argnums=(1, 2)
+                )
+            else:
+                if isinstance(draft, str):
+                    if draft not in MODEL_PRESETS:
+                        raise ValueError(
+                            f"unknown draft preset {draft!r}; "
+                            f"known: {sorted(MODEL_PRESETS)} (or 'ngram')"
+                        )
+                    draft = MODEL_PRESETS[draft]()
+                if draft_model_dir is not None:
+                    # the checkout's own config describes the draft weights
+                    # (the preset name is then just a default for when no
+                    # dir is given)
+                    draft = llama.LlamaConfig.from_hf_config(
+                        f"{draft_model_dir}/config.json"
+                    )
+                self.spec_mode = "draft"
+                self.draft_cfg = draft
+                if draft.vocab_size != cfg.vocab_size:
+                    raise ValueError(
+                        f"draft vocab_size {draft.vocab_size} != target "
+                        f"{cfg.vocab_size}: speculative accept/reject "
+                        "compares token distributions and requires a shared "
+                        "vocabulary"
+                    )
+                if draft_params is None:
+                    if draft_model_dir is not None:
+                        draft_params = llama.load_hf_weights(
+                            model_dir=draft_model_dir, cfg=draft
+                        )
+                    else:
+                        draft_params = llama.init_params(
+                            jax.random.PRNGKey(seed + 1), draft
+                        )
+                if mesh is not None:
+                    draft_params = _shard_params(draft_params, draft, mesh)
+                self.draft_params = draft_params
+                self.draft_cache = PagedKVCache.create(
+                    n_layers=draft.n_layers,
+                    n_kv_heads=draft.n_kv_heads,
+                    head_dim=draft.head_dim,
+                    n_pages=n_pages,
+                    page_size=page_size,
+                    dtype=kv_dtype,
+                    prefer_native=False,  # page ids from the target's allocator
+                )
+                if mesh is not None:
+                    self._shard_cache(self.draft_cache)
+                self._spec_jit = jax.jit(
+                    self._spec_propose_verify, donate_argnums=(2, 3, 4, 5)
+                )
+                self._draft_prefill_jits: dict[object, object] = {}
 
     def _shard_cache(self, cache) -> None:
         """Shard page arrays [L, P, ps, Hkv, D] by kv head over ``tensor`` —
@@ -639,49 +664,177 @@ class LLMEngine:
         t_logits, tk, tv = llama.verify_step(
             params, chain, positions, tk, tv, page_tables, active, cfg
         )  # [B, gamma+1, V]
+        out, n_emit = self._accept_reject(
+            t_logits, draft_toks, temps, (keys[gamma], keys[gamma + 1]),
+            active, proposal_logps=draft_logps,
+        )
+        return out, n_emit, tk, tv, dk, dv
+
+    def _accept_reject(
+        self, t_logits, proposals, temps, keys2, active, *,
+        proposal_logps=None, n_prop=None,
+    ):
+        """Shared speculative accept/reject (both spec modes route here so
+        the math can never drift). ``proposal_logps`` is the draft model's
+        log-probs; ``None`` means a degenerate (delta) proposal
+        distribution — prompt-lookup mode — where acceptance is
+        min(1, p_t(x)) and the rejection residual is p_t with x zeroed.
+        ``n_prop`` (ngram mode) marks how many proposal slots are real;
+        slots beyond it are never accepted.
+
+        Greedy slots (temperature 0) accept while proposal == target
+        argmax — reproducing the target's greedy decode token-for-token.
+        Sampling slots use standard speculative sampling, so the output
+        distribution equals the target's. Returns (out [B, gamma+1],
+        n_emit [B])."""
+        gamma = self.spec_gamma
+        B = proposals.shape[0]
         t_scaled = t_logits / jnp.maximum(temps, 1e-6)[:, None, None]
         t_logp = jax.nn.log_softmax(t_scaled, axis=-1)
         greedy_choice = jnp.argmax(t_logits, axis=-1).astype(jnp.int32)
 
         rows = jnp.arange(B)
-        match = draft_toks == greedy_choice[:, :gamma]
+        valid = (
+            jnp.ones((B, gamma), bool)
+            if n_prop is None
+            else jnp.arange(gamma)[None, :] < n_prop[:, None]
+        )
+        match = (proposals == greedy_choice[:, :gamma]) & valid
         lp_t = jnp.take_along_axis(
-            t_logp[:, :gamma], draft_toks[..., None], axis=-1
+            t_logp[:, :gamma], proposals[..., None], axis=-1
         )[..., 0]
-        lp_d = jnp.take_along_axis(
-            draft_logps, draft_toks[..., None], axis=-1
-        )[..., 0]
-        u = jax.random.uniform(keys[gamma], (B, gamma))
-        accept_sto = u < jnp.exp(jnp.minimum(0.0, lp_t - lp_d))
-        accept = jnp.where((temps <= 0.0)[:, None], match, accept_sto)
+        if proposal_logps is None:
+            accept_prob = jnp.exp(lp_t)  # min(1, p_t / 1)
+        else:
+            lp_d = jnp.take_along_axis(
+                proposal_logps, proposals[..., None], axis=-1
+            )[..., 0]
+            accept_prob = jnp.exp(jnp.minimum(0.0, lp_t - lp_d))
+        u = jax.random.uniform(keys2[0], (B, gamma))
+        accept = jnp.where(
+            (temps <= 0.0)[:, None], match, (u < accept_prob) & valid
+        )
         n_acc = jnp.argmin(
             jnp.concatenate(
-                [accept.astype(jnp.int32), jnp.zeros((B, 1), jnp.int32)], axis=1
+                [accept.astype(jnp.int32), jnp.zeros((B, 1), jnp.int32)],
+                axis=1,
             ),
             axis=1,
         )  # first rejection; == gamma when all accepted
 
         # token at the cut: target's fix on rejection, fresh bonus sample
-        # when every draft token was accepted
+        # when every proposal was accepted
         j = n_acc
-        t_row = t_logp[rows, j]  # [B, V]
-        d_row = draft_logps[rows, jnp.minimum(j, gamma - 1)]
-        p_t_row, p_d_row = jnp.exp(t_row), jnp.exp(d_row)
-        residual = jnp.maximum(p_t_row - p_d_row, 0.0)
+        p_t_row = jnp.exp(t_logp[rows, j])  # [B, V]
+        if proposal_logps is None:
+            prop_at_j = proposals[rows, jnp.minimum(j, gamma - 1)]
+            residual = p_t_row.at[rows, prop_at_j].set(0.0)
+            rejected = j < (gamma if n_prop is None else n_prop)
+        else:
+            p_d_row = jnp.exp(
+                proposal_logps[rows, jnp.minimum(j, gamma - 1)]
+            )
+            residual = jnp.maximum(p_t_row - p_d_row, 0.0)
+            rejected = j < gamma
         has_res = residual.sum(-1, keepdims=True) > 0
-        residual = jnp.where(
-            (j[:, None] < gamma) & has_res, residual, p_t_row
-        )
+        residual = jnp.where(rejected[:, None] & has_res, residual, p_t_row)
         sampled_fix = jax.vmap(jax.random.categorical)(
-            jax.random.split(keys[gamma + 1], B), jnp.log(residual + 1e-20)
+            jax.random.split(keys2[1], B), jnp.log(residual + 1e-20)
         ).astype(jnp.int32)
         fix = jnp.where(temps <= 0.0, greedy_choice[rows, j], sampled_fix)
         out = jnp.concatenate(
-            [draft_toks, jnp.zeros((B, 1), jnp.int32)], axis=1
+            [proposals, jnp.zeros((B, 1), jnp.int32)], axis=1
         )
         out = out.at[rows, j].set(fix)
         n_emit = jnp.where(active, n_acc + 1, 0)
-        return out, n_emit, tk, tv, dk, dv
+        return out, n_emit
+
+    def _ngram_verify(
+        self, params, tk, tv, proposals, n_prop, tokens, positions,
+        page_tables, active, key, temps,
+    ):
+        """One prompt-lookup tick: target-verify host-proposed tokens.
+
+        Same accept/reject math as draft-model mode with the proposal
+        distribution degenerate (a delta at the proposed token): greedy
+        slots accept while proposal == target argmax; sampling slots accept
+        token x with prob min(1, p_t(x)/1) = p_t(x) and resample rejections
+        from p_t with x zeroed (the residual max(p_t - delta_x, 0)) — the
+        output distribution equals the target's. Proposal slots beyond
+        ``n_prop`` are never accepted, so empty-proposal slots degrade to
+        exactly one plain target step.
+        """
+        k1, k2 = jax.random.split(key)
+        chain = jnp.concatenate([tokens[:, None], proposals], axis=1)
+        t_logits, tk, tv = llama.verify_step(
+            params, chain, positions, tk, tv, page_tables, active, self.cfg
+        )  # [B, gamma+1, V]
+        out, n_emit = self._accept_reject(
+            t_logits, proposals, temps, (k1, k2), active, n_prop=n_prop,
+        )
+        return out, n_emit, tk, tv
+
+    #: host-side lookup window per tick (prompt_lookup_max analog)
+    NGRAM_LOOKBACK = 1024
+
+    def _ngram_proposals(self):
+        """Host-side prompt lookup: match each slot's trailing n-gram
+        against its own prompt+generation history; propose the tokens that
+        followed the MOST RECENT earlier occurrence."""
+        gamma, n = self.spec_gamma, self.ngram_n
+        props = np.zeros((self.max_slots, gamma), np.int32)
+        n_prop = np.zeros((self.max_slots,), np.int32)
+        for i, s in enumerate(self.slots):
+            if s.free:
+                continue
+            hist = (s.request.prompt_tokens or []) + s.generated
+            # bounded lookback (vLLM's prompt_lookup_max analog): the scan
+            # is on the host critical path every tick — O(window), not
+            # O(sequence), per slot
+            hist = hist[-self.NGRAM_LOOKBACK:]
+            if len(hist) <= n:
+                continue
+            tail = hist[-n:]
+            for j in range(len(hist) - n - 1, -1, -1):
+                if hist[j : j + n] == tail:
+                    cont = hist[j + n : j + n + gamma]
+                    props[i, : len(cont)] = cont
+                    n_prop[i] = len(cont)
+                    break
+        return props, n_prop
+
+    def _ngram_tick(self, active_idx: list[int]) -> bool:
+        props, n_prop = self._ngram_proposals()
+        (
+            out_tokens, n_emit, self.cache.k_pages, self.cache.v_pages,
+        ) = self._ngram_jit(
+            self.params,
+            self.cache.k_pages,
+            self.cache.v_pages,
+            jnp.asarray(props),
+            jnp.asarray(n_prop),
+            jnp.asarray(self._tokens.copy()),
+            jnp.asarray(self._positions.copy()),
+            jnp.asarray(self._page_tables.copy()),
+            jnp.asarray(self._active.copy()),
+            self._next_key(),
+            jnp.asarray(self._temps.copy()),
+        )
+        out_np = np.asarray(out_tokens)
+        n_np = np.asarray(n_emit)
+        self.stats.steps += 1
+        for i in active_idx:
+            s = self.slots[i]
+            take = int(n_np[i])
+            self.stats.spec_proposed += int(n_prop[i])
+            self.stats.spec_accepted += max(0, take - 1)
+            for t in range(take):
+                if s.request is None:
+                    break  # finished mid-chain (eos/stop/length)
+                s.position += 1
+                s.last_token = int(out_np[i, t])
+                self._accept_token(i, s.last_token)
+        return True
 
     def _bucket_for(self, n: int) -> int:
         for b in self.prefill_buckets:
@@ -845,7 +998,24 @@ class LLMEngine:
                 jnp.zeros((B,), jnp.int32),
                 jnp.full((B,), -1, jnp.int32),
             )
-        if self.spec_gamma:
+        if self.spec_mode == "ngram":
+            B = self.max_slots
+            (
+                _, _, self.cache.k_pages, self.cache.v_pages,
+            ) = self._ngram_jit(
+                self.params,
+                self.cache.k_pages,
+                self.cache.v_pages,
+                jnp.zeros((B, self.spec_gamma), jnp.int32),
+                jnp.zeros((B,), jnp.int32),
+                jnp.zeros((B,), jnp.int32),
+                jnp.zeros((B,), jnp.int32),
+                jnp.zeros((B, self.pages_per_slot), jnp.int32),
+                jnp.zeros((B,), bool),
+                self._next_key(),
+                jnp.ones((B,), jnp.float32),
+            )
+        if self.spec_mode == "draft":
             for bucket in buckets or self.prefill_buckets:
                 B = self.prefill_batch
                 _, self.draft_cache.k_pages, self.draft_cache.v_pages = (
@@ -1157,7 +1327,7 @@ class LLMEngine:
                 jnp.asarray([len(chunk)], np.int32),
                 cfg=self.cfg,
             )
-            if self.spec_gamma:
+            if self.spec_mode == "draft":
                 # the same cached jit serves the draft: cfg is a static call
                 # argument, so target and draft get separate compile-cache
                 # entries under one callable
@@ -1255,7 +1425,7 @@ class LLMEngine:
                 jnp.asarray(top_ks),
                 jnp.asarray(seeds),
             )
-        if self.spec_gamma:
+        if self.spec_mode == "draft":
             # fill the draft model's cache over the same pages (same tables:
             # page ids are shared between the two caches)
             _, self.draft_cache.k_pages, self.draft_cache.v_pages = (
@@ -1392,6 +1562,8 @@ class LLMEngine:
 
     def _spec_tick(self, active_idx: list[int]) -> bool:
         """Speculative decode tick: up to gamma+1 tokens per slot per step."""
+        if self.spec_mode == "ngram":
+            return self._ngram_tick(active_idx)
         (
             out_tokens,
             n_emit,
