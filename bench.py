@@ -226,6 +226,18 @@ def _extract_json(stdout: str) -> dict | None:
     return None
 
 
+def _slope_time(run, iters: int) -> float:
+    """Per-iteration seconds via the two-point slope (cancels fixed
+    dispatch cost), falling back to plain elapsed when tiny/fast runs make
+    the slope non-positive on noise. ``run(n)`` executes n iterations and
+    host-syncs; shared by every secondary bench child."""
+    n1, n2 = max(1, iters // 2), iters
+    t1, t2 = run(n1), run(n2)
+    if t2 > t1 and n2 > n1:
+        return (t2 - t1) / (n2 - n1)
+    return t2 / n2
+
+
 def _image_child() -> None:
     """Secondary metric (BASELINE.json: "SDXL images/sec"): full txt2img
     pipeline — SD3-Medium-shape MMDiT (24 blocks, width 1536, ~2B params,
@@ -292,9 +304,7 @@ def _image_child() -> None:
         np.asarray(img[0, 0, 0])
         return time.time() - t0
 
-    n1, n2 = max(1, iters // 2), iters
-    t1, t2 = run(n1), run(n2)
-    sec_per_img = (t2 - t1) / ((n2 - n1) * B) if n2 > n1 else t2 / (n2 * B)
+    sec_per_img = _slope_time(run, iters) / B
     img_s = 1.0 / sec_per_img
     out_px = mcfg.img_size * vcfg.downscale
     print(
@@ -325,6 +335,193 @@ def _image_child() -> None:
     )
 
 
+def _embed_child() -> None:
+    """Secondary metric: sentence-embedding throughput (BASELINE config
+    "bge-small-en sentence embeddings"; the reference's TEI tier —
+    text_embeddings_inference.py, wikipedia/main.py's 575k tok/s fleet
+    claim). bge-small geometry = models.bert defaults (384 dim, 12
+    layers); random weights are perf-equivalent."""
+    import jax
+
+    if os.environ.get("BENCH_CPU"):
+        jax.config.update("jax_platforms", "cpu")
+    import jax.numpy as jnp
+    import numpy as np
+
+    from modal_examples_tpu.models import bert
+    from modal_examples_tpu.utils.sync import force
+
+    tiny = bool(os.environ.get("BENCH_TINY"))
+    cfg = bert.BertConfig.tiny() if tiny else bert.BertConfig()  # bge-small shape
+    B, S, iters = (8, 64, 2) if tiny else (256, 512, 8)
+    params = bert.init_params(jax.random.PRNGKey(0), cfg)
+    force(params)
+    toks = jax.random.randint(jax.random.PRNGKey(1), (B, S), 0, cfg.vocab_size)
+    mask = jnp.ones((B, S), jnp.int32)
+    fn = jax.jit(lambda p, t, m: bert.embed(p, t, m, cfg))
+    t0 = time.time()
+    np.asarray(fn(params, toks, mask))
+    compile_s = time.time() - t0
+
+    def run(n):
+        out = None
+        t0 = time.time()
+        for _ in range(n):
+            out = fn(params, toks, mask)
+        np.asarray(out[0, 0])
+        return time.time() - t0
+
+    tok_s = B * S / _slope_time(run, iters)
+    print(json.dumps({
+        "metric": ("tiny embed path-proof" if tiny
+                   else "bge-small-shape embedding throughput (1 chip)"),
+        "value": round(tok_s, 0), "unit": "tok/s",
+        "vs_baseline": 0.0,  # the reference's 575k tok/s is a fleet number
+        "batch": B, "seq": S, "compile_s": round(compile_s, 1),
+        "backend": jax.default_backend(),
+    }), flush=True)
+
+
+def _asr_child() -> None:
+    """Secondary metric: Whisper transcription speed as x-realtime
+    (BASELINE config "Whisper-base audio transcription";
+    openai_whisper/batched_whisper.py). whisper-base geometry, 30 s
+    chunks, greedy decode of 64 tokens per chunk."""
+    import jax
+
+    if os.environ.get("BENCH_CPU"):
+        jax.config.update("jax_platforms", "cpu")
+    import jax.numpy as jnp
+    import numpy as np
+
+    from modal_examples_tpu.models import whisper
+    from modal_examples_tpu.utils.sync import force
+
+    tiny = bool(os.environ.get("BENCH_TINY"))
+    if tiny:
+        cfg = whisper.WhisperConfig.test_tiny()
+        B, frames, max_toks, iters = 2, 200, 8, 2
+    else:
+        cfg = whisper.WhisperConfig.base()
+        B, frames, max_toks, iters = 8, 3000, 64, 4  # 8 x 30 s chunks
+    params = whisper.init_params(jax.random.PRNGKey(0), cfg)
+    force(params)
+    mel = jax.random.normal(jax.random.PRNGKey(1), (B, frames, cfg.n_mels))
+    fn = jax.jit(
+        lambda p, m: whisper.greedy_transcribe(
+            p, m, cfg, bos_id=0, eos_id=1, max_tokens=max_toks
+        )
+    )
+    t0 = time.time()
+    np.asarray(fn(params, mel))
+    compile_s = time.time() - t0
+
+    def run(n):
+        out = None
+        t0 = time.time()
+        for _ in range(n):
+            out = fn(params, mel)
+        np.asarray(out[0, 0])
+        return time.time() - t0
+
+    audio_s = B * frames * 0.01  # 10 ms mel hop
+    xrt = audio_s / _slope_time(run, iters)
+    print(json.dumps({
+        "metric": ("tiny asr path-proof" if tiny
+                   else "whisper-base-shape transcription speed (1 chip)"),
+        "value": round(xrt, 1), "unit": "x-realtime",
+        "vs_baseline": 0.0,  # no hard reference number in BASELINE.md
+        "batch": B, "chunk_s": frames * 0.01, "tokens_per_chunk": max_toks,
+        "compile_s": round(compile_s, 1),
+        "backend": jax.default_backend(),
+    }), flush=True)
+
+
+def _finetune_child() -> None:
+    """Secondary metric: LoRA fine-tune step throughput (BASELINE config
+    "Llama-2-7B LoRA fine-tune"; unsloth_finetune.py). Adapters train
+    on-the-fly against a frozen int8 base (the memory trick that fits 7B
+    on one 16 GB chip); tokens/sec = B*S / step."""
+    import jax
+
+    if os.environ.get("BENCH_CPU"):
+        jax.config.update("jax_platforms", "cpu")
+    import jax.numpy as jnp
+    import numpy as np
+    import optax
+
+    from modal_examples_tpu.models import llama, lora
+    from modal_examples_tpu.models.quantize import init_quantized_llama
+    from modal_examples_tpu.training import cross_entropy_loss
+    from modal_examples_tpu.utils.sync import force
+
+    tiny = bool(os.environ.get("BENCH_TINY"))
+    if tiny:
+        # tiny path keeps the SAME quantized-base shape as the real run so
+        # CI exercises it (a float-only tiny path masked an int8-adapter
+        # crash here once)
+        cfg = llama.LlamaConfig.tiny()
+        B, S, iters = 2, 32, 2
+    else:
+        cfg = llama.LlamaConfig.llama2_7b()
+        B, S, iters = 2, 512, 4
+    base = init_quantized_llama(jax.random.PRNGKey(0), cfg, bits=8)
+    force(base)
+    lcfg = lora.LoRAConfig(rank=16)
+    adapters = lora.init_lora(jax.random.PRNGKey(1), base, lcfg)
+    opt = optax.adam(1e-4)
+    opt_state = opt.init(adapters)
+    toks = jax.random.randint(jax.random.PRNGKey(2), (B, S), 0, cfg.vocab_size)
+    mask = jnp.ones((B, S), jnp.float32)
+
+    @jax.jit
+    def step(adapters, opt_state, toks, mask):
+        def loss_fn(ad):
+            logits = llama.forward(
+                base, toks, cfg, attn_impl="xla", lora=ad,
+                lora_scale=lcfg.scale,
+            )
+            return cross_entropy_loss(logits[:, :-1], toks[:, 1:], mask[:, 1:])
+
+        loss, g = jax.value_and_grad(loss_fn)(adapters)
+        upd, opt_state = opt.update(g, opt_state)
+        return optax.apply_updates(adapters, upd), opt_state, loss
+
+    t0 = time.time()
+    adapters, opt_state, loss = step(adapters, opt_state, toks, mask)
+    np.asarray(loss)
+    compile_s = time.time() - t0
+
+    def run(n):
+        nonlocal adapters, opt_state
+        loss = None
+        t0 = time.time()
+        for _ in range(n):
+            adapters, opt_state, loss = step(adapters, opt_state, toks, mask)
+        np.asarray(loss)
+        return time.time() - t0
+
+    step_s = _slope_time(run, iters)
+    print(json.dumps({
+        "metric": ("tiny finetune path-proof" if tiny
+                   else "llama2-7b-int8-base LoRA finetune (1 chip)"),
+        "value": round(B * S / step_s, 1), "unit": "train tok/s",
+        "vs_baseline": 0.0,  # reference publishes no single-GPU number
+        "batch": B, "seq": S, "step_s": round(step_s, 3),
+        "adapter_params": lora.param_count(adapters),
+        "compile_s": round(compile_s, 1),
+        "backend": jax.default_backend(),
+    }), flush=True)
+
+
+SECONDARY_CHILDREN = {
+    "--child-image": _image_child,
+    "--child-embed": _embed_child,
+    "--child-asr": _asr_child,
+    "--child-finetune": _finetune_child,
+}
+
+
 def _run_config(model: str, env: dict, timeout: float) -> tuple[dict | None, str]:
     try:
         proc = subprocess.run(
@@ -350,11 +547,11 @@ def main() -> int:
         enable_compile_cache()
         _child(sys.argv[2])
         return 0
-    if len(sys.argv) > 1 and sys.argv[1] == "--child-image":
+    if len(sys.argv) > 1 and sys.argv[1] in SECONDARY_CHILDREN:
         from modal_examples_tpu.utils.compile_cache import enable_compile_cache
 
         enable_compile_cache()
-        _image_child()
+        SECONDARY_CHILDREN[sys.argv[1]]()
         return 0
 
     # Hard wall-clock budget for the WHOLE bench (driver runs us with its own
@@ -458,28 +655,42 @@ def main() -> int:
         )
     best["all_configs"] = {k: v["value"] for k, v in results.items()}
 
-    # secondary metric: images/sec on the SD3-shape txt2img pipeline
-    # (BASELINE.json names it; reference baseline text_to_image.py:11-13).
-    # On a degraded CPU run the full shape is hopeless — run the tiny
-    # pipeline instead so the METRIC PATH stays proven end to end.
-    if deadline - time.time() > 240 and not os.environ.get("BENCH_NO_IMAGE"):
-        img_env = dict(env)
-        if env.get("BENCH_CPU"):
-            img_env["BENCH_IMAGE_TINY"] = "1"
-        try:
-            proc = subprocess.run(
-                [sys.executable, os.path.abspath(__file__), "--child-image"],
-                capture_output=True, text=True,
-                # keep ~180s in reserve so a slow SD3-shape compile can't
-                # starve the warm-boot proof that follows
-                timeout=max(120, min(600, deadline - time.time() - 180)),
-                cwd=os.path.dirname(os.path.abspath(__file__)), env=img_env,
-            )
-            img_result = _extract_json(proc.stdout)
-            if img_result is not None:
-                best["image_gen"] = img_result
-        except subprocess.TimeoutExpired:
-            best["image_gen"] = {"error": "timeout"}
+    # secondary metrics: one child per remaining BASELINE config —
+    # images/sec (SDXL analog, text_to_image.py:11-13), embedding tok/s
+    # (bge-small / TEI), ASR x-realtime (whisper-base), LoRA train tok/s
+    # (llama2-7b fine-tune). On a degraded CPU run each child runs a tiny
+    # path-proof instead so the METRIC PATHS stay proven end to end.
+    secondary = {
+        "image_gen": "--child-image",
+        "embeddings": "--child-embed",
+        "asr": "--child-asr",
+        "finetune": "--child-finetune",
+    }
+    if not os.environ.get("BENCH_NO_SECONDARY"):
+        for key, flag in secondary.items():
+            if key == "image_gen" and os.environ.get("BENCH_NO_IMAGE"):
+                continue  # BENCH_NO_IMAGE skips only the slow SD3 child
+            if deadline - time.time() < 240:
+                break
+            child_env = dict(env)
+            if env.get("BENCH_CPU"):
+                child_env["BENCH_IMAGE_TINY"] = "1"  # image child's switch
+                child_env["BENCH_TINY"] = "1"
+            try:
+                proc = subprocess.run(
+                    [sys.executable, os.path.abspath(__file__), flag],
+                    capture_output=True, text=True,
+                    # keep ~180s in reserve so a slow compile can't starve
+                    # the warm-boot proof that follows
+                    timeout=max(120, min(600, deadline - time.time() - 180)),
+                    cwd=os.path.dirname(os.path.abspath(__file__)),
+                    env=child_env,
+                )
+                result = _extract_json(proc.stdout)
+                if result is not None:
+                    best[key] = result
+            except subprocess.TimeoutExpired:
+                best[key] = {"error": "timeout"}
 
     # warm-boot proof for the compile cache: rerun the winner (tiny token
     # budget) — its compiles are now disk hits, so build+compile collapses.

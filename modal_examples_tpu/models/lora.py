@@ -37,11 +37,18 @@ def init_lora(key: jax.Array, params: dict, lcfg: LoRAConfig) -> dict:
     keys = jax.random.split(key, len(lcfg.targets))
     for k, name in zip(keys, lcfg.targets):
         w = params["layers"][name]  # [L, din, dout]
-        L, din, dout = w.shape
+        if hasattr(w, "q"):
+            # QuantizedWeight base (int8/int4 serving or memory-frugal
+            # fine-tuning): adapters must stay REAL-valued — int8 adapters
+            # would truncate a~1/rank to zeros and break autodiff
+            shape, dt = w.q.shape, w.scale.dtype
+        else:
+            shape, dt = w.shape, w.dtype
+        L, din, dout = shape
         lora_layers[f"{name}_a"] = (
             jax.random.normal(k, (L, din, lcfg.rank), jnp.float32) / lcfg.rank
-        ).astype(w.dtype)
-        lora_layers[f"{name}_b"] = jnp.zeros((L, lcfg.rank, dout), w.dtype)
+        ).astype(dt)
+        lora_layers[f"{name}_b"] = jnp.zeros((L, lcfg.rank, dout), dt)
     return {"layers": lora_layers}
 
 

@@ -71,3 +71,29 @@ def test_bench_supervisor_degrades_on_bad_model():
         assert "metric" in payload
     else:
         assert out.returncode != 0
+
+
+@pytest.mark.slow
+@pytest.mark.parametrize("flag,unit", [
+    ("--child-embed", "tok/s"),
+    ("--child-asr", "x-realtime"),
+    ("--child-finetune", "train tok/s"),
+])
+def test_secondary_children_emit_schema_json(flag, unit):
+    """Every BASELINE-config secondary child must print one JSON line in
+    tiny mode — the same code shape the real TPU run takes (the finetune
+    child's quantized base included)."""
+    out = subprocess.run(
+        [sys.executable, str(REPO / "bench.py"), flag],
+        capture_output=True,
+        text=True,
+        timeout=500,
+        env={**os.environ, "BENCH_CPU": "1", "BENCH_TINY": "1"},
+        cwd=str(REPO),
+    )
+    assert out.returncode == 0, out.stderr[-500:]
+    lines = [l for l in out.stdout.strip().splitlines() if l.strip()]
+    payload = json.loads(lines[-1])
+    assert payload["unit"] == unit
+    assert payload["value"] > 0
+    assert payload["vs_baseline"] == 0.0  # no hard single-chip ref numbers
