@@ -62,6 +62,10 @@ class Request:
     # multimodal: preprocessed [S, S, 3] float image (models.vlm); its
     # n_image_tokens placeholder ids lead prompt_tokens
     image: object | None = None
+    # prefix-cache keying sequence when it must differ from prompt_tokens:
+    # multimodal requests key image positions by CONTENT-hash ids (outside
+    # the vocab) so identical images share KV and different ones never do
+    cache_key_tokens: list | None = None
 
 
 @dataclasses.dataclass
@@ -900,6 +904,19 @@ class LLMEngine:
             text = self.tokenizer.encode(prompt)[:text_budget]
             pad = self.tokenizer.pad_id % self.cfg.vocab_size
             req.prompt_tokens = [pad] * n_img + text
+            if self.prefix_cache is not None:
+                # content-derived trie key for the image positions: one id
+                # repeated (trie depth already encodes position), offset by
+                # vocab_size so it can never collide with text keys
+                import hashlib as _hashlib
+
+                digest = _hashlib.sha256(
+                    np.asarray(req.image).tobytes()
+                ).digest()
+                base = self.cfg.vocab_size + int.from_bytes(
+                    digest[:8], "little"
+                )
+                req.cache_key_tokens = [base] * n_img + text
         else:
             # prompts longer than the largest bucket prefill in chunks; the
             # hard cap is the model length (minus >=1 decode slot)
@@ -1234,13 +1251,16 @@ class LLMEngine:
         n_prompt = len(req.prompt_tokens)
         max_total = min(n_prompt + req.params.max_tokens, self.max_model_len)
         n_pages = self.cache.pages_for(max_total)
-        # multimodal requests bypass the prefix trie: their leading token ids
-        # are placeholders identical across DIFFERENT images, so trie sharing
-        # would serve one image's KV for another's prompt
-        pc = self.prefix_cache if req.image is None else None
+        # multimodal requests key the trie by image-CONTENT hash ids
+        # (req.cache_key_tokens) instead of their placeholder prompt ids —
+        # identical images share their KV pages, different images land in
+        # different trie branches (round 5; vLLM's mm prefix caching works
+        # the same way: content-addressed image keys)
+        pc = self.prefix_cache
+        key_tokens = req.cache_key_tokens or req.prompt_tokens
         shared: list[int] = []
         if pc is not None:
-            shared, _ = pc.acquire(req.prompt_tokens)
+            shared, _ = pc.acquire(key_tokens)
         need = n_pages - len(shared)
         try:
             fresh = self.cache.allocator.alloc(need)
@@ -1261,7 +1281,7 @@ class LLMEngine:
             pc.misses += not shared
             n_full = n_prompt // self.cache.page_size
             final, displaced = pc.insert(
-                req.prompt_tokens, pages[:n_full], len(shared)
+                key_tokens, pages[:n_full], len(shared)
             )
             self.cache.allocator.free(displaced)
             trie_pages = list(final)

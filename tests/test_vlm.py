@@ -167,7 +167,8 @@ class TestMultimodalEngine:
     def test_different_images_different_outputs(self, jax, jnp, setup):
         """Two requests with identical text but different images must NOT
         share prefix-cache KV (their leading token ids are identical
-        placeholders — the trie is bypassed for multimodal requests)."""
+        placeholders — the trie keys multimodal requests by image-content
+        hash, so different images land in different branches)."""
         from modal_examples_tpu.serving import LLMEngine, SamplingParams
 
         lcfg, vcfg, lparams, vparams = setup
@@ -187,6 +188,28 @@ class TestMultimodalEngine:
         eng.stop()
         assert out_a1 == out_a2  # deterministic per image
         assert out_a1 != out_b  # image actually conditions the output
+
+    def test_same_image_reuses_prefix_pages(self, jax, jnp, setup):
+        """Round 5: multimodal requests key the prefix trie by image
+        CONTENT hash, so the same image + prompt hits cached pages on the
+        second request (different-image isolation is the sibling test)."""
+        from modal_examples_tpu.serving import LLMEngine, SamplingParams
+
+        lcfg, vcfg, lparams, vparams = setup
+        eng = LLMEngine(
+            lcfg, params=lparams, max_slots=2, max_model_len=64,
+            page_size=8, prefill_buckets=(16, 32), prefill_batch=2,
+            vision=(vcfg, vparams),
+        )
+        img = np.random.RandomState(21).rand(16, 16, 3).astype(np.float32)
+        p = SamplingParams(max_tokens=6, temperature=0.0)
+        out1 = "".join(eng.stream(eng.submit("same picture", p, image=img)))
+        hits_before = eng.prefix_cache.hits
+        out2 = "".join(eng.stream(eng.submit("same picture", p, image=img)))
+        assert eng.error_count == 0, eng.error_log
+        eng.stop()
+        assert out1 == out2
+        assert eng.prefix_cache.hits > hits_before  # pages actually shared
 
     def test_text_only_still_works_alongside_mm(self, jax, jnp, setup):
         from modal_examples_tpu.serving import LLMEngine, SamplingParams
