@@ -6,6 +6,9 @@
 # Stop at the first failing stage and treat it as the trigger.
 set -x
 cd "$(dirname "$0")/.."
+# every run leaves an attributable record (which stage ran/hung/failed)
+LOG="benchmarks/revalidate_$(date -u +%Y%m%d_%H%M).log"
+exec > >(tee "$LOG") 2>&1
 # 0. health
 timeout 120 python -c "import jax, jax.numpy as jnp; print(jax.devices()); print(float(jnp.ones(3).sum()))" || exit 1
 # 1. every kernel, tiny shapes, one killable subprocess each; registry
