@@ -185,6 +185,9 @@ class App:
                 region=region,
                 cluster_size=cluster_cfg.get("size", 0),
                 cluster_chips_per_host=cluster_cfg.get("chips_per_host"),
+                enable_memory_snapshot=enable_memory_snapshot,
+                serialized=serialized,
+                experimental_options=dict(experimental_options or {}),
             )
             f = Function(self, fn, spec)
             self.registered_functions[fn_name] = f
@@ -237,6 +240,8 @@ class App:
                 max_concurrent_inputs=getattr(user_cls, "__mtpu_concurrent__", 1),
                 methods_meta=meta["methods"],
                 region=region,
+                enable_memory_snapshot=enable_memory_snapshot,
+                experimental_options=dict(experimental_options or {}),
             )
             c = Cls(self, user_cls, spec, meta)
             self.registered_classes[user_cls.__name__] = c

@@ -13,6 +13,7 @@ Usage:
     tpurun serve script.py             # host web endpoints
     tpurun secret create NAME K=V ...
     tpurun app list
+    tpurun snapshot [list | inspect KEY | clear [KEY]]   # memory-snapshot store
 """
 
 from __future__ import annotations
@@ -256,6 +257,64 @@ def cmd_docs(argv: list[str]) -> int:
     return 0
 
 
+def cmd_snapshot(argv: list[str]) -> int:
+    """Inspect the memory-snapshot store (modal_examples_tpu.snapshot).
+
+    list     — one line per entry: key, size, age, last use, function tag
+    inspect  — full meta.json for one key (manifest, rebuild markers, ...)
+    clear    — delete one entry (``clear KEY``) or every entry (``clear``)
+
+    ``--dir PATH`` overrides the store root (default: MTPU_SNAPSHOT_DIR or
+    ``<state_dir>/snapshots``).
+    """
+    from ..snapshot.store import SnapshotStore
+
+    root = None
+    if "--dir" in argv:
+        i = argv.index("--dir")
+        if i + 1 >= len(argv):
+            raise SystemExit("usage: tpurun snapshot ... --dir PATH")
+        root = argv[i + 1]
+        argv = argv[:i] + argv[i + 2 :]
+    store = SnapshotStore(root=root)
+    sub = argv[0] if argv else "list"
+    if sub == "list":
+        entries = store.entries()
+        if not entries:
+            print(f"no snapshots in {store.root}")
+            return 0
+        import time as _time
+
+        now = _time.time()
+        print(f"{'KEY':<34} {'SIZE':>9} {'AGE':>8} {'USED':>8}  FUNCTION")
+        for e in entries:
+            size_kb = e.get("size_bytes", 0) / 1024
+            age = now - e.get("created_at", now)
+            used = now - e.get("last_used", now)
+            tag = (e.get("manifest") or {}).get("tag", "")
+            print(
+                f"{e['key']:<34} {size_kb:>7.1f}kB {age:>7.0f}s {used:>7.0f}s  {tag}"
+            )
+        return 0
+    if sub == "inspect":
+        if len(argv) < 2:
+            raise SystemExit("usage: tpurun snapshot inspect KEY")
+        meta = store.inspect(argv[1])
+        if meta is None:
+            raise SystemExit(f"no snapshot {argv[1]!r} in {store.root}")
+        print(json.dumps(meta, indent=2))
+        return 0
+    if sub == "clear":
+        if len(argv) >= 2:
+            ok = store.delete(argv[1])
+            print(f"{'deleted' if ok else 'no such entry'}: {argv[1]}")
+            return 0 if ok else 1
+        n = store.clear()
+        print(f"cleared {n} snapshot(s) from {store.root}")
+        return 0
+    raise SystemExit("usage: tpurun snapshot [list | inspect KEY | clear [KEY]] [--dir PATH]")
+
+
 def cmd_app(argv: list[str]) -> int:
     if argv and argv[0] == "list":
         reg = _config.state_dir() / "apps.json"
@@ -275,6 +334,7 @@ COMMANDS = {
     "serve": cmd_serve,
     "secret": cmd_secret,
     "app": cmd_app,
+    "snapshot": cmd_snapshot,
     "examples": cmd_examples,
     "docs": cmd_docs,
 }

@@ -95,6 +95,13 @@ def _collect_lifecycle(user_cls: type) -> dict:
             "snap", False
         )
     )
+    # snapshot-eligible hooks, in run order (the memory-snapshot layer skips
+    # these on a restored boot; see modal_examples_tpu.snapshot)
+    meta["snap_enter"] = [
+        n
+        for n in meta["enter"]
+        if getattr(getattr(user_cls, n), "__mtpu_enter__", {}).get("snap", False)
+    ]
     return meta
 
 

@@ -103,6 +103,10 @@ class ContainerConfig:
     sys_paths: list[str]
     max_concurrent_inputs: int
     volumes: list[tuple[str, str]]  # (mount path, host path)
+    # memory snapshots (enable_memory_snapshot=True on a Cls): resolved
+    # client-side so supervisor and container agree on the store entry
+    snapshot_key: str | None = None
+    snapshot_dir: str | None = None
 
 
 def _mount_volumes(volumes: list[tuple[str, str]]) -> None:
@@ -143,16 +147,24 @@ def _container_main(conn, cfg_bytes: bytes) -> None:
                 os._exit(1)
 
     exit_hooks: list[Callable] = []
+    boot_info: dict = {}
     try:
         target = ser.function_from_bytes(cfg.fn_bytes)
         if cfg.is_cls:
             cls, meta = target  # (user class, lifecycle metadata dict)
-            obj = cls()
-            if cfg.cls_params:
-                for k, v in ser.deserialize(cfg.cls_params).items():
-                    setattr(obj, k, v)
-            for name in meta.get("enter", []):
-                getattr(obj, name)()
+            params = ser.deserialize(cfg.cls_params) if cfg.cls_params else {}
+            # snapshot-aware boot: restore past snap=True @enter hooks when
+            # the store has an entry for this spec, else run them and capture
+            from ..snapshot import build_and_enter
+
+            obj, boot_info = build_and_enter(
+                cls,
+                params,
+                meta,
+                snapshot_key=cfg.snapshot_key,
+                snapshot_dir=cfg.snapshot_dir,
+                tag=cfg.function_tag,
+            )
             exit_hooks = [getattr(obj, n) for n in meta.get("exit", [])]
 
             def call_fn(method_name, args, kwargs):
@@ -163,7 +175,7 @@ def _container_main(conn, cfg_bytes: bytes) -> None:
             def call_fn(method_name, args, kwargs):
                 return target(*args, **kwargs)
 
-        send(("ready",))
+        send(("ready", boot_info))
     except BaseException as e:  # boot failure
         send(("boot_error", ser.serialize_exception(e)))
         return
@@ -448,6 +460,12 @@ class _Container:
                 kind = msg[0]
                 if kind == "ready":
                     self.ever_ready = True
+                    info = msg[1] if len(msg) > 1 else {}
+                    if info:
+                        try:
+                            self.pool.on_container_ready(self, info)
+                        except Exception:
+                            traceback.print_exc()
                     self.ready.set()
                 elif kind == "boot_error":
                     exc, _tb = ser.deserialize_exception(msg[1])
@@ -530,6 +548,9 @@ class FunctionPool:
         self.calls: dict[str, _Call] = {}
         self.containers: list[_Container] = []
         self.boot_crashes = 0
+        # while True, scale-up is capped at one container so the first warm
+        # boot can capture a snapshot every later boot restores from
+        self._snapshot_gate = bool(self.container_config.snapshot_key)
         self.lock = threading.Lock()
         self.wake = threading.Condition(self.lock)
         self.closed = False
@@ -563,6 +584,17 @@ class FunctionPool:
                 c.proc.wait(max(0.05, deadline - time.monotonic()))
             except subprocess.TimeoutExpired:
                 c.kill()
+
+    def on_container_ready(self, container: "_Container", info: dict) -> None:
+        """Boot telemetry from the container's ``ready`` message: cold-start
+        snapshot hit/miss accounting (utils/metrics.py -> prometheus)."""
+        result = info.get("snapshot")
+        if result and result != "off":
+            from ..utils.metrics import record_snapshot_boot
+
+            record_snapshot_boot(
+                self.spec.tag, result, captured=info.get("captured", False)
+            )
 
     # -- failure/retry ------------------------------------------------------
 
@@ -734,6 +766,26 @@ class FunctionPool:
                 with self.lock:
                     self.pending.extendleft(reversed(e.still_owned))
 
+    def _snapshot_pending_first_capture(self) -> bool:
+        """True while boots should serialize behind the first warm boot: the
+        spec wants memory snapshots but the store has no entry yet, so a
+        thundering herd of cold boots would all pay the full @enter cost.
+        Once a snapshot exists (or the first boot came up without producing
+        one — capture failed or state isn't capturable) the gate opens for
+        good."""
+        if not self._snapshot_gate:
+            return False
+        from ..snapshot.store import SnapshotStore
+
+        store = SnapshotStore(root=self.container_config.snapshot_dir)
+        if store.has(self.container_config.snapshot_key):
+            self._snapshot_gate = False
+            return False
+        if any(c.ever_ready for c in self.containers):
+            self._snapshot_gate = False
+            return False
+        return True
+
     def _autoscale(self, now: float) -> None:
         with self.lock:
             pending_n = len(self.pending)
@@ -748,10 +800,18 @@ class FunctionPool:
                 (pending_n - free_slots + self.spec_max_concurrent - 1)
                 // self.spec_max_concurrent,
             )
+        if want > 0 and self._snapshot_pending_first_capture():
+            want = min(want, max(0, 1 - len(live)))
         for _ in range(max(0, want)):
             self._spawn_container()
-        # keep min_containers warm
+        # keep min_containers warm (snapshot gate: warm one first, the rest
+        # boot as restores once the capture lands)
         while len([c for c in self.containers if not c.dead]) < self.spec.min_containers:
+            if (
+                self._snapshot_pending_first_capture()
+                and len([c for c in self.containers if not c.dead]) >= 1
+            ):
+                break
             self._spawn_container()
         # scale down
         idle_cut = now - self.spec.scaledown_window
@@ -816,6 +876,9 @@ class ClusterPool:
 
     def handle_failure(self, qi: _QueuedInput, exc: BaseException) -> None:
         qi.call.set_exception(exc)
+
+    def on_container_ready(self, container, info: dict) -> None:
+        pass  # gang hosts are plain functions; no snapshot boots to record
 
     def on_container_dead(self, container, orphans: list[_QueuedInput]) -> None:
         err = container.boot_error or RuntimeError(
@@ -995,13 +1058,26 @@ class InlinePool:
             os.environ.update(cfg.env)
             target = ser.function_from_bytes(cfg.fn_bytes)
             if cfg.is_cls:
+                from ..snapshot import build_and_enter
+
                 cls, meta = target
-                obj = cls()
-                if cfg.cls_params:
-                    for k, v in ser.deserialize(cfg.cls_params).items():
-                        setattr(obj, k, v)
-                for name in meta.get("enter", []):
-                    getattr(obj, name)()
+                params = ser.deserialize(cfg.cls_params) if cfg.cls_params else {}
+                obj, boot_info = build_and_enter(
+                    cls,
+                    params,
+                    meta,
+                    snapshot_key=cfg.snapshot_key,
+                    snapshot_dir=cfg.snapshot_dir,
+                    tag=cfg.function_tag,
+                )
+                if boot_info.get("snapshot", "off") != "off":
+                    from ..utils.metrics import record_snapshot_boot
+
+                    record_snapshot_boot(
+                        self.spec.tag,
+                        boot_info["snapshot"],
+                        captured=boot_info.get("captured", False),
+                    )
                 self._obj = obj
                 self._exit_hooks = [getattr(obj, n) for n in meta.get("exit", [])]
 

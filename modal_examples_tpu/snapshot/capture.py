@@ -1,0 +1,58 @@
+"""Capture path: serialize a container's post-``@enter(snap=True)`` state.
+
+Runs inside the container worker right after the snapshot-eligible enter
+hooks complete (and before the non-snap hooks, matching the reference's
+snapshot point — gpu_snapshot.py takes the memory image after ``snap=True``
+setup). The user object's ``__dict__`` goes through the pytree codec; attrs
+that can't cross the boundary (locks, clients, open handles, jitted
+callables on jax versions where cloudpickle can't ship them) become rebuild
+markers attributed to the hook that created them, so the restore path knows
+to re-run exactly that hook. The manifest also records the compile-cache
+linkage: a restored boot pairs its rebuilt ``jax.jit`` wrappers with the
+persistent XLA cache entries the capture boot produced.
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+import time
+
+from . import codec
+from .store import SnapshotStore
+
+
+def capture(
+    store: SnapshotStore,
+    key: str,
+    obj,
+    *,
+    tag: str = "",
+    baseline_attrs: set[str] | frozenset[str] = frozenset(),
+    hook_attrs: dict[str, list[str]] | None = None,
+) -> bool:
+    """Snapshot ``obj``'s state under ``key``. Returns True when an entry is
+    in place (this capture's or a racing replica's). Never raises."""
+    hook_attrs = hook_attrs or {}
+    try:
+        t0 = time.monotonic()
+        payload, rebuild = codec.encode_state(dict(obj.__dict__))
+        # Attrs created by __init__/cls-params and untouched by the snap
+        # hooks are recreated by fresh construction on every boot; but a
+        # baseline attr a hook *rebound* to something uncapturable must stay
+        # a rebuild marker so the restore re-runs the owning hook.
+        hook_owned = {a for attrs in hook_attrs.values() for a in attrs}
+        rebuild = [a for a in rebuild if a not in baseline_attrs or a in hook_owned]
+        manifest = {
+            "tag": tag,
+            "type": type(obj).__name__,
+            "hook_attrs": hook_attrs,
+            "rebuild": sorted(rebuild),
+            "baseline": sorted(baseline_attrs),
+            "jax_compile_cache": os.environ.get("JAX_COMPILATION_CACHE_DIR", ""),
+            "python": sys.version.split()[0],
+            "encode_s": round(time.monotonic() - t0, 4),
+        }
+        return store.put(key, payload, manifest)
+    except Exception:
+        return False  # capture must never take down a healthy boot

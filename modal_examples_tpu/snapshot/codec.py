@@ -1,0 +1,130 @@
+"""Jax-pytree-aware state codec for memory snapshots.
+
+Per-attribute serialization of a user object's ``__dict__``. Values are
+walked as pytrees (dict/list/tuple containers); ``jax.Array`` leaves are
+devicelessly captured as numpy (``jax.device_get`` semantics) and re-put on
+restore (``jnp.asarray`` — the "weights back to HBM" step of a restored
+boot). Everything else round-trips through the framework's pickle/cloudpickle
+serializer. A value that survives neither pickling path raises
+:class:`CodecError`; the capture layer records it as a rebuild-on-restore
+marker instead of failing the snapshot.
+
+This module must stay importable without jax (it runs in the jax-free core
+boot path); jax/numpy are only touched when a jax array is actually present,
+which implies jax is already imported in this process.
+"""
+
+from __future__ import annotations
+
+import pickle
+import sys
+
+from ..core import serialization as ser
+
+_MAX_DEPTH = 64
+
+
+class CodecError(Exception):
+    """Value cannot cross the snapshot boundary (record a rebuild marker)."""
+
+
+class _JaxLeaf:
+    """Marker wrapper: a jax array captured as host numpy."""
+
+    __slots__ = ("array",)
+
+    def __init__(self, array):
+        self.array = array
+
+    def __getstate__(self):
+        return self.array
+
+    def __setstate__(self, array):
+        self.array = array
+
+
+def _is_jax_array(v) -> bool:
+    if "jax" not in sys.modules:  # no jax imported -> no jax arrays exist
+        return False
+    mod = type(v).__module__ or ""
+    if not mod.startswith(("jax", "jaxlib")):
+        return False
+    return hasattr(v, "__array__") and hasattr(v, "dtype") and hasattr(v, "shape")
+
+
+def _encode_tree(v, depth: int = 0):
+    if depth > _MAX_DEPTH:
+        return v
+    try:
+        if _is_jax_array(v):
+            import numpy as np
+
+            return _JaxLeaf(np.asarray(v))
+        if isinstance(v, dict):
+            items = {k: _encode_tree(x, depth + 1) for k, x in v.items()}
+            return items if type(v) is dict else type(v)(items)
+        if isinstance(v, (list, tuple)):
+            items = [_encode_tree(x, depth + 1) for x in v]
+            if type(v) is list:
+                return items
+            if isinstance(v, tuple) and hasattr(v, "_fields"):  # namedtuple
+                return type(v)(*items)
+            return type(v)(items)
+    except Exception:
+        pass  # exotic container: fall through and pickle the value whole
+    return v
+
+
+def _decode_tree(v, depth: int = 0):
+    if depth > _MAX_DEPTH:
+        return v
+    if isinstance(v, _JaxLeaf):
+        try:
+            import jax.numpy as jnp
+
+            return jnp.asarray(v.array)
+        except Exception:
+            return v.array  # jax unavailable here: numpy ducks for most ops
+    if isinstance(v, dict):
+        items = {k: _decode_tree(x, depth + 1) for k, x in v.items()}
+        return items if type(v) is dict else type(v)(items)
+    if isinstance(v, (list, tuple)):
+        items = [_decode_tree(x, depth + 1) for x in v]
+        if type(v) is list:
+            return items
+        if isinstance(v, tuple) and hasattr(v, "_fields"):
+            return type(v)(*items)
+        return type(v)(items)
+    return v
+
+
+def encode_attr(value) -> bytes:
+    try:
+        return ser.serialize(_encode_tree(value))
+    except Exception as e:
+        raise CodecError(
+            f"{type(value).__name__} is not snapshot-serializable: {e}"
+        ) from e
+
+
+def decode_attr(data: bytes):
+    return _decode_tree(pickle.loads(data))
+
+
+def encode_state(state: dict) -> tuple[bytes, list[str]]:
+    """Encode an object's ``__dict__``. Returns (payload, rebuild_attrs):
+    attrs that cannot be serialized (jitted callables, locks, clients) are
+    left out of the payload and listed for the restore path to rebuild."""
+    blobs: dict[str, bytes] = {}
+    rebuild: list[str] = []
+    for name, value in state.items():
+        try:
+            blobs[name] = encode_attr(value)
+        except CodecError:
+            rebuild.append(name)
+    return pickle.dumps(blobs, protocol=pickle.HIGHEST_PROTOCOL), rebuild
+
+
+def decode_state(payload: bytes) -> dict:
+    blobs = pickle.loads(payload)
+    return {name: decode_attr(data) for name, data in blobs.items()}

@@ -1,8 +1,49 @@
 """Evaluation metrics: word error rate (the Whisper fine-tune eval,
 openai_whisper/finetuning/train/train.py:431-490 computes WER; the
-end-to-end check asserts WER < 1.0, end_to_end_check.py:29-70)."""
+end-to-end check asserts WER < 1.0, end_to_end_check.py:29-70).
+
+Also hosts runtime telemetry recorders that feed the prometheus registry
+(utils/prometheus.py) — currently cold-start memory-snapshot accounting
+(:func:`record_snapshot_boot`), pushed from the executor supervisor on every
+snapshot-enabled container boot."""
 
 from __future__ import annotations
+
+
+#: Prometheus metric names for memory-snapshot cold-start accounting
+#: (modal_examples_tpu.snapshot). Labels: function=<spec tag>, and
+#: result=hit|miss|fallback on the boots counter.
+SNAPSHOT_BOOTS_METRIC = "mtpu_snapshot_boots_total"
+SNAPSHOT_CAPTURES_METRIC = "mtpu_snapshot_captures_total"
+
+
+def record_snapshot_boot(
+    tag: str, result: str, *, captured: bool = False, registry=None
+) -> None:
+    """Count one snapshot-enabled container boot.
+
+    ``result`` is the boot's snapshot outcome: ``"hit"`` (restored past
+    ``snap=True`` hooks), ``"miss"`` (no entry yet; cold boot + capture), or
+    ``"fallback"`` (an entry existed but couldn't be used; cold boot).
+    ``captured=True`` additionally counts a published snapshot. The executor
+    calls this on the supervisor side from the container's ready message, so
+    the registry lives in the client process that serves /metrics."""
+    from .prometheus import default_registry
+
+    reg = registry if registry is not None else default_registry
+    reg.counter_inc(
+        SNAPSHOT_BOOTS_METRIC,
+        1.0,
+        labels={"function": tag, "result": result},
+        help="snapshot-enabled container boots by outcome (hit/miss/fallback)",
+    )
+    if captured:
+        reg.counter_inc(
+            SNAPSHOT_CAPTURES_METRIC,
+            1.0,
+            labels={"function": tag},
+            help="memory snapshots captured and published to the store",
+        )
 
 
 def _levenshtein(a: list[str], b: list[str]) -> int:

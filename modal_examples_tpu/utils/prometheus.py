@@ -63,6 +63,16 @@ class Registry:
                     lines.append(f"{name}{label_s} {value}")
             return "\n".join(lines) + "\n"
 
+    def value(self, name: str, labels: dict | None = None) -> float:
+        """Current value of one series (counter or gauge); 0.0 when never
+        written. Lets tests and the CLI read counters back without parsing
+        the text exposition."""
+        key = self._key(name, labels)
+        with self._lock:
+            if key in self._gauges:
+                return self._gauges[key]
+            return self._counters.get(key, 0.0)
+
     def snapshot(self) -> dict:
         with self._lock:
             return {

@@ -1,14 +1,19 @@
-"""Static tier: every module in the package byte-compiles and imports, and
-the jax-free layering invariant holds (the reference's typecheck/lint CI
-analog, SURVEY.md §4 — mypy isn't in this image, so the checks are
-compileall + import + an architectural rule)."""
+"""Static tier: every module in the package byte-compiles and imports, the
+jax-free layering invariant holds, and decorator kwargs can't be silently
+dropped (the reference's typecheck/lint CI analog, SURVEY.md §4 — mypy isn't
+in this image, so the checks are compileall + import + architectural rules)."""
 
+import ast
 import compileall
 import importlib
+import inspect
 import pkgutil
 import subprocess
 import sys
+import textwrap
 from pathlib import Path
+
+import pytest
 
 import modal_examples_tpu
 
@@ -62,3 +67,59 @@ def test_core_layer_is_jax_free():
         env={"PATH": "/usr/bin:/bin:/usr/local/bin", "PYTHONPATH": str(REPO_ROOT)},
     )
     assert out.returncode == 0 and "jax-free" in out.stdout, out.stderr
+
+
+@pytest.mark.parametrize(
+    "decorator",
+    [modal_examples_tpu.App.function, modal_examples_tpu.App.cls],
+    ids=["app.function", "app.cls"],
+)
+def test_decorator_kwargs_never_silently_dropped(decorator):
+    """Every keyword `@app.function`/`@app.cls` accepts must be *used* in the
+    decorator body — forwarded into FunctionSpec, transformed first, or
+    explicitly rejected (like gpu=). An accepted-but-unreferenced parameter
+    is the `enable_memory_snapshot` bug class: the user sets it, the spec
+    never sees it, nothing fails. This guard makes that class unrepresentable.
+    """
+    src = textwrap.dedent(inspect.getsource(decorator))
+    fn = ast.parse(src).body[0]
+    accepted = {a.arg for a in fn.args.args + fn.args.kwonlyargs} - {"self"}
+    used = {
+        node.id
+        for node in ast.walk(fn)
+        if isinstance(node, ast.Name) and isinstance(node.ctx, ast.Load)
+    }
+    dropped = accepted - used
+    assert not dropped, (
+        f"{decorator.__qualname__} accepts but never reads {sorted(dropped)}; "
+        f"forward them into FunctionSpec or reject them explicitly"
+    )
+
+
+@pytest.mark.parametrize(
+    "decorator",
+    [modal_examples_tpu.App.function, modal_examples_tpu.App.cls],
+    ids=["app.function", "app.cls"],
+)
+def test_decorator_kwargs_exist_on_function_spec(decorator):
+    """Scheduling kwargs shared by both decorators should map to a
+    FunctionSpec field of the same name, so the forwarding the guard above
+    enforces has somewhere real to land. (Params that are transformed or
+    consumed client-side are listed as such.)"""
+    from modal_examples_tpu.core.function import FunctionSpec
+
+    transformed_or_consumed = {
+        "gpu",  # explicitly rejected: TPU-native framework
+        "name",  # becomes the spec tag
+        "tpu",  # parse_tpu_request -> spec.tpu
+        "retries",  # normalize_retries -> spec.retries
+    }
+    spec_fields = {f.name for f in __import__("dataclasses").fields(FunctionSpec)}
+    src = textwrap.dedent(inspect.getsource(decorator))
+    fn = ast.parse(src).body[0]
+    accepted = {a.arg for a in fn.args.args + fn.args.kwonlyargs} - {"self"}
+    unmapped = accepted - spec_fields - transformed_or_consumed
+    assert not unmapped, (
+        f"{decorator.__qualname__} kwargs with no FunctionSpec field: "
+        f"{sorted(unmapped)}"
+    )
