@@ -140,6 +140,34 @@ def _child(model: str) -> None:
     # once for up to `slots` tokens. steps/s * weight_bytes over the HBM
     # ceiling says how close the whole serving stack runs to the hardware.
     stream_gbps = (tok_s / spec["slots"]) * weight_bytes / 1e9
+
+    # per-phase latency distributions (p50/p95/p99) from the engine's
+    # observability histograms — phase-attributed perf trajectory in every
+    # BENCH_*.json from here on (docs/observability.md)
+    from modal_examples_tpu.observability import catalog as C
+    from modal_examples_tpu.utils.prometheus import default_registry
+
+    def _q(name, labels=None):
+        q = default_registry.histogram_quantiles(name, labels=labels)
+        if q is None:
+            return None
+        return {
+            k: (round(v, 6) if isinstance(v, float) else v)
+            for k, v in q.items()
+        }
+
+    phase_latency = {}
+    for phase in ("prefill", "prefill_chunked", "decode_wait"):
+        q = _q(C.ENGINE_PHASE_SECONDS, {"phase": phase})
+        if q:
+            phase_latency[phase] = q
+    for key, name in (
+        ("queue_wait", C.ENGINE_QUEUE_WAIT_SECONDS),
+        ("batch_size", C.ENGINE_BATCH_SIZE),
+    ):
+        q = _q(name)
+        if q:
+            phase_latency[key] = q
     print(
         json.dumps(
             {
@@ -158,6 +186,7 @@ def _child(model: str) -> None:
                 "compile_s": round(compile_s, 1),
                 "pct_hbm_ceiling": round(stream_gbps / V5E_HBM_GBPS, 4),
                 "engine_errors": errors,
+                "phase_latency": phase_latency,
             }
         )
     )

@@ -23,6 +23,7 @@ import dataclasses
 import datetime as _dt
 import inspect
 import json
+import os
 import sys
 import threading
 import time
@@ -76,6 +77,16 @@ class AppRun:
             pools = list(self._pools.values())
         for p in pools:
             p.shutdown()
+        # push this run's metric series to the file gateway: the process is
+        # ephemeral, so a scraper (or `tpurun metrics`) reads the pushed
+        # exposition after we're gone — the pushgateway-for-ephemeral-
+        # containers pattern (observability.export).
+        try:
+            from ..observability.export import push_metrics_file
+
+            push_metrics_file(f"app-{self.app.name}-{os.getpid()}")
+        except Exception:
+            pass  # metrics must never break shutdown
 
 
 class _LocalEntrypoint:

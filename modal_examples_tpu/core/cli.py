@@ -14,6 +14,8 @@ Usage:
     tpurun secret create NAME K=V ...
     tpurun app list
     tpurun snapshot [list | inspect KEY | clear [KEY]]   # memory-snapshot store
+    tpurun trace [CALL_ID | list]      # call-lifecycle trace (phase spans)
+    tpurun metrics [--json]            # merged pushed prometheus expositions
 """
 
 from __future__ import annotations
@@ -45,6 +47,16 @@ def _build_entrypoint_parser(fn, prog: str) -> argparse.ArgumentParser:
             typ = ann if ann in (int, float, str) else (type(default) if default is not None and type(default) in (int, float, str) else str)
             p.add_argument(flag, type=typ, default=default, required=required)
     return p
+
+
+def _pop_dir_flag(argv: list[str], usage: str) -> tuple[list[str], str | None]:
+    """Extract ``--dir PATH`` from argv; returns (rest, path_or_None)."""
+    if "--dir" not in argv:
+        return argv, None
+    i = argv.index("--dir")
+    if i + 1 >= len(argv):
+        raise SystemExit(usage)
+    return argv[:i] + argv[i + 2 :], argv[i + 1]
 
 
 def _load_app(path: str):
@@ -269,13 +281,7 @@ def cmd_snapshot(argv: list[str]) -> int:
     """
     from ..snapshot.store import SnapshotStore
 
-    root = None
-    if "--dir" in argv:
-        i = argv.index("--dir")
-        if i + 1 >= len(argv):
-            raise SystemExit("usage: tpurun snapshot ... --dir PATH")
-        root = argv[i + 1]
-        argv = argv[:i] + argv[i + 2 :]
+    argv, root = _pop_dir_flag(argv, "usage: tpurun snapshot ... --dir PATH")
     store = SnapshotStore(root=root)
     sub = argv[0] if argv else "list"
     if sub == "list":
@@ -315,6 +321,95 @@ def cmd_snapshot(argv: list[str]) -> int:
     raise SystemExit("usage: tpurun snapshot [list | inspect KEY | clear [KEY]] [--dir PATH]")
 
 
+def cmd_trace(argv: list[str]) -> int:
+    """Render one call's lifecycle trace as an indented span tree.
+
+    trace CALL_ID      — the spans of one call (CALL_ID is the ``in-...`` id
+                         from ``FunctionCall.call_id`` / ``tpurun trace list``)
+    trace list [N]     — most recently active traces
+    ``--dir PATH`` overrides the trace root (default ``<state_dir>/traces``).
+    """
+    from ..observability.trace import TraceStore
+
+    argv, root = _pop_dir_flag(argv, "usage: tpurun trace ... --dir PATH")
+    store = TraceStore(root=root)
+    if not argv or argv[0] == "list":
+        limit = int(argv[1]) if len(argv) > 1 else 20
+        ids = store.list_traces(limit=limit)
+        if not ids:
+            print(f"no traces in {store.root}")
+            return 0
+        for tid in ids:
+            spans = store.read(tid)
+            roots = [s for s in spans if s.get("parent_id") is None]
+            head = roots[0] if roots else (spans[0] if spans else {})
+            attrs = head.get("attrs") or {}
+            dur = (head.get("end") or 0) - (head.get("start") or 0)
+            status = head.get("status", "?")
+            print(
+                f"{tid}  {attrs.get('function', '?'):<24} "
+                f"{dur * 1000:>9.1f}ms  {status}  ({len(spans)} spans)"
+            )
+        return 0
+    trace_id = argv[0]
+    spans = store.read(trace_id)
+    if not spans:
+        raise SystemExit(f"no trace {trace_id!r} in {store.root}")
+    spans.sort(key=lambda s: (s.get("start") or 0.0))
+    by_parent: dict = {}
+    for s in spans:
+        by_parent.setdefault(s.get("parent_id"), []).append(s)
+    t0 = min(s.get("start") or 0.0 for s in spans)
+
+    def render(span: dict, depth: int) -> None:
+        dur = ((span.get("end") or span["start"]) - span["start"]) * 1000
+        rel = (span["start"] - t0) * 1000
+        attrs = span.get("attrs") or {}
+        extras = " ".join(f"{k}={v}" for k, v in attrs.items())
+        mark = "" if span.get("status") == "ok" else f" [{span.get('status')}]"
+        print(
+            f"{'  ' * depth}{span['name']:<{24 - 2 * min(depth, 8)}} "
+            f"+{rel:>8.1f}ms {dur:>9.1f}ms{mark}"
+            + (f"  {extras}" if extras else "")
+        )
+        for child in by_parent.get(span.get("span_id"), []):
+            render(child, depth + 1)
+
+    print(f"trace {trace_id}")
+    for s in by_parent.get(None, []):
+        render(s, 0)
+    # spans whose parent never landed (e.g. the container died before its
+    # dispatch span closed) still print, flat, rather than vanishing
+    known = {s.get("span_id") for s in spans}
+    for s in spans:
+        pid = s.get("parent_id")
+        if pid is not None and pid not in known:
+            render(s, 0)
+    return 0
+
+
+def cmd_metrics(argv: list[str]) -> int:
+    """Print the merged prometheus exposition of every pushed job file
+    (``<state_dir>/metrics/*.prom`` — the local pushgateway) — the same text
+    a scraper sees on the gateway's ``/metrics``. ``--json`` prints
+    {job: path} of the sources instead."""
+    from ..observability.export import _metrics_dir, read_pushed_metrics
+
+    argv, root = _pop_dir_flag(
+        argv, "usage: tpurun metrics [--json] [--dir PATH]"
+    )
+    if "--json" in argv:
+        d = _metrics_dir(root)
+        print(json.dumps({p.stem: str(p) for p in sorted(d.glob("*.prom"))}))
+        return 0
+    text = read_pushed_metrics(root)
+    if not text:
+        print("no pushed metrics (run an app first, or scrape a live /metrics)")
+        return 0
+    print(text, end="")
+    return 0
+
+
 def cmd_app(argv: list[str]) -> int:
     if argv and argv[0] == "list":
         reg = _config.state_dir() / "apps.json"
@@ -335,6 +430,8 @@ COMMANDS = {
     "secret": cmd_secret,
     "app": cmd_app,
     "snapshot": cmd_snapshot,
+    "trace": cmd_trace,
+    "metrics": cmd_metrics,
     "examples": cmd_examples,
     "docs": cmd_docs,
 }
@@ -349,7 +446,16 @@ def main(argv: list[str] | None = None) -> int:
     handler = COMMANDS.get(cmd)
     if handler is None:
         raise SystemExit(f"unknown command {cmd!r}; one of {sorted(COMMANDS)}")
-    return handler(rest)
+    try:
+        return handler(rest)
+    except BrokenPipeError:
+        # `tpurun trace list | head` is a supported workflow: the reader
+        # closing early is success, not a traceback
+        try:
+            sys.stdout.close()
+        except OSError:
+            pass
+        return 0
 
 
 if __name__ == "__main__":

@@ -47,8 +47,13 @@ from multiprocessing.connection import Client, Listener
 from pathlib import Path
 
 from .._internal import config as _config
+from ..observability import metrics as _obs
+from ..observability import trace as _tr
+from ..utils.log import get_logger
 from . import serialization as ser
 from .retries import Retries
+
+_log = get_logger("executor")
 
 
 import contextvars
@@ -56,7 +61,7 @@ import contextvars
 #: the input id being processed by the current container thread
 #: (modal.current_input_id parity)
 _current_input_id: contextvars.ContextVar[str | None] = contextvars.ContextVar(
-    "mtpu_input_id", default=None
+    "mtpu-input-id", default=None
 )
 
 
@@ -122,7 +127,7 @@ def _mount_volumes(volumes: list[tuple[str, str]]) -> None:
             os.makedirs(os.path.dirname(mount_path) or "/", exist_ok=True)
             os.symlink(host_path, mount_path)
         except OSError as e:
-            print(f"[mtpu] warning: cannot mount volume at {mount_path}: {e}")
+            _log.warning("cannot mount volume at %s: %s", mount_path, e)
 
 
 def _container_main(conn, cfg_bytes: bytes) -> None:
@@ -182,24 +187,112 @@ def _container_main(conn, cfg_bytes: bytes) -> None:
 
     inflight = threading.Semaphore(cfg.max_concurrent_inputs)
 
-    def run_one(input_id: str, method_name: str, payload: bytes) -> None:
+    def run_one(
+        input_id: str, method_name: str, payload: bytes, trace: dict | None = None
+    ) -> None:
+        """Execute one input, emitting execute/serialize spans that ship back
+        over the pipe and stitch into the caller's trace (the supervisor
+        records them before delivering the result, so a trace read right
+        after ``.result()`` already sees the child's spans)."""
         _current_input_id.set(input_id)
+        spans: list[dict] = []
+
+        def begin(name: str) -> "_tr.Span | None":
+            if trace is None:
+                return None
+            return _tr.Span(
+                trace_id=trace["trace_id"],
+                name=name,
+                parent_id=trace.get("parent_id"),
+            )
+
+        def done(sp, status: str = "ok", **attrs) -> None:
+            if sp is not None:
+                sp.finish(status, **attrs)
+                spans.append(sp.to_dict())
+
         try:
-            args, kwargs = ser.deserialize(payload)
-            result = call_fn(method_name, args, kwargs)
+            ex = begin("execute")
+            if ex is not None:
+                # nested user spans (observability.span) ride the same buffer
+                _tr.set_context(
+                    _tr.TraceContext(trace["trace_id"], ex.span_id, spans.append)
+                )
+            try:
+                args, kwargs = ser.deserialize(payload)
+                result = call_fn(method_name, args, kwargs)
+            except BaseException:
+                done(ex, "error")
+                raise
             if inspect.isgenerator(result):
-                for item in result:
-                    send(("yield", input_id, ser.serialize(item)))
+                ser_s = 0.0
+                n_items = 0
+                try:
+                    while True:
+                        try:
+                            item = next(result)
+                        except StopIteration:
+                            break
+                        t0 = time.monotonic()
+                        out = ser.serialize(item)
+                        ser_s += time.monotonic() - t0
+                        send(("yield", input_id, out))
+                        n_items += 1
+                except BaseException:
+                    done(ex, "error", items=n_items)
+                    raise
+                done(ex, "ok", items=n_items)
+                sz = begin("serialize")
+                if sz is not None:
+                    # per-item serialize time accumulated across the stream
+                    sz.start = time.time() - ser_s
+                    done(sz, "ok", items=n_items, streamed=True)
+                if spans:
+                    send(("spans", spans))
                 send(("gen_done", input_id))
             else:
-                send(("result", input_id, True, ser.serialize(result)))
+                done(ex, "ok")
+                sz = begin("serialize")
+                out = ser.serialize(result)
+                done(sz, "ok", bytes=len(out))
+                if spans:
+                    send(("spans", spans))
+                send(("result", input_id, True, out))
         except BaseException as e:
+            if spans:
+                send(("spans", spans))
             send(("result", input_id, False, ser.serialize_exception(e)))
         finally:
             inflight.release()
 
-    def run_batch(input_ids: list[str], method_name: str, payloads: list[bytes]) -> None:
+    def run_batch(
+        input_ids: list[str],
+        method_name: str,
+        payloads: list[bytes],
+        traces: list | None = None,
+    ) -> None:
         """Dynamic batching: unzip single-item args, call once with lists."""
+        traces = traces or [None] * len(input_ids)
+        spans: list[dict] = []
+
+        def phase(name: str, start: float, end: float, status: str) -> None:
+            # the batch ran once, but each input's trace gets its own copy of
+            # the shared phase span (tagged with the batch size)
+            for tr in traces:
+                if tr is None:
+                    continue
+                sp = _tr.Span(
+                    trace_id=tr["trace_id"],
+                    name=name,
+                    parent_id=tr.get("parent_id"),
+                    start=start,
+                    attrs={"batch_size": len(input_ids)},
+                )
+                sp.end = end
+                sp.status = status
+                spans.append(sp.to_dict())
+
+        t_exec = time.time()
         try:
             calls = [ser.deserialize(p) for p in payloads]
             n_args = len(calls[0][0])
@@ -213,10 +306,19 @@ def _container_main(conn, cfg_bytes: bytes) -> None:
                     f"@batched function returned {len(results)} outputs for "
                     f"{len(input_ids)} inputs"
                 )
-            for iid, r in zip(input_ids, results):
-                send(("result", iid, True, ser.serialize(r)))
+            t_ser = time.time()
+            phase("execute", t_exec, t_ser, "ok")
+            outs = [ser.serialize(r) for r in results]
+            phase("serialize", t_ser, time.time(), "ok")
+            if spans:
+                send(("spans", spans))
+            for iid, out in zip(input_ids, outs):
+                send(("result", iid, True, out))
         except BaseException as e:
+            phase("execute", t_exec, time.time(), "error")
             err = ser.serialize_exception(e)
+            if spans:
+                send(("spans", spans))
             for iid in input_ids:
                 send(("result", iid, False, err))
         finally:
@@ -230,16 +332,20 @@ def _container_main(conn, cfg_bytes: bytes) -> None:
         if msg[0] == "shutdown":
             break
         elif msg[0] == "input":
-            _, input_id, method_name, payload = msg
+            _, input_id, method_name, payload, trace = msg
             inflight.acquire()
             threading.Thread(
-                target=run_one, args=(input_id, method_name, payload), daemon=True
+                target=run_one,
+                args=(input_id, method_name, payload, trace),
+                daemon=True,
             ).start()
         elif msg[0] == "batch":
-            _, input_ids, method_name, payloads = msg
+            _, input_ids, method_name, payloads, traces = msg
             inflight.acquire()
             threading.Thread(
-                target=run_batch, args=(input_ids, method_name, payloads), daemon=True
+                target=run_batch,
+                args=(input_ids, method_name, payloads, traces),
+                daemon=True,
             ).start()
 
     for hook in exit_hooks:
@@ -247,6 +353,14 @@ def _container_main(conn, cfg_bytes: bytes) -> None:
             hook()
         except Exception:
             traceback.print_exc()
+    try:
+        # this process's registry (e.g. engine histograms for a served model
+        # living in this container) outlives it via the file push gateway
+        from ..observability.export import push_metrics_file
+
+        push_metrics_file(f"container-{cfg.function_tag}-{os.getpid()}")
+    except Exception:
+        pass  # metrics must never break container shutdown
     try:
         send(("bye",))
     except Exception:
@@ -272,14 +386,37 @@ class _Call:
         self.exc: BaseException | None = None
         self.gen_queue: _queue.Queue = _queue.Queue()
         self.cancelled = False
+        # observability: trace id == input id; the pool opens the root span
+        # at submit and registers a finalizer that closes it
+        self.trace_id: str | None = None
+        self.root_span: "_tr.Span | None" = None
+        self._done_callbacks: list[Callable] = []
+        self._finalized = False
+
+    def add_done_callback(self, fn: Callable) -> None:
+        self._done_callbacks.append(fn)
+
+    def _run_done_callbacks(self) -> None:
+        if self._finalized:
+            return
+        self._finalized = True
+        for fn in self._done_callbacks:
+            try:
+                fn()
+            except Exception:
+                _log.exception("call done-callback failed")
 
     def set_result(self, value) -> None:
         self.ok, self.value = True, value
+        # finalizers run BEFORE done.set(): a caller unblocked by .result()
+        # must find the completed trace on disk
+        self._run_done_callbacks()
         self.done.set()
 
     def set_exception(self, exc: BaseException) -> None:
         self.ok, self.exc = False, exc
         self.gen_queue.put(("error", exc))
+        self._run_done_callbacks()
         self.done.set()
 
     def result(self, timeout: float | None = None):
@@ -297,6 +434,31 @@ class _QueuedInput:
     payload: bytes
     ready_at: float = 0.0  # for retry backoff
     started_at: float | None = None
+    # open phase spans; each is finished + recorded at its phase boundary
+    queue_span: "_tr.Span | None" = None
+    dispatch_span: "_tr.Span | None" = None
+
+    def trace_ctx(self) -> dict | None:
+        """Propagation payload for the container-worker protocol: the child's
+        execute/serialize spans parent under this input's dispatch span."""
+        if self.dispatch_span is None:
+            return None
+        return {
+            "trace_id": self.call.trace_id,
+            "parent_id": self.dispatch_span.span_id,
+        }
+
+
+def _end_dispatch_span(pool, qi: _QueuedInput, status: str, **attrs) -> None:
+    """Finish + record an input's dispatch span (shared by the container
+    reader's success path and the pool's failure paths)."""
+    sp = qi.dispatch_span
+    if sp is None:
+        return
+    qi.dispatch_span = None
+    dur = sp.finish(status, **attrs)
+    _tr.default_store.record(sp)
+    _obs.record_phase(pool.spec.tag, "dispatch", dur)
 
 
 def worker_entry() -> None:
@@ -364,6 +526,12 @@ class _Container:
         self.kill_reason: str | None = None
         self.ready = threading.Event()
         self.ever_ready = False
+        # observability: boot wall-clock window + snapshot outcome, consumed
+        # by the first dispatched input's "boot" span
+        self.boot_wall_start = time.time()
+        self.ready_wall: float | None = None
+        self.boot_info: dict = {}
+        self._boot_span_pending = True
         self.retired = False  # single-use containers retire after one dispatch
         self.boot_error: BaseException | None = None
         self.active: dict[str, _QueuedInput] = {}
@@ -393,6 +561,52 @@ class _Container:
                 return 0
             return self.pool.spec_max_concurrent - len(self.active)
 
+    def _trace_dispatch(self, qi: _QueuedInput) -> None:
+        """Phase-span bookkeeping at dispatch: close the queue span (observe
+        queue wait), emit the boot-or-warm span (cold boots carry the
+        snapshot outcome from the ready message), open the dispatch span."""
+        call = qi.call
+        if call.root_span is None:
+            return
+        tag = self.pool.spec.tag
+        if qi.queue_span is not None:
+            wait = qi.queue_span.finish("ok")
+            _tr.default_store.record(qi.queue_span)
+            qi.queue_span = None
+            _obs.record_queue_wait(tag, wait)
+        root_id = call.root_span.span_id
+        if self._boot_span_pending:
+            self._boot_span_pending = False
+            sp = _tr.Span(
+                trace_id=call.trace_id,
+                name="boot",
+                parent_id=root_id,
+                start=self.boot_wall_start,
+                attrs={
+                    "mode": "cold",
+                    "container": self.idx,
+                    "snapshot": (self.boot_info or {}).get("snapshot", "off"),
+                },
+            )
+            sp.end = self.ready_wall or time.time()
+            _tr.default_store.record(sp)
+            _obs.record_phase(tag, "boot", sp.duration)
+        else:
+            sp = _tr.Span(
+                trace_id=call.trace_id,
+                name="boot",
+                parent_id=root_id,
+                attrs={"mode": "warm", "container": self.idx},
+            )
+            sp.end = sp.start
+            _tr.default_store.record(sp)
+        qi.dispatch_span = _tr.Span(
+            trace_id=call.trace_id,
+            name="dispatch",
+            parent_id=root_id,
+            attrs={"container": self.idx, "attempt": call.attempt},
+        )
+
     def dispatch(self, qi: _QueuedInput) -> None:
         qi.started_at = time.monotonic()
         # timeout= is per-attempt: the clock starts at dispatch, so a retried
@@ -404,9 +618,14 @@ class _Container:
                 raise _ContainerDead(f"container {self.idx} is dead")
             self.active[qi.call.input_id] = qi
             self.last_active = time.monotonic()
+        self._trace_dispatch(qi)
         try:
-            self.conn.send(("input", qi.call.input_id, qi.method_name, qi.payload))
+            self.conn.send(
+                ("input", qi.call.input_id, qi.method_name, qi.payload,
+                 qi.trace_ctx())
+            )
         except (BrokenPipeError, OSError) as e:
+            _end_dispatch_span(self.pool, qi, "error", reason="container_death")
             with self.lock:
                 owned = self.active.pop(qi.call.input_id, None)
             raise _ContainerDead(str(e), [qi] if owned else []) from e
@@ -422,6 +641,8 @@ class _Container:
                     qi.call.deadline = now + self.pool.spec.timeout
                 self.active[qi.call.input_id] = qi
             self.last_active = now
+        for qi in qis:
+            self._trace_dispatch(qi)
         try:
             self.conn.send(
                 (
@@ -429,9 +650,14 @@ class _Container:
                     [qi.call.input_id for qi in qis],
                     qis[0].method_name,
                     [qi.payload for qi in qis],
+                    [qi.trace_ctx() for qi in qis],
                 )
             )
         except (BrokenPipeError, OSError) as e:
+            for qi in qis:
+                _end_dispatch_span(
+                    self.pool, qi, "error", reason="container_death"
+                )
             with self.lock:
                 owned = [
                     qi for qi in qis
@@ -460,7 +686,9 @@ class _Container:
                 kind = msg[0]
                 if kind == "ready":
                     self.ever_ready = True
+                    self.ready_wall = time.time()
                     info = msg[1] if len(msg) > 1 else {}
+                    self.boot_info = info or {}
                     if info:
                         try:
                             self.pool.on_container_ready(self, info)
@@ -485,8 +713,24 @@ class _Container:
                         self.last_active = time.monotonic()
                         self.inputs_served += 1
                     if qi is not None:
+                        _end_dispatch_span(self.pool, qi, "ok")
                         qi.call.gen_queue.put(("done", None))
                         qi.call.set_result(None)
+                elif kind == "spans":
+                    # child-process phase spans (execute/serialize + any user
+                    # spans): record into the owning traces and feed the
+                    # per-phase latency histograms
+                    _, child_spans = msg
+                    for sp in child_spans:
+                        _tr.default_store.record(sp)
+                        if sp.get("name") in ("execute", "serialize") and sp.get(
+                            "end"
+                        ) is not None:
+                            _obs.record_phase(
+                                self.pool.spec.tag,
+                                sp["name"],
+                                max(0.0, sp["end"] - sp["start"]),
+                            )
                 elif kind == "result":
                     _, input_id, ok, payload = msg
                     with self.lock:
@@ -496,6 +740,7 @@ class _Container:
                     if qi is None:
                         continue
                     if ok:
+                        _end_dispatch_span(self.pool, qi, "ok")
                         qi.call.set_result(ser.deserialize(payload))
                     else:
                         exc, _tb = ser.deserialize_exception(payload)
@@ -548,6 +793,7 @@ class FunctionPool:
         self.calls: dict[str, _Call] = {}
         self.containers: list[_Container] = []
         self.boot_crashes = 0
+        self._inflight_n = 0  # submitted minus completed (the gauge's source)
         # while True, scale-up is capped at one container so the first warm
         # boot can capture a snapshot every later boot restores from
         self._snapshot_gate = bool(self.container_config.snapshot_key)
@@ -564,13 +810,50 @@ class FunctionPool:
         input_id = f"in-{uuid.uuid4().hex[:16]}"
         call = _Call(input_id, None, self.spec.retries)  # deadline set at dispatch
         qi = _QueuedInput(call, method_name, payload, ready_at=time.monotonic())
+        if _tr.tracing_enabled():
+            call.trace_id = input_id
+            call.root_span = _tr.Span(
+                trace_id=input_id,
+                name="call",
+                attrs={"function": self.spec.tag, "method": method_name or ""},
+            )
+            qi.queue_span = _tr.Span(
+                trace_id=input_id,
+                name="queue",
+                parent_id=call.root_span.span_id,
+            )
+        # register BEFORE queueing: once the input is visible to the
+        # scheduler it can complete at any moment, and a finalizer added
+        # after completion would never run
+        call.add_done_callback(lambda: self._on_call_done(call))
         with self.lock:
             if self.closed:
                 raise RuntimeError("app run context is closed")
             self.calls[input_id] = call
             self.pending.append(qi)
+            self._inflight_n += 1
+            # gauge write under the pool lock: serialized with the
+            # completion-side decrement, so the last write always reflects
+            # the true count
+            _obs.set_inflight(self.spec.tag, self._inflight_n)
             self.wake.notify()
         return call
+
+    def _on_call_done(self, call: _Call) -> None:
+        """Completion finalizer (runs inside set_result/set_exception, before
+        the caller unblocks): close the root span, observe total latency,
+        drop the inflight gauge."""
+        with self.lock:
+            self._inflight_n = max(0, self._inflight_n - 1)
+            _obs.set_inflight(self.spec.tag, self._inflight_n)
+        root = call.root_span
+        if root is not None:
+            call.root_span = None  # idempotence: finalizers never double-record
+            dur = root.finish(
+                "ok" if call.ok else "error", attempts=call.attempt
+            )
+            _tr.default_store.record(root)
+            _obs.record_phase(self.spec.tag, "total", dur)
 
     def shutdown(self) -> None:
         with self.lock:
@@ -598,11 +881,27 @@ class FunctionPool:
 
     # -- failure/retry ------------------------------------------------------
 
-    def handle_failure(self, qi: _QueuedInput, exc: BaseException) -> None:
+    def handle_failure(
+        self, qi: _QueuedInput, exc: BaseException, reason: str | None = None
+    ) -> None:
+        """One failed attempt: requeue per the retry policy or surface the
+        exception. ``reason`` labels the retry counter/spans —
+        timeout | container_death | user_error (inferred when omitted)."""
+        if reason is None:
+            reason = (
+                "timeout"
+                if isinstance(exc, FunctionTimeoutError)
+                else "user_error"
+            )
+        _end_dispatch_span(
+            self, qi, "error", reason=reason, error=type(exc).__name__
+        )
         retries = qi.call.retries
         qi.call.attempt += 1
         if retries is not None and qi.call.attempt <= retries.max_retries:
             delay = retries.delay_for_attempt(qi.call.attempt)
+            _obs.record_retry(self.spec.tag, reason)
+            self._trace_requeue(qi, reason, delay, charged=True)
             qi.started_at = None
             qi.ready_at = time.monotonic() + delay
             with self.lock:
@@ -610,6 +909,36 @@ class FunctionPool:
                 self.wake.notify()
         else:
             qi.call.set_exception(exc)
+
+    def _trace_requeue(
+        self, qi: _QueuedInput, reason: str, delay: float, *, charged: bool
+    ) -> None:
+        """Record an instantaneous retry marker and reopen the queue span —
+        the requeued input's wait (backoff included) is queue time again.
+        ``charged=False`` marks a free requeue (collateral victim of another
+        input's timeout kill) that isn't counted against the retry budget."""
+        call = qi.call
+        if call.root_span is None:
+            return
+        sp = _tr.Span(
+            trace_id=call.trace_id,
+            name="retry",
+            parent_id=call.root_span.span_id,
+            attrs={
+                "reason": reason,
+                "attempt": call.attempt,
+                "delay_s": round(delay, 4),
+                "charged": charged,
+            },
+        )
+        sp.end = sp.start
+        _tr.default_store.record(sp)
+        qi.queue_span = _tr.Span(
+            trace_id=call.trace_id,
+            name="queue",
+            parent_id=call.root_span.span_id,
+            attrs={"requeue": True},
+        )
 
     def on_container_dead(self, container: _Container, orphans: list[_QueuedInput]) -> None:
         with self.lock:
@@ -628,6 +957,7 @@ class FunctionPool:
                     doomed = list(self.pending)
                     self.pending.clear()
                 for qi in doomed + orphans:
+                    _end_dispatch_span(self, qi, "error", reason="crash_loop")
                     qi.call.set_exception(err)
                 return
         elif container.ever_ready:
@@ -638,6 +968,7 @@ class FunctionPool:
                 doomed = list(self.pending)
                 self.pending.clear()
             for qi in doomed + orphans:
+                _end_dispatch_span(self, qi, "error", reason="boot_error")
                 qi.call.set_exception(container.boot_error)
             return
         for qi in orphans:
@@ -648,11 +979,16 @@ class FunctionPool:
                     FunctionTimeoutError(
                         f"{self.spec.tag} input exceeded timeout={self.spec.timeout}s"
                     ),
+                    reason="timeout",
                 )
             elif container.kill_reason == "timeout":
                 # Collateral victim of a timeout kill: another input on this
                 # @concurrent container blew its deadline. Requeue for free —
                 # this input did nothing wrong, so it isn't charged an attempt.
+                _end_dispatch_span(
+                    self, qi, "error", reason="collateral_timeout"
+                )
+                self._trace_requeue(qi, "collateral_timeout", 0.0, charged=False)
                 qi.started_at = None
                 qi.call.deadline = None
                 qi.ready_at = time.monotonic()
@@ -665,6 +1001,7 @@ class FunctionPool:
                     RuntimeError(
                         f"container for {self.spec.tag} died while processing input"
                     ),
+                    reason="container_death",
                 )
 
     # -- scheduling loop ----------------------------------------------------
@@ -699,21 +1036,29 @@ class FunctionPool:
             if expired:
                 # The input holds the container's thread; only a kill frees it.
                 # on_container_dead() routes actives through timeout handling.
+                # A slow-dying child is re-found by later ticks: count the
+                # kill only on the tick that initiates it.
+                if c.kill_reason is None:
+                    _obs.record_container_kill(self.spec.tag, "timeout")
                 c.kill_reason = "timeout"
                 c.kill()
 
     def _ready_inputs(self, now: float) -> list[_QueuedInput]:
-        ready = []
+        ready, cancelled = [], []
         with self.lock:
             n = len(self.pending)
             for _ in range(n):
                 qi = self.pending.popleft()
                 if qi.call.cancelled:
-                    qi.call.set_exception(InputCancelled(qi.call.input_id))
+                    cancelled.append(qi)
                 elif qi.ready_at <= now:
                     ready.append(qi)
                 else:
                     self.pending.append(qi)
+        # completion OUTSIDE the lock: set_exception runs the call's done
+        # callbacks (trace finalizer, inflight gauge), which re-take it
+        for qi in cancelled:
+            qi.call.set_exception(InputCancelled(qi.call.input_id))
         return ready
 
     def _dispatch_ready(self, now: float) -> None:
@@ -874,7 +1219,9 @@ class ClusterPool:
 
     # _Container callbacks ---------------------------------------------------
 
-    def handle_failure(self, qi: _QueuedInput, exc: BaseException) -> None:
+    def handle_failure(
+        self, qi: _QueuedInput, exc: BaseException, reason: str | None = None
+    ) -> None:
         qi.call.set_exception(exc)
 
     def on_container_ready(self, container, info: dict) -> None:
@@ -1094,6 +1441,32 @@ class InlinePool:
 
     def submit(self, method_name: str, args: tuple, kwargs: dict) -> _Call:
         call = _Call(f"in-{uuid.uuid4().hex[:16]}", None, self.spec.retries)
+        if _tr.tracing_enabled():
+            call.trace_id = call.input_id
+            call.root_span = _tr.Span(
+                trace_id=call.input_id,
+                name="call",
+                attrs={
+                    "function": self.spec.tag,
+                    "method": method_name or "",
+                    "backend": "inline",
+                },
+            )
+            call.add_done_callback(lambda: self._finalize_trace(call))
+
+        def phase_span(name: str, start: float, status: str = "ok", **attrs):
+            if call.root_span is None:
+                return
+            sp = _tr.Span(
+                trace_id=call.trace_id,
+                name=name,
+                parent_id=call.root_span.span_id,
+                start=start,
+                attrs=attrs,
+            )
+            sp.finish(status)
+            _tr.default_store.record(sp)
+            _obs.record_phase(self.spec.tag, name, sp.duration)
 
         def run():
             payload = ser.serialize((args, kwargs))
@@ -1101,22 +1474,40 @@ class InlinePool:
             while True:
                 try:
                     a, kw = ser.deserialize(payload)
+                    boot_needed = self._fn is None
+                    t0 = time.time()
                     fn = self._ensure_target()
-                    result = fn(method_name, a, kw)
-                    if inspect.isgenerator(result):
-                        for item in result:
-                            call.gen_queue.put(
-                                ("item", ser.deserialize(ser.serialize(item)))
-                            )
-                        call.gen_queue.put(("done", None))
-                        call.set_result(None)
-                    else:
-                        call.set_result(ser.deserialize(ser.serialize(result)))
+                    if boot_needed:
+                        phase_span("boot", t0, mode="inline")
+                    t0 = time.time()
+                    try:
+                        result = fn(method_name, a, kw)
+                        if inspect.isgenerator(result):
+                            n_items = 0
+                            for item in result:
+                                call.gen_queue.put(
+                                    ("item", ser.deserialize(ser.serialize(item)))
+                                )
+                                n_items += 1
+                            phase_span("execute", t0, items=n_items)
+                            call.gen_queue.put(("done", None))
+                            call.set_result(None)
+                        else:
+                            phase_span("execute", t0)
+                            t0 = time.time()
+                            value = ser.deserialize(ser.serialize(result))
+                            phase_span("serialize", t0)
+                            call.set_result(value)
+                    except BaseException:
+                        phase_span("execute", t0, status="error")
+                        raise
                     return
                 except BaseException as e:
                     attempt += 1
+                    call.attempt = attempt
                     r = self.spec.retries
                     if r is not None and attempt <= r.max_retries:
+                        _obs.record_retry(self.spec.tag, "user_error")
                         time.sleep(min(r.delay_for_attempt(attempt), 0.1))
                         continue
                     exc, _tb = ser.deserialize_exception(ser.serialize_exception(e))
@@ -1125,6 +1516,15 @@ class InlinePool:
 
         threading.Thread(target=run, daemon=True).start()
         return call
+
+    def _finalize_trace(self, call: _Call) -> None:
+        root = call.root_span
+        if root is None:
+            return
+        call.root_span = None
+        dur = root.finish("ok" if call.ok else "error", attempts=call.attempt)
+        _tr.default_store.record(root)
+        _obs.record_phase(self.spec.tag, "total", dur)
 
     def shutdown(self) -> None:
         for hook in self._exit_hooks:

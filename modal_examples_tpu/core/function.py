@@ -227,6 +227,9 @@ class FunctionCall:
 
     def __init__(self, object_id: str):
         self.object_id = object_id
+        #: the underlying input id == trace id (``tpurun trace <call_id>``);
+        #: None on handles rehydrated via from_id in another process
+        self.call_id: str | None = None
 
     @classmethod
     def _register(cls, call: _exec._Call) -> "FunctionCall":
@@ -255,7 +258,9 @@ class FunctionCall:
                     _local_calls.pop(object_id, None)
 
         threading.Thread(target=persist, daemon=True).start()
-        return cls(object_id)
+        fc = cls(object_id)
+        fc.call_id = call.input_id
+        return fc
 
     @classmethod
     def from_id(cls, object_id: str) -> "FunctionCall":

@@ -1,0 +1,83 @@
+"""Observability: call-lifecycle tracing + metric series for the framework.
+
+The pieces (all stdlib-only — core/ imports this layer and must stay
+jax-free):
+
+- :mod:`.catalog` — the ONE place every ``mtpu_*`` metric name is declared
+  (enforced by ``tests/test_static.py``);
+- :mod:`.trace`   — span model, per-call JSONL trace files, cross-process
+  context propagation (``tpurun trace <call_id>`` reads these);
+- :mod:`.metrics` — recorder functions the executor/engine call to emit
+  catalog series into the prometheus registry;
+- :mod:`.export`  — file-backed push gateway for ephemeral processes
+  (``tpurun metrics`` merges the pushed expositions).
+
+User code inside a remote function can nest its own spans::
+
+    from modal_examples_tpu.observability import span
+
+    @app.function()
+    def work(x):
+        with span("load-model"):
+            ...
+"""
+
+from __future__ import annotations
+
+from . import catalog
+from .export import (
+    live_and_pushed_metrics,
+    push_metrics_file,
+    pushed_jobs,
+    read_pushed_metrics,
+)
+from .metrics import (
+    record_container_kill,
+    record_engine_batch,
+    record_engine_phase,
+    record_engine_queue_wait,
+    record_phase,
+    record_queue_wait,
+    record_retry,
+    record_scheduler_error,
+    set_engine_gauges,
+    set_inflight,
+)
+from .trace import (
+    Span,
+    TraceContext,
+    TraceStore,
+    current_context,
+    current_trace_id,
+    default_store,
+    set_context,
+    span,
+    tracing_enabled,
+)
+
+__all__ = [
+    "Span",
+    "TraceContext",
+    "TraceStore",
+    "catalog",
+    "current_context",
+    "current_trace_id",
+    "default_store",
+    "live_and_pushed_metrics",
+    "push_metrics_file",
+    "pushed_jobs",
+    "read_pushed_metrics",
+    "record_container_kill",
+    "record_engine_batch",
+    "record_engine_phase",
+    "record_engine_queue_wait",
+    "record_phase",
+    "record_queue_wait",
+    "record_retry",
+    "record_scheduler_error",
+    "set_context",
+    "set_engine_gauges",
+    "set_inflight",
+    "span",
+    "tracing_enabled",
+]

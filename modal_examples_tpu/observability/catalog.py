@@ -1,0 +1,199 @@
+"""Central catalog of every ``mtpu_*`` metric series the framework emits.
+
+ONE module owns every metric name: code imports the constant, docs render
+:data:`CATALOG`, and ``tests/test_static.py`` enforces that no ``mtpu_*``
+metric-name literal exists anywhere else in the package — stringly-typed
+metric drift (two spellings of one series, phantom names in comments) is
+unrepresentable.
+
+Conventions (Prometheus): ``_total`` counters, ``_seconds`` histograms,
+unsuffixed gauges. Labels are listed per series in :data:`CATALOG`.
+"""
+
+from __future__ import annotations
+
+# -- call lifecycle (core/executor.py) --------------------------------------
+
+#: histogram {function, phase}: per-phase call latency; phases are
+#: queue | boot | dispatch | execute | serialize | total
+CALL_DURATION_SECONDS = "mtpu_call_duration_seconds"
+#: histogram {function}: submit -> dispatch wait (the queue phase, dedicated
+#: series so queue-wait distributions can be scraped without a phase filter)
+QUEUE_WAIT_SECONDS = "mtpu_queue_wait_seconds"
+#: gauge {function}: inputs submitted but not yet completed
+INFLIGHT_INPUTS = "mtpu_inflight_inputs"
+#: counter {function, reason}: retry attempts scheduled;
+#: reason = timeout | container_death | user_error
+RETRIES_TOTAL = "mtpu_retries_total"
+#: counter {function, reason}: containers killed by the supervisor
+#: (reason = timeout is the only kill the scheduler issues today)
+CONTAINER_KILLS_TOTAL = "mtpu_container_kills_total"
+
+# -- memory snapshots (modal_examples_tpu/snapshot, PR 1) -------------------
+
+#: counter {function, result}: snapshot-enabled container boots;
+#: result = hit | miss | fallback
+SNAPSHOT_BOOTS_METRIC = "mtpu_snapshot_boots_total"
+#: counter {function}: snapshots captured and published to the store
+SNAPSHOT_CAPTURES_METRIC = "mtpu_snapshot_captures_total"
+
+# -- serving engine (serving/engine.py batch loop) --------------------------
+
+#: histogram {phase}: engine hot-loop phase latency;
+#: phase = prefill | prefill_chunked | decode_wait
+ENGINE_PHASE_SECONDS = "mtpu_engine_phase_seconds"
+#: histogram: slots active per dispatched decode block (batch composition)
+ENGINE_BATCH_SIZE = "mtpu_engine_batch_size"
+#: histogram: request submit -> prefill admission wait
+ENGINE_QUEUE_WAIT_SECONDS = "mtpu_engine_queue_wait_seconds"
+#: gauge: requests waiting for admission (engine queue depth)
+WAITING_REQUESTS = "mtpu_waiting_requests"
+#: gauge: slots currently decoding
+ACTIVE_SLOTS = "mtpu_active_slots"
+#: gauge: generated tokens per second since engine start
+TOKENS_PER_SECOND = "mtpu_tokens_per_second"
+#: counter: scheduler-loop exceptions (engine.error_count mirror)
+SCHEDULER_ERRORS_TOTAL = "mtpu_scheduler_errors_total"
+
+# -- OpenAI-compatible server /metrics (serving/openai_api.py) --------------
+
+GENERATED_TOKENS_TOTAL = "mtpu_generated_tokens_total"
+PROMPT_TOKENS_TOTAL = "mtpu_prompt_tokens_total"
+DECODE_STEPS_TOTAL = "mtpu_decode_steps_total"
+KV_PAGES_FREE = "mtpu_kv_pages_free"
+DECODE_IMPL = "mtpu_decode_impl"
+SPEC_PROPOSED_TOTAL = "mtpu_spec_proposed_total"
+SPEC_ACCEPTED_TOTAL = "mtpu_spec_accepted_total"
+SPEC_ACCEPTANCE_RATE = "mtpu_spec_acceptance_rate"
+PREFIX_CACHE_HITS_TOTAL = "mtpu_prefix_cache_hits_total"
+PREFIX_CACHE_MISSES_TOTAL = "mtpu_prefix_cache_misses_total"
+PREFIX_CACHED_PAGES = "mtpu_prefix_cached_pages"
+
+
+#: machine-readable catalog: name -> {type, labels, help}. docs/observability
+#: renders this; the static guard asserts every emitted name appears here.
+CATALOG: dict[str, dict] = {
+    CALL_DURATION_SECONDS: {
+        "type": "histogram",
+        "labels": ["function", "phase"],
+        "help": "per-phase call latency "
+                "(queue|boot|dispatch|execute|serialize|total)",
+    },
+    QUEUE_WAIT_SECONDS: {
+        "type": "histogram",
+        "labels": ["function"],
+        "help": "submit-to-dispatch queue wait",
+    },
+    INFLIGHT_INPUTS: {
+        "type": "gauge",
+        "labels": ["function"],
+        "help": "inputs submitted but not yet completed",
+    },
+    RETRIES_TOTAL: {
+        "type": "counter",
+        "labels": ["function", "reason"],
+        "help": "retry attempts scheduled "
+                "(reason=timeout|container_death|user_error)",
+    },
+    CONTAINER_KILLS_TOTAL: {
+        "type": "counter",
+        "labels": ["function", "reason"],
+        "help": "containers killed by the supervisor",
+    },
+    SNAPSHOT_BOOTS_METRIC: {
+        "type": "counter",
+        "labels": ["function", "result"],
+        "help": "snapshot-enabled container boots (result=hit|miss|fallback)",
+    },
+    SNAPSHOT_CAPTURES_METRIC: {
+        "type": "counter",
+        "labels": ["function"],
+        "help": "memory snapshots captured and published to the store",
+    },
+    ENGINE_PHASE_SECONDS: {
+        "type": "histogram",
+        "labels": ["phase"],
+        "help": "engine hot-loop phase latency "
+                "(prefill|prefill_chunked|decode_wait)",
+    },
+    ENGINE_BATCH_SIZE: {
+        "type": "histogram",
+        "labels": [],
+        "help": "active slots per dispatched decode block",
+    },
+    ENGINE_QUEUE_WAIT_SECONDS: {
+        "type": "histogram",
+        "labels": [],
+        "help": "request submit-to-admission wait",
+    },
+    WAITING_REQUESTS: {
+        "type": "gauge",
+        "labels": [],
+        "help": "requests waiting for admission",
+    },
+    ACTIVE_SLOTS: {
+        "type": "gauge",
+        "labels": [],
+        "help": "slots currently decoding",
+    },
+    TOKENS_PER_SECOND: {
+        "type": "gauge",
+        "labels": [],
+        "help": "generated tokens per second since engine start",
+    },
+    SCHEDULER_ERRORS_TOTAL: {
+        "type": "counter",
+        "labels": [],
+        "help": "engine scheduler-loop exceptions",
+    },
+    GENERATED_TOKENS_TOTAL: {
+        "type": "counter", "labels": [],
+        "help": "tokens generated by the engine",
+    },
+    PROMPT_TOKENS_TOTAL: {
+        "type": "counter", "labels": [],
+        "help": "prompt tokens prefilled by the engine",
+    },
+    DECODE_STEPS_TOTAL: {
+        "type": "counter", "labels": [],
+        "help": "decode steps executed",
+    },
+    KV_PAGES_FREE: {
+        "type": "gauge", "labels": [],
+        "help": "free pages in the paged KV cache",
+    },
+    DECODE_IMPL: {
+        "type": "gauge", "labels": ["attention", "scatter"],
+        "help": "resolved decode implementation plan (info metric, value 1)",
+    },
+    SPEC_PROPOSED_TOTAL: {
+        "type": "counter", "labels": [],
+        "help": "draft tokens proposed (speculative mode)",
+    },
+    SPEC_ACCEPTED_TOTAL: {
+        "type": "counter", "labels": [],
+        "help": "draft tokens accepted by the target",
+    },
+    SPEC_ACCEPTANCE_RATE: {
+        "type": "gauge", "labels": [],
+        "help": "speculative acceptance rate",
+    },
+    PREFIX_CACHE_HITS_TOTAL: {
+        "type": "counter", "labels": [],
+        "help": "prefix-cache admission hits",
+    },
+    PREFIX_CACHE_MISSES_TOTAL: {
+        "type": "counter", "labels": [],
+        "help": "prefix-cache admission misses",
+    },
+    PREFIX_CACHED_PAGES: {
+        "type": "gauge", "labels": [],
+        "help": "pages currently held by the prefix cache",
+    },
+}
+
+#: every declared metric name (the static guard's allowlist)
+ALL_METRIC_NAMES = frozenset(CATALOG)
+
+#: buckets for batch-size-style histograms (counts, not seconds)
+COUNT_BUCKETS = (1, 2, 4, 8, 16, 32, 64, 128)

@@ -1,0 +1,110 @@
+"""File-backed metrics push gateway for ephemeral processes.
+
+A scraper can hit a live ``/metrics`` endpoint, but the processes that emit
+most series here — app runs, bench children, short CLI invocations — are
+gone before any scrape interval fires. The reference solves this with a
+Pushgateway app (10_integrations/pushgateway.py); the local analog is a
+directory of per-job exposition files under ``<state_dir>/metrics/``:
+
+- each process *pushes* its registry on shutdown (``push_metrics_file``,
+  called from ``AppRun.close``), atomically (write + rename);
+- ``tpurun metrics`` *merges* every pushed file into one valid exposition
+  (job label per source, deduplicated headers) via
+  :func:`modal_examples_tpu.utils.prometheus.merge_expositions`.
+
+Stale jobs age out after ``_PUSH_RETENTION_S``.
+"""
+
+from __future__ import annotations
+
+import os
+import time
+from pathlib import Path
+
+from .._internal import config as _config
+from ..utils.prometheus import Registry, default_registry, merge_expositions
+
+_PUSH_RETENTION_S = 7 * 86400
+
+
+def _metrics_dir(root: str | Path | None = None) -> Path:
+    p = Path(root) if root else (_config.state_dir() / "metrics")
+    p.mkdir(parents=True, exist_ok=True)
+    return p
+
+
+def _safe_job(job: str) -> str:
+    return "".join(c if (c.isalnum() or c in "-_.") else "-" for c in job)
+
+
+def push_metrics_file(
+    job: str,
+    registry: Registry | None = None,
+    *,
+    root: str | Path | None = None,
+) -> Path | None:
+    """Write this process's exposition to ``<state_dir>/metrics/<job>.prom``
+    (atomic replace; each push overwrites the job's slot). Returns the path,
+    or None when the registry holds no series (nothing to push — an empty
+    file would only add noise to the merge)."""
+    reg = registry if registry is not None else default_registry
+    text = reg.expose()
+    if text.strip() == "":
+        return None
+    d = _metrics_dir(root)
+    path = d / f"{_safe_job(job)}.prom"
+    tmp = path.with_suffix(f".tmp.{os.getpid()}")
+    tmp.write_text(text)
+    os.replace(tmp, path)
+    _gc(d)
+    return path
+
+
+def pushed_jobs(root: str | Path | None = None) -> dict[str, str]:
+    """job name -> raw exposition text, one entry per pushed ``.prom`` file."""
+    d = _metrics_dir(root)
+    jobs: dict[str, str] = {}
+    for p in sorted(d.glob("*.prom")):
+        try:
+            jobs[p.stem] = p.read_text()
+        except OSError:
+            continue
+    return jobs
+
+
+def read_pushed_metrics(root: str | Path | None = None) -> str:
+    """Merge every pushed job file into one exposition (the gateway's
+    /metrics view). Empty string when nothing was ever pushed."""
+    jobs = pushed_jobs(root)
+    if not jobs:
+        return ""
+    return merge_expositions(jobs)
+
+
+def live_and_pushed_metrics(
+    registry: Registry | None = None,
+    *,
+    job: str = "live",
+    root: str | Path | None = None,
+) -> str:
+    """One exposition covering this process's live registry (under ``job``)
+    plus every previously pushed job file — what a scraper hitting the
+    gateway's ``/metrics`` should see."""
+    reg = registry if registry is not None else default_registry
+    jobs = pushed_jobs(root)
+    live = reg.expose()
+    if live.strip():
+        jobs[job] = live
+    if not jobs:
+        return ""
+    return merge_expositions(jobs)
+
+
+def _gc(d: Path) -> None:
+    cutoff = time.time() - _PUSH_RETENTION_S
+    for p in d.glob("*.prom"):
+        try:
+            if p.stat().st_mtime < cutoff:
+                p.unlink()
+        except OSError:
+            pass

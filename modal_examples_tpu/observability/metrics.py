@@ -1,0 +1,139 @@
+"""Recorders: the narrow API the executor and serving engine call to emit
+metric series from :mod:`.catalog` into the process-wide prometheus registry
+(:mod:`modal_examples_tpu.utils.prometheus`).
+
+Keeping every write behind a named function means call sites stay one line,
+label sets can't drift between emitters, and tests can read series back via
+``default_registry.value(...)`` with the same constants.
+"""
+
+from __future__ import annotations
+
+from ..utils.prometheus import Registry, default_registry
+from . import catalog as C
+
+
+def _reg(registry: Registry | None) -> Registry:
+    return registry if registry is not None else default_registry
+
+
+# -- call lifecycle (executor) ----------------------------------------------
+
+
+def record_phase(
+    function: str, phase: str, seconds: float, *, registry: Registry | None = None
+) -> None:
+    _reg(registry).histogram_observe(
+        C.CALL_DURATION_SECONDS,
+        seconds,
+        labels={"function": function, "phase": phase},
+        help=C.CATALOG[C.CALL_DURATION_SECONDS]["help"],
+    )
+
+
+def record_queue_wait(
+    function: str, seconds: float, *, registry: Registry | None = None
+) -> None:
+    _reg(registry).histogram_observe(
+        C.QUEUE_WAIT_SECONDS,
+        seconds,
+        labels={"function": function},
+        help=C.CATALOG[C.QUEUE_WAIT_SECONDS]["help"],
+    )
+    record_phase(function, "queue", seconds, registry=registry)
+
+
+def set_inflight(
+    function: str, n: int, *, registry: Registry | None = None
+) -> None:
+    _reg(registry).gauge_set(
+        C.INFLIGHT_INPUTS,
+        float(n),
+        labels={"function": function},
+        help=C.CATALOG[C.INFLIGHT_INPUTS]["help"],
+    )
+
+
+def record_retry(
+    function: str, reason: str, *, registry: Registry | None = None
+) -> None:
+    _reg(registry).counter_inc(
+        C.RETRIES_TOTAL,
+        1.0,
+        labels={"function": function, "reason": reason},
+        help=C.CATALOG[C.RETRIES_TOTAL]["help"],
+    )
+
+
+def record_container_kill(
+    function: str, reason: str, *, registry: Registry | None = None
+) -> None:
+    _reg(registry).counter_inc(
+        C.CONTAINER_KILLS_TOTAL,
+        1.0,
+        labels={"function": function, "reason": reason},
+        help=C.CATALOG[C.CONTAINER_KILLS_TOTAL]["help"],
+    )
+
+
+# -- serving engine ---------------------------------------------------------
+
+
+def record_engine_phase(
+    phase: str, seconds: float, *, registry: Registry | None = None
+) -> None:
+    _reg(registry).histogram_observe(
+        C.ENGINE_PHASE_SECONDS,
+        seconds,
+        labels={"phase": phase},
+        help=C.CATALOG[C.ENGINE_PHASE_SECONDS]["help"],
+    )
+
+
+def record_engine_batch(n: int, *, registry: Registry | None = None) -> None:
+    _reg(registry).histogram_observe(
+        C.ENGINE_BATCH_SIZE,
+        float(n),
+        buckets=C.COUNT_BUCKETS,
+        help=C.CATALOG[C.ENGINE_BATCH_SIZE]["help"],
+    )
+
+
+def record_engine_queue_wait(
+    seconds: float, *, registry: Registry | None = None
+) -> None:
+    _reg(registry).histogram_observe(
+        C.ENGINE_QUEUE_WAIT_SECONDS,
+        seconds,
+        help=C.CATALOG[C.ENGINE_QUEUE_WAIT_SECONDS]["help"],
+    )
+
+
+def set_engine_gauges(
+    *,
+    waiting: int,
+    active_slots: int,
+    tokens_per_second: float,
+    registry: Registry | None = None,
+) -> None:
+    reg = _reg(registry)
+    reg.gauge_set(
+        C.WAITING_REQUESTS, float(waiting),
+        help=C.CATALOG[C.WAITING_REQUESTS]["help"],
+    )
+    reg.gauge_set(
+        C.ACTIVE_SLOTS, float(active_slots),
+        help=C.CATALOG[C.ACTIVE_SLOTS]["help"],
+    )
+    reg.gauge_set(
+        C.TOKENS_PER_SECOND, tokens_per_second,
+        help=C.CATALOG[C.TOKENS_PER_SECOND]["help"],
+    )
+
+
+def record_scheduler_error(*, registry: Registry | None = None) -> None:
+    _reg(registry).counter_inc(
+        C.SCHEDULER_ERRORS_TOTAL,
+        1.0,
+        help=C.CATALOG[C.SCHEDULER_ERRORS_TOTAL]["help"],
+    )

@@ -1,0 +1,235 @@
+"""Call-lifecycle tracing: spans, JSONL trace store, context propagation.
+
+Every ``.remote/.map/.spawn`` call gets a trace whose id IS the call's input
+id (``in-...``), so ``tpurun trace <call_id>`` needs no lookup table. The
+executor opens phase spans on the supervisor side (queue, boot, dispatch);
+the container worker emits its spans (execute, serialize) in the child
+process and ships them back over the existing message pipe, where they
+stitch into the same trace — one JSONL file per call under
+``<state_dir>/traces/``, one JSON object per span (greppable, same spirit
+as ``utils/tracking.RunLogger``).
+
+Span timestamps are wall-clock (``time.time()``): supervisor and containers
+share a host, so child spans land on the parent's timeline without clock
+translation.
+
+``MTPU_TRACE=0`` disables tracing entirely (span helpers return ``None``
+and the executor skips every span call site).
+"""
+
+from __future__ import annotations
+
+import contextlib
+import contextvars
+import dataclasses
+import json
+import os
+import threading
+import time
+import uuid
+from pathlib import Path
+from typing import Callable
+
+from .._internal import config as _config
+
+#: traces are retained this long (mirrors the spawned-call record retention)
+_TRACE_RETENTION_S = 7 * 86400
+
+
+def tracing_enabled() -> bool:
+    return os.environ.get("MTPU_TRACE", "1") not in ("0", "false", "off")
+
+
+def _new_span_id() -> str:
+    return f"sp-{uuid.uuid4().hex[:12]}"
+
+
+@dataclasses.dataclass
+class Span:
+    """One timed phase of a call. ``finish()`` stamps the end and returns the
+    duration; recording (JSONL write or cross-process shipping) is the
+    caller's job via :class:`TraceStore` or a child-side buffer."""
+
+    trace_id: str
+    name: str
+    span_id: str = dataclasses.field(default_factory=_new_span_id)
+    parent_id: str | None = None
+    start: float = dataclasses.field(default_factory=time.time)
+    end: float | None = None
+    status: str = "ok"
+    attrs: dict = dataclasses.field(default_factory=dict)
+
+    def finish(self, status: str = "ok", **attrs) -> float:
+        if self.end is None:
+            self.end = time.time()
+        self.status = status
+        if attrs:
+            self.attrs.update(attrs)
+        return self.duration
+
+    @property
+    def duration(self) -> float:
+        return max(0.0, (self.end or time.time()) - self.start)
+
+    def to_dict(self) -> dict:
+        return {
+            "trace_id": self.trace_id,
+            "span_id": self.span_id,
+            "parent_id": self.parent_id,
+            "name": self.name,
+            "start": self.start,
+            "end": self.end,
+            "status": self.status,
+            "attrs": self.attrs,
+        }
+
+
+class TraceStore:
+    """Per-trace JSONL files under ``<state_dir>/traces/``.
+
+    Only *finished* spans are recorded; an abandoned span (e.g. a dispatch
+    span whose container vanished without a death notification) simply never
+    appears, it can't corrupt the file. Writes are append-only and
+    line-atomic, so a concurrent ``tpurun trace`` reader sees a valid prefix.
+    """
+
+    def __init__(self, root: str | Path | None = None):
+        self._root = Path(root) if root else None
+        self._resolved: Path | None = None  # root after its one-time mkdir
+        self._lock = threading.Lock()
+        self._last_gc = 0.0
+
+    @property
+    def root(self) -> Path:
+        if self._resolved is None:
+            root = self._root or (_config.state_dir() / "traces")
+            root.mkdir(parents=True, exist_ok=True)
+            self._resolved = root
+        return self._resolved
+
+    def record(self, span: "Span | dict") -> None:
+        d = span.to_dict() if isinstance(span, Span) else dict(span)
+        if d.get("end") is None:
+            d["end"] = time.time()
+        path = self.root / f"{d['trace_id']}.jsonl"
+        line = json.dumps(d) + "\n"
+        with self._lock:
+            try:
+                with open(path, "a") as f:
+                    f.write(line)
+            except FileNotFoundError:
+                # traces dir deleted out from under us: re-create and retry
+                # (record runs in the result-delivery path — never raise)
+                self._resolved = None
+                try:
+                    with open(self.root / path.name, "a") as f:
+                        f.write(line)
+                except OSError:
+                    pass
+        self._maybe_gc()
+
+    def read(self, trace_id: str) -> list[dict]:
+        path = self.root / f"{trace_id}.jsonl"
+        if not path.exists():
+            return []
+        spans = []
+        for line in path.read_text().splitlines():
+            if not line.strip():
+                continue
+            try:
+                spans.append(json.loads(line))
+            except json.JSONDecodeError:
+                continue  # torn tail line from a concurrent writer
+        return spans
+
+    def list_traces(self, limit: int = 50) -> list[str]:
+        files = sorted(
+            self.root.glob("*.jsonl"),
+            key=lambda p: p.stat().st_mtime,
+            reverse=True,
+        )
+        return [p.stem for p in files[:limit]]
+
+    def _maybe_gc(self) -> None:
+        now = time.monotonic()
+        with self._lock:
+            if now - self._last_gc < 300:
+                return
+            self._last_gc = now
+        # the sweep globs+stats the whole trace dir — run it off-thread so a
+        # recording thread (often the container reader delivering a result)
+        # never stalls on it
+        threading.Thread(target=self._gc_sweep, daemon=True).start()
+
+    def _gc_sweep(self) -> None:
+        cutoff = time.time() - _TRACE_RETENTION_S
+        for p in self.root.glob("*.jsonl"):
+            try:
+                if p.stat().st_mtime < cutoff:
+                    p.unlink()
+            except OSError:
+                pass
+
+
+#: process-wide default store (state-dir backed)
+default_store = TraceStore()
+
+
+# --------------------------------------------------------------------------
+# Context propagation — supervisor -> container worker -> user code
+# --------------------------------------------------------------------------
+
+
+@dataclasses.dataclass
+class TraceContext:
+    """The ambient trace for the current execution context: new spans created
+    with :func:`span` become children of ``span_id`` and are delivered to
+    ``sink`` when finished (the store's ``record`` in the supervisor, a
+    buffer shipped over the pipe in a container worker)."""
+
+    trace_id: str
+    span_id: str | None
+    sink: Callable[[dict], None]
+
+
+_current: contextvars.ContextVar[TraceContext | None] = contextvars.ContextVar(
+    "mtpu-trace-ctx", default=None
+)
+
+
+def current_context() -> TraceContext | None:
+    return _current.get()
+
+
+def current_trace_id() -> str | None:
+    ctx = _current.get()
+    return ctx.trace_id if ctx else None
+
+
+def set_context(ctx: TraceContext | None) -> contextvars.Token:
+    return _current.set(ctx)
+
+
+@contextlib.contextmanager
+def span(name: str, **attrs):
+    """User-facing span context manager: nests under the ambient trace (a
+    no-op yielding None outside one). Works inside container workers — the
+    span ships back with the call's execute/serialize spans — and in the
+    supervisor process."""
+    ctx = _current.get()
+    if ctx is None or not tracing_enabled():
+        yield None
+        return
+    sp = Span(
+        trace_id=ctx.trace_id, name=name, parent_id=ctx.span_id, attrs=attrs
+    )
+    token = _current.set(TraceContext(ctx.trace_id, sp.span_id, ctx.sink))
+    try:
+        yield sp
+        sp.finish("ok")
+    except BaseException:
+        sp.finish("error")
+        raise
+    finally:
+        _current.reset(token)
+        ctx.sink(sp.to_dict())

@@ -34,9 +34,13 @@ import jax.numpy as jnp
 import numpy as np
 
 from ..models import llama
+from ..observability import metrics as _obs
+from ..utils.log import get_logger
 from .kv_cache import OutOfPages, PagedKVCache
 from .sampling import SamplingParams, sample
 from ..utils.tokenizer import load_tokenizer
+
+_log = get_logger("engine")
 
 
 @dataclasses.dataclass
@@ -380,6 +384,7 @@ class LLMEngine:
         # the loop alive (availability) but still records + counts.
         self.strict = _os.environ.get("MTPU_ENGINE_STRICT", "") not in ("", "0")
         self._stopped_on_error = False
+        self._metrics_wall = 0.0  # last gauge refresh (throttled in step())
         self._key = jax.random.PRNGKey(seed)
         self._seed_base = int(seed)
         self._submit_seq = 0  # feeds auto_seed: deterministic per submission
@@ -1122,7 +1127,8 @@ class LLMEngine:
                 del self.error_log[:-20]
                 LLMEngine._error_reports.append(tb[-800:])
                 del LLMEngine._error_reports[:-50]
-                print(tb, flush=True)
+                _obs.record_scheduler_error()
+                _log.error("scheduler-loop exception:\n%s", tb)
                 if self.strict:
                     # tests must fail loudly, not generate corrupt output:
                     # poison the engine (start() refuses to resurrect it —
@@ -1156,7 +1162,22 @@ class LLMEngine:
         work happened."""
         admitted = self._admit()
         decoded = self._decode_tick()
+        self._refresh_gauges()
         return admitted or decoded
+
+    def _refresh_gauges(self) -> None:
+        """Engine-load gauges (queue depth, active slots, tokens/s) into the
+        process registry — throttled so the hot loop never pays more than a
+        few dict writes per second."""
+        now = time.monotonic()
+        if now - self._metrics_wall < 0.25:
+            return
+        self._metrics_wall = now
+        _obs.set_engine_gauges(
+            waiting=self.waiting.qsize(),
+            active_slots=sum(1 for s in self.slots if not s.free),
+            tokens_per_second=self.stats.tokens_per_second(),
+        )
 
     def _admit(self) -> bool:
         """Claim slots+pages for waiting requests, then prefill each bucket's
@@ -1308,6 +1329,8 @@ class LLMEngine:
         kernel (llama.prefill_chunk) — bounded VMEM at any prompt length."""
         import functools
 
+        t_start = time.monotonic()
+        _obs.record_engine_queue_wait(t_start - req.created)
         pages, n_prompt = claim["pages"], claim["n_prompt"]
         slot = self.slots[slot_idx]
         slot.request = req
@@ -1374,9 +1397,13 @@ class LLMEngine:
         slot.position = n_prompt
         slot.last_token = int(first[0])
         slot.fresh = True
+        _obs.record_engine_phase("prefill_chunked", time.monotonic() - t_start)
         self._accept_token(slot_idx, slot.last_token)
 
     def _prefill_group(self, bucket: int, group: list, is_mm: bool = False) -> None:
+        t_start = time.monotonic()
+        for _slot_idx, req, _claim in group:
+            _obs.record_engine_queue_wait(t_start - req.created)
         B = self.prefill_batch  # fixed compile shape; short groups pad
         pad_tok = self.tokenizer.pad_id % self.cfg.vocab_size
         tokens = np.full((B, bucket), pad_tok, np.int32)
@@ -1459,6 +1486,7 @@ class LLMEngine:
                 )
             )
         next_np = np.asarray(next_tok)
+        _obs.record_engine_phase("prefill", time.monotonic() - t_start)
         for i, (slot_idx, req, claim) in enumerate(group):
             slot = self.slots[slot_idx]
             self.stats.prompt_tokens += claim["n_prompt"]
@@ -1512,6 +1540,7 @@ class LLMEngine:
         per-block snapshot pins request identity so the host drops output
         rows whose slot was recycled.
         """
+        _obs.record_engine_batch(len(live))
         self._active[:] = False
         self._override_mask[:] = False
         # reset dead-slot sampling params to the no-filter defaults: a stale
@@ -1564,7 +1593,9 @@ class LLMEngine:
 
     def _process_block(self) -> bool:
         toks, snapshot = self._inflight.popleft()
+        t_wait = time.monotonic()
         toks_np = np.asarray(toks)  # [K, B] — the ONE blocking read per block
+        _obs.record_engine_phase("decode_wait", time.monotonic() - t_wait)
         self.stats.steps += self.decode_block
         worked = False
         for i, req in snapshot:

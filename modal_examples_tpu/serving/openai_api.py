@@ -10,11 +10,14 @@ connection; the engine's continuous batching does the multiplexing.
 from __future__ import annotations
 
 import json
+import re
 import threading
 import time
 import uuid
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 
+from ..observability import catalog as _C
+from ..utils.prometheus import default_registry
 from .engine import LLMEngine
 from .sampling import SamplingParams
 
@@ -123,35 +126,49 @@ class _Handler(BaseHTTPRequestHandler):
             s = eng.stats
             active = sum(1 for sl in eng.slots if not sl.free)
             pc = eng.prefix_cache
-            body = (
-                f"mtpu_generated_tokens_total {s.generated_tokens}\n"
-                f"mtpu_prompt_tokens_total {s.prompt_tokens}\n"
-                f"mtpu_decode_steps_total {s.steps}\n"
-                f"mtpu_tokens_per_second {s.tokens_per_second():.3f}\n"
-                f"mtpu_active_slots {active}\n"
-                f"mtpu_waiting_requests {eng.waiting.qsize()}\n"
-                f"mtpu_kv_pages_free {eng.cache.allocator.available}\n"
-                f"mtpu_scheduler_errors_total {eng.error_count}\n"
-                f'mtpu_decode_impl{{attention="'
+            # the process registry carries the engine's histogram/gauge series
+            # (mtpu_engine_phase_seconds etc., recorded by the batch loop) —
+            # without it a scraper could never see the latency distributions
+            reg_text = default_registry.expose()
+            reg_names = set(re.findall(r"^# TYPE (\S+)", reg_text, re.M))
+            # metric names come from the central catalog (no stringly-typed
+            # drift; tests/test_static.py enforces this package-wide); series
+            # the registry already owns are skipped so names never duplicate
+            hand_built = [
+                (_C.GENERATED_TOKENS_TOTAL, f"{s.generated_tokens}"),
+                (_C.PROMPT_TOKENS_TOTAL, f"{s.prompt_tokens}"),
+                (_C.DECODE_STEPS_TOTAL, f"{s.steps}"),
+                (_C.TOKENS_PER_SECOND, f"{s.tokens_per_second():.3f}"),
+                (_C.ACTIVE_SLOTS, f"{active}"),
+                (_C.WAITING_REQUESTS, f"{eng.waiting.qsize()}"),
+                (_C.KV_PAGES_FREE, f"{eng.cache.allocator.available}"),
+                (_C.SCHEDULER_ERRORS_TOTAL, f"{eng.error_count}"),
+            ]
+            if eng.spec_gamma:
+                hand_built += [
+                    (_C.SPEC_PROPOSED_TOTAL, f"{s.spec_proposed}"),
+                    (_C.SPEC_ACCEPTED_TOTAL, f"{s.spec_accepted}"),
+                    (_C.SPEC_ACCEPTANCE_RATE, f"{s.acceptance_rate():.4f}"),
+                ]
+            if pc is not None:
+                hand_built += [
+                    (_C.PREFIX_CACHE_HITS_TOTAL, f"{pc.hits}"),
+                    (_C.PREFIX_CACHE_MISSES_TOTAL, f"{pc.misses}"),
+                    (_C.PREFIX_CACHED_PAGES, f"{pc.cached_pages}"),
+                ]
+            lines = [
+                f"{name} {value}"
+                for name, value in hand_built
+                if name not in reg_names
+            ]
+            lines.append(
+                f'{_C.DECODE_IMPL}{{attention="'
                 f'{eng.impl_plan["attention"]}",scatter='
-                f'"{eng.impl_plan["scatter"]}"}} 1\n'
-                + (
-                    f"mtpu_spec_proposed_total {s.spec_proposed}\n"
-                    f"mtpu_spec_accepted_total {s.spec_accepted}\n"
-                    f"mtpu_spec_acceptance_rate {s.acceptance_rate():.4f}\n"
-                    if eng.spec_gamma
-                    else ""
-                )
-                + (
-                    f"mtpu_prefix_cache_hits_total {pc.hits}\n"
-                    f"mtpu_prefix_cache_misses_total {pc.misses}\n"
-                    f"mtpu_prefix_cached_pages {pc.cached_pages}\n"
-                    if pc is not None
-                    else ""
-                )
-            ).encode()
+                f'"{eng.impl_plan["scatter"]}"}} 1'
+            )
+            body = ("\n".join(lines) + "\n" + reg_text).encode()
             self.send_response(200)
-            self.send_header("content-type", "text/plain")
+            self.send_header("content-type", "text/plain; version=0.0.4")
             self.send_header("content-length", str(len(body)))
             self.end_headers()
             self.wfile.write(body)

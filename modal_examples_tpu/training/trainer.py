@@ -173,3 +173,40 @@ class Trainer:
             with self.mesh:
                 return self._step_fn(state, batch)
         return self._step_fn(state, batch)
+
+    # -- the loop ------------------------------------------------------------
+
+    def fit(
+        self,
+        state: TrainState,
+        batches,
+        *,
+        run_dir=None,
+        logger=None,
+        volume=None,
+        log_every: int = 1,
+    ) -> TrainState:
+        """Drive ``train_step`` over ``batches``, recording loss/grad_norm to
+        a ``utils.tracking.RunLogger``. Pass an open ``logger`` to share one
+        across phases (the caller closes it), or just ``run_dir`` and the
+        loop owns the logger — closed (file handle + TB writer released,
+        Volume committed) even when a step raises."""
+        from ..utils.tracking import RunLogger
+
+        owned = None
+        if logger is None and run_dir is not None:
+            logger = owned = RunLogger(run_dir, volume=volume)
+        try:
+            for batch in batches:
+                state, metrics = self.train_step(state, batch)
+                if logger is not None:
+                    step = int(state.step)
+                    if step % max(1, log_every) == 0:
+                        # float() host-syncs, so only convert on log steps
+                        logger.log(
+                            step, {k: float(v) for k, v in metrics.items()}
+                        )
+            return state
+        finally:
+            if owned is not None:
+                owned.close()

@@ -9,12 +9,14 @@ snapshot-enabled container boot."""
 
 from __future__ import annotations
 
-
 #: Prometheus metric names for memory-snapshot cold-start accounting
 #: (modal_examples_tpu.snapshot). Labels: function=<spec tag>, and
-#: result=hit|miss|fallback on the boots counter.
-SNAPSHOT_BOOTS_METRIC = "mtpu_snapshot_boots_total"
-SNAPSHOT_CAPTURES_METRIC = "mtpu_snapshot_captures_total"
+#: result=hit|miss|fallback on the boots counter. Declared in the central
+#: catalog (observability.catalog); re-exported here for back-compat.
+from ..observability.catalog import (  # noqa: F401
+    SNAPSHOT_BOOTS_METRIC,
+    SNAPSHOT_CAPTURES_METRIC,
+)
 
 
 def record_snapshot_boot(

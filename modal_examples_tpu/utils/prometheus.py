@@ -7,13 +7,56 @@ ephemeral containers, so the reference runs a Pushgateway *as an app*
 it. Here the registry + exposition format are implemented directly (no Go
 binary needed), and the aggregator pattern is a Dict-backed push sink any
 app can serve via a web endpoint.
+
+Exposition follows the Prometheus text format rules: label values are
+escaped (``\\``, ``"``, newline), each metric name carries exactly one
+``# HELP``/``# TYPE`` header, and histograms emit cumulative ``_bucket``
+series ending in ``le="+Inf"`` plus ``_sum``/``_count``.
 """
 
 from __future__ import annotations
 
+import math
+import re
 import threading
 import time
 from collections import defaultdict
+
+#: default latency buckets (seconds) — sub-ms dispatch up to multi-minute
+#: cold boots, roughly log-spaced like prometheus client defaults
+DEFAULT_TIME_BUCKETS = (
+    0.001, 0.0025, 0.005, 0.01, 0.025, 0.05, 0.1, 0.25, 0.5,
+    1.0, 2.5, 5.0, 10.0, 30.0, 60.0, 120.0, 300.0,
+)
+
+
+def escape_label_value(value) -> str:
+    """Escape a label value per the Prometheus text exposition format."""
+    return (
+        str(value)
+        .replace("\\", "\\\\")
+        .replace('"', '\\"')
+        .replace("\n", "\\n")
+    )
+
+
+def _escape_help(text: str) -> str:
+    return text.replace("\\", "\\\\").replace("\n", "\\n")
+
+
+def _fmt_le(le: float) -> str:
+    if math.isinf(le):
+        return "+Inf"
+    return f"{le:.10g}"
+
+
+def _label_str(labels) -> str:
+    if not labels:
+        return ""
+    inner = ",".join(
+        f'{k}="{escape_label_value(v)}"' for k, v in labels
+    )
+    return "{" + inner + "}"
 
 
 class Registry:
@@ -21,6 +64,9 @@ class Registry:
         self._lock = threading.Lock()
         self._counters: dict[tuple, float] = defaultdict(float)
         self._gauges: dict[tuple, float] = {}
+        # key -> {"buckets": (le,...), "counts": [per-bucket + overflow],
+        #         "sum": float, "count": int}
+        self._histograms: dict[tuple, dict] = {}
         self._help: dict[str, str] = {}
         self._types: dict[str, str] = {}
 
@@ -43,34 +89,114 @@ class Registry:
             if help:
                 self._help[name] = help
 
+    def histogram_observe(self, name: str, value: float,
+                          labels: dict | None = None,
+                          buckets: tuple | None = None, help: str = ""):
+        """Observe one value into a histogram series.
+
+        ``buckets`` are upper bounds (``le``); the ``+Inf`` bucket is
+        implicit. The bucket layout is fixed by the first observation of a
+        series — later ``buckets=`` arguments are ignored for it.
+        """
+        key = self._key(name, labels)
+        with self._lock:
+            h = self._histograms.get(key)
+            if h is None:
+                bs = tuple(sorted(buckets or DEFAULT_TIME_BUCKETS))
+                h = {"buckets": bs, "counts": [0] * (len(bs) + 1),
+                     "sum": 0.0, "count": 0}
+                self._histograms[key] = h
+            for i, le in enumerate(h["buckets"]):
+                if value <= le:
+                    h["counts"][i] += 1
+                    break
+            else:
+                h["counts"][-1] += 1  # +Inf overflow
+            h["sum"] += value
+            h["count"] += 1
+            self._types[name] = "histogram"
+            if help:
+                self._help[name] = help
+
+    def histogram_quantiles(
+        self, name: str, labels: dict | None = None,
+        quantiles: tuple = (0.5, 0.95, 0.99),
+    ) -> dict | None:
+        """Estimate quantiles from one histogram series (linear interpolation
+        within the winning bucket, like PromQL ``histogram_quantile``).
+        Returns ``{"p50": ..., ..., "count": n, "sum": s}`` or None when the
+        series was never observed."""
+        key = self._key(name, labels)
+        with self._lock:
+            h = self._histograms.get(key)
+            if h is None or h["count"] == 0:
+                return None
+            bounds = h["buckets"]
+            counts = list(h["counts"])
+            total = h["count"]
+            out = {"count": total, "sum": h["sum"]}
+            for q in quantiles:
+                rank = q * total
+                cum = 0.0
+                value = float(bounds[-1]) if bounds else 0.0
+                for i, c in enumerate(counts):
+                    prev_cum = cum
+                    cum += c
+                    if cum >= rank and c > 0:
+                        hi = bounds[i] if i < len(bounds) else bounds[-1]
+                        lo = bounds[i - 1] if i > 0 else 0.0
+                        if i >= len(bounds):  # +Inf bucket: clamp to last bound
+                            value = float(bounds[-1])
+                        else:
+                            frac = (rank - prev_cum) / c
+                            value = lo + (hi - lo) * frac
+                        break
+                out[f"p{int(q * 100)}"] = value
+            return out
+
     def expose(self) -> str:
         """Prometheus text exposition format."""
         with self._lock:
             lines: list[str] = []
             seen_header = set()
+
+            def header(name: str) -> None:
+                if name in seen_header:
+                    return
+                if name in self._help:
+                    lines.append(
+                        f"# HELP {name} {_escape_help(self._help[name])}"
+                    )
+                lines.append(f"# TYPE {name} {self._types.get(name, 'untyped')}")
+                seen_header.add(name)
+
             for store in (self._counters, self._gauges):
                 for (name, labels), value in sorted(store.items()):
-                    if name not in seen_header:
-                        if name in self._help:
-                            lines.append(f"# HELP {name} {self._help[name]}")
-                        lines.append(f"# TYPE {name} {self._types.get(name, 'untyped')}")
-                        seen_header.add(name)
-                    label_s = (
-                        "{" + ",".join(f'{k}="{v}"' for k, v in labels) + "}"
-                        if labels
-                        else ""
-                    )
-                    lines.append(f"{name}{label_s} {value}")
+                    header(name)
+                    lines.append(f"{name}{_label_str(labels)} {value}")
+            for (name, labels), h in sorted(self._histograms.items()):
+                header(name)
+                cum = 0
+                for le, c in zip(
+                    tuple(h["buckets"]) + (math.inf,), h["counts"]
+                ):
+                    cum += c
+                    ls = _label_str(tuple(labels) + (("le", _fmt_le(le)),))
+                    lines.append(f"{name}_bucket{ls} {cum}")
+                lines.append(f"{name}_sum{_label_str(labels)} {h['sum']}")
+                lines.append(f"{name}_count{_label_str(labels)} {h['count']}")
             return "\n".join(lines) + "\n"
 
     def value(self, name: str, labels: dict | None = None) -> float:
-        """Current value of one series (counter or gauge); 0.0 when never
-        written. Lets tests and the CLI read counters back without parsing
-        the text exposition."""
+        """Current value of one series; 0.0 when never written. Counters and
+        gauges return their value, histograms their observation count. Lets
+        tests and the CLI read series back without parsing the exposition."""
         key = self._key(name, labels)
         with self._lock:
             if key in self._gauges:
                 return self._gauges[key]
+            if key in self._histograms:
+                return float(self._histograms[key]["count"])
             return self._counters.get(key, 0.0)
 
     def snapshot(self) -> dict:
@@ -78,6 +204,10 @@ class Registry:
             return {
                 "counters": {str(k): v for k, v in self._counters.items()},
                 "gauges": {str(k): v for k, v in self._gauges.items()},
+                "histograms": {
+                    str(k): {"sum": h["sum"], "count": h["count"]}
+                    for k, h in self._histograms.items()
+                },
             }
 
 
@@ -94,10 +224,82 @@ def push_to_dict(metrics_dict, job: str, registry: Registry | None = None) -> No
                          "text": reg.expose()}
 
 
+_SAMPLE_RE = re.compile(
+    r"^(?P<name>[a-zA-Z_:][a-zA-Z0-9_:]*)"
+    r"(?:\{(?P<labels>.*)\})?"
+    r"\s+(?P<rest>.+)$"
+)
+
+
+def merge_expositions(jobs: dict[str, str]) -> str:
+    """Merge per-job exposition texts into ONE valid exposition.
+
+    Each sample gains a ``job`` label (the pushgateway convention), every
+    metric name keeps exactly one ``# HELP``/``# TYPE`` header, and no
+    non-format comment lines are emitted — duplicate headers and ``# job:``
+    banners both violate the text format and break scrapers.
+    """
+    helps: dict[str, str] = {}
+    types: dict[str, str] = {}
+    samples: dict[str, list[str]] = {}
+    order: list[str] = []
+
+    def base_name(name: str) -> str:
+        for suffix in ("_bucket", "_sum", "_count"):
+            stem = name[: -len(suffix)]
+            if name.endswith(suffix) and types.get(stem) in (
+                "histogram", "summary"
+            ):
+                return stem
+        return name
+
+    for job, text in sorted(jobs.items()):
+        for line in text.splitlines():
+            line = line.strip()
+            if not line:
+                continue
+            if line.startswith("# HELP "):
+                _, _, rest = line.partition("# HELP ")
+                name, _, help_text = rest.partition(" ")
+                helps.setdefault(name, help_text)
+                continue
+            if line.startswith("# TYPE "):
+                _, _, rest = line.partition("# TYPE ")
+                name, _, type_text = rest.partition(" ")
+                types.setdefault(name, type_text)
+                continue
+            if line.startswith("#"):
+                continue  # drop free-form comments: not part of the format
+            m = _SAMPLE_RE.match(line)
+            if m is None:
+                continue
+            name = m.group("name")
+            labels = m.group("labels") or ""
+            job_label = f'job="{escape_label_value(job)}"'
+            labels = f"{labels},{job_label}" if labels else job_label
+            group = base_name(name)
+            if group not in samples:
+                samples[group] = []
+                order.append(group)
+            samples[group].append(f"{name}{{{labels}}} {m.group('rest')}")
+
+    lines: list[str] = []
+    for group in order:
+        if group in helps:
+            lines.append(f"# HELP {group} {helps[group]}")
+        if group in types:
+            lines.append(f"# TYPE {group} {types[group]}")
+        lines.extend(samples[group])
+    return "\n".join(lines) + ("\n" if lines else "")
+
+
 def aggregate_exposition(metrics_dict) -> str:
-    """Merge all jobs' pushed text expositions (the gateway's /metrics)."""
-    parts = []
-    for job, payload in sorted(metrics_dict.items()):
-        parts.append(f"# job: {job} (pushed at {payload['at']:.0f})")
-        parts.append(payload["text"])
-    return "\n".join(parts)
+    """Merge all jobs' pushed text expositions (the gateway's /metrics).
+
+    Series from different jobs are distinguished by an added ``job`` label;
+    headers are deduplicated so the output is itself a valid exposition.
+    """
+    jobs = {
+        job: payload["text"] for job, payload in sorted(metrics_dict.items())
+    }
+    return merge_expositions(jobs)

@@ -64,6 +64,11 @@ class RunLogger:
         return [json.loads(line) for line in path.read_text().splitlines() if line]
 
     def close(self) -> None:
+        """Release the JSONL handle and TB writer, then commit the Volume.
+        Idempotent: Trainer.fit and an outer ``with`` block may both close."""
+        if getattr(self, "_closed", False):
+            return
+        self._closed = True
         self._jsonl.close()
         if self._tb is not None:
             self._tb.flush()

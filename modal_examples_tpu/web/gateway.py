@@ -20,6 +20,9 @@ import urllib.parse
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 
 from . import registry
+from ..utils.log import get_logger
+
+_log = get_logger("gateway")
 
 
 def _coerce_kwargs(fn, raw: dict) -> dict:
@@ -168,9 +171,47 @@ class _Handler(BaseHTTPRequestHandler):
         self.end_headers()
         self.wfile.write(body)
 
+    # -- built-in observability routes --------------------------------------
+
+    def _serve_builtin(self, parsed, method: str) -> bool:
+        """``/metrics`` (prometheus exposition: this process's registry +
+        every pushed job file) and ``/traces[/<call_id>]`` (call-lifecycle
+        span JSON). User endpoints with the same label win — these only
+        answer when no route claimed the path."""
+        parts = parsed.path.strip("/").split("/")
+        label = parts[0] if parts else ""
+        if method != "GET" or label not in ("metrics", "traces"):
+            return False
+        if label == "metrics":
+            from ..observability.export import live_and_pushed_metrics
+
+            body = live_and_pushed_metrics(
+                job=f"gateway-{self.gateway.app.name}"
+            ).encode()
+            self.send_response(200)
+            self.send_header("content-type", "text/plain; version=0.0.4")
+            self.send_header("content-length", str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
+            return True
+        from ..observability.trace import default_store
+
+        if len(parts) > 1 and parts[1]:
+            trace_id = urllib.parse.unquote(parts[1])
+            spans = default_store.read(trace_id)
+            if not spans:
+                self._respond_json(404, {"error": f"no trace {trace_id!r}"})
+            else:
+                self._respond_json(200, {"trace_id": trace_id, "spans": spans})
+        else:
+            self._respond_json(200, {"traces": default_store.list_traces()})
+        return True
+
     def _handle(self, method: str) -> None:
         route, parsed = self._route()
         if route is None:
+            if self._serve_builtin(parsed, method):
+                return
             self._respond_json(404, {"error": f"no endpoint at {parsed.path}"})
             return
         fn = route["function"]
@@ -194,7 +235,9 @@ class _Handler(BaseHTTPRequestHandler):
             except ConnectionClosed:
                 pass
             except BaseException as e:
-                print(f"[gateway] websocket handler error: {type(e).__name__}: {e}")
+                _log.warning(
+                    "websocket handler error: %s: %s", type(e).__name__, e
+                )
             finally:
                 ws.close()
                 self.close_connection = True
@@ -266,7 +309,9 @@ class _Handler(BaseHTTPRequestHandler):
             if headers_sent:
                 # Response already started: a second status line would corrupt
                 # the stream. Drop the connection so the client sees EOF.
-                print(f"[gateway] error mid-response: {type(e).__name__}: {e}")
+                _log.warning(
+                    "error mid-response: %s: %s", type(e).__name__, e
+                )
                 self.close_connection = True
             else:
                 self._respond_json(500, {"error": f"{type(e).__name__}: {e}"})

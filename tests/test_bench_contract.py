@@ -30,6 +30,12 @@ def test_bench_emits_schema_json():
         assert key in payload, payload
     assert payload["value"] > 0
     assert payload["unit"] == "tok/s"
+    # phase-attributed latency: every BENCH_*.json carries p50/p95/p99 per
+    # engine phase from the observability histograms (docs/observability.md)
+    pl = payload.get("phase_latency")
+    assert pl, payload
+    some = pl.get("prefill") or pl.get("decode_wait")
+    assert some and {"p50", "p95", "p99", "count"} <= set(some)
 
 
 @pytest.mark.slow

@@ -1,0 +1,317 @@
+"""Observability tier: call-lifecycle traces stitched across the
+supervisor/container boundary, prometheus histogram exposition validity,
+the file-backed push gateway, and the `tpurun trace` / `tpurun metrics`
+CLI — the acceptance surface of the tracing+histograms subsystem."""
+
+import json
+import math
+import re
+import urllib.request
+
+import pytest
+
+import modal_examples_tpu as mtpu
+from modal_examples_tpu.core.cli import main as cli_main
+from modal_examples_tpu.observability import span
+from modal_examples_tpu.observability import catalog as C
+from modal_examples_tpu.observability.trace import default_store
+from modal_examples_tpu.utils.prometheus import (
+    Registry,
+    default_registry,
+    merge_expositions,
+)
+
+app = mtpu.App("obs-test")
+
+
+@app.function(timeout=30)
+def traced_square(x: int) -> int:
+    return x * x
+
+
+@app.function(timeout=30)
+def with_user_span(x: int) -> int:
+    with span("user-phase", tag="inner"):
+        return x + 1
+
+
+@app.function(timeout=30)
+@mtpu.fastapi_endpoint()
+def hello_endpoint(name: str = "world") -> dict:
+    return {"hello": name}
+
+
+@pytest.fixture(scope="module", autouse=True)
+def run_ctx():
+    with app.run():
+        yield
+
+
+# ---------------------------------------------------------------------------
+# trace stitching (the tier-1 acceptance criterion)
+# ---------------------------------------------------------------------------
+
+
+class TestTraceStitching:
+    def _trace_of(self, call) -> list[dict]:
+        assert call.call_id and call.call_id.startswith("in-")
+        spans = default_store.read(call.call_id)
+        assert spans, f"no trace file for {call.call_id}"
+        return spans
+
+    def test_remote_call_yields_stitched_phase_spans(self, capsys):
+        """One .remote()-path call through the process backend produces a
+        single trace holding the supervisor-side phases (queue, boot,
+        dispatch) AND the container-side phases (execute, serialize) shipped
+        back over the worker pipe — >= 4 stitched phases + the root."""
+        call = traced_square.spawn(7)  # same submit path as .remote()
+        assert call.get(timeout=30) == 49
+        spans = self._trace_of(call)
+
+        by_name = {}
+        for s in spans:
+            by_name.setdefault(s["name"], []).append(s)
+        for phase in ("call", "queue", "boot", "dispatch", "execute",
+                      "serialize"):
+            assert phase in by_name, (phase, sorted(by_name))
+
+        # every span belongs to ONE trace (the call id)
+        assert {s["trace_id"] for s in spans} == {call.call_id}
+
+        # stitching: supervisor phases parent under the root; the child
+        # process's execute/serialize parent under the dispatch span
+        root = by_name["call"][0]
+        assert root["parent_id"] is None
+        for phase in ("queue", "boot", "dispatch"):
+            assert by_name[phase][0]["parent_id"] == root["span_id"]
+        dispatch_id = by_name["dispatch"][0]["span_id"]
+        assert by_name["execute"][0]["parent_id"] == dispatch_id
+        assert by_name["serialize"][0]["parent_id"] == dispatch_id
+
+        # statuses + ordering sanity
+        assert all(s["status"] == "ok" for s in spans)
+        assert root["end"] >= root["start"]
+        assert by_name["execute"][0]["start"] >= by_name["queue"][0]["start"]
+
+        # retrievable via the CLI: `tpurun trace <call_id>`
+        assert cli_main(["trace", call.call_id]) == 0
+        out = capsys.readouterr().out
+        assert call.call_id in out
+        for phase in ("queue", "boot", "execute", "serialize"):
+            assert phase in out
+
+    def test_trace_list_cli(self, capsys):
+        call = traced_square.spawn(3)
+        assert call.get(timeout=30) == 9
+        assert cli_main(["trace", "list"]) == 0
+        out = capsys.readouterr().out
+        assert call.call_id in out
+
+    def test_user_spans_ship_back_from_container(self):
+        call = with_user_span.spawn(1)
+        assert call.get(timeout=30) == 2
+        spans = self._trace_of(call)
+        user = [s for s in spans if s["name"] == "user-phase"]
+        assert user and user[0]["attrs"]["tag"] == "inner"
+        execute = [s for s in spans if s["name"] == "execute"][0]
+        assert user[0]["parent_id"] == execute["span_id"]
+
+    def test_call_feeds_latency_histograms(self):
+        tag = traced_square.spec.tag
+        before = default_registry.value(
+            C.CALL_DURATION_SECONDS, labels={"function": tag, "phase": "total"}
+        )
+        assert traced_square.remote(5) == 25
+        after = default_registry.value(
+            C.CALL_DURATION_SECONDS, labels={"function": tag, "phase": "total"}
+        )
+        assert after == before + 1
+        # dedicated queue-wait series observed too
+        assert default_registry.value(
+            C.QUEUE_WAIT_SECONDS, labels={"function": tag}
+        ) >= 1
+
+    def test_tracing_can_be_disabled(self, monkeypatch):
+        from modal_examples_tpu.observability import trace as tr
+
+        monkeypatch.setenv("MTPU_TRACE", "0")
+        assert not tr.tracing_enabled()
+        monkeypatch.setenv("MTPU_TRACE", "1")
+        assert tr.tracing_enabled()
+
+
+# ---------------------------------------------------------------------------
+# prometheus histogram exposition (text-format validity)
+# ---------------------------------------------------------------------------
+
+
+def _parse_histogram(text: str, name: str, labels_contains: str = ""):
+    """Collect (le, cum_count) pairs + sum/count for one histogram series."""
+    buckets, total, sum_ = [], None, None
+    for line in text.splitlines():
+        if line.startswith("#") or labels_contains not in line:
+            continue
+        m = re.match(rf'^{name}_bucket\{{(.*)\}} (\S+)$', line)
+        if m:
+            le = re.search(r'le="([^"]+)"', m.group(1)).group(1)
+            buckets.append(
+                (math.inf if le == "+Inf" else float(le), float(m.group(2)))
+            )
+        elif line.startswith(f"{name}_sum"):
+            sum_ = float(line.rsplit(" ", 1)[1])
+        elif line.startswith(f"{name}_count"):
+            total = float(line.rsplit(" ", 1)[1])
+    return buckets, sum_, total
+
+
+class TestHistogramExposition:
+    def test_populated_histogram_parses_under_text_format_rules(self):
+        reg = Registry()
+        values = [0.003, 0.003, 0.04, 0.9, 2.0, 7.0, 500.0]
+        for v in values:
+            reg.histogram_observe(
+                "mtpu_call_duration_seconds", v,
+                labels={"function": "f", "phase": "execute"},
+                help="per-phase latency",
+            )
+        text = reg.expose()
+        assert text.count("# TYPE mtpu_call_duration_seconds histogram") == 1
+        assert text.count("# HELP mtpu_call_duration_seconds") == 1
+        buckets, sum_, total = _parse_histogram(
+            text, "mtpu_call_duration_seconds"
+        )
+        assert buckets, text
+        # bucket bounds ascending, counts cumulative (monotone nondecreasing)
+        les = [le for le, _ in buckets]
+        assert les == sorted(les) and les[-1] == math.inf
+        counts = [c for _, c in buckets]
+        assert counts == sorted(counts)
+        # +Inf bucket equals _count; _sum matches the observations
+        assert counts[-1] == total == len(values)
+        assert sum_ == pytest.approx(sum(values))
+        # a value past the largest finite bound lands only in +Inf
+        finite_max = max(le for le in les if le != math.inf)
+        assert 500.0 > finite_max and counts[-1] == counts[-2] + 1
+
+    def test_label_values_escaped(self):
+        reg = Registry()
+        evil = 'a"b\\c\nd'
+        reg.counter_inc("mtpu_retries_total", labels={"reason": evil})
+        text = reg.expose()
+        assert 'reason="a\\"b\\\\c\\nd"' in text
+        # the exposition itself stays line-atomic: no raw newline mid-sample
+        sample_lines = [l for l in text.splitlines() if not l.startswith("#")]
+        assert len(sample_lines) == 1
+
+    def test_histogram_quantiles(self):
+        reg = Registry()
+        for i in range(100):
+            reg.histogram_observe("mtpu_queue_wait_seconds", 0.001 + i * 0.001)
+        q = reg.histogram_quantiles("mtpu_queue_wait_seconds")
+        assert q["count"] == 100
+        assert 0.0 < q["p50"] <= q["p95"] <= q["p99"] <= 0.3
+
+    def test_value_reads_histogram_count(self):
+        reg = Registry()
+        reg.histogram_observe("mtpu_queue_wait_seconds", 0.5)
+        assert reg.value("mtpu_queue_wait_seconds") == 1.0
+
+
+# ---------------------------------------------------------------------------
+# merge/push gateway + `tpurun metrics`
+# ---------------------------------------------------------------------------
+
+
+class TestPushGateway:
+    def test_merge_is_a_single_valid_exposition(self):
+        r1, r2 = Registry(), Registry()
+        r1.counter_inc("mtpu_retries_total", 2, help="retries")
+        r1.histogram_observe("mtpu_queue_wait_seconds", 0.1)
+        r2.counter_inc("mtpu_retries_total", 5)
+        merged = merge_expositions({"job-a": r1.expose(), "job-b": r2.expose()})
+        assert merged.count("# TYPE mtpu_retries_total counter") == 1
+        assert "# job:" not in merged
+        assert 'mtpu_retries_total{job="job-a"} 2.0' in merged
+        assert 'mtpu_retries_total{job="job-b"} 5.0' in merged
+        # histogram child series stay grouped under the parent's single header
+        assert merged.count("# TYPE mtpu_queue_wait_seconds histogram") == 1
+        assert 'le="+Inf",job="job-a"' in merged
+
+    def test_push_and_cli_metrics(self, tmp_path, capsys):
+        from modal_examples_tpu.observability.export import (
+            push_metrics_file, read_pushed_metrics,
+        )
+
+        reg = Registry()
+        reg.counter_inc("mtpu_retries_total", 3, labels={"reason": "timeout"})
+        path = push_metrics_file("bench", reg, root=tmp_path)
+        assert path is not None and path.exists()
+        merged = read_pushed_metrics(tmp_path)
+        assert 'reason="timeout"' in merged and 'job="bench"' in merged
+
+        assert cli_main(["metrics", "--dir", str(tmp_path)]) == 0
+        out = capsys.readouterr().out
+        assert "mtpu_retries_total" in out
+
+    def test_empty_registry_not_pushed(self, tmp_path):
+        from modal_examples_tpu.observability.export import push_metrics_file
+
+        assert push_metrics_file("empty", Registry(), root=tmp_path) is None
+
+
+# ---------------------------------------------------------------------------
+# gateway built-in endpoints
+# ---------------------------------------------------------------------------
+
+
+class TestGatewayEndpoints:
+    def test_metrics_and_traces_endpoints(self):
+        from modal_examples_tpu.web.gateway import Gateway
+
+        call = traced_square.spawn(6)
+        assert call.get(timeout=30) == 36
+
+        gw = Gateway(app).start()
+        try:
+            # user route still wins
+            with urllib.request.urlopen(
+                f"{gw.base_url}/hello_endpoint?name=x", timeout=10
+            ) as r:
+                assert json.loads(r.read()) == {"hello": "x"}
+            with urllib.request.urlopen(
+                f"{gw.base_url}/metrics", timeout=10
+            ) as r:
+                body = r.read().decode()
+                assert r.headers["content-type"].startswith("text/plain")
+            assert "mtpu_call_duration_seconds" in body
+            with urllib.request.urlopen(
+                f"{gw.base_url}/traces/{call.call_id}", timeout=10
+            ) as r:
+                payload = json.loads(r.read())
+            names = {s["name"] for s in payload["spans"]}
+            assert {"call", "queue", "execute"} <= names
+            with urllib.request.urlopen(
+                f"{gw.base_url}/traces", timeout=10
+            ) as r:
+                listing = json.loads(r.read())
+            assert call.call_id in listing["traces"]
+        finally:
+            gw.stop()
+
+
+# ---------------------------------------------------------------------------
+# catalog hygiene
+# ---------------------------------------------------------------------------
+
+
+class TestCatalog:
+    def test_catalog_names_follow_conventions(self):
+        for name, meta in C.CATALOG.items():
+            assert name.startswith("mtpu_")
+            if meta["type"] == "counter":
+                assert name.endswith("_total"), name
+            assert isinstance(meta["labels"], list)
+            assert meta["help"]
+
+    def test_all_metric_names_matches_catalog(self):
+        assert C.ALL_METRIC_NAMES == frozenset(C.CATALOG)

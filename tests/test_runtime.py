@@ -175,6 +175,124 @@ class TestFaultTolerance:
         assert sleeper.remote(0.01) == "done"
 
 
+class TestFailureAccounting:
+    """Each failure path must leave an audit trail: the right
+    ``mtpu_retries_total{reason=...}`` / ``mtpu_container_kills_total``
+    deltas in the process registry, and error-status spans in the call's
+    trace (observability.catalog names throughout)."""
+
+    @staticmethod
+    def _counter(name, **labels):
+        from modal_examples_tpu.utils.prometheus import default_registry
+
+        return default_registry.value(name, labels=labels)
+
+    @staticmethod
+    def _trace(call):
+        from modal_examples_tpu.observability.trace import default_store
+
+        return default_store.read(call.call_id)
+
+    def test_timeout_accounting(self):
+        from modal_examples_tpu.observability import catalog as C
+
+        tag = sleeper.spec.tag
+        kills0 = self._counter(
+            C.CONTAINER_KILLS_TOTAL, function=tag, reason="timeout"
+        )
+        call = sleeper.spawn(10)
+        with pytest.raises((FunctionTimeoutError, RuntimeError)):
+            call.get(timeout=30)
+        assert self._counter(
+            C.CONTAINER_KILLS_TOTAL, function=tag, reason="timeout"
+        ) == kills0 + 1
+        spans = self._trace(call)
+        root = [s for s in spans if s["name"] == "call"][0]
+        assert root["status"] == "error"
+        dispatch = [s for s in spans if s["name"] == "dispatch"]
+        assert dispatch and dispatch[-1]["status"] == "error"
+        assert dispatch[-1]["attrs"]["reason"] == "timeout"
+
+    def test_container_death_orphan_requeued_and_counted(self, tmp_path):
+        from modal_examples_tpu.observability import catalog as C
+
+        dapp = mtpu.App("death-test")
+
+        @dapp.function(
+            timeout=60, retries=mtpu.Retries(max_retries=2, initial_delay=0.0)
+        )
+        def die_once(path: str):
+            if not os.path.exists(path):
+                with open(path, "w") as f:
+                    f.write("x")
+                os._exit(1)  # hard container death mid-input
+            return "survived"
+
+        with dapp.run():
+            tag = die_once.spec.tag
+            r0 = self._counter(
+                C.RETRIES_TOTAL, function=tag, reason="container_death"
+            )
+            call = die_once.spawn(str(tmp_path / "sentinel"))
+            assert call.get(timeout=60) == "survived"
+            assert self._counter(
+                C.RETRIES_TOTAL, function=tag, reason="container_death"
+            ) == r0 + 1
+            spans = self._trace(call)
+            retries = [s for s in spans if s["name"] == "retry"]
+            assert retries and retries[0]["attrs"]["reason"] == "container_death"
+            # first dispatch errored, the requeued attempt completed the call
+            dispatch = sorted(
+                (s for s in spans if s["name"] == "dispatch"),
+                key=lambda s: s["start"],
+            )
+            assert len(dispatch) >= 2
+            assert dispatch[0]["status"] == "error"
+            assert dispatch[-1]["status"] == "ok"
+            root = [s for s in spans if s["name"] == "call"][0]
+            assert root["status"] == "ok" and root["attrs"]["attempts"] == 1
+
+    def test_retry_exhaustion_counts_every_attempt(self):
+        from modal_examples_tpu.observability import catalog as C
+
+        eapp = mtpu.App("exhaust-test")
+
+        @eapp.function(
+            timeout=30, retries=mtpu.Retries(max_retries=2, initial_delay=0.0)
+        )
+        def always_bad():
+            raise ValueError("permanent")
+
+        with eapp.run():
+            tag = always_bad.spec.tag
+            r0 = self._counter(
+                C.RETRIES_TOTAL, function=tag, reason="user_error"
+            )
+            call = always_bad.spawn()
+            with pytest.raises(ValueError, match="permanent"):
+                call.get(timeout=30)
+            # 3 attempts total -> 2 charged retries, then the exception
+            assert self._counter(
+                C.RETRIES_TOTAL, function=tag, reason="user_error"
+            ) == r0 + 2
+            spans = self._trace(call)
+            assert len([s for s in spans if s["name"] == "retry"]) == 2
+            root = [s for s in spans if s["name"] == "call"][0]
+            assert root["status"] == "error"
+            assert root["attrs"]["attempts"] == 3
+            # every attempt's execute span shipped back, all errored
+            executes = [s for s in spans if s["name"] == "execute"]
+            assert len(executes) == 3
+            assert all(s["status"] == "error" for s in executes)
+
+    def test_inflight_gauge_returns_to_zero(self):
+        from modal_examples_tpu.observability import catalog as C
+
+        tag = square.spec.tag
+        assert square.remote(2) == 4
+        assert self._counter(C.INFLIGHT_INPUTS, function=tag) == 0.0
+
+
 class TestBatching:
     def test_batched_groups_inputs(self):
         out = list(batch_double.map(range(8)))

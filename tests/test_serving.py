@@ -415,3 +415,14 @@ class TestOpenAIServer:
         with urllib.request.urlopen(self._url(server, "/metrics")) as r:
             text = r.read().decode()
         assert "mtpu_generated_tokens_total" in text
+        # the process registry's engine series (latency histograms) are part
+        # of the exposition, and no metric name appears in both the
+        # hand-built block and the registry block
+        assert "mtpu_engine_phase_seconds_bucket" in text
+        names = [
+            l.split("{")[0].split(" ")[0]
+            for l in text.splitlines()
+            if l and not l.startswith("#")
+        ]
+        gauges = [n for n in names if n == "mtpu_active_slots"]
+        assert len(gauges) == 1, "duplicate series between blocks"

@@ -8,6 +8,7 @@ import compileall
 import importlib
 import inspect
 import pkgutil
+import re
 import subprocess
 import sys
 import textwrap
@@ -67,6 +68,71 @@ def test_core_layer_is_jax_free():
         env={"PATH": "/usr/bin:/bin:/usr/local/bin", "PYTHONPATH": str(REPO_ROOT)},
     )
     assert out.returncode == 0 and "jax-free" in out.stdout, out.stderr
+
+
+#: C-ABI / attribute-marker symbols that share the ``mtpu_`` prefix but are
+#: not metric series (ctypes exports from the native host library, etc.)
+_NON_METRIC_MTPU_PREFIXES = (
+    "mtpu_host",
+    "mtpu_alloc_",
+    "mtpu_levenshtein",
+    "mtpu_byte_encode",
+)
+
+#: token that looks like a metric name: ``mtpu_`` at a word start (the
+#: lookbehind excludes the ``__mtpu_enter__``-style attribute markers)
+_METRIC_TOKEN_RE = re.compile(r"(?<![A-Za-z0-9_])mtpu_[a-z0-9_]+")
+
+
+def test_metric_names_all_declared_in_catalog():
+    """Every ``mtpu_*`` metric name appearing ANYWHERE in the package —
+    code, f-strings, comments, docstrings — must be declared in
+    ``observability.catalog``. One module owns every name, so two spellings
+    of one series or a phantom name in a comment can't drift past review."""
+    from modal_examples_tpu.observability.catalog import ALL_METRIC_NAMES
+
+    catalog_path = PKG_ROOT / "observability" / "catalog.py"
+    undeclared = []
+    for path in sorted(PKG_ROOT.rglob("*.py")):
+        if path == catalog_path:
+            continue
+        for tok in _METRIC_TOKEN_RE.findall(path.read_text()):
+            if tok.startswith(_NON_METRIC_MTPU_PREFIXES):
+                continue
+            # histogram child series reduce to their parent's name
+            base = re.sub(r"_(bucket|sum|count)$", "", tok)
+            if tok not in ALL_METRIC_NAMES and base not in ALL_METRIC_NAMES:
+                undeclared.append(f"{path.relative_to(REPO_ROOT)}: {tok}")
+    assert not undeclared, (
+        "mtpu_* metric names not declared in observability/catalog.py "
+        f"(add them there, or import the constant): {sorted(set(undeclared))}"
+    )
+
+
+def test_no_bare_print_in_framework_code():
+    """Framework code under ``core/`` and ``serving/`` must not ``print()``:
+    diagnostics go through ``utils.log.get_logger`` so they carry a level
+    and component and can be silenced/redirected. ``core/cli.py`` is exempt
+    — its stdout IS the product."""
+    exempt = {PKG_ROOT / "core" / "cli.py"}
+    offenders = []
+    for sub in ("core", "serving"):
+        for path in sorted((PKG_ROOT / sub).rglob("*.py")):
+            if path in exempt:
+                continue
+            tree = ast.parse(path.read_text())
+            for node in ast.walk(tree):
+                if (
+                    isinstance(node, ast.Call)
+                    and isinstance(node.func, ast.Name)
+                    and node.func.id == "print"
+                ):
+                    offenders.append(
+                        f"{path.relative_to(REPO_ROOT)}:{node.lineno}"
+                    )
+    assert not offenders, (
+        f"bare print() in framework code (use utils.log): {offenders}"
+    )
 
 
 @pytest.mark.parametrize(

@@ -47,6 +47,40 @@ class TestTrainer:
         last = float(metrics["loss"])
         assert last < first * 0.7, (first, last)
 
+    def test_fit_logs_and_closes_runlogger(self, jax, tmp_path):
+        import json
+
+        import jax.numpy as jnp
+
+        from modal_examples_tpu.training import Trainer, make_optimizer
+
+        def loss_fn(params, batch):
+            return jnp.mean((params["w"] * batch["x"] - batch["y"]) ** 2)
+
+        trainer = Trainer(loss_fn, make_optimizer(1e-1))
+        state = trainer.init_state({"w": jnp.ones((4,))})
+        batch = {"x": jnp.ones((4,)), "y": jnp.full((4,), 3.0)}
+        run_dir = tmp_path / "fit-run"
+        state = trainer.fit(
+            state, [batch] * 5, run_dir=run_dir, log_every=1
+        )
+        assert int(state.step) == 5
+        lines = (run_dir / "metrics.jsonl").read_text().splitlines()
+        records = [json.loads(l) for l in lines]
+        assert [r["step"] for r in records] == [1, 2, 3, 4, 5]
+        assert records[-1]["loss"] < records[0]["loss"]
+        # the loop owned the logger, so it closed it (handle released)
+        import os
+
+        open_fds = os.listdir("/proc/self/fd")
+        paths = set()
+        for fd in open_fds:
+            try:
+                paths.add(os.readlink(f"/proc/self/fd/{fd}"))
+            except OSError:
+                pass
+        assert str(run_dir / "metrics.jsonl") not in paths
+
     def test_sharded_step_with_mesh(self, jax):
         from jax.sharding import PartitionSpec as P
 

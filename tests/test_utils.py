@@ -32,8 +32,12 @@ class TestPrometheus:
             push_to_dict(store, "job1", r1)
             push_to_dict(store, "job2", r2)
             merged = aggregate_exposition(store)
-        assert "# job: job1" in merged and "# job: job2" in merged
-        assert "x_total 3.0" in merged and "x_total 4.0" in merged
+        # the merge is itself a valid exposition: one TYPE header, job
+        # labels distinguishing sources, no free-form comment lines
+        assert merged.count("# TYPE x_total counter") == 1
+        assert 'x_total{job="job1"} 3.0' in merged
+        assert 'x_total{job="job2"} 4.0' in merged
+        assert "# job:" not in merged
 
 
 class TestTracking:
