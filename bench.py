@@ -168,6 +168,16 @@ def _child(model: str) -> None:
         q = _q(name)
         if q:
             phase_latency[key] = q
+    # token-level serving latency (the vLLM-vs-TGI comparison axes): TTFT =
+    # submit -> first token, TPOT = inter-token gap, from the engine's
+    # per-request histograms — alongside aggregate tokens/s
+    token_latency = {}
+    for key, name in (("ttft", C.TTFT_SECONDS), ("tpot", C.TPOT_SECONDS)):
+        q = _q(name)
+        if q:
+            token_latency[key] = {
+                k: q[k] for k in ("p50", "p95", "count") if k in q
+            }
     print(
         json.dumps(
             {
@@ -187,6 +197,8 @@ def _child(model: str) -> None:
                 "pct_hbm_ceiling": round(stream_gbps / V5E_HBM_GBPS, 4),
                 "engine_errors": errors,
                 "phase_latency": phase_latency,
+                "token_latency": token_latency,
+                "tokens_per_second": round(tok_s, 2),
             }
         )
     )

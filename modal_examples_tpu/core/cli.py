@@ -14,8 +14,10 @@ Usage:
     tpurun secret create NAME K=V ...
     tpurun app list
     tpurun snapshot [list | inspect KEY | clear [KEY]]   # memory-snapshot store
-    tpurun trace [CALL_ID | list]      # call-lifecycle trace (phase spans)
+    tpurun trace [CALL_ID [--perfetto] | list [--limit N]]  # call traces
     tpurun metrics [--json]            # merged pushed prometheus expositions
+    tpurun scaler [N] [--function TAG] # autoscaler decision journal
+    tpurun top [--watch S]             # live serving summary + SLO burn rates
 """
 
 from __future__ import annotations
@@ -49,14 +51,22 @@ def _build_entrypoint_parser(fn, prog: str) -> argparse.ArgumentParser:
     return p
 
 
-def _pop_dir_flag(argv: list[str], usage: str) -> tuple[list[str], str | None]:
-    """Extract ``--dir PATH`` from argv; returns (rest, path_or_None)."""
-    if "--dir" not in argv:
+def _pop_flag(
+    argv: list[str], flag: str, usage: str
+) -> tuple[list[str], str | None]:
+    """Extract ``<flag> VALUE`` from argv; returns (rest, value_or_None).
+    A flag present without its value exits with ``usage``."""
+    if flag not in argv:
         return argv, None
-    i = argv.index("--dir")
+    i = argv.index(flag)
     if i + 1 >= len(argv):
         raise SystemExit(usage)
     return argv[:i] + argv[i + 2 :], argv[i + 1]
+
+
+def _pop_dir_flag(argv: list[str], usage: str) -> tuple[list[str], str | None]:
+    """Extract ``--dir PATH`` from argv; returns (rest, path_or_None)."""
+    return _pop_flag(argv, "--dir", usage)
 
 
 def _load_app(path: str):
@@ -326,7 +336,11 @@ def cmd_trace(argv: list[str]) -> int:
 
     trace CALL_ID      — the spans of one call (CALL_ID is the ``in-...`` id
                          from ``FunctionCall.call_id`` / ``tpurun trace list``)
-    trace list [N]     — most recently active traces
+    trace CALL_ID --perfetto [-o FILE]
+                       — emit the trace as Chrome-trace/Perfetto JSON
+                         (loads in chrome://tracing and ui.perfetto.dev)
+    trace list [--limit N]
+                       — most recently active traces, newest first
     ``--dir PATH`` overrides the trace root (default ``<state_dir>/traces``).
     """
     from ..observability.trace import TraceStore
@@ -334,7 +348,12 @@ def cmd_trace(argv: list[str]) -> int:
     argv, root = _pop_dir_flag(argv, "usage: tpurun trace ... --dir PATH")
     store = TraceStore(root=root)
     if not argv or argv[0] == "list":
-        limit = int(argv[1]) if len(argv) > 1 else 20
+        rest, limit_s = _pop_flag(
+            argv[1:], "--limit", "usage: tpurun trace list [--limit N]"
+        )
+        if limit_s is None and rest:  # bare N still accepted
+            limit_s, rest = rest[0], rest[1:]
+        limit = int(limit_s) if limit_s is not None else 20
         ids = store.list_traces(limit=limit)
         if not ids:
             print(f"no traces in {store.root}")
@@ -355,6 +374,21 @@ def cmd_trace(argv: list[str]) -> int:
     spans = store.read(trace_id)
     if not spans:
         raise SystemExit(f"no trace {trace_id!r} in {store.root}")
+    if "--perfetto" in argv:
+        from ..observability.export import export_chrome_trace
+
+        argv, out_file = _pop_flag(
+            argv, "-o", "usage: tpurun trace CALL_ID --perfetto [-o FILE]"
+        )
+        doc = export_chrome_trace(trace_id, out_file, store=store)
+        if out_file:
+            print(
+                f"wrote {len(doc['traceEvents'])} events to {out_file} "
+                "(open in chrome://tracing or ui.perfetto.dev)"
+            )
+        else:
+            print(json.dumps(doc))
+        return 0
     spans.sort(key=lambda s: (s.get("start") or 0.0))
     by_parent: dict = {}
     for s in spans:
@@ -410,6 +444,150 @@ def cmd_metrics(argv: list[str]) -> int:
     return 0
 
 
+def cmd_scaler(argv: list[str]) -> int:
+    """Print the autoscaler decision journal, newest last.
+
+    scaler [N]            — last N decisions (default 20)
+    scaler --function TAG — only one function's decisions
+    scaler --json         — raw JSONL records
+    ``--dir PATH`` overrides the journal directory (default: state dir).
+    """
+    from ..observability.journal import DecisionJournal
+
+    argv, root = _pop_dir_flag(argv, "usage: tpurun scaler ... --dir PATH")
+    as_json = "--json" in argv
+    argv = [a for a in argv if a != "--json"]
+    argv, function = _pop_flag(
+        argv, "--function", "usage: tpurun scaler [N] [--function TAG]"
+    )
+    n = int(argv[0]) if argv else 20
+    from pathlib import Path
+
+    journal = DecisionJournal(
+        path=Path(root) / "scaler.jsonl" if root else None
+    )
+    recs = journal.tail(n, function=function)
+    if not recs:
+        print(f"no autoscaler decisions in {journal.path}")
+        return 0
+    if as_json:
+        for r in recs:
+            print(json.dumps(r))
+        return 0
+    import time as _time
+
+    print(
+        f"{'WHEN':<20} {'FUNCTION':<24} {'ACTION':<11} {'TRIGGER':<17} "
+        f"{'QUEUE':>5} {'POOL':>7}  DETAIL"
+    )
+    for r in recs:
+        when = _time.strftime(
+            "%Y-%m-%d %H:%M:%S", _time.localtime(r.get("at", 0))
+        )
+        pool = f"{r.get('containers_before', '?')}->{r.get('containers_after', '?')}"
+        detail = []
+        if r.get("spawned"):
+            detail.append(f"spawned={r['spawned']}")
+        if r.get("idle_ages_s"):
+            detail.append(f"idle={r['idle_ages_s'][0]:.1f}s")
+        if r.get("container") is not None:
+            detail.append(f"container={r['container']}")
+        print(
+            f"{when:<20} {r.get('function', '?'):<24} "
+            f"{r.get('action', '?'):<11} {r.get('trigger', '?'):<17} "
+            f"{r.get('queue_depth', 0):>5} {pool:>7}  {' '.join(detail)}"
+        )
+    return 0
+
+
+def cmd_top(argv: list[str]) -> int:
+    """Live serving summary: engine load, token-level latency, SLO burn
+    rates, and recent autoscaler decisions — from the pushed metrics files
+    plus the decision journal (the ``htop`` of the framework).
+
+    ``--watch S`` refreshes every S seconds until interrupted;
+    ``--dir PATH`` overrides the state dir roots.
+    """
+    from ..observability import catalog as C
+    from ..observability.export import pushed_jobs
+    from ..observability.journal import DecisionJournal
+    from ..observability.slo import evaluate
+    from ..utils.prometheus import merge_expositions, parse_exposition
+
+    usage = "usage: tpurun top [--watch S] [--dir PATH]"
+    argv, root = _pop_dir_flag(argv, usage)
+    argv, watch_s = _pop_flag(argv, "--watch", usage)
+    watch = float(watch_s) if watch_s is not None else None
+
+    from pathlib import Path
+
+    metrics_root = Path(root) / "metrics" if root else None
+    journal = DecisionJournal(
+        path=Path(root) / "scaler.jsonl" if root else None
+    )
+
+    def render() -> None:
+        jobs = pushed_jobs(metrics_root)
+        if not jobs:
+            print("no pushed metrics yet (run an app or bench first)")
+        merged = parse_exposition(merge_expositions(jobs))
+
+        def fmt_q(name):
+            q = merged.histogram_quantiles(
+                name, quantiles=(0.5, 0.95), aggregate={}
+            )
+            if q is None:
+                return "     -/-    "
+            return f"{q['p50'] * 1000:>6.1f}/{q['p95'] * 1000:<6.1f}"
+
+        print(f"jobs: {len(jobs)} ({', '.join(sorted(jobs)) or 'none'})")
+        print(
+            f"tokens/s {merged.total(C.TOKENS_PER_SECOND):>8.1f}   "
+            f"active slots {merged.total(C.ACTIVE_SLOTS):>4.0f}   "
+            f"waiting {merged.total(C.WAITING_REQUESTS):>4.0f}   "
+            # a 0..1 fraction must never sum across jobs: show the worst
+            f"kv occupancy {merged.peak(C.KV_PAGE_OCCUPANCY):>5.2f}"
+        )
+        print(
+            f"ttft p50/p95 ms {fmt_q(C.TTFT_SECONDS)}   "
+            f"tpot p50/p95 ms {fmt_q(C.TPOT_SECONDS)}"
+        )
+        print()
+        print(f"{'SLO':<22} {'TARGET':>10} {'OBSERVED':>10} {'BURN':>6}  OK")
+        for r in evaluate(merged, burn_rate_registry=merged):
+            obs = "-" if r["observed"] is None else f"{r['observed']:.4f}"
+            burn = "-" if r["burn_rate"] is None else f"{r['burn_rate']:.2f}"
+            print(
+                f"{r['name']:<22} {r['target']:>10.4f} {obs:>10} {burn:>6}  "
+                f"{'ok' if r['ok'] else 'VIOLATING'}"
+            )
+        recs = journal.tail(5)
+        if recs:
+            print()
+            print("recent autoscaler decisions:")
+            for r in recs:
+                print(
+                    f"  {r.get('function', '?')}: {r.get('action')} "
+                    f"({r.get('trigger')}) queue={r.get('queue_depth')} "
+                    f"pool {r.get('containers_before')}->"
+                    f"{r.get('containers_after')}"
+                )
+
+    if watch is None:
+        render()
+        return 0
+    import time as _time
+
+    try:
+        while True:
+            print("\033[2J\033[H", end="")
+            render()
+            _time.sleep(watch)
+    except KeyboardInterrupt:
+        pass
+    return 0
+
+
 def cmd_app(argv: list[str]) -> int:
     if argv and argv[0] == "list":
         reg = _config.state_dir() / "apps.json"
@@ -432,6 +610,8 @@ COMMANDS = {
     "snapshot": cmd_snapshot,
     "trace": cmd_trace,
     "metrics": cmd_metrics,
+    "scaler": cmd_scaler,
+    "top": cmd_top,
     "examples": cmd_examples,
     "docs": cmd_docs,
 }

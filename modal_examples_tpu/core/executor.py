@@ -47,6 +47,7 @@ from multiprocessing.connection import Client, Listener
 from pathlib import Path
 
 from .._internal import config as _config
+from ..observability import journal as _journal
 from ..observability import metrics as _obs
 from ..observability import trace as _tr
 from ..utils.log import get_logger
@@ -54,6 +55,23 @@ from . import serialization as ser
 from .retries import Retries
 
 _log = get_logger("executor")
+
+#: host-RSS sampling throttle (process-wide; every pool's tick shares it)
+_RSS_SAMPLE_EVERY_S = 2.0
+_rss_wall = 0.0
+_rss_lock = threading.Lock()
+
+
+def _maybe_sample_rss() -> None:
+    """Sample the supervisor process's RSS into ``mtpu_host_rss_bytes``,
+    throttled — scheduler ticks run at 20 Hz per pool."""
+    global _rss_wall
+    now = time.monotonic()
+    with _rss_lock:
+        if now - _rss_wall < _RSS_SAMPLE_EVERY_S:
+            return
+        _rss_wall = now
+    _obs.sample_host_rss()
 
 
 import contextvars
@@ -533,6 +551,7 @@ class _Container:
         self.boot_info: dict = {}
         self._boot_span_pending = True
         self.retired = False  # single-use containers retire after one dispatch
+        self.reaped = False  # autoscaler issued (and journaled) a scale-down
         self.boot_error: BaseException | None = None
         self.active: dict[str, _QueuedInput] = {}
         self.lock = threading.Lock()
@@ -1024,6 +1043,33 @@ class FunctionPool:
         self._enforce_timeouts(now)
         self._dispatch_ready(now)
         self._autoscale(now)
+        _maybe_sample_rss()
+
+    def _journal_decision(
+        self, action: str, trigger: str, *, containers_before: int,
+        containers_after: int, **extra,
+    ) -> None:
+        """One autoscaler decision into the journal + the decisions counter
+        (never raises; runs inside the scheduler tick)."""
+        try:
+            with self.lock:
+                queue_depth = len(self.pending)
+                inflight = self._inflight_n
+            _journal.default_journal.record(
+                _journal.make_record(
+                    function=self.spec.tag,
+                    action=action,
+                    trigger=trigger,
+                    queue_depth=queue_depth,
+                    inflight=inflight,
+                    containers_before=containers_before,
+                    containers_after=containers_after,
+                    **extra,
+                )
+            )
+            _obs.record_scaler_decision(self.spec.tag, action)
+        except Exception:
+            _log.warning("journal write failed", exc_info=True)
 
     def _enforce_timeouts(self, now: float) -> None:
         for c in list(self.containers):
@@ -1040,6 +1086,21 @@ class FunctionPool:
                 # kill only on the tick that initiates it.
                 if c.kill_reason is None:
                     _obs.record_container_kill(self.spec.tag, "timeout")
+                    # exclude containers already doomed (kill/reap is
+                    # async; dead lands later), so two same-tick kills
+                    # journal 3->2 then 2->1, not twice 3->2
+                    n_live = len([
+                        x for x in self.containers
+                        if not x.dead and x.kill_reason is None
+                        and not x.reaped
+                    ])
+                    self._journal_decision(
+                        "kill", "timeout",
+                        containers_before=n_live,
+                        containers_after=n_live - 1,
+                        container=c.idx,
+                        expired_inputs=len(expired),
+                    )
                 c.kill_reason = "timeout"
                 c.kill()
 
@@ -1147,10 +1208,19 @@ class FunctionPool:
             )
         if want > 0 and self._snapshot_pending_first_capture():
             want = min(want, max(0, 1 - len(live)))
-        for _ in range(max(0, want)):
-            self._spawn_container()
+        if want > 0:
+            for _ in range(want):
+                self._spawn_container()
+            self._journal_decision(
+                "scale_up", "queue_pressure",
+                containers_before=len(live),
+                containers_after=len(live) + want,
+                free_slots=free_slots,
+                spawned=want,
+            )
         # keep min_containers warm (snapshot gate: warm one first, the rest
         # boot as restores once the capture lands)
+        warm_spawned = 0
         while len([c for c in self.containers if not c.dead]) < self.spec.min_containers:
             if (
                 self._snapshot_pending_first_capture()
@@ -1158,6 +1228,15 @@ class FunctionPool:
             ):
                 break
             self._spawn_container()
+            warm_spawned += 1
+        if warm_spawned:
+            n_live = len([c for c in self.containers if not c.dead])
+            self._journal_decision(
+                "scale_up", "min_containers",
+                containers_before=n_live - warm_spawned,
+                containers_after=n_live,
+                spawned=warm_spawned,
+            )
         # scale down
         idle_cut = now - self.spec.scaledown_window
         for c in list(self.containers):
@@ -1166,8 +1245,29 @@ class FunctionPool:
             with c.lock:
                 idle = not c.active and c.last_active < idle_cut
                 spent = c.retired and not c.active and c.inputs_served > 0
-            live_n = len([x for x in self.containers if not x.dead])
+                idle_age = now - c.last_active
+            # count only containers not already doomed: shutdown is async
+            # (dead lands when the reader sees EOF), so an already-reaped
+            # container must neither satisfy min_containers nor inflate the
+            # journaled pool trajectory when several reap in one tick
+            live_n = len([
+                x for x in self.containers
+                if not x.dead and not x.reaped and x.kill_reason is None
+            ])
             if (idle or spent) and (spent or live_n > self.spec.min_containers):
+                if not c.reaped:
+                    # journal once per container: later ticks re-send the
+                    # graceful shutdown but record no new decision
+                    c.reaped = True
+                    self._journal_decision(
+                        "scale_down",
+                        "single_use_spent" if spent else "idle",
+                        containers_before=live_n,
+                        containers_after=live_n - 1,
+                        container=c.idx,
+                        idle_ages=[idle_age],
+                        scaledown_window_s=self.spec.scaledown_window,
+                    )
                 c.shutdown(graceful=True)
 
     def _spawn_container(self) -> None:

@@ -24,6 +24,8 @@ import functools
 import jax
 import jax.numpy as jnp
 
+from ..parallel.mesh import shard_map_compat
+
 
 @dataclasses.dataclass(frozen=True)
 class MoEConfig:
@@ -268,7 +270,7 @@ def moe_mlp_ep(
         out = jnp.einsum("tec,ecd->td", combine, expert_out)
         return out, aux[None]  # rank-1 so shards concatenate over the axis
 
-    out, aux = jax.shard_map(
+    out, aux = shard_map_compat(
         shard_fn,
         mesh=mesh,
         in_specs=(P(), P(axis), P(axis), P(axis)),

@@ -10,7 +10,12 @@ jax-free):
 - :mod:`.metrics` — recorder functions the executor/engine call to emit
   catalog series into the prometheus registry;
 - :mod:`.export`  — file-backed push gateway for ephemeral processes
-  (``tpurun metrics`` merges the pushed expositions).
+  (``tpurun metrics`` merges the pushed expositions) + the Perfetto /
+  chrome://tracing converter (``tpurun trace <id> --perfetto``);
+- :mod:`.journal` — the autoscaler decision journal (``tpurun scaler``,
+  gateway ``/autoscaler``);
+- :mod:`.slo`     — declared latency/error targets evaluated against the
+  live histograms (gateway ``/healthz``, ``tpurun top``).
 
 User code inside a remote function can nest its own spans::
 
@@ -26,23 +31,37 @@ from __future__ import annotations
 
 from . import catalog
 from .export import (
+    export_chrome_trace,
     live_and_pushed_metrics,
     push_metrics_file,
     pushed_jobs,
     read_pushed_metrics,
+    spans_to_chrome_trace,
 )
+from .journal import DecisionJournal, default_journal
 from .metrics import (
     record_container_kill,
     record_engine_batch,
     record_engine_phase,
     record_engine_queue_wait,
     record_phase,
+    record_prefix_evictions,
     record_queue_wait,
     record_retry,
+    record_scaler_decision,
     record_scheduler_error,
+    record_snapshot_store_get,
+    record_token_totals,
+    record_tpot,
+    record_ttft,
+    sample_host_rss,
     set_engine_gauges,
     set_inflight,
+    set_kv_occupancy,
+    set_prefix_cache_pages,
+    set_snapshot_store_size,
 )
+from .slo import DEFAULT_SLOS, SLO, evaluate as evaluate_slos, healthz
 from .trace import (
     Span,
     TraceContext,
@@ -56,13 +75,20 @@ from .trace import (
 )
 
 __all__ = [
+    "DEFAULT_SLOS",
+    "DecisionJournal",
+    "SLO",
     "Span",
     "TraceContext",
     "TraceStore",
     "catalog",
     "current_context",
     "current_trace_id",
+    "default_journal",
     "default_store",
+    "evaluate_slos",
+    "export_chrome_trace",
+    "healthz",
     "live_and_pushed_metrics",
     "push_metrics_file",
     "pushed_jobs",
@@ -72,12 +98,23 @@ __all__ = [
     "record_engine_phase",
     "record_engine_queue_wait",
     "record_phase",
+    "record_prefix_evictions",
     "record_queue_wait",
     "record_retry",
+    "record_scaler_decision",
     "record_scheduler_error",
+    "record_snapshot_store_get",
+    "record_token_totals",
+    "record_tpot",
+    "record_ttft",
+    "sample_host_rss",
     "set_context",
     "set_engine_gauges",
     "set_inflight",
+    "set_kv_occupancy",
+    "set_prefix_cache_pages",
+    "set_snapshot_store_size",
     "span",
+    "spans_to_chrome_trace",
     "tracing_enabled",
 ]

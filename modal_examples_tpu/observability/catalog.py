@@ -55,6 +55,42 @@ TOKENS_PER_SECOND = "mtpu_tokens_per_second"
 #: counter: scheduler-loop exceptions (engine.error_count mirror)
 SCHEDULER_ERRORS_TOTAL = "mtpu_scheduler_errors_total"
 
+# -- token-level serving telemetry (serving/engine.py) ----------------------
+
+#: histogram: request submit -> first generated token emitted (TTFT)
+TTFT_SECONDS = "mtpu_ttft_seconds"
+#: histogram: inter-token interval between consecutive generated tokens
+#: of one request (TPOT / time-per-output-token)
+TPOT_SECONDS = "mtpu_tpot_seconds"
+
+# -- resource occupancy (kv cache / prefix cache / snapshot store / host) ---
+
+#: gauge: pages currently allocated out of the paged KV cache
+KV_PAGES_USED = "mtpu_kv_pages_used"
+#: gauge: allocated fraction of the usable KV page pool (0..1)
+KV_PAGE_OCCUPANCY = "mtpu_kv_page_occupancy"
+#: counter: zero-ref prefix-cache pages reclaimed under allocator pressure
+PREFIX_CACHE_EVICTIONS_TOTAL = "mtpu_prefix_cache_evictions_total"
+#: gauge: total payload bytes resident in the memory-snapshot store
+SNAPSHOT_STORE_BYTES = "mtpu_snapshot_store_bytes"
+#: gauge: entries resident in the memory-snapshot store
+SNAPSHOT_STORE_ENTRIES = "mtpu_snapshot_store_entries"
+#: counter {result}: snapshot-store lookups (result = hit | miss)
+SNAPSHOT_STORE_GETS_TOTAL = "mtpu_snapshot_store_gets_total"
+#: gauge: supervisor-process resident set size, sampled by the executor
+HOST_RSS_BYTES = "mtpu_host_rss_bytes"
+
+# -- autoscaler decision journal (core/executor.py _autoscale) --------------
+
+#: counter {function, action}: autoscaler decisions recorded to the journal;
+#: action = scale_up | scale_down | kill
+SCALER_DECISIONS_TOTAL = "mtpu_scaler_decisions_total"
+
+# -- SLO engine (observability/slo.py) --------------------------------------
+
+#: gauge {slo}: observed/target burn rate per declared SLO (>1 = violating)
+SLO_BURN_RATE = "mtpu_slo_burn_rate"
+
 # -- OpenAI-compatible server /metrics (serving/openai_api.py) --------------
 
 GENERATED_TOKENS_TOTAL = "mtpu_generated_tokens_total"
@@ -146,6 +182,53 @@ CATALOG: dict[str, dict] = {
         "labels": [],
         "help": "engine scheduler-loop exceptions",
     },
+    TTFT_SECONDS: {
+        "type": "histogram",
+        "labels": [],
+        "help": "request submit to first generated token (TTFT)",
+    },
+    TPOT_SECONDS: {
+        "type": "histogram",
+        "labels": [],
+        "help": "inter-token interval between generated tokens (TPOT)",
+    },
+    KV_PAGES_USED: {
+        "type": "gauge", "labels": [],
+        "help": "pages currently allocated out of the paged KV cache",
+    },
+    KV_PAGE_OCCUPANCY: {
+        "type": "gauge", "labels": [],
+        "help": "allocated fraction of the usable KV page pool (0..1)",
+    },
+    PREFIX_CACHE_EVICTIONS_TOTAL: {
+        "type": "counter", "labels": [],
+        "help": "zero-ref prefix-cache pages reclaimed under pressure",
+    },
+    SNAPSHOT_STORE_BYTES: {
+        "type": "gauge", "labels": [],
+        "help": "total payload bytes resident in the snapshot store",
+    },
+    SNAPSHOT_STORE_ENTRIES: {
+        "type": "gauge", "labels": [],
+        "help": "entries resident in the snapshot store",
+    },
+    SNAPSHOT_STORE_GETS_TOTAL: {
+        "type": "counter", "labels": ["result"],
+        "help": "snapshot-store lookups (result=hit|miss)",
+    },
+    HOST_RSS_BYTES: {
+        "type": "gauge", "labels": [],
+        "help": "supervisor-process resident set size (bytes)",
+    },
+    SCALER_DECISIONS_TOTAL: {
+        "type": "counter", "labels": ["function", "action"],
+        "help": "autoscaler decisions journaled "
+                "(action=scale_up|scale_down|kill)",
+    },
+    SLO_BURN_RATE: {
+        "type": "gauge", "labels": ["slo"],
+        "help": "observed/target burn rate per declared SLO (>1 violating)",
+    },
     GENERATED_TOKENS_TOTAL: {
         "type": "counter", "labels": [],
         "help": "tokens generated by the engine",
@@ -197,3 +280,10 @@ ALL_METRIC_NAMES = frozenset(CATALOG)
 
 #: buckets for batch-size-style histograms (counts, not seconds)
 COUNT_BUCKETS = (1, 2, 4, 8, 16, 32, 64, 128)
+
+#: buckets for token-level latency (TTFT/TPOT): finer sub-ms resolution at
+#: the low end than the boot-scale default buckets, topping out at 30 s
+TOKEN_TIME_BUCKETS = (
+    0.0005, 0.001, 0.0025, 0.005, 0.01, 0.025, 0.05, 0.1, 0.25, 0.5,
+    1.0, 2.5, 5.0, 10.0, 30.0,
+)

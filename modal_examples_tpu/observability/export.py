@@ -108,3 +108,99 @@ def _gc(d: Path) -> None:
                 p.unlink()
         except OSError:
             pass
+
+
+# --------------------------------------------------------------------------
+# Perfetto / chrome://tracing export
+# --------------------------------------------------------------------------
+
+#: span names recorded by the container worker process (everything nested
+#: under them — user spans — is container-side too)
+_CONTAINER_SPAN_NAMES = ("execute", "serialize")
+
+
+def spans_to_chrome_trace(spans: list[dict], trace_id: str = "") -> dict:
+    """Convert one call's JSONL spans to Chrome-trace / Perfetto JSON.
+
+    Output is the Trace Event Format object (``{"traceEvents": [...]}``)
+    that loads directly in ``chrome://tracing`` and ui.perfetto.dev. Two
+    tracks: supervisor-side phases (queue/boot/dispatch/retry) on tid 1,
+    container-worker phases (execute/serialize + user spans) on tid 2 —
+    complete ("X") events nest by timestamp within a track, instantaneous
+    spans (retry markers) become instant ("i") events. Timestamps are
+    microseconds relative to the earliest span.
+    """
+    if not spans:
+        return {"traceEvents": [], "displayTimeUnit": "ms"}
+    by_id = {s.get("span_id"): s for s in spans}
+
+    def is_container_side(span: dict) -> bool:
+        seen = set()
+        cur: dict | None = span
+        while cur is not None and id(cur) not in seen:
+            seen.add(id(cur))
+            if cur.get("name") in _CONTAINER_SPAN_NAMES:
+                return True
+            cur = by_id.get(cur.get("parent_id"))
+        return False
+
+    t0 = min(s.get("start") or 0.0 for s in spans)
+    events: list[dict] = [
+        {"ph": "M", "pid": 1, "tid": 0, "name": "process_name",
+         "args": {"name": f"mtpu call {trace_id}".strip()}},
+        {"ph": "M", "pid": 1, "tid": 1, "name": "thread_name",
+         "args": {"name": "supervisor"}},
+        {"ph": "M", "pid": 1, "tid": 2, "name": "thread_name",
+         "args": {"name": "container"}},
+    ]
+    for s in sorted(spans, key=lambda s: s.get("start") or 0.0):
+        start = s.get("start") or t0
+        end = s.get("end")
+        tid = 2 if is_container_side(s) else 1
+        args = dict(s.get("attrs") or {})
+        args["span_id"] = s.get("span_id")
+        if s.get("status") and s["status"] != "ok":
+            args["status"] = s["status"]
+        ev = {
+            "name": s.get("name", "?"),
+            "cat": "mtpu",
+            "pid": 1,
+            "tid": tid,
+            "ts": round((start - t0) * 1e6, 3),
+            "args": args,
+        }
+        dur_us = round(((end if end is not None else start) - start) * 1e6, 3)
+        if dur_us <= 0:
+            ev["ph"] = "i"
+            ev["s"] = "t"  # thread-scoped instant
+        else:
+            ev["ph"] = "X"
+            ev["dur"] = dur_us
+        events.append(ev)
+    return {
+        "traceEvents": events,
+        "displayTimeUnit": "ms",
+        "otherData": {"trace_id": trace_id, "epoch_start_s": t0},
+    }
+
+
+def export_chrome_trace(
+    trace_id: str,
+    out_path: str | Path | None = None,
+    *,
+    store=None,
+) -> dict | None:
+    """Read one trace from the (default) TraceStore and convert it; when
+    ``out_path`` is given the JSON is also written there. Returns the trace
+    dict, or None when no such trace exists."""
+    import json
+
+    if store is None:
+        from .trace import default_store as store  # noqa: F811
+    spans = store.read(trace_id)
+    if not spans:
+        return None
+    doc = spans_to_chrome_trace(spans, trace_id)
+    if out_path is not None:
+        Path(out_path).write_text(json.dumps(doc, indent=1))
+    return doc

@@ -1,0 +1,152 @@
+"""Autoscaler decision journal: every scale-up / scale-down / kill the
+executor's ``_autoscale`` loop takes, with its rationale.
+
+The autoscaler used to be a black box: a pool would boot three containers or
+reap a warm one and the only evidence was the container count moving. Every
+decision now appends a structured record — trigger, queue depth, inflight
+count, idle ages, pool size before/after — to a bounded in-memory ring
+buffer AND a JSONL file under ``<state_dir>/scaler.jsonl``, so both a live
+gateway (``GET /autoscaler``) and a later CLI process (``tpurun scaler``)
+can answer "why did the pool scale?".
+
+Records are plain dicts (one JSON object per line, same greppable shape as
+trace files). The file is bounded: when it grows past ``_MAX_FILE_RECORDS``
+lines it is atomically rewritten keeping the newest half.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+import time
+from collections import deque
+from pathlib import Path
+
+from .._internal import config as _config
+
+#: in-memory ring-buffer capacity (per journal instance)
+RING_CAPACITY = 512
+#: JSONL file bound: rewrite keeping the newest half past this many lines
+_MAX_FILE_RECORDS = 4096
+
+
+def make_record(
+    *,
+    function: str,
+    action: str,
+    trigger: str,
+    queue_depth: int = 0,
+    inflight: int = 0,
+    free_slots: int = 0,
+    containers_before: int = 0,
+    containers_after: int = 0,
+    idle_ages: list[float] | None = None,
+    **extra,
+) -> dict:
+    """One journal record. ``action`` is what the autoscaler did
+    (``scale_up`` | ``scale_down`` | ``kill``), ``trigger`` why
+    (``queue_pressure`` | ``min_containers`` | ``idle`` | ``single_use_spent``
+    | ``timeout``)."""
+    rec = {
+        "at": time.time(),
+        "function": function,
+        "action": action,
+        "trigger": trigger,
+        "queue_depth": queue_depth,
+        "inflight": inflight,
+        "free_slots": free_slots,
+        "containers_before": containers_before,
+        "containers_after": containers_after,
+    }
+    if idle_ages:
+        rec["idle_ages_s"] = [round(a, 3) for a in idle_ages]
+    rec.update(extra)
+    return rec
+
+
+class DecisionJournal:
+    """Ring buffer + JSONL sink for autoscaler decisions."""
+
+    def __init__(self, path: str | Path | None = None):
+        self._path = Path(path) if path else None
+        self._resolved: Path | None = None
+        self._ring: deque[dict] = deque(maxlen=RING_CAPACITY)
+        self._lock = threading.Lock()
+        self._appended = 0
+
+    @property
+    def path(self) -> Path:
+        if self._resolved is None:
+            p = self._path or (_config.state_dir() / "scaler.jsonl")
+            p.parent.mkdir(parents=True, exist_ok=True)
+            self._resolved = p
+        return self._resolved
+
+    def record(self, rec: dict) -> None:
+        """Append one record (never raises — the journal runs inside the
+        scheduler tick)."""
+        line = json.dumps(rec)
+        with self._lock:
+            self._ring.append(rec)
+            try:
+                with open(self.path, "a") as f:
+                    f.write(line + "\n")
+                self._appended += 1
+                if self._appended >= 256:
+                    self._appended = 0
+                    self._compact_locked()
+            except OSError:
+                pass
+
+    def _compact_locked(self) -> None:
+        """Bound the JSONL file: keep the newest half once past the cap."""
+        try:
+            lines = self.path.read_text().splitlines()
+        except OSError:
+            return
+        if len(lines) <= _MAX_FILE_RECORDS:
+            return
+        keep = lines[-_MAX_FILE_RECORDS // 2 :]
+        tmp = self.path.with_suffix(f".tmp.{os.getpid()}")
+        try:
+            tmp.write_text("\n".join(keep) + "\n")
+            os.replace(tmp, self.path)
+        except OSError:
+            pass
+
+    def tail(
+        self, n: int = 50, *, function: str | None = None
+    ) -> list[dict]:
+        """Newest-last slice of the journal. The JSONL file is the superset
+        (every record lands in both ring and file), so it is the primary
+        source — the 512-entry ring alone would silently drop a function's
+        older decisions once busier pools evict them. The ring covers the
+        case where file writes are failing (read-only state dir)."""
+        recs = self._read_file()
+        with self._lock:
+            ring = list(self._ring)
+        if len(recs) < len(ring):
+            recs = ring  # file writes failing: the ring is all we have
+        if function is not None:
+            recs = [r for r in recs if r.get("function") == function]
+        return recs[-n:]
+
+    def _read_file(self) -> list[dict]:
+        try:
+            text = self.path.read_text()
+        except OSError:
+            return []
+        out = []
+        for line in text.splitlines():
+            if not line.strip():
+                continue
+            try:
+                out.append(json.loads(line))
+            except json.JSONDecodeError:
+                continue
+        return out
+
+
+#: process-wide default journal (state-dir backed)
+default_journal = DecisionJournal()

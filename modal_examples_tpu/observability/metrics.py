@@ -137,3 +137,148 @@ def record_scheduler_error(*, registry: Registry | None = None) -> None:
         1.0,
         help=C.CATALOG[C.SCHEDULER_ERRORS_TOTAL]["help"],
     )
+
+
+# -- token-level serving telemetry ------------------------------------------
+
+
+def record_ttft(seconds: float, *, registry: Registry | None = None) -> None:
+    _reg(registry).histogram_observe(
+        C.TTFT_SECONDS,
+        seconds,
+        buckets=C.TOKEN_TIME_BUCKETS,
+        help=C.CATALOG[C.TTFT_SECONDS]["help"],
+    )
+
+
+def record_tpot(seconds: float, *, registry: Registry | None = None) -> None:
+    _reg(registry).histogram_observe(
+        C.TPOT_SECONDS,
+        seconds,
+        buckets=C.TOKEN_TIME_BUCKETS,
+        help=C.CATALOG[C.TPOT_SECONDS]["help"],
+    )
+
+
+def record_token_totals(
+    *, prompt: int = 0, generated: int = 0, steps: int = 0,
+    registry: Registry | None = None,
+) -> None:
+    """Increment the prefill-vs-decode token counters (deltas, not totals —
+    the engine accumulates and flushes from its gauge-refresh throttle)."""
+    reg = _reg(registry)
+    if prompt:
+        reg.counter_inc(
+            C.PROMPT_TOKENS_TOTAL, float(prompt),
+            help=C.CATALOG[C.PROMPT_TOKENS_TOTAL]["help"],
+        )
+    if generated:
+        reg.counter_inc(
+            C.GENERATED_TOKENS_TOTAL, float(generated),
+            help=C.CATALOG[C.GENERATED_TOKENS_TOTAL]["help"],
+        )
+    if steps:
+        reg.counter_inc(
+            C.DECODE_STEPS_TOTAL, float(steps),
+            help=C.CATALOG[C.DECODE_STEPS_TOTAL]["help"],
+        )
+
+
+# -- resource occupancy ------------------------------------------------------
+
+
+def set_kv_occupancy(
+    *, used: int, free: int, total_usable: int,
+    registry: Registry | None = None,
+) -> None:
+    """KV page-allocator occupancy (``total_usable`` excludes the reserved
+    trash page). Emitted by the allocator on alloc/free — per-request, not
+    per-token, frequency."""
+    reg = _reg(registry)
+    reg.gauge_set(
+        C.KV_PAGES_USED, float(used),
+        help=C.CATALOG[C.KV_PAGES_USED]["help"],
+    )
+    reg.gauge_set(
+        C.KV_PAGES_FREE, float(free),
+        help=C.CATALOG[C.KV_PAGES_FREE]["help"],
+    )
+    reg.gauge_set(
+        C.KV_PAGE_OCCUPANCY,
+        used / total_usable if total_usable else 0.0,
+        help=C.CATALOG[C.KV_PAGE_OCCUPANCY]["help"],
+    )
+
+
+def set_prefix_cache_pages(
+    cached_pages: int, *, registry: Registry | None = None
+) -> None:
+    _reg(registry).gauge_set(
+        C.PREFIX_CACHED_PAGES, float(cached_pages),
+        help=C.CATALOG[C.PREFIX_CACHED_PAGES]["help"],
+    )
+
+
+def record_prefix_evictions(
+    n: int, *, registry: Registry | None = None
+) -> None:
+    if n > 0:
+        _reg(registry).counter_inc(
+            C.PREFIX_CACHE_EVICTIONS_TOTAL, float(n),
+            help=C.CATALOG[C.PREFIX_CACHE_EVICTIONS_TOTAL]["help"],
+        )
+
+
+def set_snapshot_store_size(
+    *, entries: int, total_bytes: int, registry: Registry | None = None
+) -> None:
+    reg = _reg(registry)
+    reg.gauge_set(
+        C.SNAPSHOT_STORE_ENTRIES, float(entries),
+        help=C.CATALOG[C.SNAPSHOT_STORE_ENTRIES]["help"],
+    )
+    reg.gauge_set(
+        C.SNAPSHOT_STORE_BYTES, float(total_bytes),
+        help=C.CATALOG[C.SNAPSHOT_STORE_BYTES]["help"],
+    )
+
+
+def record_snapshot_store_get(
+    result: str, *, registry: Registry | None = None
+) -> None:
+    _reg(registry).counter_inc(
+        C.SNAPSHOT_STORE_GETS_TOTAL, 1.0,
+        labels={"result": result},
+        help=C.CATALOG[C.SNAPSHOT_STORE_GETS_TOTAL]["help"],
+    )
+
+
+def sample_host_rss(*, registry: Registry | None = None) -> float | None:
+    """Current process RSS in bytes into the gauge (Linux: /proc/self/statm;
+    silently a no-op elsewhere). Returns the sampled value."""
+    import os as _os
+
+    try:
+        with open("/proc/self/statm") as f:
+            rss_pages = int(f.read().split()[1])
+        rss = rss_pages * _os.sysconf("SC_PAGE_SIZE")
+    except (OSError, ValueError, IndexError):
+        return None
+    _reg(registry).gauge_set(
+        C.HOST_RSS_BYTES, float(rss),
+        help=C.CATALOG[C.HOST_RSS_BYTES]["help"],
+    )
+    return float(rss)
+
+
+# -- autoscaler --------------------------------------------------------------
+
+
+def record_scaler_decision(
+    function: str, action: str, *, registry: Registry | None = None
+) -> None:
+    _reg(registry).counter_inc(
+        C.SCALER_DECISIONS_TOTAL, 1.0,
+        labels={"function": function, "action": action},
+        help=C.CATALOG[C.SCALER_DECISIONS_TOTAL]["help"],
+    )

@@ -36,6 +36,20 @@ from .._internal import config as _config
 _TRACE_RETENTION_S = 7 * 86400
 
 
+def _env_int(name: str, default: int) -> int:
+    try:
+        return int(os.environ.get(name, default))
+    except ValueError:
+        return default
+
+
+#: hard bounds on the traces directory — age alone is not enough on a
+#: long-running gateway (a week of traffic is unbounded files); LRU-deleted
+#: oldest-first past either cap
+_MAX_TRACE_FILES = _env_int("MTPU_TRACE_MAX_FILES", 2000)
+_MAX_TRACE_BYTES = _env_int("MTPU_TRACE_MAX_BYTES", 256 * 1024 * 1024)
+
+
 def tracing_enabled() -> bool:
     return os.environ.get("MTPU_TRACE", "1") not in ("0", "false", "off")
 
@@ -162,13 +176,32 @@ class TraceStore:
         threading.Thread(target=self._gc_sweep, daemon=True).start()
 
     def _gc_sweep(self) -> None:
+        """Age out old traces, then enforce the count/byte caps LRU-first
+        (oldest mtime deleted first) so a long-running gateway's traces
+        directory stays bounded no matter the traffic rate."""
         cutoff = time.time() - _TRACE_RETENTION_S
+        survivors: list[tuple[float, int, Path]] = []  # (mtime, size, path)
         for p in self.root.glob("*.jsonl"):
             try:
-                if p.stat().st_mtime < cutoff:
+                st = p.stat()
+                if st.st_mtime < cutoff:
                     p.unlink()
+                else:
+                    survivors.append((st.st_mtime, st.st_size, p))
             except OSError:
                 pass
+        survivors.sort()  # oldest first
+        total = sum(size for _, size, _ in survivors)
+        excess = len(survivors) - _MAX_TRACE_FILES
+        for mtime, size, p in survivors:
+            if excess <= 0 and total <= _MAX_TRACE_BYTES:
+                break
+            try:
+                p.unlink()
+            except OSError:
+                continue
+            excess -= 1
+            total -= size
 
 
 #: process-wide default store (state-dir backed)

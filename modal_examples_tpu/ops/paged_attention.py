@@ -47,6 +47,25 @@ import jax.numpy as jnp
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
+# jax renamed TPUCompilerParams -> CompilerParams across releases; accept
+# whichever this jax ships so the ragged kernels work on both
+_CompilerParamsCls = getattr(
+    pltpu, "CompilerParams", getattr(pltpu, "TPUCompilerParams", None)
+)
+
+
+def _CompilerParams(**kw):
+    if _CompilerParamsCls is None:
+        # lazy so a further-renamed class breaks the kernel call with an
+        # actionable message, not package import (the XLA decode path
+        # doesn't need pallas at all)
+        raise RuntimeError(
+            "this jax exposes neither pltpu.CompilerParams nor "
+            "pltpu.TPUCompilerParams; the pallas paged-attention kernels "
+            "cannot compile — use the XLA impls (MTPU_PAGED_IMPL=xla)"
+        )
+    return _CompilerParamsCls(**kw)
+
 
 def _decode_kernel(
     # scalar prefetch
@@ -741,7 +760,7 @@ def paged_decode_attention_ragged(
         kernel,
         grid_spec=grid_spec,
         out_shape=jax.ShapeDtypeStruct((B, Hq, D), q.dtype),
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=_CompilerParams(
             dimension_semantics=("arbitrary",),
         ),
         cost_estimate=pl.CostEstimate(
@@ -888,7 +907,7 @@ def scatter_kv_pages(
         # +2 for the two scalar-prefetch operands: alias the page arrays
         # through so the update is in place
         input_output_aliases={4: 0, 5: 1},
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=_CompilerParams(
             dimension_semantics=("arbitrary",),
         ),
         interpret=interpret,
@@ -982,7 +1001,7 @@ def paged_decode_attention(
         kernel,
         grid_spec=grid_spec,
         out_shape=jax.ShapeDtypeStruct((B, Hq, D), q.dtype),
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=_CompilerParams(
             # each sequence reads shared pages but writes a distinct output
             # block: the grid is safely parallel
             dimension_semantics=("parallel",),

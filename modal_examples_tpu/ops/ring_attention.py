@@ -31,6 +31,9 @@ import jax.numpy as jnp
 from jax import lax
 
 from .flash_attention import flash_attention_with_lse
+from ..parallel.collectives import axis_size as _axis_size
+from ..parallel.mesh import shard_map_compat
+
 
 
 def _merge(o1, lse1, o2, lse2):
@@ -60,7 +63,7 @@ def ring_attention(
     sm_scale: float | None = None,
 ) -> jax.Array:
     """Call INSIDE shard_map with the sequence dim sharded over ``axis_name``."""
-    n = lax.axis_size(axis_name)
+    n = _axis_size(axis_name)
     my_idx = lax.axis_index(axis_name)
     if sm_scale is None:
         sm_scale = q.shape[-1] ** -0.5
@@ -120,7 +123,7 @@ def ring_attention_sharded(
     fn = functools.partial(
         ring_attention, axis_name=seq_axis, causal=causal, sm_scale=sm_scale
     )
-    return jax.shard_map(
+    return shard_map_compat(
         fn, mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec,
         check_vma=False,
     )(q, k, v)
@@ -143,7 +146,7 @@ def ulysses_attention(
     the ring variant is usually preferred, but both are exact — pick by
     profile. Call inside shard_map with the seq dim sharded over
     ``axis_name``."""
-    n = lax.axis_size(axis_name)
+    n = _axis_size(axis_name)
     B, H, S_loc, D = q.shape
     if H % n:
         raise ValueError(f"heads {H} must be divisible by seq shards {n}")
@@ -180,7 +183,7 @@ def ulysses_attention_sharded(
     fn = functools.partial(
         ulysses_attention, axis_name=seq_axis, causal=causal, sm_scale=sm_scale
     )
-    return jax.shard_map(
+    return shard_map_compat(
         fn, mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec,
         check_vma=False,
     )(q, k, v)

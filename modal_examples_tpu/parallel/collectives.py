@@ -63,7 +63,7 @@ def ppermute(x, axis: AxisName, perm: list[tuple[int, int]]):
 def ring_shift(x, axis: AxisName, shift: int = 1):
     """Rotate shards around the axis ring — the ring-attention building block.
     On a TPU torus this maps to neighbor ICI hops."""
-    n = lax.axis_size(axis)
+    n = axis_size(axis)
     perm = [(i, (i + shift) % n) for i in range(n)]
     return lax.ppermute(x, axis, perm)
 
@@ -74,7 +74,10 @@ def axis_index(axis: AxisName):
 
 
 def axis_size(axis: AxisName) -> int:
-    return lax.axis_size(axis)
+    # jax.lax.axis_size landed after 0.4.x; psum(1) is the portable spelling
+    if hasattr(lax, "axis_size"):
+        return lax.axis_size(axis)
+    return lax.psum(1, axis)
 
 
 def barrier(axis: AxisName):

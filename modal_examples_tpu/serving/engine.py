@@ -55,6 +55,12 @@ class Request:
     created: float = dataclasses.field(default_factory=time.monotonic)
     aborted: bool = False
     finish_reason: str | None = None  # set when the terminal marker arrives
+    # token-level telemetry (monotonic clock): TTFT = first_token_at -
+    # created; inter-token gaps feed the TPOT histogram. n_generated is the
+    # request's own generated-token count (streaming usage reporting).
+    first_token_at: float | None = None
+    last_token_at: float | None = None
+    n_generated: int = 0
     # engine-assigned when params.seed is None: sampling is derived from
     # (auto_seed, position) so outputs never depend on scheduler timing —
     # how many blocks/keys the engine happened to burn before this request.
@@ -385,6 +391,9 @@ class LLMEngine:
         self.strict = _os.environ.get("MTPU_ENGINE_STRICT", "") not in ("", "0")
         self._stopped_on_error = False
         self._metrics_wall = 0.0  # last gauge refresh (throttled in step())
+        # last stats totals flushed into the prometheus token counters
+        # (counters take deltas; EngineStats holds the running totals)
+        self._counter_flush = {"prompt": 0, "generated": 0, "steps": 0}
         self._key = jax.random.PRNGKey(seed)
         self._seed_base = int(seed)
         self._submit_seq = 0  # feeds auto_seed: deterministic per submission
@@ -1105,6 +1114,7 @@ class LLMEngine:
         if self._thread and self._thread is not threading.current_thread():
             self._thread.join(timeout=10)
         self._release_all(_FINISH)
+        self._flush_token_counters()
 
     # -- scheduler loop ------------------------------------------------------
 
@@ -1166,9 +1176,10 @@ class LLMEngine:
         return admitted or decoded
 
     def _refresh_gauges(self) -> None:
-        """Engine-load gauges (queue depth, active slots, tokens/s) into the
-        process registry — throttled so the hot loop never pays more than a
-        few dict writes per second."""
+        """Engine-load gauges (queue depth, active slots, tokens/s), KV/
+        prefix-cache occupancy, and prefill-vs-decode token-counter deltas
+        into the process registry — throttled so the hot loop never pays
+        more than a few dict writes per second."""
         now = time.monotonic()
         if now - self._metrics_wall < 0.25:
             return
@@ -1178,6 +1189,35 @@ class LLMEngine:
             active_slots=sum(1 for s in self.slots if not s.free),
             tokens_per_second=self.stats.tokens_per_second(),
         )
+        # occupancy via the cache helper: covers the native allocator, which
+        # has no gauge hooks of its own (the python allocator's alloc/free
+        # hooks write the same series — idempotent, last-writer-wins)
+        occ = self.cache.occupancy()
+        _obs.set_kv_occupancy(
+            used=occ["pages_used"],
+            free=occ["pages_free"],
+            total_usable=occ["pages_total"],
+        )
+        if self.prefix_cache is not None:
+            _obs.set_prefix_cache_pages(self.prefix_cache.cached_pages)
+        self._flush_token_counters()
+
+    def _flush_token_counters(self) -> None:
+        """Push the stats deltas accumulated since the last flush into the
+        prometheus token counters (also called unthrottled from stop(), so
+        the final sub-throttle window is never lost from a pushed
+        exposition)."""
+        s, last = self.stats, self._counter_flush
+        _obs.record_token_totals(
+            prompt=s.prompt_tokens - last["prompt"],
+            generated=s.generated_tokens - last["generated"],
+            steps=s.steps - last["steps"],
+        )
+        self._counter_flush = {
+            "prompt": s.prompt_tokens,
+            "generated": s.generated_tokens,
+            "steps": s.steps,
+        }
 
     def _admit(self) -> bool:
         """Claim slots+pages for waiting requests, then prefill each bucket's
@@ -1657,6 +1697,18 @@ class LLMEngine:
         slot = self.slots[slot_idx]
         req = slot.request
         self.stats.generated_tokens += 1
+        # token-level latency: TTFT on the request's first token, the
+        # inter-token gap (TPOT) on every later one. Honest wall-clock from
+        # the client's seat: pipelined blocks emit in bursts, and the
+        # histogram shows exactly that.
+        now = time.monotonic()
+        if req.first_token_at is None:
+            req.first_token_at = now
+            _obs.record_ttft(now - req.created)
+        else:
+            _obs.record_tpot(now - req.last_token_at)
+        req.last_token_at = now
+        req.n_generated += 1
         finished = False
         reason = None
         if token == self.tokenizer.eos_id:

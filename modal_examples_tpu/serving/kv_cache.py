@@ -19,35 +19,69 @@ import threading
 
 import jax.numpy as jnp
 
+from ..observability import metrics as _obs
+
 
 class OutOfPages(RuntimeError):
     pass
 
 
 class PageAllocator:
-    """Thread-safe free-list over physical page ids (page 0 is reserved)."""
+    """Thread-safe free-list over physical page ids (page 0 is reserved).
 
-    def __init__(self, n_pages: int):
+    Occupancy telemetry: every alloc/free refreshes the
+    ``mtpu_kv_pages_used`` / ``mtpu_kv_pages_free`` / ``mtpu_kv_page_occupancy``
+    gauges — per-request frequency (admission/release), never per-token, so
+    the decode hot loop pays nothing. Multiple allocators in one process
+    share the gauges last-writer-wins (one serving engine per process is the
+    deployed shape); ``track=False`` opts an auxiliary allocator out.
+    """
+
+    def __init__(self, n_pages: int, *, track: bool = True):
         self.n_pages = n_pages
         self._free = list(range(n_pages - 1, 0, -1))  # pop() yields low ids first
         self._lock = threading.Lock()
+        self._track = track
+
+    def _emit_gauges_locked(self) -> None:
+        if not self._track:
+            return
+        usable = self.n_pages - 1  # page 0 is the reserved trash page
+        free = len(self._free)
+        _obs.set_kv_occupancy(
+            used=usable - free, free=free, total_usable=usable
+        )
 
     def alloc(self, n: int) -> list[int]:
         with self._lock:
             if n > len(self._free):
                 raise OutOfPages(f"need {n} pages, {len(self._free)} free")
-            return [self._free.pop() for _ in range(n)]
+            out = [self._free.pop() for _ in range(n)]
+            self._emit_gauges_locked()
+            return out
 
     def free(self, pages: list[int]) -> None:
         with self._lock:
             for p in pages:
                 if p != 0:
                     self._free.append(p)
+            self._emit_gauges_locked()
 
     @property
     def available(self) -> int:
         with self._lock:
             return len(self._free)
+
+    @property
+    def used(self) -> int:
+        with self._lock:
+            return (self.n_pages - 1) - len(self._free)
+
+    @property
+    def occupancy(self) -> float:
+        """Allocated fraction of the usable pool (0..1)."""
+        usable = self.n_pages - 1
+        return self.used / usable if usable > 0 else 0.0
 
 
 @dataclasses.dataclass
@@ -94,3 +128,20 @@ class PagedKVCache:
 
     def pages_for(self, n_tokens: int) -> int:
         return (n_tokens + self.page_size - 1) // self.page_size
+
+    def occupancy(self) -> dict:
+        """Page-pool occupancy snapshot (works for the native allocator too,
+        which has no gauge hooks of its own): used/free/total pages, the
+        allocated fraction, and the HBM bytes that fraction pins."""
+        usable = self.n_pages - 1
+        free = self.allocator.available
+        used = usable - free
+        bytes_per_page = self.bytes() // self.n_pages
+        return {
+            "pages_used": used,
+            "pages_free": free,
+            "pages_total": usable,
+            "occupancy": used / usable if usable > 0 else 0.0,
+            "bytes_used": used * bytes_per_page,
+            "bytes_total": self.bytes(),
+        }

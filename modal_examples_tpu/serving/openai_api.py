@@ -134,6 +134,7 @@ class _Handler(BaseHTTPRequestHandler):
             # metric names come from the central catalog (no stringly-typed
             # drift; tests/test_static.py enforces this package-wide); series
             # the registry already owns are skipped so names never duplicate
+            occ = eng.cache.occupancy()
             hand_built = [
                 (_C.GENERATED_TOKENS_TOTAL, f"{s.generated_tokens}"),
                 (_C.PROMPT_TOKENS_TOTAL, f"{s.prompt_tokens}"),
@@ -141,7 +142,9 @@ class _Handler(BaseHTTPRequestHandler):
                 (_C.TOKENS_PER_SECOND, f"{s.tokens_per_second():.3f}"),
                 (_C.ACTIVE_SLOTS, f"{active}"),
                 (_C.WAITING_REQUESTS, f"{eng.waiting.qsize()}"),
-                (_C.KV_PAGES_FREE, f"{eng.cache.allocator.available}"),
+                (_C.KV_PAGES_FREE, f"{occ['pages_free']}"),
+                (_C.KV_PAGES_USED, f"{occ['pages_used']}"),
+                (_C.KV_PAGE_OCCUPANCY, f"{occ['occupancy']:.4f}"),
                 (_C.SCHEDULER_ERRORS_TOTAL, f"{eng.error_count}"),
             ]
             if eng.spec_gamma:
@@ -155,6 +158,7 @@ class _Handler(BaseHTTPRequestHandler):
                     (_C.PREFIX_CACHE_HITS_TOTAL, f"{pc.hits}"),
                     (_C.PREFIX_CACHE_MISSES_TOTAL, f"{pc.misses}"),
                     (_C.PREFIX_CACHED_PAGES, f"{pc.cached_pages}"),
+                    (_C.PREFIX_CACHE_EVICTIONS_TOTAL, f"{pc.evictions}"),
                 ]
             lines = [
                 f"{name} {value}"
@@ -280,24 +284,46 @@ class _Handler(BaseHTTPRequestHandler):
             )
             return
 
+        include_usage = bool(
+            (body.get("stream_options") or {}).get("include_usage")
+        )
         req = srv.engine.submit(prompt, params, image=image)
         if stream:
             self.send_response(200)
             self.send_header("content-type", "text/event-stream")
             self.send_header("cache-control", "no-cache")
             self.end_headers()
+            def chunk_of(**fields) -> dict:
+                chunk = {
+                    "id": rid,
+                    "object": kind + ".chunk",
+                    "created": created,
+                    "model": srv.model_name,
+                    **fields,
+                }
+                if include_usage and "usage" not in chunk:
+                    # OpenAI stream_options.include_usage contract: every
+                    # content chunk carries "usage": null; only the final
+                    # dedicated chunk carries the totals
+                    chunk["usage"] = None
+                return chunk
+
+            def usage_chunk() -> dict:
+                n_prompt = len(req.prompt_tokens or [])
+                return chunk_of(choices=[], usage={
+                    "prompt_tokens": n_prompt,
+                    "completion_tokens": req.n_generated,
+                    "total_tokens": n_prompt + req.n_generated,
+                })
+
             try:
                 for piece in srv.engine.stream(req):
                     delta = (
                         {"delta": {"content": piece}} if chat else {"text": piece}
                     )
-                    chunk = {
-                        "id": rid,
-                        "object": kind + ".chunk",
-                        "created": created,
-                        "model": srv.model_name,
-                        "choices": [{"index": 0, **delta, "finish_reason": None}],
-                    }
+                    chunk = chunk_of(
+                        choices=[{"index": 0, **delta, "finish_reason": None}]
+                    )
                     self.wfile.write(f"data: {json.dumps(chunk)}\n\n".encode())
                     self.wfile.flush()
                 if req.finish_reason == "error":
@@ -309,18 +335,19 @@ class _Handler(BaseHTTPRequestHandler):
                     }}
                     self.wfile.write(f"data: {json.dumps(err)}\n\n".encode())
                 else:
-                    final = {
-                        "id": rid,
-                        "object": kind + ".chunk",
-                        "created": created,
-                        "model": srv.model_name,
-                        "choices": [{
-                            "index": 0,
-                            **({"delta": {}} if chat else {"text": ""}),
-                            "finish_reason": req.finish_reason or "stop",
-                        }],
-                    }
+                    final = chunk_of(choices=[{
+                        "index": 0,
+                        **({"delta": {}} if chat else {"text": ""}),
+                        "finish_reason": req.finish_reason or "stop",
+                    }])
                     self.wfile.write(f"data: {json.dumps(final)}\n\n".encode())
+                if include_usage:
+                    # usage ships on the error path too: a client doing
+                    # billing/accounting still learns what the partial
+                    # generation consumed
+                    self.wfile.write(
+                        f"data: {json.dumps(usage_chunk())}\n\n".encode()
+                    )
                 self.wfile.write(b"data: [DONE]\n\n")
                 self.wfile.flush()
             except BrokenPipeError:

@@ -26,6 +26,8 @@ from __future__ import annotations
 import threading
 import time
 
+from ..observability import metrics as _obs
+
 
 class _Node:
     __slots__ = ("page_id", "refcount", "children", "last_used")
@@ -46,6 +48,7 @@ class PrefixCache:
         self._lock = threading.Lock()
         self.hits = 0
         self.misses = 0
+        self.evictions = 0  # monotonic: pages reclaimed by evict()
 
     def _page_keys(self, tokens: list[int]) -> list[tuple]:
         n_full = len(tokens) // self.page_size
@@ -107,6 +110,7 @@ class PrefixCache:
                     node.last_used = time.monotonic()  # our acquire()d prefix
                 final.append(node.page_id)
                 level = node.children
+            _obs.set_prefix_cache_pages(len(self._by_page))
         return final, displaced
 
     def release(self, page_ids: list[int]) -> None:
@@ -139,6 +143,7 @@ class PrefixCache:
                     children, key = parent
                     del children[key]
                     del self._by_page[pid]
+            _obs.set_prefix_cache_pages(len(self._by_page))
 
     def _find_parent(self, target: _Node):
         def walk(children):
@@ -175,14 +180,34 @@ class PrefixCache:
                 if not wave:
                     break
                 wave.sort(key=lambda t: t[2].last_used)
+                batch: list[int] = []
                 for children, key, node in wave[: n_pages - freed]:
                     del children[key]
                     del self._by_page[node.page_id]
-                    self.allocator.free([node.page_id])
+                    batch.append(node.page_id)
                     freed += 1
+                # one allocator call per wave: per-page frees would pay a
+                # lock round-trip + 3 gauge writes per page on the
+                # allocator-pressure path
+                self.allocator.free(batch)
+            self.evictions += freed
+            _obs.set_prefix_cache_pages(len(self._by_page))
+        _obs.record_prefix_evictions(freed)
         return freed
 
     @property
     def cached_pages(self) -> int:
         with self._lock:
             return len(self._by_page)
+
+    def stats(self) -> dict:
+        """Occupancy/effectiveness snapshot for /metrics and `tpurun top`."""
+        with self._lock:
+            total = self.hits + self.misses
+            return {
+                "cached_pages": len(self._by_page),
+                "hits": self.hits,
+                "misses": self.misses,
+                "evictions": self.evictions,
+                "hit_ratio": self.hits / total if total else 0.0,
+            }

@@ -36,6 +36,7 @@ import uuid
 from pathlib import Path
 
 from .._internal import config as _config
+from ..observability import metrics as _obs
 from ..utils.compile_cache import _machine_tag
 
 _DISABLED = ("0", "off", "none")
@@ -153,21 +154,27 @@ class SnapshotStore:
 
     def get(self, key: str) -> tuple[bytes, dict] | None:
         """Payload + meta for ``key``, or None on miss/corruption (corrupt
-        entries are deleted so the next boot re-captures)."""
+        entries are deleted so the next boot re-captures). Lookups feed the
+        ``mtpu_snapshot_store_gets_total{result=hit|miss}`` hit-ratio
+        counters — once per container boot, never a hot path."""
         meta = self.inspect(key)
         if meta is None:
             if self._entry_dir(key).exists():
                 self.delete(key)  # corrupt meta.json: self-heal
+            _obs.record_snapshot_store_get("miss")
             return None
         try:
             payload = self._state_path(key).read_bytes()
         except OSError:
             self.delete(key)
+            _obs.record_snapshot_store_get("miss")
             return None
         if _sha256(payload) != meta.get("checksum"):
             self.delete(key)
+            _obs.record_snapshot_store_get("miss")
             return None
         self._touch(key, meta)
+        _obs.record_snapshot_store_get("hit")
         return payload, meta
 
     def _touch(self, key: str, meta: dict) -> None:
@@ -233,6 +240,7 @@ class SnapshotStore:
             if d.name.startswith(".") or not d.is_dir():
                 continue
             n += self.delete(d.name)
+        self.publish_size_gauges()
         return n
 
     # -- listing / eviction --------------------------------------------------
@@ -264,3 +272,14 @@ class SnapshotStore:
                 victim = entries.pop()
                 total -= victim.get("size_bytes", 0)
                 self.delete(victim["key"])
+        self.publish_size_gauges(entries)
+
+    def publish_size_gauges(self, entries: list[dict] | None = None) -> dict:
+        """Refresh ``mtpu_snapshot_store_entries`` / ``_bytes`` from the
+        store's current contents (called after every put/evict, and by
+        anything that wants a fresh reading, e.g. `tpurun top`)."""
+        if entries is None:
+            entries = self.entries()
+        total = sum(e.get("size_bytes", 0) for e in entries)
+        _obs.set_snapshot_store_size(entries=len(entries), total_bytes=total)
+        return {"entries": len(entries), "bytes": total}

@@ -128,6 +128,22 @@ def make_profile_function(app, trace_volume=None, mount_path: str = "/traces"):
     return profile
 
 
+def export_call_trace(call_id: str, out_path: str | Path) -> dict:
+    """Write one framework call's lifecycle trace as Chrome-trace/Perfetto
+    JSON next to wherever your XPlane traces go — ``jax.profiler.trace``
+    answers "what did the chip do", this answers "what did the *framework*
+    do around it" (queue/boot/dispatch/execute spans), in the same UI
+    (ui.perfetto.dev / chrome://tracing). ``call_id`` is the ``in-...`` id
+    from ``FunctionCall.call_id``; raises KeyError when no such trace
+    exists. Same converter as ``tpurun trace <id> --perfetto``."""
+    from ..observability.export import export_chrome_trace
+
+    doc = export_chrome_trace(call_id, out_path)
+    if doc is None:
+        raise KeyError(f"no trace recorded for call {call_id!r}")
+    return doc
+
+
 def device_memory_stats() -> dict:
     """HBM usage per device — the nvidia-smi replacement
     (install_cuda.py:17-20 analog)."""

@@ -121,14 +121,45 @@ class Registry:
     def histogram_quantiles(
         self, name: str, labels: dict | None = None,
         quantiles: tuple = (0.5, 0.95, 0.99),
+        aggregate: dict | None = None,
     ) -> dict | None:
-        """Estimate quantiles from one histogram series (linear interpolation
+        """Estimate quantiles from a histogram series (linear interpolation
         within the winning bucket, like PromQL ``histogram_quantile``).
         Returns ``{"p50": ..., ..., "count": n, "sum": s}`` or None when the
-        series was never observed."""
-        key = self._key(name, labels)
+        series was never observed (empty histograms never fabricate a 0.0).
+
+        ``aggregate`` sums every series of ``name`` whose label dict contains
+        the given items (``{}`` = all of them) before computing — the PromQL
+        ``sum by ()`` analog used by SLO evaluation. Series whose bucket
+        layout differs from the first matching one are skipped.
+
+        Edge cases (rather than extrapolating nonsense): a quantile landing
+        in the ``+Inf`` overflow bucket clamps to the largest finite bound;
+        interpolation fractions are clamped to [0, 1] so zero-count buckets
+        skipped along the way can never push a value outside its bucket.
+        """
         with self._lock:
-            h = self._histograms.get(key)
+            if aggregate is not None:
+                want = aggregate.items()
+                h = None
+                for (n, lbls), series in self._histograms.items():
+                    if n != name or not (set(want) <= set(lbls)):
+                        continue
+                    if h is None:
+                        h = {
+                            "buckets": series["buckets"],
+                            "counts": list(series["counts"]),
+                            "sum": series["sum"],
+                            "count": series["count"],
+                        }
+                    elif series["buckets"] == h["buckets"]:
+                        h["counts"] = [
+                            a + b for a, b in zip(h["counts"], series["counts"])
+                        ]
+                        h["sum"] += series["sum"]
+                        h["count"] += series["count"]
+            else:
+                h = self._histograms.get(self._key(name, labels))
             if h is None or h["count"] == 0:
                 return None
             bounds = h["buckets"]
@@ -143,16 +174,46 @@ class Registry:
                     prev_cum = cum
                     cum += c
                     if cum >= rank and c > 0:
-                        hi = bounds[i] if i < len(bounds) else bounds[-1]
-                        lo = bounds[i - 1] if i > 0 else 0.0
                         if i >= len(bounds):  # +Inf bucket: clamp to last bound
                             value = float(bounds[-1])
                         else:
-                            frac = (rank - prev_cum) / c
+                            hi = bounds[i]
+                            lo = bounds[i - 1] if i > 0 else 0.0
+                            frac = min(1.0, max(0.0, (rank - prev_cum) / c))
                             value = lo + (hi - lo) * frac
                         break
                 out[f"p{int(q * 100)}"] = value
             return out
+
+    def total(self, name: str, match: dict | None = None) -> float:
+        """Sum a series across label sets (counters/gauges: values sum;
+        histograms: observation counts sum). ``match`` filters to label sets
+        containing the given items. The PromQL ``sum(name)`` analog for SLO
+        ratio targets."""
+        want = (match or {}).items()
+        out = 0.0
+        with self._lock:
+            for store in (self._counters, self._gauges):
+                for (n, lbls), v in store.items():
+                    if n == name and set(want) <= set(lbls):
+                        out += v
+            for (n, lbls), h in self._histograms.items():
+                if n == name and set(want) <= set(lbls):
+                    out += h["count"]
+        return out
+
+    def peak(self, name: str, match: dict | None = None) -> float:
+        """Max of a counter/gauge series across label sets (0.0 when none
+        match). For ratio gauges like occupancy fractions, where summing
+        per-job series would produce a nonsense >1 value — show the worst."""
+        want = (match or {}).items()
+        out = 0.0
+        with self._lock:
+            for store in (self._counters, self._gauges):
+                for (n, lbls), v in store.items():
+                    if n == name and set(want) <= set(lbls):
+                        out = max(out, v)
+        return out
 
     def expose(self) -> str:
         """Prometheus text exposition format."""
@@ -229,6 +290,108 @@ _SAMPLE_RE = re.compile(
     r"(?:\{(?P<labels>.*)\})?"
     r"\s+(?P<rest>.+)$"
 )
+
+_LABEL_RE = re.compile(r'([a-zA-Z_][a-zA-Z0-9_]*)="((?:[^"\\]|\\.)*)"')
+
+
+_UNESCAPE_RE = re.compile(r"\\(.)")
+_UNESCAPE_MAP = {"n": "\n", '"': '"', "\\": "\\"}
+
+
+def _unescape_label_value(v: str) -> str:
+    # one left-to-right pass: sequential .replace() calls corrupt values
+    # where an escaped backslash precedes 'n' ('a\\nb' must round-trip to
+    # a backslash + 'n', not a newline)
+    return _UNESCAPE_RE.sub(
+        lambda m: _UNESCAPE_MAP.get(m.group(1), "\\" + m.group(1)), v
+    )
+
+
+def parse_exposition(text: str) -> Registry:
+    """Reconstruct a :class:`Registry` from Prometheus text exposition.
+
+    The inverse of :meth:`Registry.expose` — counters/gauges land as values,
+    histogram ``_bucket``/``_sum``/``_count`` child series are de-cumulated
+    back into per-bucket counts, so ``histogram_quantiles``/``value``/
+    ``total`` work on a *pushed* ``.prom`` file exactly as on the live
+    registry (what ``tpurun top`` and SLO evaluation over pushed jobs need).
+    Unparseable lines are skipped; untyped samples read as gauges.
+    """
+    reg = Registry()
+    types: dict[str, str] = {}
+    helps: dict[str, str] = {}
+    # (name, labels_tuple) -> {"buckets": [(le, cum)], "sum": s, "count": n}
+    hists: dict[tuple, dict] = {}
+    for line in text.splitlines():
+        line = line.strip()
+        if not line:
+            continue
+        if line.startswith("# TYPE "):
+            name, _, t = line[len("# TYPE "):].partition(" ")
+            types[name] = t.strip()
+            continue
+        if line.startswith("# HELP "):
+            name, _, h = line[len("# HELP "):].partition(" ")
+            helps[name] = h
+            continue
+        if line.startswith("#"):
+            continue
+        m = _SAMPLE_RE.match(line)
+        if m is None:
+            continue
+        name = m.group("name")
+        try:
+            value = float(m.group("rest").split()[0])
+        except (ValueError, IndexError):
+            continue
+        labels = {
+            k: _unescape_label_value(v)
+            for k, v in _LABEL_RE.findall(m.group("labels") or "")
+        }
+        base, part = name, None
+        for suffix in ("_bucket", "_sum", "_count"):
+            stem = name[: -len(suffix)]
+            if name.endswith(suffix) and types.get(stem) == "histogram":
+                base, part = stem, suffix
+                break
+        if part is not None:
+            le = labels.pop("le", None)
+            key = (base, tuple(sorted(labels.items())))
+            h = hists.setdefault(key, {"buckets": [], "sum": 0.0, "count": 0})
+            if part == "_bucket" and le is not None:
+                bound = math.inf if le == "+Inf" else float(le)
+                h["buckets"].append((bound, value))
+            elif part == "_sum":
+                h["sum"] = value
+            elif part == "_count":
+                h["count"] = int(value)
+            continue
+        if types.get(name) == "counter":
+            reg.counter_inc(name, value, labels=labels or None,
+                            help=helps.get(name, ""))
+        else:
+            reg.gauge_set(name, value, labels=labels or None,
+                          help=helps.get(name, ""))
+    for (name, lbl_t), h in hists.items():
+        pairs = sorted(h["buckets"])
+        finite = tuple(le for le, _ in pairs if not math.isinf(le))
+        counts, prev = [], 0.0
+        for _, cum in pairs:
+            counts.append(int(cum - prev))
+            prev = cum
+        if not any(math.isinf(le) for le, _ in pairs):
+            counts.append(max(0, h["count"] - int(prev)))  # missing +Inf
+        with reg._lock:
+            reg._histograms[(name, lbl_t)] = {
+                "buckets": finite,
+                "counts": counts,
+                "sum": h["sum"],
+                "count": h["count"] or int(prev),
+            }
+            reg._types[name] = "histogram"
+            if name in helps:
+                reg._help[name] = helps[name]
+    return reg
 
 
 def merge_expositions(jobs: dict[str, str]) -> str:

@@ -174,14 +174,45 @@ class _Handler(BaseHTTPRequestHandler):
     # -- built-in observability routes --------------------------------------
 
     def _serve_builtin(self, parsed, method: str) -> bool:
-        """``/metrics`` (prometheus exposition: this process's registry +
-        every pushed job file) and ``/traces[/<call_id>]`` (call-lifecycle
-        span JSON). User endpoints with the same label win — these only
-        answer when no route claimed the path."""
+        """Built-in observability routes: ``/metrics`` (prometheus
+        exposition: this process's registry + every pushed job file),
+        ``/traces[/<call_id>]`` (call-lifecycle span JSON), ``/healthz``
+        (SLO pass/fail + burn rates), and ``/autoscaler[?function=tag]``
+        (the autoscaler decision journal). User endpoints with the same
+        label win — these only answer when no route claimed the path."""
         parts = parsed.path.strip("/").split("/")
         label = parts[0] if parts else ""
-        if method != "GET" or label not in ("metrics", "traces"):
+        if method != "GET" or label not in (
+            "metrics", "traces", "healthz", "autoscaler"
+        ):
             return False
+        if label == "healthz":
+            from ..observability.slo import healthz
+
+            payload = healthz()
+            code = 200 if payload["status"] == "ok" else 503
+            self._respond_json(code, payload)
+            return True
+        if label == "autoscaler":
+            from ..observability.journal import default_journal
+
+            q = {
+                k: v[-1]
+                for k, v in urllib.parse.parse_qs(parsed.query).items()
+            }
+            try:
+                n = int(q.get("n", 50))
+            except ValueError:
+                n = 50
+            self._respond_json(
+                200,
+                {
+                    "decisions": default_journal.tail(
+                        n, function=q.get("function")
+                    )
+                },
+            )
+            return True
         if label == "metrics":
             from ..observability.export import live_and_pushed_metrics
 

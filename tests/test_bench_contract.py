@@ -36,6 +36,15 @@ def test_bench_emits_schema_json():
     assert pl, payload
     some = pl.get("prefill") or pl.get("decode_wait")
     assert some and {"p50", "p95", "p99", "count"} <= set(some)
+    # token-level serving latency (ISSUE-3): TTFT/TPOT p50/p95 + tokens/s
+    # ride alongside phase_latency in every BENCH json
+    tl = payload.get("token_latency")
+    assert tl and "ttft" in tl and "tpot" in tl, payload
+    for key in ("ttft", "tpot"):
+        assert {"p50", "p95", "count"} <= set(tl[key]), tl
+        assert tl[key]["p50"] <= tl[key]["p95"]
+        assert tl[key]["count"] >= 1
+    assert payload["tokens_per_second"] == payload["value"]
 
 
 @pytest.mark.slow

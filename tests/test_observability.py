@@ -217,6 +217,158 @@ class TestHistogramExposition:
         assert reg.value("mtpu_queue_wait_seconds") == 1.0
 
 
+class TestHistogramQuantileEdgeCases:
+    """ISSUE-3 satellite: empty histogram, all mass in +Inf, and single-
+    bucket layouts must return None / a bucket bound — never raise, never
+    extrapolate past the data."""
+
+    def test_empty_histogram_returns_none(self):
+        reg = Registry()
+        assert reg.histogram_quantiles("mtpu_queue_wait_seconds") is None
+        # labels that never observed anything are None too, even when a
+        # sibling label set exists
+        reg.histogram_observe(
+            "mtpu_queue_wait_seconds", 0.1, labels={"function": "a"}
+        )
+        assert reg.histogram_quantiles(
+            "mtpu_queue_wait_seconds", labels={"function": "b"}
+        ) is None
+
+    def test_all_mass_in_inf_clamps_to_largest_finite_bound(self):
+        reg = Registry()
+        for _ in range(10):
+            reg.histogram_observe(
+                "mtpu_queue_wait_seconds", 1e9, buckets=(0.1, 1.0)
+            )
+        q = reg.histogram_quantiles("mtpu_queue_wait_seconds")
+        assert q["p50"] == q["p99"] == 1.0  # the largest finite bound
+
+    def test_single_bucket_interpolates_within_bounds(self):
+        reg = Registry()
+        for _ in range(8):
+            reg.histogram_observe(
+                "mtpu_queue_wait_seconds", 0.05, buckets=(1.0,)
+            )
+        q = reg.histogram_quantiles("mtpu_queue_wait_seconds")
+        for key in ("p50", "p95", "p99"):
+            assert 0.0 <= q[key] <= 1.0
+
+    def test_sparse_buckets_never_escape_the_winning_bucket(self):
+        # observations split around an empty middle bucket: interpolation
+        # fractions must clamp so values stay inside the bucket that holds
+        # the rank
+        reg = Registry()
+        for v in (0.05, 0.05, 0.05, 5.0):
+            reg.histogram_observe(
+                "mtpu_queue_wait_seconds", v, buckets=(0.1, 1.0, 10.0)
+            )
+        q = reg.histogram_quantiles("mtpu_queue_wait_seconds")
+        assert q["p50"] <= 0.1
+        assert 1.0 <= q["p99"] <= 10.0
+
+    def test_aggregate_sums_across_label_sets(self):
+        reg = Registry()
+        for i in range(50):
+            reg.histogram_observe(
+                "mtpu_call_duration_seconds", 0.01,
+                labels={"function": "a", "phase": "total"},
+            )
+            reg.histogram_observe(
+                "mtpu_call_duration_seconds", 10.0,
+                labels={"function": "b", "phase": "total"},
+            )
+        q = reg.histogram_quantiles(
+            "mtpu_call_duration_seconds", aggregate={"phase": "total"}
+        )
+        assert q["count"] == 100
+        assert q["p50"] <= 0.025 and q["p95"] >= 5.0
+        assert reg.total(
+            "mtpu_call_duration_seconds", {"phase": "total"}
+        ) == 100.0
+
+
+class TestExpositionParser:
+    def test_round_trips_counters_gauges_histograms(self):
+        from modal_examples_tpu.utils.prometheus import parse_exposition
+
+        reg = Registry()
+        reg.counter_inc(
+            "mtpu_retries_total", 3, labels={"reason": "timeout"},
+            help="retries",
+        )
+        reg.gauge_set("mtpu_active_slots", 5.0)
+        for v in (0.004, 0.2, 2.0, 700.0):
+            reg.histogram_observe(
+                "mtpu_queue_wait_seconds", v, labels={"function": "f"}
+            )
+        parsed = parse_exposition(reg.expose())
+        assert parsed.value(
+            "mtpu_retries_total", {"reason": "timeout"}
+        ) == 3.0
+        assert parsed.value("mtpu_active_slots") == 5.0
+        assert parsed.histogram_quantiles(
+            "mtpu_queue_wait_seconds", {"function": "f"}
+        ) == reg.histogram_quantiles(
+            "mtpu_queue_wait_seconds", {"function": "f"}
+        )
+        # the parsed registry re-exposes as valid text again
+        assert "# TYPE mtpu_queue_wait_seconds histogram" in parsed.expose()
+
+
+class TestTraceStoreBounds:
+    """ISSUE-3 satellite: the traces directory must stay bounded (count +
+    bytes, LRU-deleted oldest-first) on long-running gateways."""
+
+    @staticmethod
+    def _fill(store, n):
+        import os
+        import time as _time
+
+        now = _time.time()
+        for i in range(n):
+            store.record({
+                "trace_id": f"in-{i:05d}", "span_id": f"sp-{i}",
+                "parent_id": None, "name": "call",
+                "start": 1.0, "end": 2.0, "status": "ok", "attrs": {},
+            })
+            # distinct (recent) mtimes so LRU ordering is deterministic
+            t = now - (n - i)
+            os.utime(store.root / f"in-{i:05d}.jsonl", (t, t))
+
+    def test_count_cap_deletes_oldest_first(self, tmp_path, monkeypatch):
+        from modal_examples_tpu.observability import trace as tr
+
+        monkeypatch.setattr(tr, "_MAX_TRACE_FILES", 10)
+        store = tr.TraceStore(root=tmp_path)
+        self._fill(store, 25)
+        store._gc_sweep()
+        left = sorted(p.stem for p in tmp_path.glob("*.jsonl"))
+        assert len(left) == 10
+        assert left[0] == "in-00015"  # the newest 10 survive
+
+    def test_byte_cap(self, tmp_path, monkeypatch):
+        from modal_examples_tpu.observability import trace as tr
+
+        monkeypatch.setattr(tr, "_MAX_TRACE_BYTES", 600)
+        store = tr.TraceStore(root=tmp_path)
+        self._fill(store, 20)
+        store._gc_sweep()
+        total = sum(p.stat().st_size for p in tmp_path.glob("*.jsonl"))
+        assert 0 < total <= 600
+
+    def test_trace_list_limit_flag(self, tmp_path, capsys):
+        from modal_examples_tpu.observability import trace as tr
+
+        store = tr.TraceStore(root=tmp_path)
+        self._fill(store, 6)
+        assert cli_main(
+            ["trace", "list", "--limit", "3", "--dir", str(tmp_path)]
+        ) == 0
+        out = capsys.readouterr().out
+        assert out.count("in-000") == 3
+        assert "in-00005" in out  # newest first
+
+
 # ---------------------------------------------------------------------------
 # merge/push gateway + `tpurun metrics`
 # ---------------------------------------------------------------------------

@@ -387,6 +387,72 @@ class TestSingleUse:
         assert len(set(ids)) == 3
 
 
+class TestAutoscalerJournal:
+    """Every autoscale decision must leave a structured journal record
+    (observability.journal) with its trigger and pool-state rationale."""
+
+    @staticmethod
+    def _decisions(tag, action):
+        from modal_examples_tpu.observability.journal import default_journal
+
+        return [
+            r for r in default_journal.tail(500, function=tag)
+            if r["action"] == action
+        ]
+
+    def test_queue_pressure_scale_up_is_journaled(self):
+        japp = mtpu.App("journal-scale-test")
+
+        @japp.function(timeout=60, max_containers=3)
+        def slow_id(x):
+            time.sleep(0.2)
+            return x
+
+        with japp.run():
+            assert sorted(slow_id.map(range(6))) == list(range(6))
+            tag = slow_id.spec.tag
+            ups = self._decisions(tag, "scale_up")
+            assert ups, "no scale_up journal record"
+            first = ups[0]
+            assert first["trigger"] == "queue_pressure"
+            assert first["queue_depth"] >= 1
+            assert first["inflight"] >= 1
+            assert first["containers_after"] > first["containers_before"]
+            assert first["spawned"] >= 1
+            # the prometheus decisions counter mirrors the journal
+            from modal_examples_tpu.observability import catalog as C
+            from modal_examples_tpu.utils.prometheus import default_registry
+
+            assert default_registry.value(
+                C.SCALER_DECISIONS_TOTAL,
+                labels={"function": tag, "action": "scale_up"},
+            ) == len(ups)
+
+    def test_scaledown_window_reap_is_journaled(self):
+        sapp = mtpu.App("journal-reap-test")
+
+        @sapp.function(timeout=30, scaledown_window=0.4)
+        def ping() -> str:
+            return "pong"
+
+        with sapp.run():
+            assert ping.remote() == "pong"
+            tag = ping.spec.tag
+            # the idle reaper fires from the scheduler tick once the
+            # container has been idle past the (short) scaledown window
+            deadline = time.monotonic() + 20
+            downs = []
+            while time.monotonic() < deadline and not downs:
+                downs = self._decisions(tag, "scale_down")
+                time.sleep(0.1)
+            assert downs, "idle container was never reaped into the journal"
+            rec = downs[0]
+            assert rec["trigger"] == "idle"
+            assert rec["idle_ages_s"][0] >= 0.4
+            assert rec["scaledown_window_s"] == pytest.approx(0.4)
+            assert rec["containers_after"] == rec["containers_before"] - 1
+
+
 class TestAppRegistry:
     def test_registered_functions(self):
         assert "square" in app.registered_functions

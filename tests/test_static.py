@@ -109,6 +109,78 @@ def test_metric_names_all_declared_in_catalog():
     )
 
 
+def _const_str(node):
+    """The literal str of an AST node, or None (f-strings, names, calls)."""
+    if isinstance(node, ast.Constant) and isinstance(node.value, str):
+        return node.value
+    return None
+
+
+def test_metric_label_keys_declared_in_catalog():
+    """Every *label key* passed to the registry emitters (``counter_inc`` /
+    ``gauge_set`` / ``histogram_observe``) with a resolvable metric name and
+    a dict-literal ``labels=`` must be declared for that series in
+    ``observability.catalog``. The name guard above stops series-name drift;
+    this stops **label-cardinality drift** — a call site growing an
+    undeclared ``user_id`` label would explode series cardinality without
+    any name changing. Dynamic names/labels (e.g. the exposition parser)
+    are skipped: the guard is for declared-series call sites."""
+    from modal_examples_tpu.observability import catalog
+
+    # constant name -> series name, e.g. RETRIES_TOTAL -> mtpu_retries_total
+    const_to_series = {
+        attr: val
+        for attr, val in vars(catalog).items()
+        if isinstance(val, str) and val.startswith("mtpu_")
+    }
+    emitters = {"counter_inc", "gauge_set", "histogram_observe"}
+    violations = []
+    for path in sorted(PKG_ROOT.rglob("*.py")):
+        tree = ast.parse(path.read_text())
+        for node in ast.walk(tree):
+            if not (
+                isinstance(node, ast.Call)
+                and isinstance(node.func, ast.Attribute)
+                and node.func.attr in emitters
+                and node.args
+            ):
+                continue
+            # resolve the series name: str literal, C.NAME attribute, or a
+            # bare imported catalog constant
+            name_node = node.args[0]
+            series = _const_str(name_node)
+            if series is None and isinstance(name_node, ast.Attribute):
+                series = const_to_series.get(name_node.attr)
+            if series is None and isinstance(name_node, ast.Name):
+                series = const_to_series.get(name_node.id)
+            if series is None or series not in catalog.CATALOG:
+                continue  # dynamic name (parser/merger internals)
+            labels_node = next(
+                (kw.value for kw in node.keywords if kw.arg == "labels"),
+                None,
+            )
+            if not isinstance(labels_node, ast.Dict):
+                continue  # no labels / passed through a variable
+            declared = set(catalog.CATALOG[series]["labels"])
+            for key_node in labels_node.keys:
+                key = _const_str(key_node) if key_node is not None else None
+                if key is None:
+                    violations.append(
+                        f"{path.relative_to(REPO_ROOT)}:{node.lineno}: "
+                        f"{series} has a non-literal label key"
+                    )
+                elif key not in declared:
+                    violations.append(
+                        f"{path.relative_to(REPO_ROOT)}:{node.lineno}: "
+                        f"label {key!r} not declared for {series} "
+                        f"(declared: {sorted(declared)})"
+                    )
+    assert not violations, (
+        "label keys not declared in observability/catalog.py "
+        f"(add them to the series' labels list): {violations}"
+    )
+
+
 def test_no_bare_print_in_framework_code():
     """Framework code under ``core/`` and ``serving/`` must not ``print()``:
     diagnostics go through ``utils.log.get_logger`` so they carry a level
