@@ -178,6 +178,25 @@ def _child(model: str) -> None:
             token_latency[key] = {
                 k: q[k] for k in ("p50", "p95", "count") if k in q
             }
+    # scheduling telemetry (ISSUE-4): per-class admission queue-wait
+    # distributions + the shed rate — the control layer's own trajectory
+    # rides in every BENCH json alongside the kernel numbers
+    sched_wait = {}
+    for klass in ("interactive", "default", "batch"):
+        q = _q(C.SCHED_QUEUE_WAIT_SECONDS, {"class": klass})
+        if q:
+            sched_wait[klass] = {
+                k: q[k] for k in ("p50", "p95", "count") if k in q
+            }
+    sheds = default_registry.total(C.SHEDS_TOTAL)
+    admitted = default_registry.total(C.REQUESTS_ADMITTED_TOTAL)
+    offered = sheds + admitted
+    scheduling = {
+        "queue_wait": sched_wait,
+        "shed_rate": round(sheds / offered, 6) if offered else 0.0,
+        "sheds_total": int(sheds),
+        "admitted_total": int(admitted),
+    }
     print(
         json.dumps(
             {
@@ -198,6 +217,7 @@ def _child(model: str) -> None:
                 "engine_errors": errors,
                 "phase_latency": phase_latency,
                 "token_latency": token_latency,
+                "scheduling": scheduling,
                 "tokens_per_second": round(tok_s, 2),
             }
         )

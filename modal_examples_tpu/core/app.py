@@ -44,6 +44,12 @@ def _registry_path() -> Path:
     return _config.state_dir() / "apps.json"
 
 
+def _validate_priority(priority: str) -> None:
+    from ..scheduling.policy import validate_class
+
+    validate_class(priority)
+
+
 #: All App objects instantiated in this process, by name (for App.lookup).
 _app_instances: dict[str, "App"] = {}
 
@@ -160,6 +166,8 @@ class App:
         region: str | None = None,
         name: str | None = None,
         serialized: bool = False,
+        priority: str = "default",
+        max_pending_inputs: int | None = None,
         enable_memory_snapshot: bool = False,
         experimental_options: dict | None = None,
     ) -> Callable[[Callable], Function]:
@@ -168,6 +176,7 @@ class App:
                 "this framework is TPU-native: use tpu='v5e-8' (see "
                 "modal_examples_tpu.core.resources), not gpu=..."
             )
+        _validate_priority(priority)
 
         def deco(fn: Callable) -> Function:
             fn_name = name or fn.__name__
@@ -196,6 +205,8 @@ class App:
                 region=region,
                 cluster_size=cluster_cfg.get("size", 0),
                 cluster_chips_per_host=cluster_cfg.get("chips_per_host"),
+                priority=priority,
+                max_pending_inputs=max_pending_inputs,
                 enable_memory_snapshot=enable_memory_snapshot,
                 serialized=serialized,
                 experimental_options=dict(experimental_options or {}),
@@ -223,12 +234,15 @@ class App:
         max_containers: int = 8,
         min_containers: int = 0,
         scaledown_window: float = 60.0,
+        priority: str = "default",
+        max_pending_inputs: int | None = None,
         enable_memory_snapshot: bool = False,
         experimental_options: dict | None = None,
         region: str | None = None,
     ) -> Callable[[type], Cls]:
         if gpu is not None:
             raise ValueError("TPU-native framework: use tpu=, not gpu=")
+        _validate_priority(priority)
 
         def deco(user_cls: type) -> Cls:
             meta = _collect_lifecycle(user_cls)
@@ -251,6 +265,8 @@ class App:
                 max_concurrent_inputs=getattr(user_cls, "__mtpu_concurrent__", 1),
                 methods_meta=meta["methods"],
                 region=region,
+                priority=priority,
+                max_pending_inputs=max_pending_inputs,
                 enable_memory_snapshot=enable_memory_snapshot,
                 experimental_options=dict(experimental_options or {}),
             )

@@ -17,6 +17,7 @@ Usage:
     tpurun trace [CALL_ID [--perfetto] | list [--limit N]]  # call traces
     tpurun metrics [--json]            # merged pushed prometheus expositions
     tpurun scaler [N] [--function TAG] # autoscaler decision journal
+    tpurun sched [--watch S]           # live class queues, shed rates, router
     tpurun top [--watch S]             # live serving summary + SLO burn rates
 """
 
@@ -271,7 +272,19 @@ def cmd_docs(argv: list[str]) -> int:
         target.parent.mkdir(parents=True, exist_ok=True)
         target.write_text(md)
         n += 1
-    index = ["# Examples\n"]
+    index = []
+    # hand-written guides live next to the rendered examples; the index
+    # links both so regeneration never clobbers the guide entries
+    guides = sorted(
+        p.name for p in out_dir.glob("*.md") if p.name != "index.md"
+    )
+    if guides:
+        index.append("# Guides\n")
+        for g in guides:
+            title = g.removesuffix(".md").replace("_", " ")
+            index.append(f"- [{title}]({g})")
+        index.append("")
+    index.append("# Examples\n")
     for e in get_examples():
         index.append(f"- [{e.module_name}]({e.path.with_suffix('.md')})")
     (out_dir / "index.md").write_text("\n".join(index) + "\n")
@@ -588,6 +601,109 @@ def cmd_top(argv: list[str]) -> int:
     return 0
 
 
+def cmd_sched(argv: list[str]) -> int:
+    """Live scheduler view: per-class queue depth + admission wait, shed
+    rates by reason, deadline misses, and router affinity — from the pushed
+    metrics files (the scheduling companion of ``tpurun top``).
+
+    ``--watch S`` refreshes every S seconds; ``--dir PATH`` overrides the
+    state dir root.
+    """
+    from ..observability import catalog as C
+    from ..observability.export import pushed_jobs
+    from ..scheduling.policy import PRIORITY_CLASSES
+    from ..utils.prometheus import merge_expositions, parse_exposition
+
+    usage = "usage: tpurun sched [--watch S] [--dir PATH]"
+    argv, root = _pop_dir_flag(argv, usage)
+    argv, watch_s = _pop_flag(argv, "--watch", usage)
+    watch = float(watch_s) if watch_s is not None else None
+
+    from pathlib import Path
+
+    metrics_root = Path(root) / "metrics" if root else None
+
+    def render() -> None:
+        jobs = pushed_jobs(metrics_root)
+        if not jobs:
+            print("no pushed metrics yet (run an app or bench first)")
+        merged = parse_exposition(merge_expositions(jobs))
+        print(f"jobs: {len(jobs)} ({', '.join(sorted(jobs)) or 'none'})")
+        print(
+            f"{'CLASS':<13} {'QUEUED':>6} {'ADMITTED':>9} {'SHED':>6} "
+            f"{'WAIT p50/p95 ms':>18}"
+        )
+        for klass in PRIORITY_CLASSES:
+            depth = merged.total(C.SCHED_QUEUE_DEPTH, {"class": klass})
+            admitted = merged.total(
+                C.REQUESTS_ADMITTED_TOTAL, {"class": klass}
+            )
+            shed = merged.total(C.SHEDS_TOTAL, {"class": klass})
+            q = merged.histogram_quantiles(
+                C.SCHED_QUEUE_WAIT_SECONDS,
+                quantiles=(0.5, 0.95),
+                aggregate={"class": klass},
+            )
+            wait = (
+                f"{q['p50'] * 1000:>7.1f}/{q['p95'] * 1000:<7.1f}"
+                if q
+                else "      -/-     "
+            )
+            print(
+                f"{klass:<13} {depth:>6.0f} {admitted:>9.0f} {shed:>6.0f} "
+                f"{wait:>18}"
+            )
+        offered = merged.total(C.REQUESTS_ADMITTED_TOTAL) + merged.total(
+            C.SHEDS_TOTAL
+        )
+        shed_rate = (
+            merged.total(C.SHEDS_TOTAL) / offered if offered else 0.0
+        )
+        by_reason = {}
+        for lbls, v in merged.series(C.SHEDS_TOTAL):
+            reason = lbls.get("reason", "?")
+            by_reason[reason] = by_reason.get(reason, 0.0) + v
+        reasons = " ".join(
+            f"{r}={int(v)}" for r, v in sorted(by_reason.items())
+        )
+        print(
+            f"shed rate {shed_rate:.4f}"
+            + (f"   by reason: {reasons}" if reasons else "")
+        )
+        misses = {
+            lbls.get("stage", "?"): v
+            for lbls, v in merged.series(C.DEADLINE_MISSES_TOTAL)
+        }
+        if misses:
+            print(
+                "deadline misses: "
+                + " ".join(f"{k}={int(v)}" for k, v in sorted(misses.items()))
+            )
+        routed = merged.total(C.ROUTER_REQUESTS_TOTAL)
+        if routed:
+            print(
+                f"router: {int(routed)} placed, "
+                f"{int(merged.total(C.ROUTER_AFFINITY_HITS_TOTAL))} affinity "
+                f"hits, "
+                f"{int(merged.total(C.ROUTER_REQUESTS_TOTAL, {'route': 'fallback'}))}"
+                f" fallbacks"
+            )
+
+    if watch is None:
+        render()
+        return 0
+    import time as _time
+
+    try:
+        while True:
+            print("\033[2J\033[H", end="")
+            render()
+            _time.sleep(watch)
+    except KeyboardInterrupt:
+        pass
+    return 0
+
+
 def cmd_app(argv: list[str]) -> int:
     if argv and argv[0] == "list":
         reg = _config.state_dir() / "apps.json"
@@ -611,6 +727,7 @@ COMMANDS = {
     "trace": cmd_trace,
     "metrics": cmd_metrics,
     "scaler": cmd_scaler,
+    "sched": cmd_sched,
     "top": cmd_top,
     "examples": cmd_examples,
     "docs": cmd_docs,

@@ -126,7 +126,13 @@ class _BoundMethod:
         return self.local(*args, **kwargs)
 
     def _submit(self, args, kwargs):
-        return self._obj._pool().submit(self._name, args, kwargs)
+        from .function import split_priority
+
+        target = getattr(self._obj._cls._user_cls, self._name, None)
+        priority, kwargs = split_priority(target, kwargs)
+        return self._obj._pool().submit(
+            self._name, args, kwargs, priority=priority
+        )
 
     def _remote(self, *args, **kwargs):
         call = self._submit(args, kwargs)

@@ -50,6 +50,7 @@ from .._internal import config as _config
 from ..observability import journal as _journal
 from ..observability import metrics as _obs
 from ..observability import trace as _tr
+from ..scheduling.policy import CLASS_RANK
 from ..utils.log import get_logger
 from . import serialization as ser
 from .retries import Retries
@@ -452,6 +453,9 @@ class _QueuedInput:
     payload: bytes
     ready_at: float = 0.0  # for retry backoff
     started_at: float | None = None
+    # scheduling class (modal_examples_tpu/scheduling): interactive inputs
+    # dispatch before default before batch when contending for containers
+    priority: str = "default"
     # open phase spans; each is finished + recorded at its phase boundary
     queue_span: "_tr.Span | None" = None
     dispatch_span: "_tr.Span | None" = None
@@ -824,11 +828,37 @@ class FunctionPool:
 
     # -- public API ---------------------------------------------------------
 
-    def submit(self, method_name: str, args: tuple, kwargs: dict) -> _Call:
+    def submit(
+        self, method_name: str, args: tuple, kwargs: dict,
+        *, priority: str | None = None,
+    ) -> _Call:
+        # bounded admission (scheduling PR 4): a spec with
+        # max_pending_inputs sheds instead of queueing without limit —
+        # the gateway surfaces the ShedError as HTTP 429 + Retry-After
+        limit = self.spec.max_pending_inputs
+        if limit is not None:
+            with self.lock:
+                depth = len(self.pending)
+            if depth >= limit:
+                from ..scheduling.admission import ShedError
+                from ..scheduling.policy import DEFAULT_CLASS
+
+                _obs.record_shed(
+                    priority or self.spec.priority or DEFAULT_CLASS,
+                    "queue_full",
+                )
+                raise ShedError(
+                    "queue_full",
+                    1.0 + depth / max(1, limit),
+                    f"{self.spec.tag} queue is full ({depth}/{limit})",
+                )
         payload = ser.serialize((args, kwargs))
         input_id = f"in-{uuid.uuid4().hex[:16]}"
         call = _Call(input_id, None, self.spec.retries)  # deadline set at dispatch
-        qi = _QueuedInput(call, method_name, payload, ready_at=time.monotonic())
+        qi = _QueuedInput(
+            call, method_name, payload, ready_at=time.monotonic(),
+            priority=priority or self.spec.priority,
+        )
         if _tr.tracing_enabled():
             call.trace_id = input_id
             call.root_span = _tr.Span(
@@ -1120,6 +1150,10 @@ class FunctionPool:
         # callbacks (trace finalizer, inflight gauge), which re-take it
         for qi in cancelled:
             qi.call.set_exception(InputCancelled(qi.call.input_id))
+        # priority classes: interactive dispatches before default before
+        # batch when contending for containers (stable sort keeps FIFO
+        # within a class — the engine-side fair-share analog for .remote)
+        ready.sort(key=lambda qi: CLASS_RANK.get(qi.priority, 1))
         return ready
 
     def _dispatch_ready(self, now: float) -> None:
@@ -1308,7 +1342,11 @@ class ClusterPool:
         self._lock = threading.Lock()
         self._active_containers: list[_Container] = []
 
-    def submit(self, method_name: str, args: tuple, kwargs: dict) -> _Call:
+    def submit(
+        self, method_name: str, args: tuple, kwargs: dict,
+        *, priority: str | None = None,
+    ) -> _Call:
+        del priority  # gang slices run one call at a time; nothing to order
         if self.closed:
             raise RuntimeError("app run context is closed")
         call = _Call(f"in-{uuid.uuid4().hex[:16]}", None, self.spec.retries)
@@ -1539,7 +1577,11 @@ class InlinePool:
             self._fn = call_fn
             return call_fn
 
-    def submit(self, method_name: str, args: tuple, kwargs: dict) -> _Call:
+    def submit(
+        self, method_name: str, args: tuple, kwargs: dict,
+        *, priority: str | None = None,
+    ) -> _Call:
+        del priority  # inline backend runs the call in-process, immediately
         call = _Call(f"in-{uuid.uuid4().hex[:16]}", None, self.spec.retries)
         if _tr.tracing_enabled():
             call.trace_id = call.input_id

@@ -101,6 +101,12 @@ class FunctionSpec:
     force_inline: bool = False
     cluster_size: int = 0  # >0: gang-scheduled multi-host slice (@clustered)
     cluster_chips_per_host: int | None = None
+    #: scheduling class for this function's inputs (interactive|default|
+    #: batch); per-call override via .remote(..., priority=)
+    priority: str = "default"
+    #: bound on queued (undispatched) inputs; None = unbounded. Exceeding it
+    #: sheds: pool.submit raises ShedError, the gateway answers 429.
+    max_pending_inputs: int | None = None
     enable_memory_snapshot: bool = False
     serialized: bool = False  # ship-by-value parity flag (reference: serialized=True)
     experimental_options: dict = dataclasses.field(default_factory=dict)
@@ -347,6 +353,29 @@ class _GenInvoker(_Invoker):
 # --------------------------------------------------------------------------
 
 
+def split_priority(target: Callable, kwargs: dict) -> tuple[str | None, dict]:
+    """Pop the reserved ``priority=`` scheduling kwarg from a ``.remote``
+    call — UNLESS the user function declares its own ``priority`` parameter
+    (or ``**kwargs``), in which case the name belongs to the function and
+    scheduling falls back to the spec default."""
+    if "priority" not in kwargs:
+        return None, kwargs
+    try:
+        params = inspect.signature(target).parameters
+    except (TypeError, ValueError):
+        return None, kwargs
+    if "priority" in params or any(
+        p.kind is inspect.Parameter.VAR_KEYWORD for p in params.values()
+    ):
+        return None, kwargs
+    from ..scheduling.policy import validate_class
+
+    rest = dict(kwargs)
+    # a typo'd class must fail HERE at the call site, not silently degrade
+    # to default rank inside the pool
+    return validate_class(rest.pop("priority")), rest
+
+
 class Function:
     """A registered serverless function bound to an App."""
 
@@ -379,7 +408,10 @@ class Function:
         return current_run(self.app).pool_for(self.spec)
 
     def _submit(self, args, kwargs) -> _exec._Call:
-        return self._pool().submit("", args, kwargs)
+        # .remote(..., priority="interactive"): reserved scheduling kwarg
+        # (skipped when the user function declares its own `priority`)
+        priority, kwargs = split_priority(self.raw_f, kwargs)
+        return self._pool().submit("", args, kwargs, priority=priority)
 
     def _remote(self, *args, **kwargs):
         call = self._submit(args, kwargs)

@@ -86,6 +86,27 @@ HOST_RSS_BYTES = "mtpu_host_rss_bytes"
 #: action = scale_up | scale_down | kill
 SCALER_DECISIONS_TOTAL = "mtpu_scaler_decisions_total"
 
+# -- request scheduler (modal_examples_tpu/scheduling, PR 4) ----------------
+
+#: counter {class, reason}: requests shed by admission control;
+#: reason = queue_full | kv_pressure | too_large
+SHEDS_TOTAL = "mtpu_sheds_total"
+#: counter {class}: requests accepted by admission control
+REQUESTS_ADMITTED_TOTAL = "mtpu_requests_admitted_total"
+#: gauge {class}: requests queued per priority class (policy depth)
+SCHED_QUEUE_DEPTH = "mtpu_sched_queue_depth"
+#: histogram {class}: per-class submit -> prefill-admission wait
+SCHED_QUEUE_WAIT_SECONDS = "mtpu_sched_queue_wait_seconds"
+#: gauge: KV pages reserved by queued (not-yet-claimed) admissions
+KV_PAGES_RESERVED = "mtpu_kv_pages_reserved"
+#: counter {stage}: requests that blew their deadline;
+#: stage = queued (cancelled before a slot) | inflight (aborted mid-decode)
+DEADLINE_MISSES_TOTAL = "mtpu_deadline_misses_total"
+#: counter {route}: router placements; route = affinity | fallback
+ROUTER_REQUESTS_TOTAL = "mtpu_router_requests_total"
+#: counter: repeated shared-prefix prompts landed on their affinity replica
+ROUTER_AFFINITY_HITS_TOTAL = "mtpu_router_affinity_hits_total"
+
 # -- SLO engine (observability/slo.py) --------------------------------------
 
 #: gauge {slo}: observed/target burn rate per declared SLO (>1 = violating)
@@ -224,6 +245,40 @@ CATALOG: dict[str, dict] = {
         "type": "counter", "labels": ["function", "action"],
         "help": "autoscaler decisions journaled "
                 "(action=scale_up|scale_down|kill)",
+    },
+    SHEDS_TOTAL: {
+        "type": "counter", "labels": ["class", "reason"],
+        "help": "requests shed by admission control "
+                "(reason=queue_full|kv_pressure|too_large)",
+    },
+    REQUESTS_ADMITTED_TOTAL: {
+        "type": "counter", "labels": ["class"],
+        "help": "requests accepted by admission control",
+    },
+    SCHED_QUEUE_DEPTH: {
+        "type": "gauge", "labels": ["class"],
+        "help": "requests queued per priority class",
+    },
+    SCHED_QUEUE_WAIT_SECONDS: {
+        "type": "histogram", "labels": ["class"],
+        "help": "per-class request submit-to-admission wait",
+    },
+    KV_PAGES_RESERVED: {
+        "type": "gauge", "labels": [],
+        "help": "KV pages reserved by queued (not-yet-claimed) admissions",
+    },
+    DEADLINE_MISSES_TOTAL: {
+        "type": "counter", "labels": ["stage"],
+        "help": "requests past their deadline (stage=queued|inflight)",
+    },
+    ROUTER_REQUESTS_TOTAL: {
+        "type": "counter", "labels": ["route"],
+        "help": "router placements (route=affinity|fallback)",
+    },
+    ROUTER_AFFINITY_HITS_TOTAL: {
+        "type": "counter", "labels": [],
+        "help": "repeated shared-prefix prompts landed on their affinity "
+                "replica",
     },
     SLO_BURN_RATE: {
         "type": "gauge", "labels": ["slo"],

@@ -184,6 +184,83 @@ def record_token_totals(
         )
 
 
+# -- request scheduler (modal_examples_tpu/scheduling) -----------------------
+
+
+def record_shed(
+    klass: str, reason: str, *, registry: Registry | None = None
+) -> None:
+    _reg(registry).counter_inc(
+        C.SHEDS_TOTAL, 1.0,
+        labels={"class": klass, "reason": reason},
+        help=C.CATALOG[C.SHEDS_TOTAL]["help"],
+    )
+
+
+def record_admitted(klass: str, *, registry: Registry | None = None) -> None:
+    _reg(registry).counter_inc(
+        C.REQUESTS_ADMITTED_TOTAL, 1.0,
+        labels={"class": klass},
+        help=C.CATALOG[C.REQUESTS_ADMITTED_TOTAL]["help"],
+    )
+
+
+def set_sched_queue_depths(
+    depths: dict, *, registry: Registry | None = None
+) -> None:
+    reg = _reg(registry)
+    for klass, depth in depths.items():
+        reg.gauge_set(
+            C.SCHED_QUEUE_DEPTH, float(depth),
+            labels={"class": klass},
+            help=C.CATALOG[C.SCHED_QUEUE_DEPTH]["help"],
+        )
+
+
+def record_sched_queue_wait(
+    klass: str, seconds: float, *, registry: Registry | None = None
+) -> None:
+    _reg(registry).histogram_observe(
+        C.SCHED_QUEUE_WAIT_SECONDS, seconds,
+        labels={"class": klass},
+        help=C.CATALOG[C.SCHED_QUEUE_WAIT_SECONDS]["help"],
+    )
+
+
+def set_kv_pages_reserved(n: int, *, registry: Registry | None = None) -> None:
+    _reg(registry).gauge_set(
+        C.KV_PAGES_RESERVED, float(n),
+        help=C.CATALOG[C.KV_PAGES_RESERVED]["help"],
+    )
+
+
+def record_deadline_miss(
+    stage: str, *, registry: Registry | None = None
+) -> None:
+    _reg(registry).counter_inc(
+        C.DEADLINE_MISSES_TOTAL, 1.0,
+        labels={"stage": stage},
+        help=C.CATALOG[C.DEADLINE_MISSES_TOTAL]["help"],
+    )
+
+
+def record_router_route(
+    route: str, *, affinity_hit: bool = False,
+    registry: Registry | None = None,
+) -> None:
+    reg = _reg(registry)
+    reg.counter_inc(
+        C.ROUTER_REQUESTS_TOTAL, 1.0,
+        labels={"route": route},
+        help=C.CATALOG[C.ROUTER_REQUESTS_TOTAL]["help"],
+    )
+    if affinity_hit:
+        reg.counter_inc(
+            C.ROUTER_AFFINITY_HITS_TOTAL, 1.0,
+            help=C.CATALOG[C.ROUTER_AFFINITY_HITS_TOTAL]["help"],
+        )
+
+
 # -- resource occupancy ------------------------------------------------------
 
 

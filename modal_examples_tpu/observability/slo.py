@@ -104,6 +104,17 @@ DEFAULT_SLOS: tuple[SLO, ...] = (
         kind="ratio",
         env="MTPU_SLO_RETRY_RATE",
     ),
+    SLO(
+        # scheduling (PR 4): deadline-armed requests that blew their budget
+        # (queued-cancelled + inflight-aborted) over admitted load — the
+        # scheduler's own SLO: shedding and priority exist to keep this low
+        name="deadline_miss_rate",
+        series=C.DEADLINE_MISSES_TOTAL,
+        denom_series=C.REQUESTS_ADMITTED_TOTAL,
+        target=0.05,
+        kind="ratio",
+        env="MTPU_SLO_DEADLINE_MISS_RATE",
+    ),
 )
 
 

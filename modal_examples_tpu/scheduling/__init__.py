@@ -1,0 +1,50 @@
+"""Request scheduling: priority + fair-share admission control, deadline-
+aware shedding, and prefix-affinity multi-replica routing.
+
+The first subsystem where the framework makes load-dependent decisions on
+the serving path (ISSUE 4). Three layers, each usable on its own:
+
+- :mod:`.policy` — pluggable queue-ordering policies. ``SchedulerPolicy``
+  replaces the engine's FIFO pop: priority classes
+  (``interactive`` > ``default`` > ``batch``) with weighted fair-share
+  deficit scheduling across tenants within a class.
+- :mod:`.admission` — bounded per-class queues with cost-aware admission
+  (estimated KV pages vs. live occupancy), load shedding (HTTP 429 +
+  ``Retry-After`` at the API layers), and per-request deadlines.
+- :mod:`.router` — a multi-replica front that routes requests sharing a
+  prompt prefix to the same replica (so paged-KV prefix reuse actually
+  hits), with least-outstanding-work fallback and health/backpressure
+  awareness.
+
+The whole package is jax-free (like ``core/``): policies and admission run
+on the control path and must never pay a jax import or chip attach.
+"""
+
+from .admission import AdmissionConfig, AdmissionController, ShedError
+from .policy import (
+    CLASS_RANK,
+    DEFAULT_CLASS,
+    PRIORITY_CLASSES,
+    FairSharePolicy,
+    FIFOPolicy,
+    ScheduledRequest,
+    SchedulerPolicy,
+    validate_class,
+)
+from .router import EngineReplica, PrefixAffinityRouter
+
+__all__ = [
+    "AdmissionConfig",
+    "AdmissionController",
+    "CLASS_RANK",
+    "DEFAULT_CLASS",
+    "EngineReplica",
+    "FIFOPolicy",
+    "FairSharePolicy",
+    "PRIORITY_CLASSES",
+    "PrefixAffinityRouter",
+    "ScheduledRequest",
+    "SchedulerPolicy",
+    "ShedError",
+    "validate_class",
+]

@@ -1,0 +1,190 @@
+"""Prefix-affinity multi-replica routing.
+
+One ``LLMEngine`` per process is the deployed shape; serving heavy traffic
+means N replicas behind a front. A random/round-robin front wastes the
+paged-KV prefix cache: two requests sharing a system prompt land on
+different replicas and each pays the full prefill. This router keys every
+request by its **first prefix-cache block** (the first ``prefix_tokens``
+prompt tokens — the same page-aligned unit the :mod:`..serving.prefix_cache`
+trie shares) and sends equal keys to the same replica via rendezvous
+hashing, so prefix reuse actually hits (the Ragged Paged Attention paper's
+motivating layout: KV pages are only reusable on the replica that holds
+them).
+
+Fallbacks keep affinity from becoming a hotspot:
+
+- a **saturated** replica (outstanding work >= ``saturation_factor`` x its
+  slot capacity) diverts new prompts to the least-loaded healthy replica;
+- an **unhealthy** replica (scheduler stopped on error, or a custom health
+  probe) is skipped entirely.
+
+``mtpu_router_requests_total{route=affinity|fallback}`` counts placements;
+``mtpu_router_affinity_hits_total`` counts the wins that matter — a repeated
+key landing on the replica that already holds its prefix KV.
+
+Replicas are duck-typed (``name``/``encode``/``submit``/``stream``/
+``abort``/``outstanding``/``capacity``/``healthy``): :class:`EngineReplica`
+adapts an in-process ``LLMEngine``; the same protocol fronts remote
+replicas (e.g. an executor container pool proxying to a served engine) —
+anything that can estimate its outstanding work can sit behind the router.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import threading
+from collections import OrderedDict
+
+from ..observability import metrics as _obs
+
+
+class EngineReplica:
+    """Adapter: one in-process ``LLMEngine`` as a routable replica."""
+
+    def __init__(self, engine, name: str, *, saturation_factor: float = 2.0):
+        self.engine = engine
+        self.name = name
+        self.saturation_factor = float(saturation_factor)
+
+    def encode(self, prompt: str) -> list[int]:
+        return self.engine.tokenizer.encode(prompt)
+
+    def submit(self, prompt: str, params=None, image=None, **kw):
+        return self.engine.submit(prompt, params, image=image, **kw)
+
+    def stream(self, req):
+        return self.engine.stream(req)
+
+    def abort(self, req) -> None:
+        self.engine.abort(req)
+
+    def outstanding(self) -> int:
+        """Waiting + decoding requests (the router's load signal)."""
+        active = sum(1 for s in self.engine.slots if not s.free)
+        return self.engine.policy.total_depth() + active
+
+    def capacity(self) -> int:
+        return self.engine.max_slots
+
+    def healthy(self) -> bool:
+        return not self.engine._stopped_on_error
+
+    def saturated(self) -> bool:
+        return self.outstanding() >= self.saturation_factor * max(
+            1, self.capacity()
+        )
+
+
+class PrefixAffinityRouter:
+    """Route requests to replicas by shared-prefix affinity."""
+
+    #: remembered key -> replica-name placements (bounded LRU): an affinity
+    #: *hit* requires the key to have been routed there before — the first
+    #: occurrence builds the prefix KV, repeats reuse it
+    SEEN_KEYS_MAX = 4096
+
+    def __init__(
+        self,
+        replicas: list,
+        *,
+        prefix_tokens: int = 16,
+    ):
+        if not replicas:
+            raise ValueError("router needs at least one replica")
+        names = [r.name for r in replicas]
+        if len(set(names)) != len(names):
+            raise ValueError(f"replica names must be unique: {names}")
+        self.replicas = list(replicas)
+        self.prefix_tokens = max(1, int(prefix_tokens))
+        self._lock = threading.Lock()
+        self._seen: OrderedDict[bytes, str] = OrderedDict()
+        self.affinity_hits = 0
+        self.fallbacks = 0
+
+    # -- placement -----------------------------------------------------------
+
+    def _key(self, tokens: list[int]) -> bytes:
+        head = tokens[: self.prefix_tokens]
+        return hashlib.sha1(
+            b",".join(str(int(t)).encode() for t in head)
+        ).digest()
+
+    def _preferred(self, key: bytes):
+        """Rendezvous (highest-random-weight) hashing: stable per key, and
+        removing a replica only remaps that replica's keys."""
+        def score(replica) -> bytes:
+            return hashlib.sha1(key + replica.name.encode()).digest()
+
+        return max(self.replicas, key=score)
+
+    def route(self, prompt: str):
+        """Pick the replica for ``prompt``; records routing metrics."""
+        # tokenize only enough text to cover the key's token prefix (the
+        # engine re-encodes the full prompt at submit anyway — hashing the
+        # whole thing here would pay full tokenization twice per request)
+        head = prompt[: max(64, 8 * self.prefix_tokens)]
+        tokens = self.replicas[0].encode(head)
+        key = self._key(tokens)
+        preferred = self._preferred(key)
+        healthy = [r for r in self.replicas if r.healthy()]
+        if not healthy:
+            raise RuntimeError("no healthy replicas")
+        if preferred.healthy() and not preferred.saturated():
+            chosen, route = preferred, "affinity"
+        else:
+            chosen = min(healthy, key=lambda r: (r.outstanding(), r.name))
+            route = "fallback"
+        with self._lock:
+            hit = route == "affinity" and self._seen.get(key) == chosen.name
+            self._seen[key] = chosen.name
+            self._seen.move_to_end(key)
+            while len(self._seen) > self.SEEN_KEYS_MAX:
+                self._seen.popitem(last=False)
+            if hit:
+                self.affinity_hits += 1
+            if route == "fallback":
+                self.fallbacks += 1
+        _obs.record_router_route(route, affinity_hit=hit)
+        return chosen
+
+    # -- request lifecycle (delegates to the owning replica) -----------------
+
+    def submit(self, prompt: str, params=None, image=None, **kw):
+        replica = self.route(prompt)
+        req = replica.submit(prompt, params, image=image, **kw)
+        # ownership rides ON the request (not a router-side map that would
+        # grow one entry per request forever): the request's lifetime IS
+        # the mapping's lifetime
+        req._router_replica = replica
+        return req
+
+    def replica_for(self, req):
+        replica = getattr(req, "_router_replica", None)
+        if replica is None:
+            raise KeyError(f"request {req.request_id} not routed here")
+        return replica
+
+    def stream(self, req):
+        yield from self.replica_for(req).stream(req)
+
+    def abort(self, req) -> None:
+        self.replica_for(req).abort(req)
+
+    def stats(self) -> dict:
+        with self._lock:
+            hits, fallbacks, keys = (
+                self.affinity_hits, self.fallbacks, len(self._seen)
+            )
+        return {
+            "replicas": {
+                r.name: {
+                    "outstanding": r.outstanding(),
+                    "healthy": r.healthy(),
+                    "saturated": r.saturated(),
+                }
+                for r in self.replicas
+            },
+            "affinity_hits": hits,
+            "fallbacks": fallbacks,
+            "keys_tracked": keys,
+        }

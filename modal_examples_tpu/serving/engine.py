@@ -35,6 +35,14 @@ import numpy as np
 
 from ..models import llama
 from ..observability import metrics as _obs
+from ..scheduling.admission import AdmissionController
+from ..scheduling.policy import (
+    DEFAULT_CLASS,
+    FairSharePolicy,
+    ScheduledRequest,
+    SchedulerPolicy,
+    validate_class,
+)
 from ..utils.log import get_logger
 from .kv_cache import OutOfPages, PagedKVCache
 from .sampling import SamplingParams, sample
@@ -76,6 +84,15 @@ class Request:
     # multimodal requests key image positions by CONTENT-hash ids (outside
     # the vocab) so identical images share KV and different ones never do
     cache_key_tokens: list | None = None
+    # scheduling (modal_examples_tpu/scheduling): priority class + tenant
+    # drive the fair-share policy; deadline is ABSOLUTE in the engine's
+    # clock domain (params.deadline_s resolved at submit). deadline_expired
+    # marks an abort as a deadline miss so the stream finishes with
+    # finish_reason="deadline" instead of "stop".
+    priority: str = DEFAULT_CLASS
+    tenant: str = "default"
+    deadline: float | None = None
+    deadline_expired: bool = False
 
 
 @dataclasses.dataclass
@@ -110,6 +127,17 @@ class EngineStats:
 
     def acceptance_rate(self) -> float:
         return self.spec_accepted / self.spec_proposed if self.spec_proposed else 0.0
+
+
+def _unstable_tail(text: str) -> bool:
+    """True when the last char may still change as more tokens arrive: the
+    replacement char (HF tokenizers mid-codepoint) or a surrogate-escaped
+    byte (ByteTokenizer mid-codepoint) — either way, emitting it now would
+    stream a char that the next token's re-decode replaces."""
+    if not text:
+        return False
+    c = ord(text[-1])
+    return c == 0xFFFD or 0xDC80 <= c <= 0xDCFF
 
 
 def _stop_safe_len(text: str, stop: tuple[str, ...]) -> int:
@@ -227,6 +255,9 @@ class LLMEngine:
         mesh=None,  # jax Mesh with a "tensor" axis: tensor-parallel serving
         paged_impl: str | None = None,  # decode structure; None: env/default
         vision: tuple | None = None,  # (models.vlm.VLMConfig, vision_params)
+        policy: SchedulerPolicy | None = None,  # waiting-set ordering
+        admission: AdmissionController | None = None,  # shed/deadline gate
+        clock=None,  # injectable monotonic clock (fake-clock scheduling tests)
     ):
         import os as _os
 
@@ -378,7 +409,16 @@ class LLMEngine:
         self._prefill_mm_jits: dict[object, object] = {}
 
         self.slots = [_Slot() for _ in range(max_slots)]
-        self.waiting: queue.Queue[Request] = queue.Queue()
+        # scheduling: the waiting set is a pluggable SchedulerPolicy (PR 4;
+        # replaces the single unbounded FIFO queue) — priority classes +
+        # tenant fair share by default — gated by cost-aware admission
+        # control (bounded per-class queues, KV-pressure shedding,
+        # deadlines). A plain FIFO is one `policy=FIFOPolicy()` away.
+        self._clock = clock or time.monotonic
+        self.policy: SchedulerPolicy = policy or FairSharePolicy(
+            clock=self._clock
+        )
+        self.admission = admission or AdmissionController(clock=self._clock)
         self.stats = EngineStats()
         self.error_log: list[str] = []  # recent scheduler tracebacks
         self.error_count = 0  # monotonic (error_log is capped at 20)
@@ -890,8 +930,22 @@ class LLMEngine:
         prompt: str,
         params: SamplingParams | None = None,
         image=None,  # PIL image or [H, W, 3] array: multimodal request
+        *,
+        priority: str = DEFAULT_CLASS,
+        tenant: str = "default",
     ) -> Request:
-        req = Request(prompt=prompt, params=params or SamplingParams())
+        """Enqueue one request through admission control.
+
+        ``priority`` (interactive|default|batch) and ``tenant`` drive the
+        fair-share policy; ``params.deadline_s`` arms a deadline. Raises
+        :class:`~modal_examples_tpu.scheduling.admission.ShedError` when
+        admission rejects the request (servers surface it as HTTP 429)."""
+        req = Request(
+            prompt=prompt,
+            params=params or SamplingParams(),
+            priority=validate_class(priority),
+            tenant=tenant,
+        )
         self.validate_params(req.params)
         if req.params.seed is None:
             with self._lock:
@@ -937,7 +991,33 @@ class LLMEngine:
             req.prompt_tokens = self.tokenizer.encode(prompt)[
                 : self.max_model_len - 1
             ]
-        self.waiting.put(req)
+        now = self._clock()
+        if req.params.deadline_s is not None:
+            req.deadline = now + float(req.params.deadline_s)
+        max_total = min(
+            len(req.prompt_tokens) + req.params.max_tokens, self.max_model_len
+        )
+        entry = ScheduledRequest(
+            payload=req,
+            priority=req.priority,
+            tenant=req.tenant,
+            cost=self.cache.pages_for(max_total),
+            deadline=req.deadline,
+            enqueued_at=now,
+        )
+        occ = self.cache.occupancy()
+        # admit-then-enqueue (raises ShedError; reservation taken on admit):
+        # the depth read and the enqueue are not one atomic step, so bounds
+        # are approximate by up to the number of racing submitters — fine
+        # for overload control, which only needs to stop unbounded growth
+        self.admission.admit(
+            entry,
+            depths=self.policy.depths(),
+            pages_used=occ["pages_used"],
+            pages_total=occ["pages_total"],
+        )
+        req._sched_entry = entry
+        self.policy.submit(entry)
         return req
 
     def generate(self, prompt: str, params: SamplingParams | None = None) -> str:
@@ -1087,10 +1167,20 @@ class LLMEngine:
         return time.monotonic() - t0
 
     def abort(self, request: Request) -> None:
-        """Cancel a request: waiting ones are dropped at admission; active
-        ones finish at the next scheduler tick and free their slot/pages
-        (the engine-abort surface vLLM exposes for client disconnects)."""
+        """Cancel a request (the engine-abort surface vLLM exposes for
+        client disconnects). Queued (never-scheduled) ones are removed from
+        the policy HERE — releasing their admission page reservation and
+        per-class depth immediately, and finishing the caller's stream even
+        if the scheduler thread never runs. Active ones finish at the next
+        scheduler tick and free their slot/pages."""
         request.aborted = True
+        entry = getattr(request, "_sched_entry", None)
+        if entry is not None and self.policy.remove(entry):
+            # was still queued: nothing on a slot, nothing in flight —
+            # reservation back to the pool, caller released now
+            self.admission.release(entry)
+            _obs.set_sched_queue_depths(self.policy.depths())
+            request.out_queue.put(_FINISH)
 
     def start(self) -> "LLMEngine":
         with self._lock:
@@ -1160,20 +1250,42 @@ class LLMEngine:
                 slot.request.out_queue.put(marker)
                 self._release_slot_pages(slot)
                 slot.request = None
-        while True:
-            try:
-                req = self.waiting.get_nowait()
-            except queue.Empty:
-                break
-            req.out_queue.put(marker)
+        for entry in self.policy.drain():
+            self.admission.release(entry)
+            entry.payload.out_queue.put(marker)
 
     def step(self) -> bool:
-        """One scheduler tick: admit -> decode -> emit. Returns True if any
-        work happened."""
+        """One scheduler tick: expire deadlines -> admit -> decode -> emit.
+        Returns True if any work happened."""
+        self._expire_deadlines()
         admitted = self._admit()
         decoded = self._decode_tick()
         self._refresh_gauges()
         return admitted or decoded
+
+    def _expire_deadlines(self) -> None:
+        """Deadline enforcement, both stages: queued work past its deadline
+        is cancelled before ever taking a slot (its page reservation goes
+        back to the pool); in-flight work is aborted so the next decode
+        tick reaps the slot and frees its pages."""
+        now = self._clock()
+        for entry in self.policy.expired(now):
+            self.admission.release(entry)
+            req = entry.payload
+            req.deadline_expired = True
+            _obs.record_deadline_miss("queued")
+            req.out_queue.put(_Finish("deadline"))
+        for s in self.slots:
+            req = s.request
+            if (
+                req is not None
+                and req.deadline is not None
+                and not req.aborted
+                and now >= req.deadline
+            ):
+                req.deadline_expired = True
+                req.aborted = True  # reaped (pages freed) in _decode_tick
+                _obs.record_deadline_miss("inflight")
 
     def _refresh_gauges(self) -> None:
         """Engine-load gauges (queue depth, active slots, tokens/s), KV/
@@ -1184,11 +1296,13 @@ class LLMEngine:
         if now - self._metrics_wall < 0.25:
             return
         self._metrics_wall = now
+        depths = self.policy.depths()
         _obs.set_engine_gauges(
-            waiting=self.waiting.qsize(),
+            waiting=sum(depths.values()),
             active_slots=sum(1 for s in self.slots if not s.free),
             tokens_per_second=self.stats.tokens_per_second(),
         )
+        _obs.set_sched_queue_depths(depths)
         # occupancy via the cache helper: covers the native allocator, which
         # has no gauge hooks of its own (the python allocator's alloc/free
         # hooks write the same series — idempotent, last-writer-wins)
@@ -1220,33 +1334,43 @@ class LLMEngine:
         }
 
     def _admit(self) -> bool:
-        """Claim slots+pages for waiting requests, then prefill each bucket's
-        admissions as ONE batched jitted call (compile shapes: bucket x
-        pow2-padded batch — continuous batching on the prefill side too)."""
+        """Claim slots+pages for policy-selected requests, then prefill each
+        bucket's admissions as ONE batched jitted call (compile shapes:
+        bucket x pow2-padded batch — continuous batching on the prefill side
+        too). The pop order is the SchedulerPolicy's (priority classes +
+        tenant fair share by default), not submission order."""
         assignments: list[tuple[int, "Request", dict]] = []  # (slot, req, claim)
-        while True:
-            free_slot = next(
-                (
-                    i
-                    for i, s in enumerate(self.slots)
-                    if s.free and i not in {a[0] for a in assignments}
-                ),
-                None,
-            )
-            if free_slot is None or self.waiting.empty():
-                break
-            try:
-                req = self.waiting.get_nowait()
-            except queue.Empty:
-                break
+        free_slots = [i for i, s in enumerate(self.slots) if s.free]
+        entries = (
+            self.policy.next_batch(len(free_slots)) if free_slots else []
+        )
+        now = self._clock()
+        for pos, entry in enumerate(entries):
+            req: Request = entry.payload
+            # popped = the reservation converts into a real page claim (or
+            # is dropped with the request); either way it's off the books
+            self.admission.release(entry)
             if req.aborted:
-                req.out_queue.put(_FINISH)
+                req.out_queue.put(
+                    _Finish("deadline") if req.deadline_expired else _FINISH
+                )
                 continue
             claim = self._claim_pages(req)
             if claim is None:
-                self.waiting.put(req)  # no KV room: wait for a completion
+                # no KV room: preemption-safe requeue — this entry and every
+                # not-yet-examined one go back to the FRONT of their queues
+                # in original order (reservations re-taken), and admission
+                # waits for a completion to free pages
+                rest = entries[pos:]
+                # only THIS entry's reservation was released above; the
+                # not-yet-examined rest still hold theirs
+                self.admission.reserve(entry)
+                self.policy.requeue(rest)
                 break
-            assignments.append((free_slot, req, claim))
+            _obs.record_sched_queue_wait(
+                entry.priority, max(0.0, now - entry.enqueued_at)
+            )
+            assignments.append((free_slots[len(assignments)], req, claim))
 
         long_ones = [
             a for a in assignments
@@ -1536,10 +1660,15 @@ class LLMEngine:
             self._accept_token(slot_idx, slot.last_token)
 
     def _decode_tick(self) -> bool:
-        # reap aborted slots before spending a step on them
+        # reap aborted slots before spending a step on them (deadline-
+        # expired aborts finish with their own reason, not a fake "stop")
         for i, s in enumerate(self.slots):
             if not s.free and s.request.aborted:
-                s.request.out_queue.put(_FINISH)
+                s.request.out_queue.put(
+                    _Finish("deadline")
+                    if s.request.deadline_expired
+                    else _FINISH
+                )
                 self._release_slot_pages(s)
                 s.request = None
                 self._active[i] = False
@@ -1738,7 +1867,7 @@ class LLMEngine:
             else _stop_safe_len(text, req.params.stop)
         )
         new = text[slot.emitted_text_len : safe_len]
-        if new and (finished or not new.endswith("�")):
+        if new and (finished or not _unstable_tail(new)):
             req.out_queue.put(new)
             slot.emitted_text_len = slot.emitted_text_len + len(new)
         if finished:

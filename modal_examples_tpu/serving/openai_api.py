@@ -16,7 +16,10 @@ import time
 import uuid
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 
+import math
+
 from ..observability import catalog as _C
+from ..scheduling.admission import ShedError
 from ..utils.prometheus import default_registry
 from .engine import LLMEngine
 from .sampling import SamplingParams
@@ -75,7 +78,13 @@ def _extract_images(messages: list) -> tuple[list, object]:
     return flat, image
 
 
-def _params_from_body(body: dict) -> SamplingParams:
+def _params_from_body(body: dict, headers=None) -> SamplingParams:
+    # per-request deadline: the x-mtpu-deadline-ms header wins over a
+    # deadline_ms body field (headers let proxies inject budgets without
+    # rewriting payloads)
+    deadline_ms = body.get("deadline_ms")
+    if headers is not None and headers.get("x-mtpu-deadline-ms"):
+        deadline_ms = headers.get("x-mtpu-deadline-ms")
     return SamplingParams(
         temperature=float(body.get("temperature", 1.0)),
         top_p=float(body.get("top_p", 1.0)),
@@ -86,7 +95,27 @@ def _params_from_body(body: dict) -> SamplingParams:
             else body.get("stop") or []
         ),
         seed=int(body["seed"]) if body.get("seed") is not None else None,
+        deadline_s=(
+            float(deadline_ms) / 1000.0 if deadline_ms is not None else None
+        ),
     )
+
+
+def _sched_kwargs(body: dict, headers) -> dict:
+    """Scheduling identity for one request: priority class from the
+    x-mtpu-priority header (or a "priority" body field), tenant from
+    x-mtpu-tenant (or OpenAI's own "user" field — the natural tenant key)."""
+    from ..scheduling.policy import validate_class
+
+    priority = body.get("priority") or "default"
+    tenant = body.get("user") or "default"
+    if headers is not None:
+        priority = headers.get("x-mtpu-priority") or priority
+        tenant = headers.get("x-mtpu-tenant") or tenant
+    return {
+        "priority": validate_class(str(priority)),  # typo'd class -> 400
+        "tenant": str(tenant),
+    }
 
 
 class _Handler(BaseHTTPRequestHandler):
@@ -95,13 +124,29 @@ class _Handler(BaseHTTPRequestHandler):
     def log_message(self, fmt, *args):
         pass
 
-    def _json(self, code: int, obj) -> None:
+    def _json(self, code: int, obj, extra_headers: dict | None = None) -> None:
         data = json.dumps(obj).encode()
         self.send_response(code)
         self.send_header("content-type", "application/json")
         self.send_header("content-length", str(len(data)))
+        for k, v in (extra_headers or {}).items():
+            self.send_header(k, v)
         self.end_headers()
         self.wfile.write(data)
+
+    def _shed_response(self, e: ShedError) -> None:
+        """Admission rejected the request: 429 + Retry-After (the OpenAI
+        rate_limit_error shape) — overload is a fast honest no, not an
+        unbounded queue."""
+        self._json(
+            429,
+            {"error": {
+                "message": str(e),
+                "type": "rate_limit_error",
+                "code": e.reason,
+            }},
+            extra_headers={"retry-after": str(math.ceil(e.retry_after_s))},
+        )
 
     def do_GET(self):
         srv = self.server_ref
@@ -141,7 +186,7 @@ class _Handler(BaseHTTPRequestHandler):
                 (_C.DECODE_STEPS_TOTAL, f"{s.steps}"),
                 (_C.TOKENS_PER_SECOND, f"{s.tokens_per_second():.3f}"),
                 (_C.ACTIVE_SLOTS, f"{active}"),
-                (_C.WAITING_REQUESTS, f"{eng.waiting.qsize()}"),
+                (_C.WAITING_REQUESTS, f"{eng.policy.total_depth()}"),
                 (_C.KV_PAGES_FREE, f"{occ['pages_free']}"),
                 (_C.KV_PAGES_USED, f"{occ['pages_used']}"),
                 (_C.KV_PAGE_OCCUPANCY, f"{occ['occupancy']:.4f}"),
@@ -208,7 +253,8 @@ class _Handler(BaseHTTPRequestHandler):
                     "this model does not accept images (engine has no "
                     "vision tower)"
                 )
-            params = _params_from_body(body)
+            params = _params_from_body(body, self.headers)
+            sched = _sched_kwargs(body, self.headers)
             srv.engine.validate_params(params)
         except ValueError as e:
             self._json(400, {"error": {
@@ -238,17 +284,28 @@ class _Handler(BaseHTTPRequestHandler):
             # would be identical.
             import dataclasses as _dc
 
-            reqs = [
-                srv.engine.submit(
-                    prompt,
-                    _dc.replace(params, seed=params.seed + i)
-                    if params.seed is not None
-                    else params,
-                    image=image,
-                )
-                for i in range(n)
-            ]
-            texts = ["".join(srv.engine.stream(r)) for r in reqs]
+            pairs = []
+            try:
+                for i in range(n):
+                    pairs.append(srv.submit(
+                        prompt,
+                        _dc.replace(params, seed=params.seed + i)
+                        if params.seed is not None
+                        else params,
+                        image=image,
+                        **sched,
+                    ))
+            except ShedError as e:
+                # partial fan-out shed: cancel the admitted siblings (their
+                # slots go back to the pool) and reject the whole call
+                for r, eng in pairs:
+                    eng.abort(r)
+                    for _ in eng.stream(r):
+                        pass
+                self._shed_response(e)
+                return
+            reqs = [r for r, _eng in pairs]
+            texts = ["".join(eng.stream(r)) for r, eng in pairs]
             if any(r.finish_reason == "error" for r in reqs):
                 self._json(500, {"error": {
                     "message": "engine error while processing the request",
@@ -287,7 +344,11 @@ class _Handler(BaseHTTPRequestHandler):
         include_usage = bool(
             (body.get("stream_options") or {}).get("include_usage")
         )
-        req = srv.engine.submit(prompt, params, image=image)
+        try:
+            req, eng = srv.submit(prompt, params, image=image, **sched)
+        except ShedError as e:
+            self._shed_response(e)
+            return
         if stream:
             self.send_response(200)
             self.send_header("content-type", "text/event-stream")
@@ -317,7 +378,7 @@ class _Handler(BaseHTTPRequestHandler):
                 })
 
             try:
-                for piece in srv.engine.stream(req):
+                for piece in eng.stream(req):
                     delta = (
                         {"delta": {"content": piece}} if chat else {"text": piece}
                     )
@@ -358,12 +419,12 @@ class _Handler(BaseHTTPRequestHandler):
                 # chunk/[DONE] writes arrives after the terminal marker was
                 # already consumed, and draining then would block forever.
                 if req.finish_reason is None:
-                    srv.engine.abort(req)
-                    for _ in srv.engine.stream(req):  # drain until _FINISH
+                    eng.abort(req)
+                    for _ in eng.stream(req):  # drain until _FINISH
                         pass
             return
 
-        text = "".join(srv.engine.stream(req))
+        text = "".join(eng.stream(req))
         if req.finish_reason == "error":
             # engine-side prefill/decode failure: a 5xx, not a fake success
             # with a non-OpenAI finish_reason
@@ -400,28 +461,60 @@ class _Handler(BaseHTTPRequestHandler):
 
 
 class OpenAIServer:
-    """HTTP front end; start() binds and serves in a background thread."""
+    """HTTP front end; start() binds and serves in a background thread.
 
-    def __init__(self, engine: LLMEngine, model_name: str = "mtpu-llm",
-                 host: str = "0.0.0.0", port: int = 8000):
-        self.engine = engine
+    Fronts either ONE engine (``engine=``, the per-process deployed shape)
+    or N replicas behind a ``PrefixAffinityRouter`` (``router=``): with a
+    router, every submit routes by shared-prefix affinity and streams from
+    the replica that owns the request. ``self.engine`` stays the primary
+    replica's engine (tokenizer, /metrics, validate_params — replicas serve
+    one model, so any replica answers those)."""
+
+    def __init__(self, engine: LLMEngine | None = None,
+                 model_name: str = "mtpu-llm",
+                 host: str = "0.0.0.0", port: int = 8000, *, router=None):
+        if (engine is None) == (router is None):
+            raise ValueError("pass exactly one of engine= or router=")
+        self.router = router
+        self.engine = engine if engine is not None else (
+            router.replicas[0].engine
+        )
         self.model_name = model_name
         handler = type("BoundHandler", (_Handler,), {"server_ref": self})
         self.httpd = ThreadingHTTPServer((host, port), handler)
         self.host, self.port = self.httpd.server_address[:2]
         self._thread: threading.Thread | None = None
 
+    def submit(self, prompt, params, image=None, **sched):
+        """Place one request; returns (request, owning engine). Raises
+        ShedError when the target engine's admission rejects it."""
+        if self.router is not None:
+            req = self.router.submit(prompt, params, image=image, **sched)
+            return req, self.router.replica_for(req).engine
+        return (
+            self.engine.submit(prompt, params, image=image, **sched),
+            self.engine,
+        )
+
+    def _engines(self):
+        if self.router is not None:
+            return [r.engine for r in self.router.replicas]
+        return [self.engine]
+
     def start(self) -> "OpenAIServer":
-        self.engine.start()
+        for eng in self._engines():
+            eng.start()
         self._thread = threading.Thread(target=self.httpd.serve_forever, daemon=True)
         self._thread.start()
         return self
 
     def serve_forever(self) -> None:
-        self.engine.start()
+        for eng in self._engines():
+            eng.start()
         self.httpd.serve_forever()
 
     def stop(self) -> None:
         self.httpd.shutdown()
         self.httpd.server_close()
-        self.engine.stop()
+        for eng in self._engines():
+            eng.stop()

@@ -20,6 +20,10 @@ class SamplingParams:
     max_tokens: int = 128
     stop: tuple[str, ...] = ()
     seed: int | None = None  # per-request determinism (OpenAI `seed`)
+    #: relative deadline in seconds from submit (``x-mtpu-deadline-ms`` over
+    #: HTTP). Past it, queued requests are cancelled and in-flight decodes
+    #: aborted with finish_reason="deadline" (scheduling/admission.py).
+    deadline_s: float | None = None
 
 
 def sample(

@@ -248,6 +248,21 @@ class Registry:
                 lines.append(f"{name}_count{_label_str(labels)} {h['count']}")
             return "\n".join(lines) + "\n"
 
+    def series(self, name: str) -> list[tuple[dict, float]]:
+        """Every label set recorded for ``name`` with its value (counters/
+        gauges: the value; histograms: the observation count) — lets the CLI
+        enumerate e.g. shed reasons without parsing the exposition."""
+        out: list[tuple[dict, float]] = []
+        with self._lock:
+            for store in (self._counters, self._gauges):
+                for (n, lbls), v in store.items():
+                    if n == name:
+                        out.append((dict(lbls), v))
+            for (n, lbls), h in self._histograms.items():
+                if n == name:
+                    out.append((dict(lbls), float(h["count"])))
+        return out
+
     def value(self, name: str, labels: dict | None = None) -> float:
         """Current value of one series; 0.0 when never written. Counters and
         gauges return their value, histograms their observation count. Lets

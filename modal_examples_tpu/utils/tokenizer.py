@@ -11,7 +11,17 @@ from __future__ import annotations
 
 
 class ByteTokenizer:
-    """Reversible byte-level tokenizer: vocab = 256 bytes + BOS/EOS/PAD."""
+    """Reversible byte-level tokenizer: vocab = 256 bytes + BOS/EOS/PAD.
+
+    Reversible for real: decode/encode use ``surrogateescape``, so a byte
+    sequence that isn't valid UTF-8 round-trips exactly instead of turning
+    into U+FFFD replacement chars — which re-encode to THREE bytes each and
+    made decode->re-encode length-unstable (a 5-token generation could
+    re-encode to 7 "tokens", tripping every max_tokens accounting built on
+    the round trip). Lone surrogates are ordinary str content to Python
+    (json.dumps escapes them losslessly); the engine's stream hold-back
+    treats a trailing surrogate like a trailing partial codepoint.
+    """
 
     def __init__(self):
         self.bos_id = 256
@@ -20,12 +30,12 @@ class ByteTokenizer:
         self.vocab_size = 259
 
     def encode(self, text: str, add_bos: bool = True) -> list[int]:
-        ids = list(text.encode("utf-8", errors="replace"))
+        ids = list(text.encode("utf-8", errors="surrogateescape"))
         return ([self.bos_id] + ids) if add_bos else ids
 
     def decode(self, ids: list[int]) -> str:
         data = bytes(i for i in ids if i < 256)
-        return data.decode("utf-8", errors="replace")
+        return data.decode("utf-8", errors="surrogateescape")
 
     def encode_batch(self, texts: list[str], max_len: int, add_bos: bool = True):
         """Batched encode -> padded (ids, mask) int32 matrices in one native

@@ -20,6 +20,7 @@ import urllib.parse
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 
 from . import registry
+from ..scheduling.admission import ShedError as _ShedError
 from ..utils.log import get_logger
 
 _log = get_logger("gateway")
@@ -163,11 +164,15 @@ class _Handler(BaseHTTPRequestHandler):
         )
         self._send_payload(status["status"], status.get("headers", []), payload)
 
-    def _respond_json(self, code: int, obj) -> None:
+    def _respond_json(
+        self, code: int, obj, extra_headers: dict | None = None
+    ) -> None:
         body = json.dumps(obj).encode()
         self.send_response(code)
         self.send_header("content-type", "application/json")
         self.send_header("content-length", str(len(body)))
+        for k, v in (extra_headers or {}).items():
+            self.send_header(k, v)
         self.end_headers()
         self.wfile.write(body)
 
@@ -314,12 +319,15 @@ class _Handler(BaseHTTPRequestHandler):
         headers_sent = False
         try:
             if fn.spec.is_generator:
+                # submit BEFORE the SSE headers: a shed (bounded queue)
+                # must still be able to answer 429
+                gen = fn.remote_gen(**kwargs)
                 self.send_response(200)
                 self.send_header("content-type", "text/event-stream")
                 self.send_header("cache-control", "no-cache")
                 self.end_headers()
                 headers_sent = True
-                for item in fn.remote_gen(**kwargs):
+                for item in gen:
                     data = item if isinstance(item, str) else json.dumps(item)
                     self.wfile.write(f"data: {data}\n\n".encode())
                     self.wfile.flush()
@@ -336,6 +344,22 @@ class _Handler(BaseHTTPRequestHandler):
                 self._respond_json(200, result)
         except BrokenPipeError:
             pass
+        except _ShedError as e:
+            # bounded pool queue (max_pending_inputs=) rejected the input:
+            # overload surfaces as a fast 429 + Retry-After, the same
+            # contract the OpenAI layer keeps — never unbounded queueing
+            if headers_sent:
+                self.close_connection = True
+            else:
+                import math
+
+                self._respond_json(
+                    429,
+                    {"error": str(e), "reason": e.reason},
+                    extra_headers={
+                        "retry-after": str(math.ceil(e.retry_after_s))
+                    },
+                )
         except BaseException as e:
             if headers_sent:
                 # Response already started: a second status line would corrupt

@@ -44,6 +44,16 @@ def test_bench_emits_schema_json():
         assert {"p50", "p95", "count"} <= set(tl[key]), tl
         assert tl[key]["p50"] <= tl[key]["p95"]
         assert tl[key]["count"] >= 1
+    # scheduling telemetry (ISSUE-4): per-class admission queue-wait
+    # quantiles + shed rate ride in every BENCH json
+    sched = payload.get("scheduling")
+    assert sched, payload
+    assert {"queue_wait", "shed_rate", "sheds_total"} <= set(sched), sched
+    dq = sched["queue_wait"].get("default")  # bench traffic is default-class
+    assert dq and {"p50", "p95", "count"} <= set(dq), sched
+    assert dq["p50"] <= dq["p95"]
+    assert 0.0 <= sched["shed_rate"] <= 1.0
+    assert sched["shed_rate"] == 0.0  # bench must never overload itself
     assert payload["tokens_per_second"] == payload["value"]
 
 

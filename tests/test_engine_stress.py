@@ -128,3 +128,53 @@ class TestConcurrentLoadDeterminism:
             if quiet[k] != loaded.get(k)
         }
         assert engine.error_count == 0, engine.error_log
+
+
+class TestPriorityInversion:
+    def test_interactive_queue_wait_bounded_under_batch_flood(self, engine):
+        """A flood of `batch` requests plus a trickle of `interactive` ones:
+        under the fair-share policy the interactive trickle must jump the
+        batch backlog — its p95 queue wait (submit -> first token) stays
+        bounded and strictly below the flood's, and every interactive
+        request starts before the flood finishes draining."""
+        from modal_examples_tpu.serving import SamplingParams
+
+        flood = [
+            engine.submit(
+                f"bulk work item {i}",
+                SamplingParams(max_tokens=24, temperature=1.0),
+                priority="batch",
+                tenant="bulk-job",
+            )
+            for i in range(24)
+        ]
+        # interactive trickle lands while the flood is still queued (24
+        # batch items over 4 slots take many decode waves to drain)
+        trickle = [
+            engine.submit(
+                f"chat {i}",
+                SamplingParams(max_tokens=4, temperature=0.0),
+                priority="interactive",
+                tenant="chat-user",
+            )
+            for i in range(6)
+        ]
+        engine.start()
+        for r in trickle + flood:
+            "".join(engine.stream(r))
+            assert r.finish_reason not in (None, "error")
+
+        def waits(reqs):
+            return sorted(r.first_token_at - r.created for r in reqs)
+
+        def p95(xs):
+            return xs[min(len(xs) - 1, int(0.95 * len(xs)))]
+
+        chat_waits, bulk_waits = waits(trickle), waits(flood)
+        # the flood saturates 4 slots for many blocks; interactive work must
+        # not queue behind the whole backlog
+        assert p95(chat_waits) < p95(bulk_waits), (chat_waits, bulk_waits)
+        # every interactive request started before the flood fully drained
+        last_bulk_start = max(r.first_token_at for r in flood)
+        assert all(r.first_token_at <= last_bulk_start for r in trickle)
+        assert engine.error_count == 0, engine.error_log

@@ -1,0 +1,522 @@
+"""Scheduling subsystem tests: policies (priority + tenant fair share),
+admission control (bounds, KV pressure, shedding, deadlines — fake clock,
+fully deterministic), the engine integration, the OpenAI 429 surface, and
+prefix-affinity multi-replica routing."""
+
+import json
+import urllib.error
+import urllib.request
+
+import pytest
+
+from modal_examples_tpu.scheduling import (
+    AdmissionConfig,
+    AdmissionController,
+    FairSharePolicy,
+    FIFOPolicy,
+    ScheduledRequest,
+    ShedError,
+)
+
+
+class FakeClock:
+    def __init__(self, t: float = 0.0):
+        self.t = t
+
+    def __call__(self) -> float:
+        return self.t
+
+    def advance(self, dt: float) -> None:
+        self.t += dt
+
+
+def _entry(payload=None, priority="default", tenant="default", cost=1,
+           deadline=None):
+    return ScheduledRequest(
+        payload=payload, priority=priority, tenant=tenant, cost=cost,
+        deadline=deadline,
+    )
+
+
+class TestFairSharePolicy:
+    def test_strict_class_priority(self):
+        p = FairSharePolicy(clock=FakeClock())
+        for i in range(3):
+            p.submit(_entry(payload=f"b{i}", priority="batch"))
+        p.submit(_entry(payload="d0", priority="default"))
+        p.submit(_entry(payload="i0", priority="interactive"))
+        out = [e.payload for e in p.next_batch(3)]
+        # interactive first, then default, then batch fills the rest
+        assert out == ["i0", "d0", "b0"]
+        assert [e.payload for e in p.next_batch(10)] == ["b1", "b2"]
+
+    def test_tenant_fair_share_interleaves_a_flood(self):
+        p = FairSharePolicy(clock=FakeClock(), quantum=1)
+        for i in range(8):
+            p.submit(_entry(payload=f"flood{i}", tenant="flooder"))
+        p.submit(_entry(payload="t0", tenant="trickle"))
+        p.submit(_entry(payload="t1", tenant="trickle"))
+        out = [e.payload for e in p.next_batch(4)]
+        # DRR with equal weights: the trickle tenant is served alongside the
+        # flood, not behind all 8 of its requests
+        assert "t0" in out and "t1" in out, out
+
+    def test_tenant_weights_skew_service(self):
+        p = FairSharePolicy(
+            clock=FakeClock(), quantum=1, tenant_weights={"heavy": 3.0}
+        )
+        for i in range(6):
+            p.submit(_entry(payload=("heavy", i), tenant="heavy"))
+            p.submit(_entry(payload=("light", i), tenant="light"))
+        out = p.next_batch(8)
+        heavy = sum(1 for e in out if e.payload[0] == "heavy")
+        assert heavy > 8 - heavy  # weighted tenant gets the larger share
+
+    def test_requeue_goes_back_to_the_front_in_order(self):
+        p = FairSharePolicy(clock=FakeClock())
+        for name in ("a", "b", "c"):
+            p.submit(_entry(payload=name))
+        batch = p.next_batch(2)
+        assert [e.payload for e in batch] == ["a", "b"]
+        p.requeue(batch)
+        assert [e.payload for e in p.next_batch(3)] == ["a", "b", "c"]
+
+    def test_expired_removes_past_deadline_entries(self):
+        clock = FakeClock()
+        p = FairSharePolicy(clock=clock)
+        p.submit(_entry(payload="no-deadline"))
+        p.submit(_entry(payload="soon", deadline=1.0))
+        p.submit(_entry(payload="later", deadline=10.0))
+        assert p.expired() == []
+        clock.advance(5.0)
+        dead = [e.payload for e in p.expired()]
+        assert dead == ["soon"]
+        assert p.total_depth() == 2
+
+    def test_remove_queued_entry(self):
+        p = FairSharePolicy(clock=FakeClock())
+        e = _entry(payload="x", priority="interactive", tenant="t")
+        p.submit(e)
+        assert p.depths()["interactive"] == 1
+        assert p.remove(e) is True
+        assert p.remove(e) is False  # already gone
+        assert p.total_depth() == 0
+
+
+class TestFIFOPolicy:
+    def test_fifo_ignores_class_for_ordering(self):
+        p = FIFOPolicy(clock=FakeClock())
+        p.submit(_entry(payload="b", priority="batch"))
+        p.submit(_entry(payload="i", priority="interactive"))
+        assert [e.payload for e in p.next_batch(2)] == ["b", "i"]
+
+    def test_depths_and_expiry(self):
+        clock = FakeClock()
+        p = FIFOPolicy(clock=clock)
+        p.submit(_entry(payload="x", priority="batch", deadline=1.0))
+        assert p.depths()["batch"] == 1
+        clock.advance(2.0)
+        assert [e.payload for e in p.expired()] == ["x"]
+
+
+class TestAdmission:
+    def _ctl(self, **cfg_kw):
+        return AdmissionController(AdmissionConfig(**cfg_kw), clock=FakeClock())
+
+    def test_queue_full_sheds_with_retry_after(self):
+        ctl = self._ctl(max_queue={"interactive": 8, "default": 2, "batch": 8})
+        with pytest.raises(ShedError) as exc:
+            ctl.admit(
+                _entry(), depths={"default": 2}, pages_used=0, pages_total=64
+            )
+        assert exc.value.reason == "queue_full"
+        assert exc.value.retry_after_s >= 1.0
+        assert ctl.sheds == 1 and ctl.admitted == 0
+
+    def test_too_large_sheds(self):
+        ctl = self._ctl()
+        with pytest.raises(ShedError) as exc:
+            ctl.admit(
+                _entry(cost=100), depths={}, pages_used=0, pages_total=64
+            )
+        assert exc.value.reason == "too_large"
+
+    def test_kv_pressure_sheds_batch_before_interactive(self):
+        ctl = self._ctl(kv_ceiling={"batch": 0.5, "default": 0.8})
+        # occupancy 40/64 = 0.625: batch (ceiling .5) sheds, default (.8)
+        # and interactive (no ceiling) admit
+        with pytest.raises(ShedError) as exc:
+            ctl.admit(
+                _entry(priority="batch"), depths={},
+                pages_used=40, pages_total=64,
+            )
+        assert exc.value.reason == "kv_pressure"
+        ctl.admit(_entry(), depths={}, pages_used=40, pages_total=64)
+        ctl.admit(
+            _entry(priority="interactive"), depths={},
+            pages_used=40, pages_total=64,
+        )
+        assert ctl.admitted == 2
+
+    def test_reservations_count_toward_pressure(self):
+        ctl = self._ctl(kv_ceiling={"batch": 0.5})
+        e1 = _entry(priority="batch", cost=20)
+        ctl.admit(e1, depths={}, pages_used=0, pages_total=64)
+        assert ctl.reserved_pages == 20
+        # 20 reserved + 20 more = 0.625 > 0.5 -> shed
+        with pytest.raises(ShedError):
+            ctl.admit(
+                _entry(priority="batch", cost=20), depths={},
+                pages_used=0, pages_total=64,
+            )
+        ctl.release(e1)
+        assert ctl.reserved_pages == 0
+        ctl.admit(
+            _entry(priority="batch", cost=20), depths={},
+            pages_used=0, pages_total=64,
+        )
+
+    def test_shed_metrics_recorded(self):
+        from modal_examples_tpu.observability import catalog as C
+        from modal_examples_tpu.utils.prometheus import default_registry
+
+        before = default_registry.value(
+            C.SHEDS_TOTAL, {"class": "batch", "reason": "queue_full"}
+        )
+        ctl = self._ctl(max_queue={"interactive": 1, "default": 1, "batch": 0})
+        with pytest.raises(ShedError):
+            ctl.admit(
+                _entry(priority="batch"), depths={}, pages_used=0,
+                pages_total=8,
+            )
+        after = default_registry.value(
+            C.SHEDS_TOTAL, {"class": "batch", "reason": "queue_full"}
+        )
+        assert after == before + 1
+        assert ctl.shed_rate() == 1.0
+
+
+@pytest.fixture(scope="module")
+def jax(jax_cpu):
+    return jax_cpu
+
+
+def _tiny_engine(jax, seed=0, **kw):
+    from modal_examples_tpu.models import llama
+    from modal_examples_tpu.serving import LLMEngine
+
+    return LLMEngine(
+        llama.LlamaConfig.tiny(), max_slots=2, max_model_len=64,
+        page_size=16, prefill_buckets=(32,), seed=seed, **kw,
+    )
+
+
+class TestEngineScheduling:
+    def test_queued_deadline_expires_with_fake_clock(self, jax):
+        """Fully deterministic: the engine's scheduler thread never runs —
+        the test drives step() by hand against a fake clock."""
+        from modal_examples_tpu.observability import catalog as C
+        from modal_examples_tpu.serving import SamplingParams
+        from modal_examples_tpu.utils.prometheus import default_registry
+
+        clock = FakeClock()
+        eng = _tiny_engine(jax, seed=5, clock=clock)
+        try:
+            # fill both slots so the deadline-armed request stays queued
+            hogs = [
+                eng.submit("hog", SamplingParams(max_tokens=32))
+                for _ in range(2)
+            ]
+            doomed = eng.submit(
+                "doomed", SamplingParams(max_tokens=4, deadline_s=1.0)
+            )
+            misses_before = default_registry.value(
+                C.DEADLINE_MISSES_TOTAL, {"stage": "queued"}
+            )
+            eng.step()  # hogs take the slots; doomed stays queued
+            assert eng.policy.total_depth() == 1
+            clock.advance(2.0)  # past the deadline
+            eng.step()
+            assert eng.policy.total_depth() == 0
+            assert eng.admission.reserved_pages == 0
+            # the caller's stream terminates with the deadline reason
+            item = doomed.out_queue.get(timeout=1)
+            assert getattr(item, "reason", None) == "deadline"
+            assert default_registry.value(
+                C.DEADLINE_MISSES_TOTAL, {"stage": "queued"}
+            ) == misses_before + 1
+            for r in hogs:
+                eng.abort(r)
+        finally:
+            eng.stop()
+
+    def test_interactive_admitted_before_queued_batch(self, jax):
+        from modal_examples_tpu.serving import SamplingParams
+
+        eng = _tiny_engine(jax, seed=6)
+        try:
+            batch = [
+                eng.submit(
+                    f"bulk {i}", SamplingParams(max_tokens=8),
+                    priority="batch",
+                )
+                for i in range(4)
+            ]
+            chat = eng.submit(
+                "chat", SamplingParams(max_tokens=2), priority="interactive"
+            )
+            eng.step()  # one admission pass, 2 slots
+            admitted = {
+                s.request.request_id for s in eng.slots if not s.free
+            }
+            assert chat.request_id in admitted, (
+                "interactive request must take a slot before queued batch work"
+            )
+            for r in batch:
+                eng.abort(r)
+            eng.abort(chat)
+        finally:
+            eng.stop()
+
+    def test_inflight_deadline_aborts_decode(self, jax):
+        from modal_examples_tpu.serving import SamplingParams
+
+        clock = FakeClock()
+        eng = _tiny_engine(jax, seed=7, clock=clock)
+        try:
+            req = eng.submit(
+                "never ends",
+                SamplingParams(max_tokens=10_000, deadline_s=5.0),
+            )
+            eng.step()  # admitted into a slot
+            assert any(not s.free for s in eng.slots)
+            clock.advance(10.0)
+            for _ in range(4):  # expire + reap happen on later ticks
+                eng.step()
+                if all(s.free for s in eng.slots):
+                    break
+            assert all(s.free for s in eng.slots)
+            item = req.out_queue.get(timeout=1)
+            while not hasattr(item, "reason"):
+                item = req.out_queue.get(timeout=1)  # drain partial text
+            assert item.reason == "deadline"
+        finally:
+            eng.stop()
+
+
+class TestOverloadSheds429:
+    """The acceptance scenario: under a synthetic overload (queue bound
+    exceeded) the OpenAI endpoint answers 429 + Retry-After and
+    mtpu_sheds_total increments, while admitted interactive requests
+    complete within their deadline."""
+
+    @pytest.fixture(scope="class")
+    def server(self, jax):
+        from modal_examples_tpu.serving import OpenAIServer
+
+        eng = _tiny_engine(
+            jax, seed=8,
+            admission=AdmissionController(
+                # batch is always over its (zero) bound -> deterministic
+                # queue_full shedding; interactive/default admit freely
+                AdmissionConfig(
+                    max_queue={"interactive": 64, "default": 64, "batch": 0}
+                )
+            ),
+        )
+        srv = OpenAIServer(eng, model_name="sched-test", host="127.0.0.1", port=0)
+        srv.start()
+        yield srv
+        srv.stop()
+
+    def _post(self, server, body, headers=None):
+        req = urllib.request.Request(
+            f"http://127.0.0.1:{server.port}/v1/chat/completions",
+            data=json.dumps(body).encode(),
+            headers={"content-type": "application/json", **(headers or {})},
+        )
+        return urllib.request.urlopen(req)
+
+    def test_overload_returns_429_with_retry_after(self, server):
+        from modal_examples_tpu.observability import catalog as C
+        from modal_examples_tpu.utils.prometheus import default_registry
+
+        sheds_before = default_registry.value(
+            C.SHEDS_TOTAL, {"class": "batch", "reason": "queue_full"}
+        )
+        with pytest.raises(urllib.error.HTTPError) as exc:
+            self._post(
+                server,
+                {"messages": [{"role": "user", "content": "bulk"}],
+                 "max_tokens": 4},
+                headers={"x-mtpu-priority": "batch"},
+            )
+        err = exc.value
+        assert err.code == 429
+        assert int(err.headers["retry-after"]) >= 1
+        payload = json.loads(err.read())
+        assert payload["error"]["code"] == "queue_full"
+        assert default_registry.value(
+            C.SHEDS_TOTAL, {"class": "batch", "reason": "queue_full"}
+        ) == sheds_before + 1
+
+    def test_admitted_interactive_completes_within_deadline(self, server):
+        with self._post(
+            server,
+            {"messages": [{"role": "user", "content": "hi"}],
+             "max_tokens": 4, "temperature": 0.0},
+            headers={
+                "x-mtpu-priority": "interactive",
+                "x-mtpu-deadline-ms": "30000",
+            },
+        ) as r:
+            out = json.load(r)
+        # completed (stop/length), NOT cancelled by its deadline
+        assert out["choices"][0]["finish_reason"] in ("stop", "length")
+
+    def test_bad_priority_class_is_a_400(self, server):
+        with pytest.raises(urllib.error.HTTPError) as exc:
+            self._post(
+                server,
+                {"messages": [{"role": "user", "content": "x"}],
+                 "max_tokens": 2},
+                headers={"x-mtpu-priority": "urgent"},
+            )
+        assert exc.value.code == 400
+
+
+class _FakeReplica:
+    """Minimal replica protocol for deterministic router unit tests."""
+
+    def __init__(self, name, outstanding=0, capacity=4, healthy=True):
+        self.name = name
+        self._outstanding = outstanding
+        self._capacity = capacity
+        self._healthy = healthy
+        self.submitted = []
+
+    def encode(self, prompt):
+        return list(prompt.encode())
+
+    def submit(self, prompt, params=None, image=None, **kw):
+        self.submitted.append(prompt)
+
+        class _Req:
+            request_id = f"req-{self.name}-{len(self.submitted)}"
+
+        return _Req()
+
+    def outstanding(self):
+        return self._outstanding + len(self.submitted)
+
+    def capacity(self):
+        return self._capacity
+
+    def healthy(self):
+        return self._healthy
+
+    def saturated(self):
+        return self.outstanding() >= 2 * self._capacity
+
+
+class TestRouterUnit:
+    def test_same_prefix_routes_to_same_replica(self):
+        from modal_examples_tpu.observability import catalog as C
+        from modal_examples_tpu.scheduling import PrefixAffinityRouter
+        from modal_examples_tpu.utils.prometheus import default_registry
+
+        a, b = _FakeReplica("a"), _FakeReplica("b")
+        router = PrefixAffinityRouter([a, b], prefix_tokens=8)
+        hits_before = default_registry.value(C.ROUTER_AFFINITY_HITS_TOTAL)
+        shared = "SYSTEM PROMPT: be nice. user says hello"
+        first = router.route(shared)
+        for _ in range(3):
+            assert router.route(shared) is first
+        assert router.affinity_hits >= 3
+        assert default_registry.value(
+            C.ROUTER_AFFINITY_HITS_TOTAL
+        ) >= hits_before + 3
+
+    def test_saturated_replica_diverts_to_least_loaded(self):
+        from modal_examples_tpu.scheduling import PrefixAffinityRouter
+
+        a, b = _FakeReplica("a"), _FakeReplica("b")
+        router = PrefixAffinityRouter([a, b], prefix_tokens=8)
+        prompt = "the shared prefix of a very hot conversation"
+        preferred = router.route(prompt)
+        other = b if preferred is a else a
+        preferred._outstanding = 10 * preferred.capacity()  # saturate it
+        assert router.route(prompt) is other
+        assert router.fallbacks >= 1
+
+    def test_unhealthy_replica_is_skipped(self):
+        from modal_examples_tpu.scheduling import PrefixAffinityRouter
+
+        a, b = _FakeReplica("a"), _FakeReplica("b")
+        router = PrefixAffinityRouter([a, b], prefix_tokens=8)
+        prompt = "route me somewhere alive"
+        preferred = router.route(prompt)
+        other = b if preferred is a else a
+        preferred._healthy = False
+        assert router.route(prompt) is other
+        preferred._healthy = True
+        other._healthy = False
+        a._healthy = False
+        with pytest.raises(RuntimeError, match="no healthy replicas"):
+            router.route(prompt)
+
+
+class TestRouterWithEngines:
+    def test_two_replica_affinity_and_divert(self, jax):
+        """Acceptance: repeated shared-prefix prompts hit the same replica
+        (mtpu_router_affinity_hits_total > 0); a saturated replica diverts
+        new prompts to the other."""
+        from modal_examples_tpu.observability import catalog as C
+        from modal_examples_tpu.scheduling import (
+            EngineReplica,
+            PrefixAffinityRouter,
+        )
+        from modal_examples_tpu.serving import SamplingParams
+        from modal_examples_tpu.utils.prometheus import default_registry
+
+        e1 = _tiny_engine(jax, seed=11)
+        e2 = _tiny_engine(jax, seed=12)
+        r1 = EngineReplica(e1, "replica-1", saturation_factor=2.0)
+        r2 = EngineReplica(e2, "replica-2", saturation_factor=2.0)
+        router = PrefixAffinityRouter([r1, r2], prefix_tokens=16)
+        try:
+            hits_before = default_registry.value(C.ROUTER_AFFINITY_HITS_TOTAL)
+            shared = "You are a helpful assistant. Answer briefly: hello"
+            reqs = [
+                router.submit(shared, SamplingParams(max_tokens=2))
+                for _ in range(3)
+            ]
+            owners = {router.replica_for(r).name for r in reqs}
+            assert len(owners) == 1, f"shared prefix split across {owners}"
+            assert router.affinity_hits >= 2
+            assert default_registry.value(
+                C.ROUTER_AFFINITY_HITS_TOTAL
+            ) > hits_before
+            for req in reqs:
+                text = "".join(router.stream(req))
+                assert isinstance(text, str)
+
+            # saturate the affinity owner (without running it): queue more
+            # outstanding work than saturation_factor x slots allows
+            owner = r1 if "replica-1" in owners else r2
+            other = r2 if owner is r1 else r1
+            owner.engine.stop()  # hold its queue still
+            hold = [
+                owner.engine.submit("hold", SamplingParams(max_tokens=2))
+                for _ in range(2 * owner.capacity())
+            ]
+            assert owner.saturated()
+            diverted = router.route(shared)
+            assert diverted is other, "saturated replica must divert"
+            for h in hold:
+                owner.engine.abort(h)
+        finally:
+            try:
+                e1.stop()
+            finally:
+                e2.stop()

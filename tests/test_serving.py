@@ -279,6 +279,40 @@ class TestEngine:
             time.sleep(0.05)
         assert all(s.free for s in engine.slots)
 
+    def test_abort_queued_frees_reservation_and_depth(self, jax):
+        """Regression (ISSUE 4 satellite): aborting a request that never
+        reached a slot must free its reserved KV pages and decrement the
+        queue-depth gauge immediately — without the scheduler thread ever
+        running — and release the caller's stream."""
+        from modal_examples_tpu.models import llama
+        from modal_examples_tpu.observability import catalog as C
+        from modal_examples_tpu.serving import LLMEngine, SamplingParams
+        from modal_examples_tpu.utils.prometheus import default_registry
+
+        eng = LLMEngine(
+            llama.LlamaConfig.tiny(), max_slots=2, max_model_len=64,
+            page_size=16, prefill_buckets=(32,), seed=9,
+        )
+        try:
+            req = eng.submit("never scheduled", SamplingParams(max_tokens=16))
+            assert eng.policy.total_depth() == 1
+            assert eng.admission.reserved_pages > 0
+            assert default_registry.value(C.KV_PAGES_RESERVED) > 0
+            eng.abort(req)
+            assert eng.policy.total_depth() == 0
+            assert eng.admission.reserved_pages == 0
+            assert default_registry.value(C.KV_PAGES_RESERVED) == 0
+            assert default_registry.value(
+                C.SCHED_QUEUE_DEPTH, {"class": "default"}
+            ) == 0
+            # the stream terminates promptly (marker already queued)
+            item = req.out_queue.get(timeout=5)
+            assert hasattr(item, "reason")
+            # and the page pool is untouched: nothing was ever claimed
+            assert eng.cache.occupancy()["pages_used"] == 0
+        finally:
+            eng.stop()
+
     def test_seeded_sampling_deterministic_across_batches(self, engine):
         """A seeded request must sample identically whether it runs alone or
         alongside other traffic (the OpenAI `seed` contract)."""

@@ -181,6 +181,41 @@ def test_metric_label_keys_declared_in_catalog():
     )
 
 
+def test_scheduler_policies_implement_full_abc():
+    """Every ``SchedulerPolicy`` subclass anywhere in the package must
+    implement the FULL ABC — a policy missing ``remove``/``expired`` would
+    silently leak aborted or deadline-expired requests, so partial policies
+    are rejected here, not discovered at 3am. (The metric-name and
+    label-key guards above already cover ``scheduling/`` series: they scan
+    the whole package.)"""
+    from modal_examples_tpu.scheduling.policy import SchedulerPolicy
+
+    def walk(cls):
+        for sub in cls.__subclasses__():
+            yield sub
+            yield from walk(sub)
+
+    # import every module so subclasses defined anywhere in the package are
+    # registered before we enumerate them
+    for mod in pkgutil.walk_packages([str(PKG_ROOT)], "modal_examples_tpu."):
+        if mod.name.endswith("__main__") or "libmtpu_host" in mod.name:
+            continue
+        try:
+            importlib.import_module(mod.name)
+        except Exception:
+            pass  # import failures are test_every_module_imports' job
+    partial = [
+        f"{sub.__module__}.{sub.__qualname__}: missing "
+        f"{sorted(sub.__abstractmethods__)}"
+        for sub in walk(SchedulerPolicy)
+        if getattr(sub, "__abstractmethods__", None)
+    ]
+    assert not partial, (
+        f"SchedulerPolicy subclasses with abstract methods remaining "
+        f"(implement the full ABC): {partial}"
+    )
+
+
 def test_no_bare_print_in_framework_code():
     """Framework code under ``core/`` and ``serving/`` must not ``print()``:
     diagnostics go through ``utils.log.get_logger`` so they carry a level

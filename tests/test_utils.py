@@ -208,3 +208,29 @@ class TestDebugging:
 
         s = tree_summary({"w": jnp.ones((2, 3))})
         assert "(2, 3)" in s and "|x|=" in s
+
+
+class TestByteTokenizer:
+    def test_round_trip_is_length_stable(self):
+        """Decode -> re-encode must return EXACTLY the original byte ids —
+        the old errors="replace" turned invalid UTF-8 bytes into U+FFFD
+        (3 bytes re-encoded), inflating every max_tokens round-trip count
+        (the pre-existing tier-1 failure this fixes)."""
+        from modal_examples_tpu.utils.tokenizer import ByteTokenizer
+
+        tok = ByteTokenizer()
+        # 0xC3 alone is an invalid UTF-8 sequence; 0xF0 starts a 4-byte one
+        for ids in ([0xC3], [0xF0, 0x48], [0x68, 0x69], list(range(256))):
+            text = tok.decode(ids)
+            assert tok.encode(text, add_bos=False) == ids
+        # special ids are dropped by decode, never inflated
+        assert tok.encode(
+            tok.decode([tok.bos_id, 0x41, tok.eos_id]), add_bos=False
+        ) == [0x41]
+
+    def test_valid_utf8_unchanged(self):
+        from modal_examples_tpu.utils.tokenizer import ByteTokenizer
+
+        tok = ByteTokenizer()
+        s = "héllo wörld ✓"
+        assert tok.decode(tok.encode(s, add_bos=False)) == s
