@@ -29,6 +29,7 @@ import time
 
 A100_LLAMA2_7B_TOK_S = 1400.0
 V5E_HBM_GBPS = 819.0  # v5e HBM bandwidth ceiling, bytes streamed per second
+V5E_HBM_BYTES = 16e9  # v5e HBM capacity: the slots-at-budget denominator
 
 CONFIGS = {
     # name: engine kwargs + measurement shape. int8 weight-only quantization
@@ -44,6 +45,24 @@ CONFIGS = {
         # 36 slots is the measured sweet spot with the ragged kernel; the
         # remote-compile helper crashes somewhere past ~40 (round-4 sweep)
         slots=36, max_len=256, max_tokens=128, timeout=1200, quant="int8"
+    ),
+    "llama2-7b-int8-kv8-s36": dict(
+        # int8 KV on top of int8 weights: KV reads at the headline shape
+        # are ~4.3 GB/step (comparable to the int8 weight floor); int8 KV
+        # halves them AND halves residency (docs/kv_cache.md). Same 36-slot
+        # sweet spot — the compile-helper cap (~40), not HBM, binds slots.
+        slots=36, max_len=256, max_tokens=128, timeout=1200, quant="int8",
+        kv_dtype="int8",
+    ),
+    "llama2-7b-int8-kv8-ctx1024": dict(
+        # long-context decode: at ctx 1024 KV reads are ~34 GB/step and
+        # DOMINATE the step (NOTES r5) — the config where int8 KV is the
+        # whole game. 16 slots x 1024 ctx = ~4 GB int8 KV (bf16 would be
+        # ~8 GB next to the ~7 GB int8 weights: right at the HBM edge).
+        # prompt_mult pushes real contexts to ~500+ tokens so decode runs
+        # at long positions (chunked prefill path), not just long tables.
+        slots=16, max_len=1024, max_tokens=128, timeout=1500, quant="int8",
+        kv_dtype="int8", prompt_mult=40,
     ),
     "llama2-7b-int8-s32": dict(
         slots=32, max_len=256, max_tokens=128, timeout=1200, quant="int8"
@@ -97,7 +116,9 @@ def _child(model: str) -> None:
         max_model_len=spec["max_len"],
         page_size=16,
         prefill_buckets=(64, 128, 256),
-        kv_dtype=jnp.bfloat16,
+        # "int8" = quantized paged KV (half the decode KV HBM traffic and
+        # residency, docs/kv_cache.md); default bf16
+        kv_dtype=spec.get("kv_dtype", jnp.bfloat16),
         quantization=spec.get("quant"),
         # the v3 ragged kernel + pallas scatter decode structure (round 4);
         # models whose shapes don't fit the kernel fall back to XLA inside
@@ -106,7 +127,10 @@ def _child(model: str) -> None:
     )
     build_s = time.time() - t0
     weight_bytes = param_bytes(engine.params)
-    prompt = "The quick brown fox jumps over the lazy dog. " * 2
+    prompt = (
+        "The quick brown fox jumps over the lazy dog. "
+        * spec.get("prompt_mult", 2)
+    )
     max_tokens = spec["max_tokens"]
     if os.environ.get("BENCH_WARM"):
         max_tokens = 16  # warm rerun only measures boot, not throughput
@@ -140,6 +164,23 @@ def _child(model: str) -> None:
     # once for up to `slots` tokens. steps/s * weight_bytes over the HBM
     # ceiling says how close the whole serving stack runs to the hardware.
     stream_gbps = (tok_s / spec["slots"]) * weight_bytes / 1e9
+
+    # KV-cache footprint (dtype-aware: int8 counts int8 payload + f32 scale
+    # rows): the residency half of the int8-KV win. max_slots_at_hbm = how
+    # many slots of THIS config's context length fit in v5e HBM after the
+    # weights — ~2x at kv_dtype="int8", measurable the moment the bytes
+    # halve, no chip required.
+    cache_occ = engine.cache.occupancy()
+    bytes_per_page = cache_occ["bytes_total"] // engine.cache.n_pages
+    bytes_per_slot = engine.pages_per_slot * bytes_per_page
+    kv_cache_info = {
+        "dtype": engine.cache.kv_dtype,
+        "bytes": int(cache_occ["bytes_total"]),
+        "bytes_per_slot": int(bytes_per_slot),
+        "max_slots_at_hbm": int(
+            max(0.0, V5E_HBM_BYTES - weight_bytes) // max(bytes_per_slot, 1)
+        ),
+    }
 
     # per-phase latency distributions (p50/p95/p99) from the engine's
     # observability histograms — phase-attributed perf trajectory in every
@@ -218,6 +259,7 @@ def _child(model: str) -> None:
                 "phase_latency": phase_latency,
                 "token_latency": token_latency,
                 "scheduling": scheduling,
+                "kv_cache": kv_cache_info,
                 "tokens_per_second": round(tok_s, 2),
             }
         )
@@ -641,8 +683,10 @@ def main() -> int:
         # the strongest measured number on the table.
         order = [
             "tiny",
+            "llama2-7b-int8-kv8-s36",
             "llama2-7b-int4-s36",
             "llama2-7b-int8-s36",
+            "llama2-7b-int8-kv8-ctx1024",
             "llama2-7b-int8-s32",
             "llama2-7b-int8-s16",
             "llama3.1-8b-int8-s32",
@@ -653,9 +697,11 @@ def main() -> int:
     results: dict[str, dict] = {}
     last_err = ""
     # the LLM decode headline must not starve the other four BASELINE
-    # configs (image/embeddings/ASR/finetune secondary children): LLM
-    # configs stop drawing budget once the top TWO real configs have
-    # numbers, keeping ~500s for the breadth metrics
+    # configs (image/embeddings/ASR/finetune secondary children): a flat
+    # 500s reserve is carved out of the deadline for the whole LLM-config
+    # loop — both the break check and each config's timeout are computed
+    # against (deadline - reserve), so the config in flight when budget
+    # runs low cannot eat the breadth metrics' time either
     secondary_reserve = (
         0 if os.environ.get("BENCH_NO_SECONDARY") else 500
     )

@@ -86,7 +86,10 @@ def main() -> int:
     )
 
     K = args.steps
-    kv_dt = jnp.dtype(args.kv_dtype)
+    # "int8" = the QUANTIZED cache (int8 pages + f32 scale rows, the 4-leaf
+    # QuantizedKV pytree) — not a plain int8 array, which no decode path
+    # reads; any other value is a plain page dtype
+    kv_dt = "int8" if args.kv_dtype == "int8" else jnp.dtype(args.kv_dtype)
 
     real_attn = llama.paged_decode_attention_inflight
 
@@ -146,11 +149,14 @@ def main() -> int:
             n_pages = 1 + slots * pp
             try:
                 with attn_patched(patch):
-                    kp = jnp.zeros(
-                        (cfg.n_layers, n_pages, args.page_size,
-                         cfg.n_kv_heads, cfg.head_dim), kv_dt,
+                    from modal_examples_tpu.ops import kv_empty
+
+                    cache_shape = (
+                        cfg.n_layers, n_pages, args.page_size,
+                        cfg.n_kv_heads, cfg.head_dim,
                     )
-                    vp = jnp.zeros_like(kp)
+                    kp = kv_empty(cache_shape, kv_dt)
+                    vp = kv_empty(cache_shape, kv_dt)
                     tables = jnp.asarray(
                         1 + np.arange(slots * pp).reshape(slots, pp), jnp.int32
                     )

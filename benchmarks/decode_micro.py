@@ -38,9 +38,19 @@ def main() -> int:
     ap.add_argument("--slots", default="8,16,32")
     ap.add_argument("--impl", default="xla,xla-writeback")
     ap.add_argument(
-        "--variant", default=None, choices=[None, "flat", "grouped"],
+        # no None in choices: argparse compares the PARSED string against
+        # choices, so None only ever matched by being the default — listing
+        # it rejected an explicit "--variant" while implying it was valid
+        "--variant", default=None, choices=["flat", "grouped"],
         help="ragged-kernel formulation A/B (impl=pallas): flat = v3 "
-        "all-heads matmul, grouped = v4 per-kv-head (GQA-capable)",
+        "all-heads matmul, grouped = v4 per-kv-head (GQA-capable); "
+        "default: auto by head geometry + kv dtype",
+    )
+    ap.add_argument(
+        "--kv-dtype", default="bf16", choices=["bf16", "int8"],
+        help="page-cache dtype A/B: int8 = quantized KV (int8 pages + f32 "
+        "scale rows — half the KV HBM traffic and residency, "
+        "docs/kv_cache.md)",
     )
     ap.add_argument("--steps", type=int, default=8, help="decode_block")
     ap.add_argument("--max-len", type=int, default=256)
@@ -78,12 +88,17 @@ def main() -> int:
         )
         needed = []
         if "pallas" in args.impl:
-            variant = args.variant or ragged_variant_for(_cfg.n_kv_heads)
+            kvd = "int8" if args.kv_dtype == "int8" else "bfloat16"
+            variant = args.variant or ragged_variant_for(_cfg.n_kv_heads, kvd)
+            suffix = "_int8kv" if args.kv_dtype == "int8" else ""
             needed.append(
-                "ragged_decode" if variant == "flat" else "ragged_decode_gqa"
+                ("ragged_decode" if variant == "flat" else "ragged_decode_gqa")
+                + suffix
             )
         if os.environ.get("MTPU_SCATTER_IMPL") == "pallas":
-            needed.append("scatter_kv")
+            needed.append(
+                "scatter_kv_int8" if args.kv_dtype == "int8" else "scatter_kv"
+            )
         results = run_probes(needed, timeout_s=600)
         bad = {k: r.status for k, r in results.items() if not r.ok}
         if bad:
@@ -170,12 +185,15 @@ def main() -> int:
             pp = args.max_len // args.page_size
             n_pages = 1 + slots * pp
             try:
-                kp = jnp.zeros(
-                    (cfg.n_layers, n_pages, args.page_size, cfg.n_kv_heads,
-                     cfg.head_dim),
-                    jnp.bfloat16,
+                from modal_examples_tpu.ops import kv_empty
+
+                cache_shape = (
+                    cfg.n_layers, n_pages, args.page_size, cfg.n_kv_heads,
+                    cfg.head_dim,
                 )
-                vp = jnp.zeros_like(kp)
+                kv_dt = "int8" if args.kv_dtype == "int8" else jnp.bfloat16
+                kp = kv_empty(cache_shape, kv_dt)
+                vp = kv_empty(cache_shape, kv_dt)
                 tables = jnp.asarray(
                     1 + np.arange(slots * pp).reshape(slots, pp), jnp.int32
                 )
@@ -221,12 +239,15 @@ def main() -> int:
                         {
                             "impl": impl,
                             # what actually ran, incl. the flat/grouped
-                            # ragged formulation — the A/B lines must be
-                            # attributable in captured logs
+                            # ragged formulation and kv dtype — the A/B
+                            # lines must be attributable in captured logs
                             "plan": {
                                 k: v
                                 for k, v in llama.paged_impl_plan(
                                     cfg, args.page_size, impl, scatter_impl,
+                                    kv_dtype=args.kv_dtype
+                                    if args.kv_dtype == "int8"
+                                    else "bfloat16",
                                     warn=False,
                                 ).items()
                                 if k != "downgraded"
@@ -235,10 +256,13 @@ def main() -> int:
                                 if args.variant else {}
                             ),
                             "slots": slots,
+                            "kv_dtype": args.kv_dtype,
                             "step_ms": round(step_ms, 2),
                             "tok_s": round(slots / step_ms * 1e3, 1),
                             "floor_ms": round(weight_bytes / 819e9 * 1e3, 2),
-                            "cache_gb": round(2 * kp.size * 2 / 1e9, 2),
+                            # nbytes is a property on QuantizedKV and
+                            # jax.Array alike (dtype-aware: int8 + scales)
+                            "cache_gb": round((kp.nbytes + vp.nbytes) / 1e9, 3),
                             "compile_s": round(compile_s, 1),
                         }
                     ),

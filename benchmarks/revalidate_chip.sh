@@ -26,5 +26,11 @@ timeout 900 python benchmarks/decode_micro.py --quant int4 --slots 8,36 --impl p
 #    flat-vs-grouped A/B at the 7B MHA shape
 timeout 1500 python benchmarks/decode_micro.py --model llama3.1-8b --quant int8 --slots 8,32 --impl pallas || exit 7
 timeout 900 python benchmarks/decode_micro.py --quant int8 --slots 32 --impl pallas --variant grouped || exit 8
-# 7. full bench
-timeout 1500 python bench.py || exit 9
+# 7. int8 KV cache (new Mosaic paths: int8 page + scale-row DMAs, in-VMEM
+#    dequant — probed first via --probe) — the bf16-vs-int8 KV A/B at the
+#    headline shape, then the long-context config where KV reads dominate
+timeout 900 python benchmarks/decode_micro.py --probe --quant int8 --slots 32 --impl pallas --kv-dtype int8 || exit 9
+timeout 900 python benchmarks/decode_micro.py --quant int8 --slots 8,16 --max-len 1024 --impl pallas --kv-dtype bf16 || exit 10
+timeout 900 python benchmarks/decode_micro.py --quant int8 --slots 8,16 --max-len 1024 --impl pallas --kv-dtype int8 || exit 11
+# 8. full bench (includes the kv_cache section + the ctx-1024 int8-KV config)
+timeout 1500 python bench.py || exit 12

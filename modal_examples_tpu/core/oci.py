@@ -35,6 +35,7 @@ import hashlib
 import json
 import os
 import tarfile
+import uuid
 from pathlib import Path
 
 from .image import Image
@@ -109,41 +110,50 @@ def _write_layer_blob(
 
     blob_dir = dest / "blobs" / "sha256"
     blob_dir.mkdir(parents=True, exist_ok=True)
-    tmp = blob_dir / ".layer.tmp"
-    with open(tmp, "wb") as raw:
-        outer = _HashingWriter(raw)  # hashes the COMPRESSED blob
-        with gzip.GzipFile(fileobj=outer, mode="wb", mtime=0) as gz:
-            inner = _HashingWriter(gz)  # hashes the UNCOMPRESSED tar
-            with tarfile.open(
-                fileobj=inner, mode="w", format=tarfile.PAX_FORMAT
-            ) as tf:
-                seen_dirs: set[str] = set()
-                for arcname, local in expanded:
-                    arcname = arcname.lstrip("/")
-                    parts = arcname.split("/")[:-1]
-                    for i in range(1, len(parts) + 1):
-                        d = "/".join(parts[:i])
-                        if d and d not in seen_dirs:
-                            seen_dirs.add(d)
-                            ti = tarfile.TarInfo(d)
-                            ti.type = tarfile.DIRTYPE
-                            ti.mode = 0o755
-                            ti.mtime = 0
-                            tf.addfile(ti)
-                    ti = tarfile.TarInfo(arcname)
-                    ti.size = local.stat().st_size
-                    ti.mode = 0o755 if os.access(local, os.X_OK) else 0o644
-                    ti.mtime = 0
-                    with open(local, "rb") as f:
-                        tf.addfile(ti, f)
-            diff_id = "sha256:" + inner.hash.hexdigest()
-        digest = "sha256:" + outer.hash.hexdigest()
-    size = tmp.stat().st_size
-    final = blob_dir / digest.split(":", 1)[1]
-    if final.exists():
-        tmp.unlink()
-    else:
-        tmp.replace(final)
+    # unique per-writer staging name: a fixed ".layer.tmp" raced when two
+    # exports shared a dest (one writer's replace() shipped the other's
+    # half-written bytes under a wrong digest); the rename into the
+    # content-addressed final name stays atomic either way
+    tmp = blob_dir / f".layer.{uuid.uuid4().hex}.tmp"
+    try:
+        with open(tmp, "wb") as raw:
+            outer = _HashingWriter(raw)  # hashes the COMPRESSED blob
+            with gzip.GzipFile(fileobj=outer, mode="wb", mtime=0) as gz:
+                inner = _HashingWriter(gz)  # hashes the UNCOMPRESSED tar
+                with tarfile.open(
+                    fileobj=inner, mode="w", format=tarfile.PAX_FORMAT
+                ) as tf:
+                    seen_dirs: set[str] = set()
+                    for arcname, local in expanded:
+                        arcname = arcname.lstrip("/")
+                        parts = arcname.split("/")[:-1]
+                        for i in range(1, len(parts) + 1):
+                            d = "/".join(parts[:i])
+                            if d and d not in seen_dirs:
+                                seen_dirs.add(d)
+                                ti = tarfile.TarInfo(d)
+                                ti.type = tarfile.DIRTYPE
+                                ti.mode = 0o755
+                                ti.mtime = 0
+                                tf.addfile(ti)
+                        ti = tarfile.TarInfo(arcname)
+                        ti.size = local.stat().st_size
+                        ti.mode = (
+                            0o755 if os.access(local, os.X_OK) else 0o644
+                        )
+                        ti.mtime = 0
+                        with open(local, "rb") as f:
+                            tf.addfile(ti, f)
+                diff_id = "sha256:" + inner.hash.hexdigest()
+            digest = "sha256:" + outer.hash.hexdigest()
+        size = tmp.stat().st_size
+        final = blob_dir / digest.split(":", 1)[1]
+        if final.exists():
+            tmp.unlink()
+        else:
+            tmp.replace(final)
+    finally:
+        tmp.unlink(missing_ok=True)  # no orphaned staging file on failure
     return digest, size, diff_id
 
 

@@ -34,6 +34,9 @@ from jax.sharding import PartitionSpec as P
 
 from ..ops import (
     flash_attention,
+    is_quantized,
+    kv_gather,
+    kv_scatter,
     paged_decode_attention,
     paged_decode_attention_inflight,
     paged_decode_attention_ragged,
@@ -473,13 +476,15 @@ def prefill(
 
 def _scatter_pages(k_pages, v_pages, k_all, v_all, page_idx, slot):
     """Write [L, Hkv, B, S, D] new KV into [L, P, page_size, Hkv, D] pages
-    at (page_idx[b,s], slot[b,s])."""
+    at (page_idx[b,s], slot[b,s]). int8 (QuantizedKV) caches quantize at
+    this write — per token-head amax/127 over D, fused by XLA into the
+    prefill program — and scatter the f32 scale rows alongside."""
     # adjacent advanced indices (page_idx, slot) at dims 1, 2 keep their
     # position: the target block is [L, B, S, Hkv, D]
     upd_k = k_all.transpose(0, 2, 3, 1, 4)
     upd_v = v_all.transpose(0, 2, 3, 1, 4)
-    k_pages = k_pages.at[:, page_idx, slot].set(upd_k)
-    v_pages = v_pages.at[:, page_idx, slot].set(upd_v)
+    k_pages = kv_scatter(k_pages, upd_k, page_idx, slot)
+    v_pages = kv_scatter(v_pages, upd_v, page_idx, slot)
     return k_pages, v_pages
 
 
@@ -534,11 +539,16 @@ def prefill_chunk(
         k = layers.apply_rope(k, cos, sin)
 
         if n_prefix_pages:
-            # [B, n_pp, ps, Hkv, D] -> [B, Hkv, prefix, D]
-            pk = k_pg[prefix_tables].transpose(0, 3, 1, 2, 4).reshape(
+            # [B, n_pp, ps, Hkv, D] -> [B, Hkv, prefix, D]; int8 caches
+            # dequantize in the gather (one multiply at the chunk's dtype)
+            pk = kv_gather(
+                k_pg, prefix_tables, dtype=k.dtype
+            ).transpose(0, 3, 1, 2, 4).reshape(
                 B, cfg.n_kv_heads, n_prefix_pages * page_size, D
             )
-            pv = v_pg[prefix_tables].transpose(0, 3, 1, 2, 4).reshape(
+            pv = kv_gather(
+                v_pg, prefix_tables, dtype=v.dtype
+            ).transpose(0, 3, 1, 2, 4).reshape(
                 B, cfg.n_kv_heads, n_prefix_pages * page_size, D
             )
             k_full = jnp.concatenate([pk, k], axis=2)
@@ -584,6 +594,7 @@ def paged_impl_plan(
     impl: str = "xla",
     scatter_impl: str = "xla",
     *,
+    kv_dtype="bfloat16",
     warn: bool = True,
 ) -> dict:
     """Resolve the decode structure that will ACTUALLY run for these shapes
@@ -592,10 +603,17 @@ def paged_impl_plan(
     impl that gets shape-downgraded (GQA Hkv<16, sub-128 head_dim) is
     visible instead of silently benchmarking the XLA path (ADVICE r4).
 
+    ``kv_dtype`` ("int8" = the quantized QuantizedKV cache) affects the
+    flat-variant Hkv legality (int8 page flattens need Hkv%32, not %16).
+
     Returns ``{"attention": "ragged"|"xla-gather"|"writeback",
     "ragged_variant": "flat"|"grouped"|None, "scatter": "pallas"|"xla",
-    "downgraded": [...]}``.
+    "kv_dtype": str, "downgraded": [...]}``.
     """
+    from ..ops.kv_quant import resolve_kv_dtype
+
+    kvd = resolve_kv_dtype(kv_dtype)
+    kvd_name = "int8" if kvd == "int8" else str(kvd)
     on_tpu = jax.default_backend() == "tpu"
     downgraded = []
     ragged_variant = None
@@ -612,7 +630,7 @@ def paged_impl_plan(
         ok = not on_tpu or ragged_shapes_ok(cfg.head_dim, page_size)
         attention = "ragged" if ok else "xla-gather"
         if ok:
-            ragged_variant = ragged_variant_for(cfg.n_kv_heads)
+            ragged_variant = ragged_variant_for(cfg.n_kv_heads, kvd_name)
         else:
             downgraded.append(
                 f"paged_impl=pallas -> xla-gather (head_dim={cfg.head_dim}, "
@@ -642,7 +660,7 @@ def paged_impl_plan(
                 )
     return {
         "attention": attention, "ragged_variant": ragged_variant,
-        "scatter": scatter, "downgraded": downgraded,
+        "scatter": scatter, "kv_dtype": kvd_name, "downgraded": downgraded,
     }
 
 
@@ -702,7 +720,10 @@ def decode_step(
     # as the default path (in-flight token as an extra softmax column, one
     # scatter after the scan); shape legality + downgrade reporting live in
     # paged_impl_plan (single source of truth with the engine's stats).
-    plan = paged_impl_plan(cfg, page_size, impl, scatter_impl)
+    kv_dtype = "int8" if is_quantized(k_pages) else str(k_pages.dtype)
+    plan = paged_impl_plan(
+        cfg, page_size, impl, scatter_impl, kv_dtype=kv_dtype
+    )
     use_ragged = plan["attention"] == "ragged"
     x = params["embed"][tokens]  # [B, D]
     cos, sin = layers.rotary_embedding(
@@ -743,9 +764,12 @@ def decode_step(
         else:
             # one gather from the full [L, P, ...] arrays (layer scalar +
             # table array fuse into a single XLA gather — no per-layer slice
-            # copy)
-            ks = k_pages[li, page_tables]  # [B, pp, ps, Hkv, D]
-            vs = v_pages[li, page_tables]
+            # copy); int8 caches dequantize in the gather (one multiply at
+            # the model dtype, fused into the same bandwidth-bound loop)
+            ks = kv_gather(
+                k_pages, page_tables, layer=li, dtype=x.dtype
+            )  # [B, pp, ps, Hkv, D]
+            vs = kv_gather(v_pages, page_tables, layer=li, dtype=x.dtype)
             o = paged_decode_attention_inflight(
                 q[:, :, 0], ks, vs, prefix_lens, k_tok, v_tok
             )  # [B, H, D]
@@ -774,9 +798,11 @@ def decode_step(
     else:
         # XLA scatter: adjacent advanced indices (dims 1, 2) keep their
         # position, so the [L, B, Hkv, D] scan ys line up directly.
-        # Auto-partitionable (TP serving).
-        k_pages = k_pages.at[:, page_idx, slot].set(k_all)
-        v_pages = v_pages.at[:, page_idx, slot].set(v_all)
+        # Auto-partitionable (TP serving). int8 caches quantize at this
+        # write (kv_scatter fuses the per token-head amax/127 into the
+        # decode program and scatters data + scale rows).
+        k_pages = kv_scatter(k_pages, k_all, page_idx, slot)
+        v_pages = kv_scatter(v_pages, v_all, page_idx, slot)
     x = layers.rms_norm(x, params["final_norm"], cfg.norm_eps)
     head = params["embed"].T if cfg.tie_embeddings else params["lm_head"]
     logits = layers.mm(x, head)
@@ -820,9 +846,12 @@ def _decode_step_writeback(
         q = layers.apply_rope(q, cos, sin)
         k = layers.apply_rope(k, cos, sin)
         # write this token's KV into the page cache ([P, ps, Hkv, D] layout:
-        # adjacent advanced indices at dims 0, 1 land the [B, Hkv, D] update)
-        k_pg = k_pg.at[page_idx, slot].set(k[:, :, 0])
-        v_pg = v_pg.at[page_idx, slot].set(v[:, :, 0])
+        # adjacent advanced indices at dims 0, 1 land the [B, Hkv, D]
+        # update); int8 caches quantize at the write
+        k_pg = kv_scatter(k_pg, k[:, :, 0], page_idx, slot,
+                          leading_layer=False)
+        v_pg = kv_scatter(v_pg, v[:, :, 0], page_idx, slot,
+                          leading_layer=False)
         o = paged_decode_attention(
             q[:, :, 0], k_pg, v_pg, page_tables, ctx_lens,
             impl="pallas" if impl == "pallas-writeback" else "xla",
@@ -898,8 +927,12 @@ def verify_step(
         # write the whole chain's KV, then attend (the per-t causal mask in
         # the verify attention keeps token t from seeing tokens > t).
         # Adjacent advanced indices (dims 0, 1): result is [B, T, Hkv, D].
-        k_pg = k_pg.at[page_idx, slot].set(k.transpose(0, 2, 1, 3))
-        v_pg = v_pg.at[page_idx, slot].set(v.transpose(0, 2, 1, 3))
+        # int8 caches quantize the chain writes so verification scores
+        # proposals against exactly the (dequantized) KV decode will read.
+        k_pg = kv_scatter(k_pg, k.transpose(0, 2, 1, 3), page_idx, slot,
+                          leading_layer=False)
+        v_pg = kv_scatter(v_pg, v.transpose(0, 2, 1, 3), page_idx, slot,
+                          leading_layer=False)
         o = _ref.paged_verify_attention(
             q.transpose(0, 2, 1, 3), k_pg, v_pg, page_tables, positions
         )  # [B, T, Hq, D]

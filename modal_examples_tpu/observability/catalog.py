@@ -69,6 +69,9 @@ TPOT_SECONDS = "mtpu_tpot_seconds"
 KV_PAGES_USED = "mtpu_kv_pages_used"
 #: gauge: allocated fraction of the usable KV page pool (0..1)
 KV_PAGE_OCCUPANCY = "mtpu_kv_page_occupancy"
+#: gauge {dtype}: total HBM bytes of the paged KV cache arrays (dtype-aware:
+#: int8 caches report ~half the bf16 footprint — docs/kv_cache.md)
+KV_CACHE_BYTES = "mtpu_kv_cache_bytes"
 #: counter: zero-ref prefix-cache pages reclaimed under allocator pressure
 PREFIX_CACHE_EVICTIONS_TOTAL = "mtpu_prefix_cache_evictions_total"
 #: gauge: total payload bytes resident in the memory-snapshot store
@@ -221,6 +224,10 @@ CATALOG: dict[str, dict] = {
         "type": "gauge", "labels": [],
         "help": "allocated fraction of the usable KV page pool (0..1)",
     },
+    KV_CACHE_BYTES: {
+        "type": "gauge", "labels": ["dtype"],
+        "help": "total HBM bytes of the paged KV cache (dtype-aware)",
+    },
     PREFIX_CACHE_EVICTIONS_TOTAL: {
         "type": "counter", "labels": [],
         "help": "zero-ref prefix-cache pages reclaimed under pressure",
@@ -301,7 +308,7 @@ CATALOG: dict[str, dict] = {
         "help": "free pages in the paged KV cache",
     },
     DECODE_IMPL: {
-        "type": "gauge", "labels": ["attention", "scatter"],
+        "type": "gauge", "labels": ["attention", "scatter", "kv_dtype"],
         "help": "resolved decode implementation plan (info metric, value 1)",
     },
     SPEC_PROPOSED_TOTAL: {

@@ -287,6 +287,19 @@ def set_kv_occupancy(
     )
 
 
+def set_kv_cache_bytes(
+    total_bytes: int, dtype: str, *, registry: Registry | None = None
+) -> None:
+    """Total HBM bytes of the paged KV cache arrays, labeled by the page
+    dtype ("bfloat16" | "int8" | ...). Dtype-aware (int8 counts the int8
+    payload + f32 scale rows), so the gauge shows the ~2x footprint
+    headroom the quantized cache buys (docs/kv_cache.md)."""
+    _reg(registry).gauge_set(
+        C.KV_CACHE_BYTES, float(total_bytes), labels={"dtype": dtype},
+        help=C.CATALOG[C.KV_CACHE_BYTES]["help"],
+    )
+
+
 def set_prefix_cache_pages(
     cached_pages: int, *, registry: Registry | None = None
 ) -> None:

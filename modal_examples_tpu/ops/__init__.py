@@ -11,6 +11,15 @@ from .flash_attention import (
     flash_attention_chunked,
     flash_attention_with_lse,
 )
+from .kv_quant import (
+    QuantizedKV,
+    dequantize_kv,
+    is_quantized,
+    kv_empty,
+    kv_gather,
+    kv_scatter,
+    quantize_kv,
+)
 from .paged_attention import (
     paged_decode_attention,
     paged_decode_attention_inflight,
@@ -27,15 +36,22 @@ from .ring_attention import (
 from . import reference
 
 __all__ = [
+    "QuantizedKV",
     "dequantize_int8",
+    "dequantize_kv",
     "flash_attention",
     "flash_attention_chunked",
     "flash_attention_with_lse",
     "paged_decode_attention",
     "paged_decode_attention_inflight",
     "paged_decode_attention_ragged",
+    "is_quantized",
+    "kv_empty",
+    "kv_gather",
+    "kv_scatter",
     "scatter_kv_pages",
     "quantize_int8",
+    "quantize_kv",
     "quantized_matmul",
     "reference",
     "ring_attention",

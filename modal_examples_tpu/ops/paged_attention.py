@@ -47,6 +47,8 @@ import jax.numpy as jnp
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
+from .kv_quant import QuantizedKV, is_quantized, kv_gather, quantize_kv
+
 # jax renamed TPUCompilerParams -> CompilerParams across releases; accept
 # whichever this jax ships so the ragged kernels work on both
 _CompilerParamsCls = getattr(
@@ -178,14 +180,19 @@ def _paged_decode_xla(
     gather → QK → softmax → PV into bandwidth-bound loops. Also (unlike a
     pallas_call) this is auto-partitionable under a sharded jit, which is
     what lets tensor-parallel serving shard the page cache by kv head.
+
+    int8 caches (:class:`~.kv_quant.QuantizedKV`) dequantize HERE: one
+    multiply at the query's dtype fused into the gather (bf16 on the
+    serving path, matching the ragged kernels' in-VMEM dequant exactly),
+    so the HBM page reads stay int8.
     """
     B, Hq, D = q.shape
     _, page_size, Hkv, _ = k_pages.shape
     G = Hq // Hkv
     pages_per_seq = page_tables.shape[1]
 
-    ks = k_pages[page_tables]  # [B, pp, ps, Hkv, D]
-    vs = v_pages[page_tables]
+    ks = kv_gather(k_pages, page_tables, dtype=q.dtype)  # [B, pp, ps, Hkv, D]
+    vs = kv_gather(v_pages, page_tables, dtype=q.dtype)
     qg = q.reshape(B, Hkv, G, D)
     # operands stay in cache dtype INTO the MXU (f32 accumulation via
     # preferred_element_type): an `.astype(f32)` on the gathered pages
@@ -289,18 +296,16 @@ def _decode_kernel_ragged(
     v_new_ref,  # (B, Hkv, D) VMEM
     k_hbm,  # (L, n_pages, page_size, Hkv, D) ANY/HBM
     v_hbm,
-    # outputs
-    o_ref,  # (B, Hq, D) VMEM
-    # scratch
-    k_scr,  # (depth, page_size, Hkv, D) VMEM — DMA ring, token-major pages
-    v_scr,
-    acc_scr,  # (Hq, D) f32
-    sems,  # DMA sems (depth, 2)
-    *,
+    # quantized=True adds ks_hbm/vs_hbm (L, n_pages, page_size, Hkv) f32
+    # scale inputs and ks_scr/vs_scr (depth, page_size, Hkv) scratch rings;
+    # sems widen to (depth, 4). `*rest` keeps ONE kernel for both layouts.
+    *rest,  # [ks_hbm, vs_hbm,] o_ref, k_scr, v_scr, [ks_scr, vs_scr,]
+    # acc_scr, sems
     page_size: int,
     pages_per_seq: int,
     group: int,  # Hq // Hkv
     sm_scale: float,
+    quantized: bool = False,
 ):
     """Ragged decode attention v3: prefix pages + ONE in-flight column.
 
@@ -317,12 +322,24 @@ def _decode_kernel_ragged(
     reads (and materializes) all pages_per_seq pages regardless of context,
     measured round 4 as the dominant, superlinear-in-slots decode cost
     (benchmarks/decode_ablate.py: 44 of 57 ms/step at 7B int8, 32 slots).
+
+    With ``quantized=True`` the pages stream as int8 plus a per-token-head
+    f32 scale row, and the dequant (one bf16 multiply) happens on the VMEM
+    copy right before the MXU — KV HBM traffic is halved, the online
+    softmax math is unchanged.
     """
+    if quantized:
+        (ks_hbm, vs_hbm, o_ref, k_scr, v_scr, ks_scr, vs_scr, acc_scr,
+         sems) = rest
+    else:
+        o_ref, k_scr, v_scr, acc_scr, sems = rest
+        ks_hbm = vs_hbm = ks_scr = vs_scr = None
     b = pl.program_id(0)
     li = layer_ref[0]
     prefix, n_pages, depth, k_dma, v_dma = _ragged_ring_setup(
         li, page_tables_ref, prefix_lens_ref, b, k_hbm, v_hbm, k_scr, v_scr,
-        sems, pages_per_seq,
+        sems, pages_per_seq, ks_hbm=ks_hbm, vs_hbm=vs_hbm, ks_scr=ks_scr,
+        vs_scr=vs_scr,
     )
 
     acc_scr[:] = jnp.zeros_like(acc_scr)
@@ -356,13 +373,26 @@ def _decode_kernel_ragged(
         @pl.when(i + depth - 1 < n_pages)
         def _prefetch():
             nxt = jax.lax.rem(i + depth - 1, depth)
-            k_dma(nxt, i + depth - 1).start()
-            v_dma(nxt, i + depth - 1).start()
+            for c in k_dma(nxt, i + depth - 1) + v_dma(nxt, i + depth - 1):
+                c.start()
 
-        k_dma(slot, i).wait()
-        v_dma(slot, i).wait()
-        k = k_scr[slot].reshape(W, D)  # cache dtype, no retile
-        v = v_scr[slot].reshape(W, D)
+        for c in k_dma(slot, i) + v_dma(slot, i):
+            c.wait()
+        if quantized:
+            # dequant at the VMEM load: int8 page * its f32 scale row, one
+            # multiply per element at the query's compute dtype (bf16 on
+            # the serving path — matches the XLA gather fallback)
+            k = (
+                k_scr[slot].astype(q.dtype)
+                * ks_scr[slot][..., None].astype(q.dtype)
+            ).reshape(W, D)
+            v = (
+                v_scr[slot].astype(q.dtype)
+                * vs_scr[slot][..., None].astype(q.dtype)
+            ).reshape(W, D)
+        else:
+            k = k_scr[slot].reshape(W, D)  # cache dtype, no retile
+            v = v_scr[slot].reshape(W, D)
 
         s = jax.lax.dot_general(
             q, k, (((1,), (1,)), ((), ())),
@@ -406,11 +436,23 @@ def ragged_shapes_ok(head_dim: int, page_size: int) -> bool:
     return head_dim % 128 == 0 and page_size % 16 == 0
 
 
-def ragged_variant_for(n_kv_heads: int) -> str:
+def flat_variant_hkv_multiple(kv_dtype: str = "bfloat16") -> int:
+    """The Hkv multiple the "flat" variant's (ps, Hkv, D) -> (ps*Hkv, D)
+    page flatten needs: the sublane count of one packed Mosaic tile —
+    16 for bf16, 32 for int8 ((32, 128) tiles)."""
+    return 32 if str(kv_dtype) == "int8" else 16
+
+
+def ragged_variant_for(n_kv_heads: int, kv_dtype: str = "bfloat16") -> str:
     """Default kernel formulation: "flat" (one all-heads matmul) needs the
-    (ps, Hkv, D) -> (ps*Hkv, D) flatten, legal only at Hkv%16; everything
-    else (GQA) takes "grouped" (per-kv-head contractions)."""
-    return "flat" if n_kv_heads % 16 == 0 else "grouped"
+    (ps, Hkv, D) -> (ps*Hkv, D) flatten, legal only at Hkv % tile-sublanes
+    (16 bf16, 32 int8); everything else (GQA) takes "grouped" (per-kv-head
+    contractions)."""
+    return (
+        "flat"
+        if n_kv_heads % flat_variant_hkv_multiple(kv_dtype) == 0
+        else "grouped"
+    )
 
 
 def scatter_shapes_ok(head_dim: int) -> bool:
@@ -420,10 +462,13 @@ def scatter_shapes_ok(head_dim: int) -> bool:
 
 def _ragged_ring_setup(
     li, page_tables_ref, prefix_lens_ref, b, k_hbm, v_hbm, k_scr, v_scr,
-    sems, pages_per_seq,
+    sems, pages_per_seq, *, ks_hbm=None, vs_hbm=None, ks_scr=None,
+    vs_scr=None,
 ):
     """v3 (flat) DMA-ring prologue: page-id lookup, K/V copy factories,
-    and the warm-up that puts depth-1 page transfers in flight. The
+    and the warm-up that puts depth-1 page transfers in flight. The copy
+    factories return a LIST of copies: just the page for plain caches, the
+    page plus its f32 scale row for int8 caches (sems columns 2/3). The
     grouped kernel streams at CHUNK granularity with clamped page ids and
     owns its own inlined version."""
     prefix = prefix_lens_ref[b]
@@ -434,21 +479,41 @@ def _ragged_ring_setup(
         return page_tables_ref[b * pages_per_seq + i]
 
     def k_dma(slot, i):
-        return pltpu.make_async_copy(
-            k_hbm.at[li, page_id(i)], k_scr.at[slot], sems.at[slot, 0]
-        )
+        copies = [
+            pltpu.make_async_copy(
+                k_hbm.at[li, page_id(i)], k_scr.at[slot], sems.at[slot, 0]
+            )
+        ]
+        if ks_hbm is not None:
+            copies.append(
+                pltpu.make_async_copy(
+                    ks_hbm.at[li, page_id(i)], ks_scr.at[slot],
+                    sems.at[slot, 2],
+                )
+            )
+        return copies
 
     def v_dma(slot, i):
-        return pltpu.make_async_copy(
-            v_hbm.at[li, page_id(i)], v_scr.at[slot], sems.at[slot, 1]
-        )
+        copies = [
+            pltpu.make_async_copy(
+                v_hbm.at[li, page_id(i)], v_scr.at[slot], sems.at[slot, 1]
+            )
+        ]
+        if vs_hbm is not None:
+            copies.append(
+                pltpu.make_async_copy(
+                    vs_hbm.at[li, page_id(i)], vs_scr.at[slot],
+                    sems.at[slot, 3],
+                )
+            )
+        return copies
 
     depth = k_scr.shape[0]
     for j in range(depth - 1):
         @pl.when(j < n_pages)
         def _(j=j):
-            k_dma(j, j).start()
-            v_dma(j, j).start()
+            for c in k_dma(j, j) + v_dma(j, j):
+                c.start()
 
     return prefix, n_pages, depth, k_dma, v_dma
 
@@ -499,19 +564,16 @@ def _decode_kernel_ragged_grouped(
     v_new_ref,  # (B, Hkv, D) VMEM
     k_hbm,  # (L, n_pages, page_size, Hkv, D) ANY/HBM
     v_hbm,
-    # outputs
-    o_ref,  # (B, Hq, D) VMEM
-    # scratch
-    k_scr,  # (depth, page_size, Hkv, D) VMEM
-    v_scr,
-    acc_scr,  # (Hq, D) f32
-    sems,  # DMA sems (depth, 2)
-    *,
+    # quantized=True adds ks_hbm/vs_hbm scale inputs and ks_scr/vs_scr
+    # scratch (see _decode_kernel_ragged); sems widen to (depth, 4)
+    *rest,  # [ks_hbm, vs_hbm,] o_ref, k_scr, v_scr, [ks_scr, vs_scr,]
+    # acc_scr, sems
     page_size: int,
     pages_per_seq: int,
     group: int,
     sm_scale: float,
     chunk: int,
+    quantized: bool = False,
 ):
     """Ragged decode attention v4 ("grouped"): per-kv-head contractions
     over CHUNKS of pages.
@@ -536,7 +598,17 @@ def _decode_kernel_ragged_grouped(
       the next chunk streams while the current one computes.
     The trade: Hkv small matmuls per chunk at G-row MXU utilization.
     On-chip A/B vs flat: benchmarks/decode_micro.py --variant.
+
+    ``quantized=True`` streams int8 pages + f32 scale rows and dequantizes
+    per-head slices at the VMEM load (one bf16 multiply) — same online
+    softmax, half the KV HBM traffic.
     """
+    if quantized:
+        (ks_hbm, vs_hbm, o_ref, k_scr, v_scr, ks_scr, vs_scr, acc_scr,
+         sems) = rest
+    else:
+        o_ref, k_scr, v_scr, acc_scr, sems = rest
+        ks_hbm = vs_hbm = ks_scr = vs_scr = None
     b = pl.program_id(0)
     li = layer_ref[0]
     prefix = prefix_lens_ref[b]
@@ -559,22 +631,42 @@ def _decode_kernel_ragged_grouped(
         ]
 
     def k_dma(slot, i):
-        return pltpu.make_async_copy(
-            k_hbm.at[li, page_id(i)], k_scr.at[slot], sems.at[slot, 0]
-        )
+        copies = [
+            pltpu.make_async_copy(
+                k_hbm.at[li, page_id(i)], k_scr.at[slot], sems.at[slot, 0]
+            )
+        ]
+        if quantized:
+            copies.append(
+                pltpu.make_async_copy(
+                    ks_hbm.at[li, page_id(i)], ks_scr.at[slot],
+                    sems.at[slot, 2],
+                )
+            )
+        return copies
 
     def v_dma(slot, i):
-        return pltpu.make_async_copy(
-            v_hbm.at[li, page_id(i)], v_scr.at[slot], sems.at[slot, 1]
-        )
+        copies = [
+            pltpu.make_async_copy(
+                v_hbm.at[li, page_id(i)], v_scr.at[slot], sems.at[slot, 1]
+            )
+        ]
+        if quantized:
+            copies.append(
+                pltpu.make_async_copy(
+                    vs_hbm.at[li, page_id(i)], vs_scr.at[slot],
+                    sems.at[slot, 3],
+                )
+            )
+        return copies
 
     # warm-up: chunk 0 into half 0 (every chunk's start has exactly one
     # matching wait in the body: warmup pairs with iteration 0)
     @pl.when(n_chunks > 0)
     def _():
         for j in range(C):
-            k_dma(j, j).start()
-            v_dma(j, j).start()
+            for c in k_dma(j, j) + v_dma(j, j):
+                c.start()
 
     acc_scr[:] = jnp.zeros_like(acc_scr)
     q = q_ref[b]  # (Hq, D) model dtype into the MXU, f32 accumulate
@@ -594,18 +686,32 @@ def _decode_kernel_ragged_grouped(
         @pl.when(i + 1 < n_chunks)
         def _():
             for j in range(C):
-                k_dma(nxt_base + j, (i + 1) * C + j).start()
-                v_dma(nxt_base + j, (i + 1) * C + j).start()
+                for c in (
+                    k_dma(nxt_base + j, (i + 1) * C + j)
+                    + v_dma(nxt_base + j, (i + 1) * C + j)
+                ):
+                    c.start()
         # wait this chunk's pages (all C were started: warmup or prefetch)
         for j in range(C):
-            k_dma(base + j, i * C + j).wait()
-            v_dma(base + j, i * C + j).wait()
+            for c in k_dma(base + j, i * C + j) + v_dma(base + j, i * C + j):
+                c.wait()
+
+        def head_slice(scr, scale_scr, h):
+            """The head's (chunk*ps, D) keys/values, dequantized for int8
+            caches (int8 slice * its (C, ps) scale slice, one multiply at
+            the query's compute dtype)."""
+            x = scr[pl.ds(base, C), :, h, :]
+            if quantized:
+                x = x.astype(q.dtype) * (
+                    scale_scr[pl.ds(base, C), :, h][..., None]
+                ).astype(q.dtype)
+            return x.reshape(W, D)
 
         # per-kv-head: query rows h*G:(h+1)*G against the head's
         # (chunk*ps, D) keys — static head slices, unrolled over Hkv
         s_parts = []
         for h in range(Hkv):
-            k_h = k_scr[pl.ds(base, C), :, h, :].reshape(W, D)
+            k_h = head_slice(k_scr, ks_scr, h)
             s_parts.append(
                 jax.lax.dot_general(
                     q[h * G : (h + 1) * G], k_h, (((1,), (1,)), ((), ())),
@@ -625,7 +731,7 @@ def _decode_kernel_ragged_grouped(
         l_new = l_prev * alpha + jnp.sum(p, axis=-1, keepdims=True)
         pv_parts = []
         for h in range(Hkv):
-            v_h = v_scr[pl.ds(base, C), :, h, :].reshape(W, D)
+            v_h = head_slice(v_scr, vs_scr, h)
             pv_parts.append(
                 jax.lax.dot_general(
                     p[h * G : (h + 1) * G].astype(v_h.dtype), v_h,
@@ -672,8 +778,14 @@ def paged_decode_attention_ragged(
       matmuls — only real logits, any Hkv (GQA's Hkv=8 included).
     Default picks flat where legal (the round-4 measured configuration)
     and grouped otherwise; pass ``variant=`` explicitly to A/B.
+
+    ``k_pages``/``v_pages`` may be int8 :class:`~.kv_quant.QuantizedKV`
+    caches: both variants then DMA the int8 page plus its f32 scale row and
+    dequantize in VMEM — tolerance-accurate vs the f32 cache (the accuracy
+    contract in docs/kv_cache.md), half the KV HBM traffic.
     """
     B, Hq, D = q.shape
+    quantized = is_quantized(k_pages)
     L, n_pages, page_size, Hkv, _ = k_pages.shape
     if Hq % Hkv:
         raise ValueError(f"Hq={Hq} must be a multiple of Hkv={Hkv}")
@@ -683,26 +795,34 @@ def paged_decode_attention_ragged(
         sm_scale = D**-0.5
     if interpret is None:
         interpret = jax.default_backend() != "tpu"
+    kv_dtype = "int8" if quantized else "bfloat16"
     if variant is None:
-        variant = ragged_variant_for(Hkv)
+        variant = ragged_variant_for(Hkv, kv_dtype)
     if variant not in ("flat", "grouped"):
         raise ValueError(f"unknown variant {variant!r}: flat | grouped")
     if not interpret and not ragged_shapes_ok(D, page_size):
         # fail with the constraint instead of an opaque Mosaic lowering
-        # error: pages must be whole (16, 128) bf16 tiles
+        # error: pages must be whole (16, 128) bf16 / (32, 128) int8 tiles
         raise ValueError(
             f"paged_decode_attention_ragged needs head_dim%128==0 and "
             f"page_size%16==0 on TPU; got D={D}, page_size={page_size}"
         )
-    if not interpret and variant == "flat" and Hkv % 16:
+    flat_mult = flat_variant_hkv_multiple(kv_dtype)
+    if not interpret and variant == "flat" and Hkv % flat_mult:
         raise ValueError(
-            f"variant='flat' needs n_kv_heads%16==0 on TPU (the "
-            f"(ps, Hkv, D) -> (ps*Hkv, D) flatten); got Hkv={Hkv} — use "
-            "variant='grouped' (the default for this shape)"
+            f"variant='flat' needs n_kv_heads%{flat_mult}==0 on TPU for "
+            f"{kv_dtype} pages (the (ps, Hkv, D) -> (ps*Hkv, D) flatten); "
+            f"got Hkv={Hkv} — use variant='grouped' (the default for this "
+            "shape)"
         )
 
+    # int8 caches dequantize to (and fold the in-flight token at) the
+    # query's compute dtype; plain caches keep their own dtype into the
+    # MXU exactly as before (no retile, bit-identical default path)
+    compute_dtype = q.dtype if quantized else k_pages.dtype
     # DMA ring depth: enough in-flight pages to hide issue latency (measured
-    # ~2.3 us/page at depth 2), capped so K+V scratch stays ~<=4 MB of VMEM
+    # ~2.3 us/page at depth 2), capped so K+V scratch stays ~<=4 MB of VMEM.
+    # int8 pages are half the bytes, so the same budget holds twice the ring
     page_bytes = page_size * Hkv * D * k_pages.dtype.itemsize
     depth = max(2, min(pages_per_seq, (2 * 1024 * 1024) // max(page_bytes, 1)))
     chunk = 1
@@ -712,43 +832,65 @@ def paged_decode_attention_ragged(
         chunk = max(1, min(8, pages_per_seq, depth // 2))
         depth = 2 * chunk
 
+    def _const3(shape):
+        return pl.BlockSpec(
+            shape, lambda b, *_refs: (0, 0, 0), memory_space=pltpu.VMEM
+        )
+
+    # full arrays, constant index maps: fetched into VMEM once per call,
+    # not once per program (see _decode_kernel_ragged docstring)
+    in_specs = [
+        _const3((B, Hq, D)),
+        _const3((B, Hkv, D)),
+        _const3((B, Hkv, D)),
+        pl.BlockSpec(memory_space=pltpu.ANY),
+        pl.BlockSpec(memory_space=pltpu.ANY),
+    ]
+    scratch = [
+        pltpu.VMEM((depth, page_size, Hkv, D), k_pages.dtype),
+        pltpu.VMEM((depth, page_size, Hkv, D), v_pages.dtype),
+    ]
+    operands = [
+        q,
+        k_new.astype(compute_dtype),
+        v_new.astype(compute_dtype),
+    ]
+    if quantized:
+        # int8 data + f32 scale-row inputs; scale scratch rides the same
+        # ring (sems columns 2/3)
+        in_specs += [
+            pl.BlockSpec(memory_space=pltpu.ANY),
+            pl.BlockSpec(memory_space=pltpu.ANY),
+        ]
+        scratch += [
+            pltpu.VMEM((depth, page_size, Hkv), jnp.float32),
+            pltpu.VMEM((depth, page_size, Hkv), jnp.float32),
+        ]
+        operands += [
+            k_pages.data, v_pages.data, k_pages.scale, v_pages.scale,
+        ]
+    else:
+        operands += [k_pages, v_pages]
+    scratch += [
+        pltpu.VMEM((Hq, D), jnp.float32),
+        pltpu.SemaphoreType.DMA((depth, 4 if quantized else 2)),
+    ]
     grid_spec = pltpu.PrefetchScalarGridSpec(
         num_scalar_prefetch=3,
         grid=(B,),
-        in_specs=[
-            # full arrays, constant index maps: fetched into VMEM once per
-            # call, not once per program (see _decode_kernel_ragged docstring)
-            pl.BlockSpec(
-                (B, Hq, D), lambda b, *_refs: (0, 0, 0),
-                memory_space=pltpu.VMEM,
-            ),
-            pl.BlockSpec(
-                (B, Hkv, D), lambda b, *_refs: (0, 0, 0),
-                memory_space=pltpu.VMEM,
-            ),
-            pl.BlockSpec(
-                (B, Hkv, D), lambda b, *_refs: (0, 0, 0),
-                memory_space=pltpu.VMEM,
-            ),
-            pl.BlockSpec(memory_space=pltpu.ANY),
-            pl.BlockSpec(memory_space=pltpu.ANY),
-        ],
+        in_specs=in_specs,
         out_specs=pl.BlockSpec(
             (B, Hq, D), lambda b, *_refs: (0, 0, 0),
             memory_space=pltpu.VMEM,
         ),
-        scratch_shapes=[
-            pltpu.VMEM((depth, page_size, Hkv, D), k_pages.dtype),
-            pltpu.VMEM((depth, page_size, Hkv, D), v_pages.dtype),
-            pltpu.VMEM((Hq, D), jnp.float32),
-            pltpu.SemaphoreType.DMA((depth, 2)),
-        ],
+        scratch_shapes=scratch,
     )
     kernel_kw = dict(
         page_size=page_size,
         pages_per_seq=pages_per_seq,
         group=G,
         sm_scale=sm_scale,
+        quantized=quantized,
     )
     if variant == "flat":
         kernel = functools.partial(_decode_kernel_ragged, **kernel_kw)
@@ -756,6 +898,7 @@ def paged_decode_attention_ragged(
         kernel = functools.partial(
             _decode_kernel_ragged_grouped, chunk=chunk, **kernel_kw
         )
+    scale_bytes = 4 * page_size * Hkv if quantized else 0
     out = pl.pallas_call(
         kernel,
         grid_spec=grid_spec,
@@ -766,8 +909,8 @@ def paged_decode_attention_ragged(
         cost_estimate=pl.CostEstimate(
             flops=int(4 * B * Hq * pages_per_seq * page_size * D),
             bytes_accessed=int(
-                2 * B * pages_per_seq * Hkv * page_size * D
-                * k_pages.dtype.itemsize
+                2 * B * pages_per_seq
+                * (Hkv * page_size * D * k_pages.dtype.itemsize + scale_bytes)
             ),
             transcendentals=int(B * Hq * pages_per_seq * page_size),
         ),
@@ -776,11 +919,7 @@ def paged_decode_attention_ragged(
         jnp.reshape(layer, (1,)).astype(jnp.int32),
         page_tables.reshape(-1).astype(jnp.int32),
         prefix_lens.astype(jnp.int32),
-        q,
-        k_new.astype(k_pages.dtype),
-        v_new.astype(v_pages.dtype),
-        k_pages,
-        v_pages,
+        *operands,
     )
     return out
 
@@ -789,64 +928,54 @@ def _kv_scatter_kernel(
     # scalar prefetch
     page_idx_ref,  # (B,) int32
     slot_ref,  # (B,) int32
-    # inputs
-    k_all_hbm,  # (L, B, Hkv, D) ANY — every layer's new KV for each slot
-    v_all_hbm,
-    k_pages_in,  # (L, P, ps, Hkv, D) ANY — aliased with outputs
-    v_pages_in,
-    # outputs (aliased)
-    k_pages_out,
-    v_pages_out,
-    # scratch
-    sems,  # DMA sems (2, 2)
+    # `*refs` (n_arrays is static): n_arrays sources (L, B, ...) ANY, then
+    # n_arrays aliased page inputs, then n_arrays outputs, then DMA sems
+    # (2, n_arrays). n_arrays=2 is the plain k/v cache; int8 caches run
+    # n_arrays=4 with the f32 scale rows as arrays 2/3 ((L, B, Hkv) ->
+    # (L, Hkv) at (page, slot) — the scale travels with its page).
+    *refs,
+    n_arrays: int,
 ):
     """One strided HBM->HBM DMA per (slot, array): copies the [L, Hkv, D]
-    column of new KV into (page_idx[b], slot[b]) of every layer's pages.
+    column of new KV (and, for int8 caches, its [L, Hkv] scale column) into
+    (page_idx[b], slot[b]) of every layer's pages.
 
     XLA's scatter for the same update measured 4.8 ms/step at 7B/32 slots
     (benchmarks/decode_ablate.py) — it rewrites far more than the 33 MB it
     touches. Dead slots all target trash page 0 slot 0; those writes race
     harmlessly (the trash page's content is never attended).
     """
+    srcs = refs[:n_arrays]
+    outs = refs[2 * n_arrays : 3 * n_arrays]
+    sems = refs[3 * n_arrays]
     b = pl.program_id(0)
     nb = pl.num_programs(0)
-    pid = page_idx_ref[b]
-    sl = slot_ref[b]
+
+    def copies(bb):
+        pid = page_idx_ref[bb]
+        sl = slot_ref[bb]
+        buf = jax.lax.rem(bb, 2)
+        return [
+            pltpu.make_async_copy(
+                srcs[a].at[:, bb], outs[a].at[:, pid, sl], sems.at[buf, a]
+            )
+            for a in range(n_arrays)
+        ]
 
     # two-deep pipeline: start this program's copies, wait the previous
     # program's (issued last grid step) so issue latency overlaps transfer
-    buf = jax.lax.rem(b, 2)
-    pltpu.make_async_copy(
-        k_all_hbm.at[:, b], k_pages_out.at[:, pid, sl], sems.at[buf, 0]
-    ).start()
-    pltpu.make_async_copy(
-        v_all_hbm.at[:, b], v_pages_out.at[:, pid, sl], sems.at[buf, 1]
-    ).start()
+    for c in copies(b):
+        c.start()
 
     @pl.when(b > 0)
     def _():
-        prev = b - 1
-        pltpu.make_async_copy(
-            k_all_hbm.at[:, prev],
-            k_pages_out.at[:, page_idx_ref[prev], slot_ref[prev]],
-            sems.at[jax.lax.rem(prev, 2), 0],
-        ).wait()
-        pltpu.make_async_copy(
-            v_all_hbm.at[:, prev],
-            v_pages_out.at[:, page_idx_ref[prev], slot_ref[prev]],
-            sems.at[jax.lax.rem(prev, 2), 1],
-        ).wait()
+        for c in copies(b - 1):
+            c.wait()
 
     @pl.when(b == nb - 1)
     def _():
-        pltpu.make_async_copy(
-            k_all_hbm.at[:, b], k_pages_out.at[:, pid, sl],
-            sems.at[jax.lax.rem(b, 2), 0],
-        ).wait()
-        pltpu.make_async_copy(
-            v_all_hbm.at[:, b], v_pages_out.at[:, pid, sl],
-            sems.at[jax.lax.rem(b, 2), 1],
-        ).wait()
+        for c in copies(b):
+            c.wait()
 
 
 def scatter_kv_pages(
@@ -863,9 +992,14 @@ def scatter_kv_pages(
     DMA per slot per array) — the Pallas replacement for the post-scan XLA
     scatter in llama.decode_step. Exact same semantics as
     ``pages.at[:, page_idx, slot].set(...)`` for distinct targets; dead
-    slots (all pointed at trash page 0) may race, which is harmless."""
+    slots (all pointed at trash page 0) may race, which is harmless.
+
+    int8 caches quantize HERE (per token-head amax/127, fused by XLA into
+    the producing program) and scatter four arrays — int8 K/V columns plus
+    their f32 scale columns — through the same DMA pipeline."""
     if interpret is None:
         interpret = jax.default_backend() != "tpu"
+    quantized = is_quantized(k_pages)
     L, B, Hkv, D = k_all.shape
     if not interpret and not scatter_shapes_ok(D):
         raise ValueError(
@@ -874,51 +1008,53 @@ def scatter_kv_pages(
             f"llama.decode_step / paged_impl_plan for automatic fallback "
             "to the XLA scatter."
         )
+    if quantized:
+        qk, qv = quantize_kv(k_all), quantize_kv(v_all)
+        srcs = [qk.data, qv.data, qk.scale, qv.scale]
+        pages = [k_pages.data, v_pages.data, k_pages.scale, v_pages.scale]
+    else:
+        srcs = [k_all.astype(k_pages.dtype), v_all.astype(v_pages.dtype)]
+        pages = [k_pages, v_pages]
     if interpret:
         # interpreter-mode DMAs of doubly-indexed HBM views are flaky; the
         # XLA scatter is exact and CPU tests only check semantics. Adjacent
         # advanced indices (dims 1, 2) keep their position: result [L, B,
         # Hkv, D] lines up with k_all directly.
-        kp = k_pages.at[:, page_idx, slot].set(k_all)
-        vp = v_pages.at[:, page_idx, slot].set(v_all)
-        return kp, vp
-    grid_spec = pltpu.PrefetchScalarGridSpec(
-        num_scalar_prefetch=2,
-        grid=(B,),
-        in_specs=[
-            pl.BlockSpec(memory_space=pltpu.ANY),
-            pl.BlockSpec(memory_space=pltpu.ANY),
-            pl.BlockSpec(memory_space=pltpu.ANY),
-            pl.BlockSpec(memory_space=pltpu.ANY),
-        ],
-        out_specs=[
-            pl.BlockSpec(memory_space=pltpu.ANY),
-            pl.BlockSpec(memory_space=pltpu.ANY),
-        ],
-        scratch_shapes=[pltpu.SemaphoreType.DMA((2, 2))],
-    )
-    return pl.pallas_call(
-        _kv_scatter_kernel,
-        grid_spec=grid_spec,
-        out_shape=[
-            jax.ShapeDtypeStruct(k_pages.shape, k_pages.dtype),
-            jax.ShapeDtypeStruct(v_pages.shape, v_pages.dtype),
-        ],
-        # +2 for the two scalar-prefetch operands: alias the page arrays
-        # through so the update is in place
-        input_output_aliases={4: 0, 5: 1},
-        compiler_params=_CompilerParams(
-            dimension_semantics=("arbitrary",),
-        ),
-        interpret=interpret,
-    )(
-        page_idx.astype(jnp.int32),
-        slot.astype(jnp.int32),
-        k_all.astype(k_pages.dtype),
-        v_all.astype(v_pages.dtype),
-        k_pages,
-        v_pages,
-    )
+        outs = [p.at[:, page_idx, slot].set(s) for p, s in zip(pages, srcs)]
+    else:
+        n = len(pages)
+        grid_spec = pltpu.PrefetchScalarGridSpec(
+            num_scalar_prefetch=2,
+            grid=(B,),
+            in_specs=[pl.BlockSpec(memory_space=pltpu.ANY)] * (2 * n),
+            out_specs=[pl.BlockSpec(memory_space=pltpu.ANY)] * n,
+            scratch_shapes=[pltpu.SemaphoreType.DMA((2, n))],
+        )
+        outs = pl.pallas_call(
+            functools.partial(_kv_scatter_kernel, n_arrays=n),
+            grid_spec=grid_spec,
+            out_shape=[
+                jax.ShapeDtypeStruct(p.shape, p.dtype) for p in pages
+            ],
+            # +2 for the two scalar-prefetch operands, +n for the sources:
+            # alias the page arrays through so the update is in place
+            input_output_aliases={2 + n + a: a for a in range(n)},
+            compiler_params=_CompilerParams(
+                dimension_semantics=("arbitrary",),
+            ),
+            interpret=interpret,
+        )(
+            page_idx.astype(jnp.int32),
+            slot.astype(jnp.int32),
+            *srcs,
+            *pages,
+        )
+    if quantized:
+        return (
+            QuantizedKV(data=outs[0], scale=outs[2]),
+            QuantizedKV(data=outs[1], scale=outs[3]),
+        )
+    return outs[0], outs[1]
 
 
 def paged_decode_attention(
@@ -960,9 +1096,14 @@ def paged_decode_attention(
     # (observed on-chip with head_dim 32), and the kernel's (ps, Hkv, D) ->
     # (ps*Hkv, D) flatten needs Hkv % 16 (sub-16 head counts pad sublanes;
     # merging padded tiles relayouts). Sub-tile shapes (tiny/test models,
-    # GQA) take the XLA path regardless of impl.
-    if impl != "pallas" or (
-        not interpret and (D % 128 or page_size % 16 or Hkv % 16)
+    # GQA) take the XLA path regardless of impl. int8 (QuantizedKV) caches
+    # also take the XLA path here — _paged_decode_xla dequantizes in its
+    # gather; only the v3/v4 ragged kernels have the int8 Mosaic bring-up
+    # (this legacy write-then-attend kernel is the decode_micro A/B lever).
+    if (
+        impl != "pallas"
+        or is_quantized(k_pages)
+        or (not interpret and (D % 128 or page_size % 16 or Hkv % 16))
     ):
         return _paged_decode_xla(
             q, k_pages, v_pages, page_tables, context_lens, sm_scale

@@ -27,7 +27,15 @@ KERNEL_PROBES: dict[str, str] = {
     "paged_decode": "modal_examples_tpu.ops.probes:probe_paged_decode",
     "ragged_decode": "modal_examples_tpu.ops.probes:probe_ragged_decode",
     "ragged_decode_gqa": "modal_examples_tpu.ops.probes:probe_ragged_decode_gqa",
+    # int8-KV bring-ups (the quantized-cache Mosaic paths: int8 page +
+    # f32 scale-row DMAs, in-VMEM dequant). New DMA shapes => new
+    # first-compile risk => probe-harness territory, per the wedge rule.
+    "ragged_decode_int8kv":
+        "modal_examples_tpu.ops.probes:probe_ragged_decode_int8kv",
+    "ragged_decode_gqa_int8kv":
+        "modal_examples_tpu.ops.probes:probe_ragged_decode_gqa_int8kv",
     "scatter_kv": "modal_examples_tpu.ops.probes:probe_scatter_kv",
+    "scatter_kv_int8": "modal_examples_tpu.ops.probes:probe_scatter_kv_int8",
 }
 
 # which probes cover which pallas_call-bearing module; a test asserts this
@@ -38,7 +46,9 @@ PROBED_MODULES: dict[str, list[str]] = {
         "flash_fwd", "flash_bwd", "flash_chunked",
     ],
     "modal_examples_tpu.ops.paged_attention": [
-        "paged_decode", "ragged_decode", "ragged_decode_gqa", "scatter_kv",
+        "paged_decode", "ragged_decode", "ragged_decode_gqa",
+        "ragged_decode_int8kv", "ragged_decode_gqa_int8kv", "scatter_kv",
+        "scatter_kv_int8",
     ],
     "modal_examples_tpu.ops.quantized_matmul": ["int8_matmul"],
 }
@@ -235,6 +245,101 @@ def probe_ragged_decode_gqa() -> dict:
     err = _err(o, ref)
     assert err < 0.06, err
     return {"max_err": round(err, 4)}
+
+
+def _int8kv_ragged_probe(Hq: int, Hkv: int, variant: str) -> dict:
+    """Shared body for the int8-KV ragged bring-ups: quantized cache into
+    the kernel vs the XLA inflight reference over the DEQUANTIZED pages —
+    isolates kernel correctness from quantization noise, so the bound is
+    the same 0.06 the bf16 probes use."""
+    import functools
+
+    import jax
+    import jax.numpy as jnp
+
+    from modal_examples_tpu import ops
+
+    L, B, D, ps, pp = 2, 2, 128, 16, 4
+    n_pages = B * pp + 1
+    kp = jax.random.normal(
+        jax.random.PRNGKey(0), (L, n_pages, ps, Hkv, D), jnp.bfloat16
+    )
+    vp = jax.random.normal(
+        jax.random.PRNGKey(1), kp.shape, jnp.bfloat16
+    )
+    qkp, qvp = ops.quantize_kv(kp), ops.quantize_kv(vp)
+    pt = (1 + jnp.arange(B * pp, dtype=jnp.int32)).reshape(B, pp)
+    prefix = jnp.array([19, 44], jnp.int32)
+    q = jax.random.normal(jax.random.PRNGKey(2), (B, Hq, D), jnp.bfloat16)
+    k_new = jax.random.normal(jax.random.PRNGKey(3), (B, Hkv, D), jnp.bfloat16)
+    v_new = jax.random.normal(jax.random.PRNGKey(4), (B, Hkv, D), jnp.bfloat16)
+    o = jax.jit(functools.partial(
+        ops.paged_decode_attention_ragged, variant=variant
+    ))(q, qkp, qvp, jnp.int32(1), pt, prefix, k_new, v_new)
+    dk = ops.dequantize_kv(qkp)[1][pt]
+    dv = ops.dequantize_kv(qvp)[1][pt]
+    ref = jax.jit(ops.paged_decode_attention_inflight)(
+        q, dk, dv, prefix, k_new, v_new
+    )
+    err = _err(o, ref)
+    assert err < 0.06, err
+    return {"max_err": round(err, 4)}
+
+
+def probe_ragged_decode_int8kv() -> dict:
+    """int8-KV flat variant (Hkv=32: the int8 page flatten needs Hkv%32 —
+    (32, 128) tiles). First-compile risk: the f32 scale-row DMAs + the
+    in-VMEM int8 dequant multiply."""
+    return _int8kv_ragged_probe(Hq=32, Hkv=32, variant="flat")
+
+
+def probe_ragged_decode_gqa_int8kv() -> dict:
+    """int8-KV grouped variant at the GQA shape (Hkv=8, G=4): per-head
+    strided int8 slices + their (chunk, ps) scale slices."""
+    return _int8kv_ragged_probe(Hq=32, Hkv=8, variant="grouped")
+
+
+def probe_scatter_kv_int8() -> dict:
+    """int8-KV scatter: four-array DMA pipeline (int8 K/V columns + f32
+    scale columns). Same in-place-DMA risk class as scatter_kv; runs after
+    it so a bf16 scatter wedge is attributed first."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from modal_examples_tpu import ops
+
+    L, P, ps, Hkv, D, B = 2, 6, 16, 32, 128, 3
+    kp = ops.quantize_kv(jax.random.normal(
+        jax.random.PRNGKey(0), (L, P, ps, Hkv, D), jnp.float32
+    ))
+    vp = ops.quantize_kv(jax.random.normal(
+        jax.random.PRNGKey(1), (L, P, ps, Hkv, D), jnp.float32
+    ))
+    k_all = jax.random.normal(
+        jax.random.PRNGKey(2), (L, B, Hkv, D), jnp.bfloat16
+    )
+    v_all = jax.random.normal(jax.random.PRNGKey(3), k_all.shape, jnp.bfloat16)
+    page_idx = jnp.array([1, 3, 5], jnp.int32)
+    slot = jnp.array([0, 7, 15], jnp.int32)
+    qk, qv = ops.quantize_kv(k_all), ops.quantize_kv(v_all)
+    # references BEFORE the call: kp/vp are donated through the jit. All
+    # FOUR arrays are checked — v's scale column rides the 4th sem column,
+    # the one DMA no other probe exercises.
+    ref_kd = kp.data.at[:, page_idx, slot].set(qk.data)
+    ref_ks = kp.scale.at[:, page_idx, slot].set(qk.scale)
+    ref_vd = vp.data.at[:, page_idx, slot].set(qv.data)
+    ref_vs = vp.scale.at[:, page_idx, slot].set(qv.scale)
+    ok, ov = jax.jit(ops.scatter_kv_pages, donate_argnums=(0, 1))(
+        kp, vp, k_all, v_all, page_idx, slot
+    )
+    err = max(_err(ok.data, ref_kd), _err(ok.scale, ref_ks))
+    err = max(err, _err(ov.data, ref_vd), _err(ov.scale, ref_vs))
+    assert err == 0.0, err
+    # every non-target entry untouched (data AND scale)
+    assert bool(np.asarray(jnp.all(ok.data[:, 0] == ref_kd[:, 0])))
+    assert bool(np.asarray(jnp.all(ok.scale[:, 0] == ref_ks[:, 0])))
+    return {"max_err": err}
 
 
 def probe_scatter_kv() -> dict:

@@ -15,6 +15,8 @@ from __future__ import annotations
 import jax
 import jax.numpy as jnp
 
+from .kv_quant import kv_gather
+
 
 def attention(
     q: jax.Array,  # [B, Hq, S, D]
@@ -110,7 +112,8 @@ def paged_decode_attention(
     sm_scale: float | None = None,
 ) -> jax.Array:
     """Decode-step attention over a paged KV cache (vLLM-semantics ground
-    truth for the Pallas ragged kernel)."""
+    truth for the Pallas ragged kernel). int8 (QuantizedKV) page caches
+    dequantize in the gather."""
     B, Hq, D = q.shape
     _, page_size, Hkv, _ = k_pages.shape
     group = Hq // Hkv
@@ -119,9 +122,10 @@ def paged_decode_attention(
     if sm_scale is None:
         sm_scale = D**-0.5
 
-    # gather each sequence's logical KV [B, Hkv, S, D]
-    ks = k_pages[page_tables]  # [B, pages, page_size, Hkv, D]
-    vs = v_pages[page_tables]
+    # gather each sequence's logical KV [B, Hkv, S, D]; int8 caches
+    # dequantize at the query's dtype (same as the kernels' VMEM dequant)
+    ks = kv_gather(k_pages, page_tables, dtype=q.dtype)
+    vs = kv_gather(v_pages, page_tables, dtype=q.dtype)
     ks = ks.transpose(0, 3, 1, 2, 4).reshape(B, Hkv, S, D)
     vs = vs.transpose(0, 3, 1, 2, 4).reshape(B, Hkv, S, D)
 
@@ -150,6 +154,8 @@ def paged_verify_attention(
     positions <= positions[b, t] — the multi-token generalization of
     ``paged_decode_attention`` used by speculative-decoding verification
     (the reference ships spec decode engine-side, vllm_inference.py:196-205).
+    int8 (QuantizedKV) page caches dequantize in the gather, so the verify
+    pass scores proposals against exactly the KV values decode will read.
     """
     B, T, Hq, D = q.shape
     _, page_size, Hkv, _ = k_pages.shape
@@ -159,8 +165,9 @@ def paged_verify_attention(
     if sm_scale is None:
         sm_scale = D**-0.5
 
-    ks = k_pages[page_tables]  # [B, pages, page_size, Hkv, D]
-    vs = v_pages[page_tables]
+    # int8 caches dequantize in the gather at the query's dtype
+    ks = kv_gather(k_pages, page_tables, dtype=q.dtype)
+    vs = kv_gather(v_pages, page_tables, dtype=q.dtype)
     ks = ks.transpose(0, 3, 1, 2, 4).reshape(B, Hkv, S, D)
     vs = vs.transpose(0, 3, 1, 2, 4).reshape(B, Hkv, S, D)
 

@@ -106,10 +106,66 @@ class _Slot:
     fresh: bool = False  # just prefilled: first token rides the override lane
     generated: list[int] = dataclasses.field(default_factory=list)
     emitted_text_len: int = 0
+    ngram: "_NgramIndex | None" = None  # prompt-lookup spec mode only
 
     @property
     def free(self) -> bool:
         return self.request is None
+
+
+class _NgramIndex:
+    """Incremental per-slot n-gram index for prompt-lookup speculation.
+
+    Replaces the per-tick O(window x n) rescan of each slot's full history:
+    the index is built ONCE per request from the prompt (O(prompt), off the
+    decode hot path) and updated in O(1) per accepted token, so a proposal
+    tick costs O(gamma) per slot. Semantics match the rescan exactly: the
+    proposal is the continuation of the MOST RECENT occurrence of the
+    trailing n-gram strictly before the tail itself, with the match start
+    confined to the last ``lookback`` tokens (vLLM's prompt_lookup_max
+    analog).
+    """
+
+    __slots__ = ("n", "lookback", "hist", "occ")
+
+    def __init__(self, n: int, prompt: list[int], lookback: int):
+        self.n = n
+        self.lookback = lookback
+        self.hist: list[int] = []
+        #: n-gram tuple -> ascending start positions of its occurrences
+        self.occ: dict[tuple, list[int]] = {}
+        for tok in prompt:
+            self.push(tok)
+
+    def push(self, token: int) -> None:
+        """Append one accepted token; records the n-gram it completes."""
+        self.hist.append(token)
+        start = len(self.hist) - self.n
+        if start >= 0:
+            gram = tuple(self.hist[start:])
+            self.occ.setdefault(gram, []).append(start)
+
+    def propose(self, gamma: int) -> list[int]:
+        """Up to ``gamma`` continuation tokens after the most recent
+        earlier occurrence of the current tail n-gram ([] = no proposal,
+        which degrades that slot to one plain verify step)."""
+        hist, n = self.hist, self.n
+        if len(hist) <= n:
+            return []
+        tail_start = len(hist) - n
+        occs = self.occ.get(tuple(hist[tail_start:]))
+        if not occs:
+            return []
+        lo = max(0, len(hist) - self.lookback)
+        # occs is ascending; the last entry is the tail itself (pushed when
+        # its final token arrived), so scan backwards for the first start
+        # strictly before it — and inside the lookback window
+        for j in reversed(occs):
+            if j < tail_start:
+                if j < lo:
+                    return []  # every earlier occurrence is older still
+                return hist[j + n : j + n + gamma]
+        return []
 
 
 @dataclasses.dataclass
@@ -247,7 +303,10 @@ class LLMEngine:
         enable_prefix_cache: bool = True,
         quantization: str | None = None,  # "int8": weight-only quant serving
         seed: int = 0,
-        kv_dtype=jnp.bfloat16,
+        # page-cache dtype: "int8" = quantized KV (half the decode HBM
+        # traffic + residency; tolerance-based accuracy, docs/kv_cache.md),
+        # a jnp dtype, or None -> MTPU_KV_DTYPE env -> bfloat16
+        kv_dtype=None,
         speculative: tuple | None = None,  # (draft preset|LlamaConfig, gamma)
         draft_params=None,
         draft_model_dir: str | None = None,
@@ -278,6 +337,15 @@ class LLMEngine:
                 f"unknown MTPU_SCATTER_IMPL {self.scatter_impl!r}; "
                 "known: xla, pallas"
             )
+        # cache dtype, same resolve-once rule as the impls: explicit arg
+        # beats MTPU_KV_DTYPE beats the bf16 default ("int8" = quantized
+        # pages + scale arrays, the 4-leaf cache)
+        from ..ops.kv_quant import resolve_kv_dtype
+
+        if kv_dtype is None:
+            kv_dtype = _os.environ.get("MTPU_KV_DTYPE") or jnp.bfloat16
+        kv_dtype = resolve_kv_dtype(kv_dtype)
+        self.kv_dtype = "int8" if kv_dtype == "int8" else str(kv_dtype)
         self.cfg = cfg
         self.tokenizer = load_tokenizer(model_dir)
         from ..models.quantize import SUPPORTED as _QUANT_MODES
@@ -344,17 +412,19 @@ class LLMEngine:
             head_dim=cfg.head_dim,
             n_pages=n_pages,
             page_size=page_size,
-            dtype=kv_dtype,
+            kv_dtype=kv_dtype,
         )
         if mesh is not None:
             self._shard_cache(self.cache)
         # what will ACTUALLY run for these shapes on this backend — a
         # requested pallas impl can be shape-downgraded (sub-128 head_dim /
         # unaligned page_size; GQA runs the "grouped" ragged variant since
-        # round 5); record it so benches/metrics report the real path
-        # instead of the requested one (ADVICE r4)
+        # round 5), and the kv dtype changes the flat-variant legality —
+        # record it so benches/metrics report the real path instead of the
+        # requested one (ADVICE r4)
         self.impl_plan = llama.paged_impl_plan(
-            cfg, page_size, self.paged_impl, self.scatter_impl
+            cfg, page_size, self.paged_impl, self.scatter_impl,
+            kv_dtype=self.kv_dtype,
         )
         self.prefill_buckets = tuple(
             b for b in sorted(prefill_buckets) if b <= max_model_len
@@ -544,7 +614,7 @@ class LLMEngine:
                     head_dim=draft.head_dim,
                     n_pages=n_pages,
                     page_size=page_size,
-                    dtype=kv_dtype,
+                    kv_dtype=kv_dtype,
                     prefer_native=False,  # page ids from the target's allocator
                 )
                 if mesh is not None:
@@ -557,13 +627,18 @@ class LLMEngine:
     def _shard_cache(self, cache) -> None:
         """Shard page arrays [L, P, ps, Hkv, D] by kv head over ``tensor`` —
         every cache byte and its attention math stay on the chip owning the
-        head; page tables/ids remain host-global."""
+        head; page tables/ids remain host-global. int8 caches shard the
+        [L, P, ps, Hkv] f32 scale arrays WITH their pages on the same Hkv
+        axis, so dequant never crosses chips."""
         from jax.sharding import NamedSharding
         from jax.sharding import PartitionSpec as P
 
-        sh = NamedSharding(self.mesh, P(None, None, None, "tensor", None))
-        cache.k_pages = jax.device_put(cache.k_pages, sh)
-        cache.v_pages = jax.device_put(cache.v_pages, sh)
+        from ..ops.kv_quant import shard_kv
+
+        data_sh = NamedSharding(self.mesh, P(None, None, None, "tensor", None))
+        scale_sh = NamedSharding(self.mesh, P(None, None, None, "tensor"))
+        cache.k_pages = shard_kv(cache.k_pages, data_sh, scale_sh)
+        cache.v_pages = shard_kv(cache.v_pages, data_sh, scale_sh)
 
     # -- jitted programs ----------------------------------------------------
 
@@ -838,27 +913,20 @@ class LLMEngine:
     def _ngram_proposals(self):
         """Host-side prompt lookup: match each slot's trailing n-gram
         against its own prompt+generation history; propose the tokens that
-        followed the MOST RECENT earlier occurrence."""
-        gamma, n = self.spec_gamma, self.ngram_n
+        followed the MOST RECENT earlier occurrence. Each slot's
+        ``_NgramIndex`` (built at prefill, pushed per accepted token) makes
+        this O(gamma) per slot per tick — the old full-history rescan was
+        O(window x n) on the host critical path every tick."""
+        gamma = self.spec_gamma
         props = np.zeros((self.max_slots, gamma), np.int32)
         n_prop = np.zeros((self.max_slots,), np.int32)
         for i, s in enumerate(self.slots):
-            if s.free:
+            if s.free or s.ngram is None:
                 continue
-            hist = (s.request.prompt_tokens or []) + s.generated
-            # bounded lookback (vLLM's prompt_lookup_max analog): the scan
-            # is on the host critical path every tick — O(window), not
-            # O(sequence), per slot
-            hist = hist[-self.NGRAM_LOOKBACK:]
-            if len(hist) <= n:
-                continue
-            tail = hist[-n:]
-            for j in range(len(hist) - n - 1, -1, -1):
-                if hist[j : j + n] == tail:
-                    cont = hist[j + n : j + n + gamma]
-                    props[i, : len(cont)] = cont
-                    n_prop[i] = len(cont)
-                    break
+            cont = s.ngram.propose(gamma)
+            if cont:
+                props[i, : len(cont)] = cont
+                n_prop[i] = len(cont)
         return props, n_prop
 
     def _ngram_tick(self, active_idx: list[int]) -> bool:
@@ -1312,6 +1380,9 @@ class LLMEngine:
             free=occ["pages_free"],
             total_usable=occ["pages_total"],
         )
+        # dtype-aware footprint: the same page count pins half the HBM at
+        # kv_dtype="int8", and this gauge is where that shows up
+        _obs.set_kv_cache_bytes(occ["bytes_total"], self.cache.kv_dtype)
         if self.prefix_cache is not None:
             _obs.set_prefix_cache_pages(self.prefix_cache.cached_pages)
         self._flush_token_counters()
@@ -1428,6 +1499,7 @@ class LLMEngine:
             slot = self.slots[slot_idx]
             slot.request = None
             slot.pages = slot.trie_pages = slot.private_pages = []
+            slot.ngram = None
             self._active[slot_idx] = False
             req.out_queue.put(_Finish("error"))
 
@@ -1486,6 +1558,7 @@ class LLMEngine:
         else:
             self.cache.allocator.free(slot.pages)
         slot.pages, slot.trie_pages, slot.private_pages = [], [], []
+        slot.ngram = None
 
     def _prefill_long(self, slot_idx: int, req: Request, claim: dict) -> None:
         """Chunked prefill for prompts beyond the largest bucket: bucket-
@@ -1503,6 +1576,10 @@ class LLMEngine:
         slot.private_pages = claim["private_pages"]
         slot.generated = []
         slot.emitted_text_len = 0
+        if self.spec_mode == "ngram":
+            slot.ngram = _NgramIndex(
+                self.ngram_n, req.prompt_tokens or [], self.NGRAM_LOOKBACK
+            )
         table = np.zeros((self.pages_per_slot,), np.int32)
         table[: len(pages)] = pages
         self._page_tables[slot_idx] = table
@@ -1590,6 +1667,10 @@ class LLMEngine:
             slot.private_pages = claim["private_pages"]
             slot.generated = []
             slot.emitted_text_len = 0
+            if self.spec_mode == "ngram":
+                slot.ngram = _NgramIndex(
+                    self.ngram_n, req.prompt_tokens or [], self.NGRAM_LOOKBACK
+                )
             table = np.zeros((self.pages_per_slot,), np.int32)
             table[: len(pages)] = pages
             self._page_tables[slot_idx] = table
@@ -1844,6 +1925,8 @@ class LLMEngine:
             finished, reason = True, "stop"
         else:
             slot.generated.append(token)
+            if slot.ngram is not None:
+                slot.ngram.push(token)  # O(1) prompt-lookup index update
             if len(slot.generated) >= req.params.max_tokens:
                 finished, reason = True, "length"
             elif slot.position + 1 >= self.max_model_len:

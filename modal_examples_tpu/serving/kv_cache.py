@@ -10,6 +10,14 @@ free-list allocator — intentionally simple; each sequence claims
 ``ceil(max_tokens/page_size)`` pages at admission so decode can never fail
 mid-flight (no preemption/swap in v1, documented trade-off vs vLLM's
 best-effort allocation + preemption).
+
+``create(kv_dtype="int8")`` stores the pages quantized
+(:class:`~..ops.kv_quant.QuantizedKV`: int8 data + per-token-head f32
+scales ``[L, P, page_size, Hkv]``), making the cache a **4-leaf jax
+pytree** — k data/scale + v data/scale — that flows through jit/donation/
+sharding like the plain 2-leaf bf16 cache. Halves KV HBM traffic AND
+residency (~2x the slots/context in the same HBM); see docs/kv_cache.md
+for the layout and the tolerance-based accuracy contract.
 """
 
 from __future__ import annotations
@@ -17,9 +25,11 @@ from __future__ import annotations
 import dataclasses
 import threading
 
+import jax
 import jax.numpy as jnp
 
 from ..observability import metrics as _obs
+from ..ops.kv_quant import is_quantized, kv_dtype_name, kv_empty
 
 
 class OutOfPages(RuntimeError):
@@ -86,7 +96,10 @@ class PageAllocator:
 
 @dataclasses.dataclass
 class PagedKVCache:
-    k_pages: object  # [L, P, page_size, Hkv, hd]
+    # plain [L, P, page_size, Hkv, hd] arrays, or QuantizedKV (int8 data +
+    # [L, P, page_size, Hkv] f32 scales) — two device leaves each way, so
+    # the whole cache is a 2- (bf16) or 4-leaf (int8) pytree
+    k_pages: object
     v_pages: object
     page_size: int
     allocator: PageAllocator
@@ -100,9 +113,15 @@ class PagedKVCache:
         head_dim: int,
         n_pages: int,
         page_size: int = 16,
-        dtype=jnp.bfloat16,
+        kv_dtype=None,  # "int8" | jnp dtype; the canonical spelling
+        dtype=None,  # legacy alias for kv_dtype (kept for callers)
         prefer_native: bool = True,
     ) -> "PagedKVCache":
+        if kv_dtype is not None and dtype is not None:
+            raise ValueError("pass kv_dtype= or dtype=, not both")
+        kv_dtype = kv_dtype if kv_dtype is not None else dtype
+        if kv_dtype is None:
+            kv_dtype = jnp.bfloat16
         shape = (n_layers, n_pages, page_size, n_kv_heads, head_dim)
         allocator = None
         if prefer_native:
@@ -113,8 +132,8 @@ class PagedKVCache:
             except Exception:
                 allocator = None
         return cls(
-            k_pages=jnp.zeros(shape, dtype),
-            v_pages=jnp.zeros(shape, dtype),
+            k_pages=kv_empty(shape, kv_dtype),
+            v_pages=kv_empty(shape, kv_dtype),
             page_size=page_size,
             allocator=allocator or PageAllocator(n_pages),
         )
@@ -123,8 +142,23 @@ class PagedKVCache:
     def n_pages(self) -> int:
         return self.k_pages.shape[1]
 
+    @property
+    def kv_dtype(self) -> str:
+        """Reporting name of the page dtype: "int8" (quantized) or the
+        array dtype name ("bfloat16"/"float32")."""
+        return kv_dtype_name(self.k_pages)
+
+    @property
+    def quantized(self) -> bool:
+        return is_quantized(self.k_pages)
+
     def bytes(self) -> int:
-        return 2 * self.k_pages.size * self.k_pages.dtype.itemsize
+        """Total device bytes of the page arrays, dtype-aware: int8 caches
+        count the int8 payload plus the f32 scale rows (~3% at D=128) —
+        about half the bf16 figure, which is exactly the headroom the
+        occupancy gauges and bench.py's ``kv_cache`` section report.
+        (``nbytes`` is a property on QuantizedKV and jax.Array alike.)"""
+        return self.k_pages.nbytes + self.v_pages.nbytes
 
     def pages_for(self, n_tokens: int) -> int:
         return (n_tokens + self.page_size - 1) // self.page_size
@@ -132,7 +166,9 @@ class PagedKVCache:
     def occupancy(self) -> dict:
         """Page-pool occupancy snapshot (works for the native allocator too,
         which has no gauge hooks of its own): used/free/total pages, the
-        allocated fraction, and the HBM bytes that fraction pins."""
+        allocated fraction, and the HBM bytes that fraction pins (dtype-
+        aware via :meth:`bytes` — int8 caches report ~half the bf16
+        footprint for the same page count)."""
         usable = self.n_pages - 1
         free = self.allocator.available
         used = usable - free
@@ -145,3 +181,17 @@ class PagedKVCache:
             "bytes_used": used * bytes_per_page,
             "bytes_total": self.bytes(),
         }
+
+
+# a jax pytree (device leaves: k/v pages — 2 for bf16, 4 for int8 with the
+# scale arrays riding alongside) so tree utilities (jax.tree.leaves,
+# utils.sync.force, snapshot codecs) see the device state. CAUTION: the
+# allocator rides in meta_fields and compares by IDENTITY (mutable host
+# state, no __eq__) — do NOT pass a whole cache as a jit argument; every
+# distinct allocator would be a distinct static key (silent retraces).
+# Jitted programs take cache.k_pages / cache.v_pages, as the engine does.
+jax.tree_util.register_dataclass(
+    PagedKVCache,
+    data_fields=("k_pages", "v_pages"),
+    meta_fields=("page_size", "allocator"),
+)

@@ -213,7 +213,8 @@ class _Handler(BaseHTTPRequestHandler):
             lines.append(
                 f'{_C.DECODE_IMPL}{{attention="'
                 f'{eng.impl_plan["attention"]}",scatter='
-                f'"{eng.impl_plan["scatter"]}"}} 1'
+                f'"{eng.impl_plan["scatter"]}",kv_dtype='
+                f'"{eng.impl_plan["kv_dtype"]}"}} 1'
             )
             body = ("\n".join(lines) + "\n" + reg_text).encode()
             self.send_response(200)

@@ -19,6 +19,16 @@ Mechanics:
 - zero-ref pages stay cached until ``evict()`` reclaims them LRU-first under
   allocator pressure. Decode never writes shared pages: a sequence's writes
   start at its first non-shared page.
+
+int8 KV (``kv_dtype="int8"``, docs/kv_cache.md): sharing is by PHYSICAL
+page id, and the quantized cache's f32 scale rows are indexed by the same
+page ids as their int8 data — so a shared prefix page always travels with
+its scale row, and nothing here changes. The rewrite-identical-values
+property holds too: quantization (per token-head amax/127) is
+deterministic, so same tokens + same weights => same int8 bytes AND same
+scale rows when concurrent prefills rewrite a shared page. Bonus: int8
+pages are half the HBM, so the same allocator headroom caches ~2x the
+prefix pages before eviction pressure starts.
 """
 
 from __future__ import annotations

@@ -13,6 +13,12 @@ is the multi-chip counterpart of the single-chip paged cache: kv-head
 sharding keeps every cache byte and its attention math on the chip that owns
 the head. (Paged attention stays the single-chip fast path; a TP paged
 kernel via shard_map is a later-round item.)
+
+``kv_dtype="int8"`` stores the dense cache quantized, exactly like the
+paged cache: int8 ``[L, B, Hkv, S, D]`` data plus per-token-head f32
+``[L, B, Hkv, S]`` scales (a :class:`~..ops.kv_quant.QuantizedKV` per
+side), with the scales sharded over the SAME ``tensor``/kv-head axis as
+their data so dequantization never crosses chips.
 """
 
 from __future__ import annotations
@@ -25,22 +31,34 @@ import jax.numpy as jnp
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from ..models import llama, layers
+from ..ops.kv_quant import (
+    QuantizedKV,
+    dequantize_kv,
+    kv_empty,
+    quantize_kv,
+    shard_kv,
+)
 
 
 @dataclasses.dataclass
 class DenseKVCache:
-    k: jax.Array  # [L, B, Hkv, S, D]
-    v: jax.Array
+    k: object  # [L, B, Hkv, S, D] array, or QuantizedKV (int8 + scales)
+    v: object
     _pytree = None
 
     @classmethod
-    def create(cls, cfg: llama.LlamaConfig, batch: int, max_len: int, mesh=None, dtype=jnp.bfloat16):
+    def create(
+        cls, cfg: llama.LlamaConfig, batch: int, max_len: int, mesh=None,
+        dtype=jnp.bfloat16, kv_dtype=None,
+    ):
         shape = (cfg.n_layers, batch, cfg.n_kv_heads, max_len, cfg.head_dim)
-        k = jnp.zeros(shape, dtype)
-        v = jnp.zeros(shape, dtype)
+        k = kv_empty(shape, kv_dtype if kv_dtype is not None else dtype)
+        v = kv_empty(shape, kv_dtype if kv_dtype is not None else dtype)
         if mesh is not None:
-            sh = NamedSharding(mesh, P(None, None, "tensor", None, None))
-            k, v = jax.device_put(k, sh), jax.device_put(v, sh)
+            data_sh = NamedSharding(mesh, P(None, None, "tensor", None, None))
+            scale_sh = NamedSharding(mesh, P(None, None, "tensor", None))
+            k = shard_kv(k, data_sh, scale_sh)
+            v = shard_kv(v, data_sh, scale_sh)
         return cls(k, v)
 
 
@@ -95,20 +113,37 @@ def decode_step_dense(
         q = layers.apply_rope(q, cos, sin)
         k = layers.apply_rope(k, cos, sin)
 
-        # write this token's K/V at its position (scatter over batch)
+        # write this token's K/V at its position (scatter over batch);
+        # int8 caches quantize at the write (per token-head amax/127) and
+        # scatter the scale with its data
         b_idx = jnp.arange(B)
-        k_c = k_c.at[b_idx, :, positions].set(k[:, :, 0])
-        v_c = v_c.at[b_idx, :, positions].set(v[:, :, 0])
+        if isinstance(k_c, QuantizedKV):
+            qk, qv = quantize_kv(k[:, :, 0]), quantize_kv(v[:, :, 0])
+            k_c = QuantizedKV(
+                data=k_c.data.at[b_idx, :, positions].set(qk.data),
+                scale=k_c.scale.at[b_idx, :, positions].set(qk.scale),
+            )
+            v_c = QuantizedKV(
+                data=v_c.data.at[b_idx, :, positions].set(qv.data),
+                scale=v_c.scale.at[b_idx, :, positions].set(qv.scale),
+            )
+            k_att = dequantize_kv(k_c, x.dtype)
+            v_att = dequantize_kv(v_c, x.dtype)
+        else:
+            k_c = k_c.at[b_idx, :, positions].set(k[:, :, 0])
+            v_c = v_c.at[b_idx, :, positions].set(v[:, :, 0])
+            k_att, v_att = k_c, v_c
 
         # GQA attention over the cache, masked to live positions
         G = cfg.n_heads // cfg.n_kv_heads
         qg = q.reshape(B, cfg.n_kv_heads, G, D)
         s = jnp.einsum(
-            "bhgd,bhsd->bhgs", qg.astype(jnp.float32), k_c.astype(jnp.float32)
+            "bhgd,bhsd->bhgs", qg.astype(jnp.float32),
+            k_att.astype(jnp.float32),
         ) * (D**-0.5)
         s = jnp.where(pos_mask[:, None, None, :], s, -jnp.inf)
         p = jax.nn.softmax(s, axis=-1)
-        o = jnp.einsum("bhgs,bhsd->bhgd", p.astype(v_c.dtype), v_c)
+        o = jnp.einsum("bhgs,bhsd->bhgd", p.astype(v_att.dtype), v_att)
         o = o.reshape(B, cfg.n_heads * D)
         x = x + layers.mm(o, layer["wo"]).astype(x.dtype)
         h = layers.rms_norm(x, layer["mlp_norm"], cfg.norm_eps)
@@ -135,13 +170,17 @@ def generate_tp(
     max_len: int = 256,
     key: jax.Array | None = None,
     temperature: float = 0.0,
+    kv_dtype=None,  # "int8": quantized dense cache (halved KV bytes)
 ) -> jax.Array:
     """Greedy/temperature generation with the dense TP cache: prefill token
     by token (simple, compile-once), then decode max_new tokens."""
     B, S0 = prompts.shape
     if mesh is not None:
         params = shard_params_tp(params, cfg, mesh)
-    cache = DenseKVCache.create(cfg, B, max_len, mesh, dtype=params["embed"].dtype)
+    cache = DenseKVCache.create(
+        cfg, B, max_len, mesh, dtype=params["embed"].dtype,
+        kv_dtype=kv_dtype,
+    )
     key = key if key is not None else jax.random.PRNGKey(0)
 
     out = jnp.zeros((B, S0 + max_new), jnp.int32)

@@ -54,6 +54,14 @@ def test_bench_emits_schema_json():
     assert dq["p50"] <= dq["p95"]
     assert 0.0 <= sched["shed_rate"] <= 1.0
     assert sched["shed_rate"] == 0.0  # bench must never overload itself
+    # KV-cache footprint (ISSUE-5): dtype-aware bytes + the slots-at-HBM
+    # headroom figure ride in every BENCH json (int8 KV shows ~2x here)
+    kv = payload.get("kv_cache")
+    assert kv, payload
+    assert {"dtype", "bytes", "bytes_per_slot", "max_slots_at_hbm"} <= set(kv)
+    assert kv["dtype"] in ("bfloat16", "int8", "float32")
+    assert kv["bytes"] > 0 and kv["bytes_per_slot"] > 0
+    assert kv["max_slots_at_hbm"] > 0  # tiny model: plenty of HBM headroom
     assert payload["tokens_per_second"] == payload["value"]
 
 

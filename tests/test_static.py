@@ -216,6 +216,80 @@ def test_scheduler_policies_implement_full_abc():
     )
 
 
+#: modules that consume the paged/dense KV cache arrays; every entry point
+#: in them must handle BOTH cache forms (plain arrays and the int8 4-leaf
+#: QuantizedKV pytree — docs/kv_cache.md)
+_KV_CONSUMER_MODULES = (
+    "ops/paged_attention.py",
+    "ops/reference.py",
+    "models/llama.py",
+    "serving/tensor_parallel.py",
+)
+
+#: referencing any of these marks a function as quantized-cache-aware
+_KV_QUANT_TOKENS = {
+    "QuantizedKV", "is_quantized", "kv_gather", "kv_scatter", "kv_empty",
+    "quantize_kv", "dequantize_kv", "kv_quant", "kv_dtype_name", "shard_kv",
+}
+
+
+def test_kv_cache_consumers_handle_quantized_pytree():
+    """Every paged-attention entry point / cache consumer — any top-level
+    function taking the page arrays (``k_pages``/``v_pages`` params, or the
+    dense ``cache`` in tensor_parallel) — must handle the int8 4-leaf
+    QuantizedKV cache: either it references a kv_quant helper directly, or
+    it delegates to another checked consumer (transitive closure). A
+    consumer that silently indexes plain arrays would make ``kv_dtype=
+    "int8"`` crash (best case) or silently read garbage through a pytree
+    leaf (worst) — the same unrepresentability treatment as the decorator-
+    kwargs guard above. Raw Pallas kernels (``k_hbm``/``k_all_hbm``
+    params) are exempt: their wrappers are the checked entry points."""
+    funcs: dict[str, ast.FunctionDef] = {}
+    consumers: list[str] = []
+    for rel in _KV_CONSUMER_MODULES:
+        tree = ast.parse((PKG_ROOT / rel).read_text())
+        for node in tree.body:
+            if not isinstance(node, ast.FunctionDef):
+                continue
+            params = {
+                a.arg for a in node.args.args + node.args.kwonlyargs
+            }
+            funcs[node.name] = node
+            if {"k_pages", "v_pages"} & params or (
+                rel.endswith("tensor_parallel.py") and "cache" in params
+            ):
+                consumers.append(node.name)
+
+    def refs(fn: ast.FunctionDef) -> set[str]:
+        out = set()
+        for node in ast.walk(fn):
+            if isinstance(node, ast.Name):
+                out.add(node.id)
+            elif isinstance(node, ast.Attribute):
+                out.add(node.attr)
+        return out
+
+    aware = {
+        name for name, fn in funcs.items() if refs(fn) & _KV_QUANT_TOKENS
+    }
+    changed = True
+    while changed:  # transitive: delegating to an aware consumer counts
+        changed = False
+        for name, fn in funcs.items():
+            if name not in aware and refs(fn) & aware:
+                aware.add(name)
+                changed = True
+    unaware = sorted(set(consumers) - aware)
+    assert not unaware, (
+        "KV-cache consumers that never branch on (or delegate to a handler "
+        f"of) the quantized 4-leaf cache: {unaware} — use ops.kv_quant "
+        "helpers (kv_gather/kv_scatter/is_quantized/...) so kv_dtype="
+        "'int8' cannot silently hit an f32-only path"
+    )
+    # the guard must actually be guarding something
+    assert len(consumers) >= 8, consumers
+
+
 def test_no_bare_print_in_framework_code():
     """Framework code under ``core/`` and ``serving/`` must not ``print()``:
     diagnostics go through ``utils.log.get_logger`` so they carry a level

@@ -129,6 +129,101 @@ class TestEngineTP:
             single.stop()
             tp.stop()
 
+    def test_int8_kv_engine_tp2(self, jax):
+        """int8 KV composes with tensor parallelism: the 4-leaf cache's
+        scale arrays shard on the same kv-head axis as their int8 data
+        (engine._shard_cache), so dequant never crosses chips. NOT a
+        token-exact assertion like the bf16/f32 TP tests: a psum's
+        ulp-level reduction reordering can flip an int8 rounding at a code
+        boundary, so the contract is tolerance-based (docs/kv_cache.md) —
+        checked on logits below; here the engine must boot, shard all four
+        leaves, and generate cleanly."""
+        from modal_examples_tpu.models import llama
+        from modal_examples_tpu.parallel import make_mesh
+        from modal_examples_tpu.serving import LLMEngine, SamplingParams
+
+        cfg = llama.LlamaConfig(
+            vocab_size=128, dim=64, n_layers=2, n_heads=4, n_kv_heads=2,
+            ffn_dim=128, max_seq_len=128, dtype="float32",
+        )
+        params = llama.init_params(jax.random.PRNGKey(7), cfg)
+        mesh = make_mesh({"tensor": 2}, devices=jax.devices()[:2])
+
+        tp = LLMEngine(
+            cfg, params, mesh=mesh, max_slots=2, max_model_len=64,
+            page_size=16, prefill_buckets=(32,), seed=0, kv_dtype="int8",
+        )
+        try:
+            sp = SamplingParams(max_tokens=12, temperature=0.0)
+            out = tp.generate("quantized cache sharded", sp)
+            assert isinstance(out, str) and tp.error_count == 0
+            # int8 payload AND f32 scale rows actually sharded
+            kp = tp.cache.k_pages
+            assert len(kp.data.sharding.device_set) == 2
+            assert len(kp.scale.sharding.device_set) == 2
+        finally:
+            tp.stop()
+
+    def test_int8_kv_tp2_logit_drift_vs_single(self, jax):
+        """The tolerance half of the int8-KV TP contract: prefill + decode
+        logits over the sharded quantized cache stay within the declared
+        drift of the single-device quantized run (differences come only
+        from psum reduction order at int8 code boundaries)."""
+        import jax.numpy as jnp
+
+        from modal_examples_tpu.models import llama
+        from modal_examples_tpu.parallel import make_mesh
+        from modal_examples_tpu.serving.engine import _shard_params
+        from modal_examples_tpu.serving.kv_cache import PagedKVCache
+
+        cfg = llama.LlamaConfig(
+            vocab_size=128, dim=64, n_layers=2, n_heads=4, n_kv_heads=2,
+            ffn_dim=128, max_seq_len=128, dtype="float32",
+        )
+        params = llama.init_params(jax.random.PRNGKey(8), cfg)
+        mesh = make_mesh({"tensor": 2}, devices=jax.devices()[:2])
+        toks = jax.random.randint(jax.random.PRNGKey(9), (2, 16), 0, 128)
+        tables = jnp.asarray(
+            1 + np.arange(2 * 4).reshape(2, 4), jnp.int32
+        )
+        seq_lens = jnp.array([12, 16], jnp.int32)
+        active = jnp.ones((2,), bool)
+
+        def run(p, shard):
+            cache = PagedKVCache.create(
+                n_layers=cfg.n_layers, n_kv_heads=cfg.n_kv_heads,
+                head_dim=cfg.head_dim, n_pages=9, page_size=16,
+                kv_dtype="int8", prefer_native=False,
+            )
+            if shard:
+                from jax.sharding import NamedSharding
+                from jax.sharding import PartitionSpec as P
+
+                from modal_examples_tpu.ops import QuantizedKV
+
+                d = NamedSharding(mesh, P(None, None, None, "tensor", None))
+                s = NamedSharding(mesh, P(None, None, None, "tensor"))
+                for name in ("k_pages", "v_pages"):
+                    pg = getattr(cache, name)
+                    setattr(cache, name, QuantizedKV(
+                        data=jax.device_put(pg.data, d),
+                        scale=jax.device_put(pg.scale, s),
+                    ))
+            lo, kp, vp = llama.prefill(
+                p, toks, cache.k_pages, cache.v_pages, tables, seq_lens,
+                cfg, attn_impl="xla",
+            )
+            nxt = jnp.argmax(lo, -1).astype(jnp.int32)
+            l2, _, _ = llama.decode_step(
+                p, nxt, seq_lens, kp, vp, tables, active, cfg, impl="xla"
+            )
+            return np.asarray(lo), np.asarray(l2)
+
+        lo_s, l2_s = run(params, shard=False)
+        lo_t, l2_t = run(_shard_params(params, cfg, mesh), shard=True)
+        assert float(np.max(np.abs(lo_t - lo_s))) < 0.25
+        assert float(np.max(np.abs(l2_t - l2_s))) < 0.25
+
     def test_quantized_engine_tp2_exact_match(self, jax):
         """int8 weight-only quantization composes with tensor parallelism
         (vLLM serves quantized TP): TP engine output must equal the
