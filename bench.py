@@ -64,6 +64,17 @@ CONFIGS = {
         slots=16, max_len=1024, max_tokens=128, timeout=1500, quant="int8",
         kv_dtype="int8", prompt_mult=40,
     ),
+    "llama2-7b-disagg-2rep": dict(
+        # disaggregated prefill/decode at the ctx-1024 int8-KV shape (the
+        # A/B partner of llama2-7b-int8-kv8-ctx1024): a prefill replica
+        # computes prompt KV and ships int8 pages + scale rows to the
+        # decode replica (docs/disagg.md). Weights are SHARED between the
+        # two in-process engines (params= alias, read-only in the jits) so
+        # HBM pays one int8 weight set + two caches; the prefill replica
+        # runs 4 slots of transient claims (prefills are serialized).
+        slots=16, max_len=1024, max_tokens=128, timeout=1500, quant="int8",
+        kv_dtype="int8", prompt_mult=40, disagg=True,
+    ),
     "llama2-7b-int8-s32": dict(
         slots=32, max_len=256, max_tokens=128, timeout=1200, quant="int8"
     ),
@@ -81,6 +92,11 @@ CONFIGS = {
     ),
     "llama-1b": dict(slots=16, max_len=512, max_tokens=128, timeout=900),
     "tiny": dict(slots=4, max_len=128, max_tokens=16, timeout=420),
+    # CPU path-proof of the disagg pipeline (test_bench_contract): never the
+    # headline, but the same two-replica code shape the 7B config runs
+    "tiny-disagg": dict(
+        slots=4, max_len=128, max_tokens=16, timeout=420, disagg=True
+    ),
 }
 
 
@@ -127,6 +143,43 @@ def _child(model: str) -> None:
     )
     build_s = time.time() - t0
     weight_bytes = param_bytes(engine.params)
+
+    # disaggregated two-replica mode (docs/disagg.md): `engine` becomes the
+    # DECODE replica; a second engine sharing the same (read-only) weight
+    # buffers runs prefill only and ships finished KV pages over the chunked
+    # wire. Traffic then flows through the coordinator, so the measured
+    # tok/s includes prefill, migration, adoption, and decode.
+    coord = None
+    if spec.get("disagg"):
+        from modal_examples_tpu.scheduling import EngineReplica
+        from modal_examples_tpu.serving.disagg import DisaggCoordinator
+
+        prefill_engine = LLMEngine(
+            cfg,
+            params=engine.params,  # alias, not a copy: one weight set in HBM
+            max_slots=min(4, spec["slots"]),  # transient, serialized claims
+            max_model_len=spec["max_len"],
+            page_size=16,
+            prefill_buckets=(64, 128, 256),
+            kv_dtype=spec.get("kv_dtype", jnp.bfloat16),
+            paged_impl="xla",  # never decodes; skip kernel-probe surface
+            tiered_prefix=True,  # host-RAM spill tier under the trie
+        )
+        coord = DisaggCoordinator(
+            [
+                EngineReplica(prefill_engine, "prefill-0", role="prefill"),
+                EngineReplica(engine, "decode-0", role="decode"),
+            ]
+        )
+
+    def _submit(prompt_s, sampling):
+        if coord is not None:
+            return coord.submit(prompt_s, sampling)
+        return engine.submit(prompt_s, sampling)
+
+    def _stream(req):
+        return coord.stream(req) if coord is not None else engine.stream(req)
+
     prompt = (
         "The quick brown fox jumps over the lazy dog. "
         * spec.get("prompt_mult", 2)
@@ -140,19 +193,19 @@ def _child(model: str) -> None:
     t0 = time.time()
     engine.warmup()
     engine.start()
-    warm = [engine.submit(prompt, SamplingParams(max_tokens=8, temperature=1.0))
+    warm = [_submit(prompt, SamplingParams(max_tokens=8, temperature=1.0))
             for _ in range(2)]
     for r in warm:
-        "".join(engine.stream(r))
+        "".join(_stream(r))
     compile_s = time.time() - t0
 
     # timed: saturate all slots
     n_reqs = spec["slots"] * 2
     base_tokens = engine.stats.generated_tokens
     t0 = time.time()
-    reqs = [engine.submit(prompt, params) for _ in range(n_reqs)]
+    reqs = [_submit(prompt, params) for _ in range(n_reqs)]
     for r in reqs:
-        for _ in engine.stream(r):
+        for _ in _stream(r):
             pass
     elapsed = time.time() - t0
     generated = engine.stats.generated_tokens - base_tokens
@@ -238,6 +291,35 @@ def _child(model: str) -> None:
         "sheds_total": int(sheds),
         "admitted_total": int(admitted),
     }
+    # disaggregated serving (docs/disagg.md): migration volume + latency and
+    # the tiered prefix cache's per-tier hit mix, only for disagg configs
+    disagg_info = None
+    if coord is not None:
+        mig = coord.stats()["migrations"]
+        mq = _q(C.DISAGG_MIGRATION_SECONDS)
+        tier_hits = {
+            lbls.get("tier", "?"): int(v)
+            for lbls, v in default_registry.series(C.PREFIX_TIER_HITS_TOTAL)
+        }
+        total_hits = sum(tier_hits.values())
+        disagg_info = {
+            "pages_migrated": int(mig["pages"]),
+            "migration_bytes": int(mig["bytes"]),
+            "migrations": {
+                k: int(mig[k]) for k in ("ok", "fallback", "aborted")
+            },
+            "migration_latency": (
+                {k: mq[k] for k in ("p50", "p95", "count") if k in mq}
+                if mq
+                else None
+            ),
+            "tier_hits": tier_hits,
+            "tier_hit_rates": {
+                k: round(v / total_hits, 6) for k, v in tier_hits.items()
+            }
+            if total_hits
+            else {},
+        }
     print(
         json.dumps(
             {
@@ -261,6 +343,7 @@ def _child(model: str) -> None:
                 "scheduling": scheduling,
                 "kv_cache": kv_cache_info,
                 "tokens_per_second": round(tok_s, 2),
+                **({"disagg": disagg_info} if disagg_info else {}),
             }
         )
     )
@@ -687,6 +770,7 @@ def main() -> int:
             "llama2-7b-int4-s36",
             "llama2-7b-int8-s36",
             "llama2-7b-int8-kv8-ctx1024",
+            "llama2-7b-disagg-2rep",
             "llama2-7b-int8-s32",
             "llama2-7b-int8-s16",
             "llama3.1-8b-int8-s32",

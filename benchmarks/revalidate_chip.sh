@@ -32,5 +32,11 @@ timeout 900 python benchmarks/decode_micro.py --quant int8 --slots 32 --impl pal
 timeout 900 python benchmarks/decode_micro.py --probe --quant int8 --slots 32 --impl pallas --kv-dtype int8 || exit 9
 timeout 900 python benchmarks/decode_micro.py --quant int8 --slots 8,16 --max-len 1024 --impl pallas --kv-dtype bf16 || exit 10
 timeout 900 python benchmarks/decode_micro.py --quant int8 --slots 8,16 --max-len 1024 --impl pallas --kv-dtype int8 || exit 11
-# 8. full bench (includes the kv_cache section + the ctx-1024 int8-KV config)
-timeout 1500 python bench.py || exit 12
+# 8. two-replica disagg smoke: the ctx-1024 int8-KV config unified, then the
+#    same shape disaggregated (prefill replica shipping int8 pages + scale
+#    rows to the decode replica, weights shared) — the A/B that prices page
+#    migration on real hardware (docs/disagg.md)
+timeout 1500 env BENCH_MODEL=llama2-7b-int8-kv8-ctx1024 BENCH_NO_SECONDARY=1 python bench.py || exit 12
+timeout 1500 env BENCH_MODEL=llama2-7b-disagg-2rep BENCH_NO_SECONDARY=1 python bench.py || exit 13
+# 9. full bench (includes the kv_cache + disagg sections)
+timeout 1500 python bench.py || exit 14

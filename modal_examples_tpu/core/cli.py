@@ -19,6 +19,7 @@ Usage:
     tpurun scaler [N] [--function TAG] # autoscaler decision journal
     tpurun sched [--watch S]           # live class queues, shed rates, router
     tpurun top [--watch S]             # live serving summary + SLO burn rates
+    tpurun disagg [--watch S]          # replica roles, migrations, KV tiers
 """
 
 from __future__ import annotations
@@ -704,6 +705,104 @@ def cmd_sched(argv: list[str]) -> int:
     return 0
 
 
+def cmd_disagg(argv: list[str]) -> int:
+    """Live disaggregated-serving view: replica roles, outstanding and
+    completed migrations (with wire bytes + latency quantiles), and the
+    tiered prefix cache's per-tier occupancy and hit rates — from the
+    pushed metrics files (the disagg companion of ``tpurun sched``;
+    docs/disagg.md).
+
+    ``--watch S`` refreshes every S seconds; ``--dir PATH`` overrides the
+    state dir root.
+    """
+    from ..observability import catalog as C
+    from ..observability.export import pushed_jobs
+    from ..utils.prometheus import merge_expositions, parse_exposition
+
+    usage = "usage: tpurun disagg [--watch S] [--dir PATH]"
+    argv, root = _pop_dir_flag(argv, usage)
+    argv, watch_s = _pop_flag(argv, "--watch", usage)
+    watch = float(watch_s) if watch_s is not None else None
+
+    from pathlib import Path
+
+    metrics_root = Path(root) / "metrics" if root else None
+
+    def render() -> None:
+        jobs = pushed_jobs(metrics_root)
+        if not jobs:
+            print("no pushed metrics yet (run an app or bench first)")
+        merged = parse_exposition(merge_expositions(jobs))
+        print(f"jobs: {len(jobs)} ({', '.join(sorted(jobs)) or 'none'})")
+        roles = sorted(
+            (lbls.get("replica", "?"), lbls.get("role", "?"))
+            for lbls, v in merged.series(C.REPLICA_ROLE)
+            if v
+        )
+        if roles:
+            print(f"{'REPLICA':<24} ROLE")
+            for name, role in roles:
+                print(f"{name:<24} {role}")
+        else:
+            print("no role-tagged replicas (unified fleet)")
+        by_result = {
+            lbls.get("result", "?"): v
+            for lbls, v in merged.series(C.DISAGG_MIGRATIONS_TOTAL)
+        }
+        inflight = merged.total(C.DISAGG_MIGRATIONS_INFLIGHT)
+        q = merged.histogram_quantiles(
+            C.DISAGG_MIGRATION_SECONDS, quantiles=(0.5, 0.95), aggregate={}
+        )
+        lat = (
+            f"{q['p50'] * 1000:.1f}/{q['p95'] * 1000:.1f} ms"
+            if q
+            else "-/-"
+        )
+        print(
+            f"migrations: {int(sum(by_result.values()))} total "
+            f"({' '.join(f'{k}={int(v)}' for k, v in sorted(by_result.items())) or 'none'})"
+            f"   inflight {int(inflight)}"
+        )
+        print(
+            f"  pages {int(merged.total(C.DISAGG_PAGES_MIGRATED_TOTAL))}   "
+            f"wire bytes {int(merged.total(C.DISAGG_MIGRATION_BYTES_TOTAL))}   "
+            f"chunk retries "
+            f"{int(merged.total(C.DISAGG_CHUNK_RETRIES_TOTAL))}   "
+            f"latency p50/p95 {lat}"
+        )
+        hits = {
+            lbls.get("tier", "?"): v
+            for lbls, v in merged.series(C.PREFIX_TIER_HITS_TOTAL)
+        }
+        total_hits = sum(hits.values())
+        print()
+        print(f"{'TIER':<8} {'BLOCKS':>8} {'BYTES':>12} {'HITS':>8} {'RATE':>6}")
+        for tier in ("hbm", "host", "volume"):
+            pages = merged.total(C.PREFIX_TIER_PAGES, {"tier": tier})
+            tier_bytes = merged.total(C.PREFIX_TIER_BYTES, {"tier": tier})
+            h = hits.get(tier, 0.0)
+            rate = h / total_hits if total_hits else 0.0
+            occ = "-" if tier == "hbm" else f"{int(pages)}"
+            occ_b = "-" if tier == "hbm" else f"{int(tier_bytes)}"
+            print(
+                f"{tier:<8} {occ:>8} {occ_b:>12} {int(h):>8} {rate:>6.2f}"
+            )
+
+    if watch is None:
+        render()
+        return 0
+    import time as _time
+
+    try:
+        while True:
+            print("\033[2J\033[H", end="")
+            render()
+            _time.sleep(watch)
+    except KeyboardInterrupt:
+        pass
+    return 0
+
+
 def cmd_app(argv: list[str]) -> int:
     if argv and argv[0] == "list":
         reg = _config.state_dir() / "apps.json"
@@ -728,6 +827,7 @@ COMMANDS = {
     "metrics": cmd_metrics,
     "scaler": cmd_scaler,
     "sched": cmd_sched,
+    "disagg": cmd_disagg,
     "top": cmd_top,
     "examples": cmd_examples,
     "docs": cmd_docs,

@@ -110,6 +110,37 @@ ROUTER_REQUESTS_TOTAL = "mtpu_router_requests_total"
 #: counter: repeated shared-prefix prompts landed on their affinity replica
 ROUTER_AFFINITY_HITS_TOTAL = "mtpu_router_affinity_hits_total"
 
+# -- disaggregated serving (serving/disagg, docs/disagg.md) -----------------
+
+#: counter {result}: page migrations between replicas;
+#: result = ok | fallback (unified re-prefill) | aborted (client/deadline)
+DISAGG_MIGRATIONS_TOTAL = "mtpu_disagg_migrations_total"
+#: counter: KV pages successfully migrated prefill -> decode
+DISAGG_PAGES_MIGRATED_TOTAL = "mtpu_disagg_pages_migrated_total"
+#: counter: serialized wire bytes of successful migrations (int8 caches
+#: ship ~half the bf16 bytes — the PR 5 residency win on the wire)
+DISAGG_MIGRATION_BYTES_TOTAL = "mtpu_disagg_migration_bytes_total"
+#: histogram: end-to-end migration latency (prefill start -> adopt/fail)
+DISAGG_MIGRATION_SECONDS = "mtpu_disagg_migration_seconds"
+#: gauge: migrations currently in flight (prefilling or on the wire)
+DISAGG_MIGRATIONS_INFLIGHT = "mtpu_disagg_migrations_inflight"
+#: counter: transfer chunks re-sent after loss/corruption (resumable retry)
+DISAGG_CHUNK_RETRIES_TOTAL = "mtpu_disagg_chunk_retries_total"
+#: gauge {replica, role}: info metric (value 1) — each replica's serving
+#: role (prefill | decode | unified)
+REPLICA_ROLE = "mtpu_replica_role"
+
+# -- tiered prefix cache (serving/disagg/tiered_cache.py) -------------------
+
+#: counter {tier}: prefix PAGES served per tier (page units on every tier,
+#: so rates are comparable); tier = hbm (trie-shared pages) | host (RAM
+#: promotes) | volume (spill promotes). Only tiered engines emit it.
+PREFIX_TIER_HITS_TOTAL = "mtpu_prefix_tier_hits_total"
+#: gauge {tier}: prefix blocks resident per spill tier (host | volume)
+PREFIX_TIER_PAGES = "mtpu_prefix_tier_pages"
+#: gauge {tier}: serialized bytes resident per spill tier (host | volume)
+PREFIX_TIER_BYTES = "mtpu_prefix_tier_bytes"
+
 # -- SLO engine (observability/slo.py) --------------------------------------
 
 #: gauge {slo}: observed/target burn rate per declared SLO (>1 = violating)
@@ -276,7 +307,8 @@ CATALOG: dict[str, dict] = {
     },
     DEADLINE_MISSES_TOTAL: {
         "type": "counter", "labels": ["stage"],
-        "help": "requests past their deadline (stage=queued|inflight)",
+        "help": "requests past their deadline "
+                "(stage=queued|inflight|migrating)",
     },
     ROUTER_REQUESTS_TOTAL: {
         "type": "counter", "labels": ["route"],
@@ -286,6 +318,48 @@ CATALOG: dict[str, dict] = {
         "type": "counter", "labels": [],
         "help": "repeated shared-prefix prompts landed on their affinity "
                 "replica",
+    },
+    DISAGG_MIGRATIONS_TOTAL: {
+        "type": "counter", "labels": ["result"],
+        "help": "page migrations between replicas "
+                "(result=ok|fallback|aborted)",
+    },
+    DISAGG_PAGES_MIGRATED_TOTAL: {
+        "type": "counter", "labels": [],
+        "help": "KV pages successfully migrated prefill -> decode",
+    },
+    DISAGG_MIGRATION_BYTES_TOTAL: {
+        "type": "counter", "labels": [],
+        "help": "serialized wire bytes of successful page migrations",
+    },
+    DISAGG_MIGRATION_SECONDS: {
+        "type": "histogram", "labels": [],
+        "help": "end-to-end migration latency (prefill start to adopt/fail)",
+    },
+    DISAGG_MIGRATIONS_INFLIGHT: {
+        "type": "gauge", "labels": [],
+        "help": "migrations currently in flight",
+    },
+    DISAGG_CHUNK_RETRIES_TOTAL: {
+        "type": "counter", "labels": [],
+        "help": "transfer chunks re-sent after loss/corruption",
+    },
+    REPLICA_ROLE: {
+        "type": "gauge", "labels": ["replica", "role"],
+        "help": "replica serving role, info metric "
+                "(role=prefill|decode|unified, value 1)",
+    },
+    PREFIX_TIER_HITS_TOTAL: {
+        "type": "counter", "labels": ["tier"],
+        "help": "prefix pages served per tier (tier=hbm|host|volume)",
+    },
+    PREFIX_TIER_PAGES: {
+        "type": "gauge", "labels": ["tier"],
+        "help": "prefix blocks resident per spill tier",
+    },
+    PREFIX_TIER_BYTES: {
+        "type": "gauge", "labels": ["tier"],
+        "help": "serialized bytes resident per spill tier",
     },
     SLO_BURN_RATE: {
         "type": "gauge", "labels": ["slo"],

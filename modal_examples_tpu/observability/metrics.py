@@ -261,6 +261,101 @@ def record_router_route(
         )
 
 
+# -- disaggregated serving (serving/disagg) ----------------------------------
+
+
+def record_migration(
+    result: str, *, pages: int = 0, wire_bytes: int = 0,
+    registry: Registry | None = None,
+) -> None:
+    """One finished migration attempt (result = ok|fallback|aborted); a
+    successful one also counts its pages and wire bytes."""
+    reg = _reg(registry)
+    reg.counter_inc(
+        C.DISAGG_MIGRATIONS_TOTAL, 1.0,
+        labels={"result": result},
+        help=C.CATALOG[C.DISAGG_MIGRATIONS_TOTAL]["help"],
+    )
+    if pages:
+        reg.counter_inc(
+            C.DISAGG_PAGES_MIGRATED_TOTAL, float(pages),
+            help=C.CATALOG[C.DISAGG_PAGES_MIGRATED_TOTAL]["help"],
+        )
+    if wire_bytes:
+        reg.counter_inc(
+            C.DISAGG_MIGRATION_BYTES_TOTAL, float(wire_bytes),
+            help=C.CATALOG[C.DISAGG_MIGRATION_BYTES_TOTAL]["help"],
+        )
+
+
+def record_migration_seconds(
+    seconds: float, *, registry: Registry | None = None
+) -> None:
+    _reg(registry).histogram_observe(
+        C.DISAGG_MIGRATION_SECONDS, seconds,
+        help=C.CATALOG[C.DISAGG_MIGRATION_SECONDS]["help"],
+    )
+
+
+def set_migrations_inflight(
+    n: int, *, registry: Registry | None = None
+) -> None:
+    _reg(registry).gauge_set(
+        C.DISAGG_MIGRATIONS_INFLIGHT, float(n),
+        help=C.CATALOG[C.DISAGG_MIGRATIONS_INFLIGHT]["help"],
+    )
+
+
+def record_disagg_chunk_retries(
+    n: int, *, registry: Registry | None = None
+) -> None:
+    if n > 0:
+        _reg(registry).counter_inc(
+            C.DISAGG_CHUNK_RETRIES_TOTAL, float(n),
+            help=C.CATALOG[C.DISAGG_CHUNK_RETRIES_TOTAL]["help"],
+        )
+
+
+def set_replica_role(
+    replica: str, role: str, *, registry: Registry | None = None
+) -> None:
+    _reg(registry).gauge_set(
+        C.REPLICA_ROLE, 1.0,
+        labels={"replica": replica, "role": role},
+        help=C.CATALOG[C.REPLICA_ROLE]["help"],
+    )
+
+
+def record_tier_hit(
+    tier: str, *, n: int = 1, registry: Registry | None = None
+) -> None:
+    """``n`` prefix PAGES served from ``tier`` — page units on every tier
+    (hbm counts the trie-shared pages of a claim, host/volume count
+    promoted pages), so the per-tier rates are comparable fractions."""
+    _reg(registry).counter_inc(
+        C.PREFIX_TIER_HITS_TOTAL, float(n),
+        labels={"tier": tier},
+        help=C.CATALOG[C.PREFIX_TIER_HITS_TOTAL]["help"],
+    )
+
+
+def set_tier_occupancy(
+    tier: str, *, pages: int, total_bytes: int,
+    registry: Registry | None = None,
+) -> None:
+    reg = _reg(registry)
+    reg.gauge_set(
+        C.PREFIX_TIER_PAGES, float(pages),
+        labels={"tier": tier},
+        help=C.CATALOG[C.PREFIX_TIER_PAGES]["help"],
+    )
+    reg.gauge_set(
+        C.PREFIX_TIER_BYTES, float(total_bytes),
+        labels={"tier": tier},
+        help=C.CATALOG[C.PREFIX_TIER_BYTES]["help"],
+    )
+
+
 # -- resource occupancy ------------------------------------------------------
 
 

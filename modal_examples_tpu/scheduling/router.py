@@ -38,13 +38,36 @@ from collections import OrderedDict
 from ..observability import metrics as _obs
 
 
+#: disaggregated-serving roles (docs/disagg.md): a ``prefill`` replica only
+#: computes prompt KV and ships pages (its engine never starts a scheduler
+#: loop); a ``decode`` replica adopts shipped pages and continues decoding
+#: (and can re-prefill as the unified fallback); ``unified`` does both.
+ROLES = ("prefill", "decode", "unified")
+
+
 class EngineReplica:
     """Adapter: one in-process ``LLMEngine`` as a routable replica."""
 
-    def __init__(self, engine, name: str, *, saturation_factor: float = 2.0):
+    def __init__(
+        self,
+        engine,
+        name: str,
+        *,
+        saturation_factor: float = 2.0,
+        role: str = "unified",
+    ):
+        if role not in ROLES:
+            raise ValueError(f"unknown replica role {role!r}; one of {ROLES}")
         self.engine = engine
         self.name = name
+        self.role = role
         self.saturation_factor = float(saturation_factor)
+
+    @property
+    def serves_requests(self) -> bool:
+        """Whether this replica can own a full request end to end (prefill-
+        only replicas cannot: they hold no decode loop)."""
+        return self.role != "prefill"
 
     def encode(self, prompt: str) -> list[int]:
         return self.engine.tokenizer.encode(prompt)
@@ -59,9 +82,11 @@ class EngineReplica:
         self.engine.abort(req)
 
     def outstanding(self) -> int:
-        """Waiting + decoding requests (the router's load signal)."""
+        """Waiting + decoding requests (the router's load signal); for a
+        prefill-role replica, slot-free prefills in flight count too."""
         active = sum(1 for s in self.engine.slots if not s.free)
-        return self.engine.policy.total_depth() + active
+        pending = getattr(self.engine, "_prefill_sync_pending", 0)
+        return self.engine.policy.total_depth() + active + pending
 
     def capacity(self) -> int:
         return self.engine.max_slots
@@ -100,6 +125,18 @@ class PrefixAffinityRouter:
         self._seen: OrderedDict[bytes, str] = OrderedDict()
         self.affinity_hits = 0
         self.fallbacks = 0
+        # role-aware split (replicas without a .role are unified): route()
+        # only ever places full requests on serving-capable replicas;
+        # prefill-only ones are plan()'s business
+        self._serving = [
+            r for r in self.replicas
+            if getattr(r, "role", "unified") != "prefill"
+        ]
+        if not self._serving:
+            raise ValueError(
+                "router needs at least one decode-capable (non-prefill) "
+                "replica to own requests"
+            )
 
     # -- placement -----------------------------------------------------------
 
@@ -109,24 +146,30 @@ class PrefixAffinityRouter:
             b",".join(str(int(t)).encode() for t in head)
         ).digest()
 
-    def _preferred(self, key: bytes):
+    def _preferred(self, key: bytes, candidates: list | None = None):
         """Rendezvous (highest-random-weight) hashing: stable per key, and
         removing a replica only remaps that replica's keys."""
         def score(replica) -> bytes:
             return hashlib.sha1(key + replica.name.encode()).digest()
 
-        return max(self.replicas, key=score)
+        return max(
+            candidates if candidates is not None else self.replicas, key=score
+        )
 
-    def route(self, prompt: str):
-        """Pick the replica for ``prompt``; records routing metrics."""
+    def _prompt_key(self, prompt: str) -> bytes:
         # tokenize only enough text to cover the key's token prefix (the
         # engine re-encodes the full prompt at submit anyway — hashing the
         # whole thing here would pay full tokenization twice per request)
         head = prompt[: max(64, 8 * self.prefix_tokens)]
-        tokens = self.replicas[0].encode(head)
-        key = self._key(tokens)
-        preferred = self._preferred(key)
-        healthy = [r for r in self.replicas if r.healthy()]
+        return self._key(self.replicas[0].encode(head))
+
+    def route(self, prompt: str):
+        """Pick the serving replica for ``prompt``; records routing metrics.
+        Prefill-only replicas are never chosen here — they cannot own a
+        request (see :meth:`plan` for disaggregated placement)."""
+        key = self._prompt_key(prompt)
+        preferred = self._preferred(key, self._serving)
+        healthy = [r for r in self._serving if r.healthy()]
         if not healthy:
             raise RuntimeError("no healthy replicas")
         if preferred.healthy() and not preferred.saturated():
@@ -146,6 +189,51 @@ class PrefixAffinityRouter:
                 self.fallbacks += 1
         _obs.record_router_route(route, affinity_hit=hit)
         return chosen
+
+    def plan(self, prompt: str):
+        """Disaggregated placement: ``(prefill_replica | None,
+        decode_replica)``.
+
+        The prefill replica is chosen by PREFIX-BLOCK affinity among
+        healthy, unsaturated prefill-role replicas — its prefix trie holds
+        the shared-prefix KV, so a repeated system prompt prefills once and
+        ships from cache-warm pages. Its decode target is a stable
+        rendezvous pairing over decode-capable replicas (each prefill
+        replica streams to "its" decode peer, keeping transfer fan-in
+        bounded), diverted to the least-outstanding healthy one when the
+        pair is saturated. ``None`` prefill means no healthy prefill peer:
+        the caller serves unified on the returned decode replica."""
+        key = self._prompt_key(prompt)
+        decoders = [r for r in self._serving if r.healthy()]
+        if not decoders:
+            raise RuntimeError("no healthy decode-capable replicas")
+        prefillers = [
+            r for r in self.replicas
+            if getattr(r, "role", "unified") == "prefill"
+            and r.healthy() and not r.saturated()
+        ]
+        if not prefillers:
+            chosen = min(decoders, key=lambda r: (r.outstanding(), r.name))
+            with self._lock:
+                self.fallbacks += 1
+            _obs.record_router_route("fallback")
+            return None, chosen
+        pre = self._preferred(key, prefillers)
+        pair = self._preferred(
+            hashlib.sha1(pre.name.encode()).digest(), decoders
+        )
+        if pair.saturated():
+            pair = min(decoders, key=lambda r: (r.outstanding(), r.name))
+        with self._lock:
+            hit = self._seen.get(key) == pre.name
+            self._seen[key] = pre.name
+            self._seen.move_to_end(key)
+            while len(self._seen) > self.SEEN_KEYS_MAX:
+                self._seen.popitem(last=False)
+            if hit:
+                self.affinity_hits += 1
+        _obs.record_router_route("affinity", affinity_hit=hit)
+        return pre, pair
 
     # -- request lifecycle (delegates to the owning replica) -----------------
 
@@ -178,6 +266,7 @@ class PrefixAffinityRouter:
         return {
             "replicas": {
                 r.name: {
+                    "role": getattr(r, "role", "unified"),
                     "outstanding": r.outstanding(),
                     "healthy": r.healthy(),
                     "saturated": r.saturated(),

@@ -4,7 +4,7 @@ The TPU-native replacement for the vLLM/SGLang/TRT-LLM engines every
 llm-serving example in the reference shells out to (SURVEY.md §2.2).
 """
 
-from . import speculative, tensor_parallel
+from . import disagg, speculative, tensor_parallel
 from .engine import LLMEngine, Request, build_engine
 from .kv_cache import OutOfPages, PagedKVCache, PageAllocator
 from .openai_api import OpenAIServer
@@ -12,6 +12,7 @@ from .sampling import SamplingParams, sample
 
 __all__ = [
     "LLMEngine",
+    "disagg",
     "OpenAIServer",
     "OutOfPages",
     "PageAllocator",

@@ -1,0 +1,64 @@
+"""Disaggregated prefill/decode serving: KV-page transport, role-aware
+replicas, and a tiered prefix cache.
+
+The serving-systems papers' architecture gap (PAPERS.md, arxiv 2511.17593):
+one engine with a queue couples the two phases of an LLM request that want
+opposite hardware shapes — prefill is compute-bound and bursty, decode is
+bandwidth-bound and steady. Disaggregation runs them on *different
+replicas*: a prefill replica computes the prompt's KV, ships the finished
+pages to a decode replica (the Ragged Paged Attention paper's page is the
+unit of transfer), and frees them; the decode replica adopts the pages into
+its own :class:`~..kv_cache.PagedKVCache` and continues, so neither phase
+ever steals the other's step time.
+
+Three modules, each usable alone:
+
+- :mod:`.transport` — the wire half: every device leaf of the paged cache
+  (2 for bf16, 4 for int8: data pages + scale rows) extracted per page,
+  serialized with checksums, chunked, and reassembled with resumable
+  retry. int8 pages ship at half the bytes — PR 5's residency win is also
+  the wire win.
+- :mod:`.roles` — the control half: :class:`DisaggCoordinator` pairs
+  prefill replicas with decode targets (placement via the role-aware
+  :class:`~...scheduling.router.PrefixAffinityRouter`), reserves the
+  migration's pages in the decode replica's admission controller before a
+  byte moves, and falls back to unified serving when no peer exists or a
+  transfer dies mid-request.
+- :mod:`.tiered_cache` — the memory half: prefix blocks spill
+  HBM -> host RAM -> ``Volume`` on eviction and promote back on demand,
+  riding the same page (de)serialization machinery, so warm prefixes
+  survive replica churn.
+
+See docs/disagg.md for the wire format, the role lifecycle, and the
+failure matrix.
+"""
+
+from .roles import DisaggCoordinator
+from .tiered_cache import TieredPrefixCache
+from .transport import (
+    ChunkAssembler,
+    PageBlock,
+    TransferAborted,
+    TransportError,
+    adopt_pages,
+    deserialize_block,
+    extract_pages,
+    iter_chunks,
+    serialize_block,
+    wire_leaves,
+)
+
+__all__ = [
+    "ChunkAssembler",
+    "DisaggCoordinator",
+    "PageBlock",
+    "TransferAborted",
+    "TieredPrefixCache",
+    "TransportError",
+    "adopt_pages",
+    "deserialize_block",
+    "extract_pages",
+    "iter_chunks",
+    "serialize_block",
+    "wire_leaves",
+]

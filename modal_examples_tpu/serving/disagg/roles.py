@@ -1,0 +1,310 @@
+"""Role-aware replica coordination: the control plane of disaggregated
+prefill/decode serving.
+
+:class:`DisaggCoordinator` fronts a fleet of
+:class:`~...scheduling.router.EngineReplica` objects carrying roles
+(``prefill`` / ``decode`` / ``unified``) and drives one request through the
+migration pipeline:
+
+1. **plan** — the role-aware router picks a prefill replica by prefix-block
+   affinity and its paired decode target;
+2. **reserve** — the migration's full KV-page cost is admitted on the
+   DECODE replica before any byte moves (a shed here is an honest 429, not
+   a half-migrated request);
+3. **prefill** — the prefill replica runs a slot-free
+   :meth:`~..engine.LLMEngine.prefill_sync` (its engine never starts a
+   decode loop), the finished pages are extracted and freed — trie pages
+   stay cached, so the prefill replica's prefix cache keeps getting warmer;
+4. **transfer** — the serialized block streams in checksummed chunks with
+   resumable retry, abortable between chunks (client abort or deadline);
+5. **adopt** — the decode engine adopts the block on ITS scheduler thread
+   at admission and continues decoding from the migrated position.
+
+Every failure mode lands in one of two states (docs/disagg.md's failure
+matrix): the request completes via **unified fallback** (re-prefill on the
+decode-capable side), or it terminates with an honest finish_reason
+(``deadline`` / client abort) — with page claims and admission reservations
+released on BOTH replicas either way.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+
+from ...observability import metrics as _obs
+from ...scheduling.policy import DEFAULT_CLASS, ScheduledRequest
+from ...utils.log import get_logger
+from .transport import (
+    DEFAULT_CHUNK_BYTES,
+    LoopbackChannel,
+    TransferAborted,
+    deserialize_block,
+    serialize_block,
+    transfer,
+)
+
+_log = get_logger("disagg")
+
+
+class Migration:
+    """One in-flight page migration (observability / test handle)."""
+
+    __slots__ = ("request", "source", "target", "started_at")
+
+    def __init__(self, request, source: str, target: str):
+        self.request = request
+        self.source = source
+        self.target = target
+        self.started_at = time.monotonic()
+
+
+def _finish_marker(reason: str):
+    """The engine's own terminal stream marker class, so a request that
+    dies mid-migration (before ever reaching an engine queue) terminates
+    its caller's ``stream()`` exactly like an engine-finished one."""
+    from ..engine import _Finish
+
+    return _Finish(reason)
+
+
+class DisaggCoordinator:
+    """Front a role-tagged replica fleet with prefill/decode migration.
+
+    Duck-type compatible with :class:`~...scheduling.router.
+    PrefixAffinityRouter` where the OpenAI server cares (``replicas`` /
+    ``submit`` / ``stream`` / ``abort`` / ``replica_for`` / ``stats``), plus
+    ``serving_engines()`` so servers only ever start decode-capable
+    engines — a prefill replica's scheduler loop must never run.
+
+    ``channel_factory`` builds the chunk channel per migration (default:
+    in-process :class:`LoopbackChannel`; tests inject corrupt/dying
+    channels; a cross-process deployment hands the executor's worker
+    pipe endpoints here).
+    """
+
+    def __init__(
+        self,
+        replicas: list,
+        *,
+        prefix_tokens: int = 16,
+        chunk_bytes: int = DEFAULT_CHUNK_BYTES,
+        max_rounds: int = 3,
+        channel_factory=None,
+    ):
+        from ...scheduling.router import PrefixAffinityRouter
+
+        self.replicas = list(replicas)
+        self.router = PrefixAffinityRouter(
+            replicas, prefix_tokens=prefix_tokens
+        )
+        self.chunk_bytes = int(chunk_bytes)
+        self.max_rounds = int(max_rounds)
+        self._channel_factory = channel_factory or LoopbackChannel
+        self._lock = threading.Lock()
+        self._inflight: dict[str, Migration] = {}
+        self.migrations_ok = 0
+        self.migrations_fallback = 0
+        self.migrations_aborted = 0
+        self.pages_migrated = 0
+        self.bytes_migrated = 0
+        # one model, one cache geometry: peers must agree on the page unit
+        # and dtype or adopted blocks would be garbage
+        shapes = {
+            (r.engine.cache.page_size, r.engine.cache.kv_dtype)
+            for r in self.replicas
+        }
+        if len(shapes) > 1:
+            raise ValueError(
+                f"replicas disagree on (page_size, kv_dtype): {sorted(shapes)}"
+                " — disagg peers must share the cache geometry"
+            )
+        for r in self.replicas:
+            _obs.set_replica_role(r.name, getattr(r, "role", "unified"))
+
+    # -- submission ----------------------------------------------------------
+
+    def submit(
+        self,
+        prompt: str,
+        params=None,
+        image=None,
+        *,
+        priority: str = DEFAULT_CLASS,
+        tenant: str = "default",
+    ):
+        """Place one request: disaggregated when a healthy prefill/decode
+        pair exists, unified otherwise. Multimodal requests always serve
+        unified (image KV does not take the migration path). Raises
+        ``ShedError`` when the owning replica's admission rejects it."""
+        if image is not None:
+            return self.router.submit(
+                prompt, params, image=image, priority=priority, tenant=tenant
+            )
+        prefill_r, decode_r = self.router.plan(prompt)
+        if prefill_r is None:
+            req = decode_r.submit(
+                prompt, params, priority=priority, tenant=tenant
+            )
+            req._router_replica = decode_r
+            return req
+        return self._submit_disagg(
+            prompt, params, prefill_r, decode_r,
+            priority=priority, tenant=tenant,
+        )
+
+    def _submit_disagg(
+        self, prompt, params, prefill_r, decode_r, *, priority, tenant
+    ):
+        engine_d = decode_r.engine
+        req = engine_d.make_request(
+            prompt, params, priority=priority, tenant=tenant
+        )
+        req._router_replica = decode_r
+        # migration cost reserved on the DECODE side before any byte moves:
+        # the admission controller counts these pages exactly like queued
+        # local work, so a decode replica can't be over-committed by
+        # migrations it never saw coming
+        entry = ScheduledRequest(
+            payload=req,
+            priority=req.priority,
+            tenant=req.tenant,
+            cost=engine_d.request_cost(req),
+            deadline=req.deadline,
+            enqueued_at=engine_d._clock(),
+        )
+        occ = engine_d.cache.occupancy()
+        engine_d.admission.admit(  # ShedError propagates: honest 429
+            entry,
+            depths=engine_d.policy.depths(),
+            pages_used=occ["pages_used"],
+            pages_total=occ["pages_total"],
+        )
+        migration = Migration(req, prefill_r.name, decode_r.name)
+        with self._lock:
+            self._inflight[req.request_id] = migration
+            _obs.set_migrations_inflight(len(self._inflight))
+        t0 = time.monotonic()
+        try:
+            block, payload = self._prefill_and_pack(prefill_r, req)
+
+            def should_abort() -> bool:
+                if req.aborted:
+                    return True
+                if (
+                    req.deadline is not None
+                    and engine_d._clock() >= req.deadline
+                ):
+                    req.deadline_expired = True
+                    return True
+                return False
+
+            wire = transfer(
+                payload,
+                self._channel_factory(),
+                transfer_id=req.request_id,
+                chunk_bytes=self.chunk_bytes,
+                max_rounds=self.max_rounds,
+                should_abort=should_abort,
+            )
+            if should_abort():
+                raise TransferAborted(req.request_id)
+            engine_d.submit_adopted(req, entry, deserialize_block(wire))
+            with self._lock:
+                self.migrations_ok += 1
+                self.pages_migrated += block.n_pages
+                self.bytes_migrated += len(payload)
+            _obs.record_migration(
+                "ok", pages=block.n_pages, wire_bytes=len(payload)
+            )
+            return req
+        except TransferAborted:
+            engine_d.admission.release(entry)
+            with self._lock:
+                self.migrations_aborted += 1
+            _obs.record_migration("aborted")
+            if req.deadline_expired:
+                _obs.record_deadline_miss("migrating")
+            req.out_queue.put(
+                _finish_marker(
+                    "deadline" if req.deadline_expired else "stop"
+                )
+            )
+            return req
+        except Exception as e:
+            # replica death, wire corruption beyond retry, OutOfPages on the
+            # prefill side: unified fallback — the decode-capable replica
+            # re-prefills the request from scratch. Reservations/claims are
+            # already unwound (prefill_sync releases its claim on failure;
+            # the decode reservation releases here).
+            engine_d.admission.release(entry)
+            with self._lock:
+                self.migrations_fallback += 1
+            _obs.record_migration("fallback")
+            if req.aborted:
+                req.out_queue.put(_finish_marker("stop"))
+                return req
+            _log.warning(
+                "migration %s (%s -> %s) failed (%s: %s); unified re-prefill "
+                "on %s",
+                req.request_id, prefill_r.name, decode_r.name,
+                type(e).__name__, e, decode_r.name,
+            )
+            return engine_d.submit_request(req)  # ShedError propagates
+        finally:
+            with self._lock:
+                self._inflight.pop(req.request_id, None)
+                _obs.set_migrations_inflight(len(self._inflight))
+            _obs.record_migration_seconds(time.monotonic() - t0)
+
+    def _prefill_and_pack(self, prefill_r, req):
+        """Prefill on the source replica, extract the wire block, and free
+        the source pages (trie pages stay cached: the prefill replica's
+        prefix cache survives the request)."""
+        engine_p = prefill_r.engine
+        state = engine_p.prefill_sync(req)
+        try:
+            block = engine_p.extract_request_pages(req, state)
+        finally:
+            engine_p.release_claim(state["claim"], valid=True)
+        return block, serialize_block(block)
+
+    # -- request lifecycle ---------------------------------------------------
+
+    def replica_for(self, req):
+        replica = getattr(req, "_router_replica", None)
+        if replica is None:
+            raise KeyError(f"request {req.request_id} not routed here")
+        return replica
+
+    def stream(self, req):
+        yield from self.replica_for(req).stream(req)
+
+    def abort(self, req) -> None:
+        """Abort a request wherever it is: still migrating (the transfer
+        loop trips between chunks), queued, or decoding."""
+        req.aborted = True
+        self.replica_for(req).abort(req)
+
+    def migrations(self) -> list:
+        """Snapshot of in-flight migrations (observability/tests)."""
+        with self._lock:
+            return list(self._inflight.values())
+
+    def serving_engines(self) -> list:
+        """Engines whose scheduler loop may run: decode-capable replicas
+        only. A prefill replica's engine must NEVER be started — its cache
+        buffers are owned by the synchronous prefill path."""
+        return [r.engine for r in self.replicas if r.serves_requests]
+
+    def stats(self) -> dict:
+        with self._lock:
+            mig = {
+                "ok": self.migrations_ok,
+                "fallback": self.migrations_fallback,
+                "aborted": self.migrations_aborted,
+                "inflight": len(self._inflight),
+                "pages": self.pages_migrated,
+                "bytes": self.bytes_migrated,
+            }
+        return {"migrations": mig, "router": self.router.stats()}

@@ -1,0 +1,295 @@
+"""Tiered prefix cache: HBM -> host RAM -> ``Volume`` spill and promote.
+
+The trie (:class:`~..prefix_cache.PrefixCache`) keeps shared prompt-prefix
+KV in HBM until allocator pressure evicts it — and evicted meant GONE: the
+next request over the same system prompt re-pays the memory (and, once
+compute-skip lands, the compute). This tier stack catches evictions
+instead:
+
+- **host tier** — evicted prefix pages are serialized (the SAME
+  page-(de)serialization machinery the disagg wire uses:
+  :func:`~.transport.extract_pages` + :func:`~.transport.serialize_block`,
+  checksums included) into a bounded host-RAM LRU. Quantized (int8) pages
+  spill at ~half the bf16 bytes, so the same budget holds ~2x the blocks.
+- **volume tier** — host-LRU overflow demotes to a
+  :class:`~...storage.volume.Volume` (one file per block, named by content
+  hash), so warm prefixes survive replica churn: a fresh replica promotes
+  yesterday's system prompt from the Volume instead of recomputing it.
+
+Keys are CHAINED content hashes (:func:`~.transport.chain_hashes`): block i
+hashes its page's tokens together with block i-1's hash, so a page's
+identity encodes its whole prefix — the same 16 tokens at two different
+prompt depths never alias.
+
+Promotion happens inside the engine's claim path: after the trie's
+longest-prefix hit, consecutive lower-tier blocks are allocated a fresh
+page, their bytes adopted (bit-exact for int8, value-exact for bf16), and
+the page joins the trie as a normal insert. Correctness never depends on
+promotion: prefill recomputes and rewrites identical values over promoted
+pages (deterministic quantization included — docs/kv_cache.md), exactly as
+it does for trie-shared pages.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+from collections import OrderedDict
+
+from ...observability import metrics as _obs
+from ...utils.log import get_logger
+from .transport import (
+    PageBlock,
+    TransportError,
+    adopt_pages,
+    chain_hashes,
+    deserialize_block,
+    extract_pages,
+    serialize_block,
+)
+
+_log = get_logger("tiered_cache")
+
+#: default host-RAM budget for spilled blocks (MTPU_TIER_HOST_BYTES env)
+DEFAULT_HOST_BYTES = 64 * 1024 * 1024
+
+
+class TieredPrefixCache:
+    """Spill/promote tiers below one engine's prefix trie.
+
+    Wired by the engine (``tiered_prefix=`` kwarg): ``prefix_cache.spill``
+    points at :meth:`spill_pages`, and the claim path calls
+    :meth:`register` (after trie insert) and :meth:`promote` (after trie
+    acquire). All entry points run on the cache-owning thread — the same
+    thread discipline the decode jits already impose — so device reads and
+    writes here never race a donated buffer.
+    """
+
+    def __init__(
+        self,
+        cache,
+        prefix_cache,
+        *,
+        host_bytes: int | None = None,
+        volume=None,
+        volume_prefix: str = "kv-tier",
+    ):
+        self.cache = cache
+        self.prefix_cache = prefix_cache
+        if host_bytes is None:
+            try:
+                host_bytes = int(
+                    os.environ.get("MTPU_TIER_HOST_BYTES", "")
+                    or DEFAULT_HOST_BYTES
+                )
+            except ValueError:
+                host_bytes = DEFAULT_HOST_BYTES
+        self.host_bytes_budget = int(host_bytes)
+        self.volume = volume
+        self.volume_prefix = volume_prefix.strip("/")
+        self._lock = threading.Lock()
+        #: trie-resident page id -> chained block hash (spill key material)
+        self._by_page: dict[int, str] = {}
+        #: host tier: hash -> serialized single-block bytes, LRU order
+        self._host: OrderedDict[str, bytes] = OrderedDict()
+        self._host_used = 0
+        #: hashes known to exist in the volume tier (process-local view:
+        #: seeded from the volume's directory at init, grown on demote)
+        self._volume_index: dict[str, int] = {}
+        if self.volume is not None:
+            self._seed_volume_index()
+        self.tier_hits = {"host": 0, "volume": 0}
+        self.spilled = 0
+        self.promoted = 0
+
+    # -- bookkeeping ---------------------------------------------------------
+
+    def _seed_volume_index(self) -> None:
+        """Discover blocks a previous replica left behind (the churn-survival
+        path): every ``block-<hash>.kv`` under the prefix is promotable.
+        Sizes start at 0 and fill in lazily on first touch — reading every
+        block at init just for a byte gauge would make engine construction
+        proportional to the tier's size."""
+        try:
+            entries = list(self.volume.listdir(self.volume_prefix))
+        except Exception:
+            return  # prefix directory doesn't exist yet: empty tier
+        for name in entries:
+            base = str(name).rsplit("/", 1)[-1]
+            if base.startswith("block-") and base.endswith(".kv"):
+                self._volume_index[base[len("block-"):-len(".kv")]] = 0
+
+    def _volume_path(self, block_hash: str) -> str:
+        return f"{self.volume_prefix}/block-{block_hash}.kv"
+
+    def _emit_gauges_locked(self) -> None:
+        _obs.set_tier_occupancy(
+            "host", pages=len(self._host), total_bytes=self._host_used
+        )
+        if self.volume is not None:
+            _obs.set_tier_occupancy(
+                "volume",
+                pages=len(self._volume_index),
+                total_bytes=sum(self._volume_index.values()),
+            )
+
+    def register(self, key_tokens: list, trie_pages: list) -> None:
+        """Record the chained hash of every trie-resident full-prompt page
+        (called after ``PrefixCache.insert``), so a later eviction knows
+        what content each physical page holds."""
+        hashes = chain_hashes(key_tokens, self.cache.page_size)
+        with self._lock:
+            for pid, h in zip(trie_pages, hashes):
+                self._by_page[pid] = h
+
+    # -- spill (HBM -> host -> volume) ---------------------------------------
+
+    def spill_pages(self, page_ids: list) -> None:
+        """Serialize evicted trie pages into the host tier before their HBM
+        pages return to the allocator (the ``PrefixCache.spill`` hook).
+        Unregistered pages (never inserted through a claim this tier saw)
+        are skipped."""
+        with self._lock:
+            work = [
+                (pid, self._by_page.pop(pid))
+                for pid in page_ids
+                if pid in self._by_page
+            ]
+        work = [
+            (pid, h) for pid, h in work
+            if self._lookup_host(h, touch=False) is None  # already spilled
+        ]
+        if not work:
+            return
+        # ONE device->host transfer for the whole eviction wave (this runs
+        # on the allocator-pressure path): per-page blocks are sliced out
+        # of the batched copy on the host
+        batch = extract_pages(self.cache, [pid for pid, _ in work])
+        for i, (_pid, block_hash) in enumerate(work):
+            block = PageBlock(
+                leaves={
+                    name: arr[:, i : i + 1] for name, arr in batch.leaves.items()
+                },
+                page_size=batch.page_size,
+                kv_dtype=batch.kv_dtype,
+            )
+            self._host_put(block_hash, serialize_block(block))
+            self.spilled += 1
+        with self._lock:
+            self._emit_gauges_locked()
+
+    def _host_put(self, block_hash: str, data: bytes) -> None:
+        with self._lock:
+            if block_hash in self._host:
+                return
+            self._host[block_hash] = data
+            self._host_used += len(data)
+            # bounded LRU: overflow demotes oldest blocks to the volume
+            # tier (or drops them when no volume is configured)
+            demote: list[tuple[str, bytes]] = []
+            while self._host_used > self.host_bytes_budget and len(self._host) > 1:
+                old_hash, old_data = self._host.popitem(last=False)
+                self._host_used -= len(old_data)
+                demote.append((old_hash, old_data))
+        for old_hash, old_data in demote:
+            self._demote_to_volume(old_hash, old_data)
+
+    def _demote_to_volume(self, block_hash: str, data: bytes) -> None:
+        if self.volume is None:
+            return
+        try:
+            self.volume.write_file(self._volume_path(block_hash), data)
+        except Exception as e:
+            _log.warning("volume demote of %s failed: %s", block_hash, e)
+            return
+        with self._lock:
+            self._volume_index[block_hash] = len(data)
+
+    # -- promote (volume -> host -> HBM) -------------------------------------
+
+    def _lookup_host(self, block_hash: str, *, touch: bool = True):
+        with self._lock:
+            data = self._host.get(block_hash)
+            if data is not None and touch:
+                self._host.move_to_end(block_hash)
+            return data
+
+    def _lookup_volume(self, block_hash: str):
+        if self.volume is None:
+            return None
+        try:
+            data = self.volume.read_file(self._volume_path(block_hash))
+        except Exception:
+            return None
+        with self._lock:
+            # lazily fill the size the seeding pass skipped (byte gauge)
+            self._volume_index[block_hash] = len(data)
+        return data
+
+    def promote(self, key_tokens: list, *, n_have: int) -> list:
+        """Restore consecutive full-prompt pages past the trie's
+        ``n_have``-page hit from the lower tiers. Each hit allocates one
+        fresh page, adopts the stored bytes into it, and returns it — the
+        engine's claim inserts these into the trie like freshly prefilled
+        pages (refcount 1), so the block is shared again from here on.
+        Stops at the first miss, corrupt block, or allocator exhaustion."""
+        hashes = chain_hashes(key_tokens, self.cache.page_size)
+        out: list[int] = []
+        for block_hash in hashes[n_have:]:
+            tier = "host"
+            data = self._lookup_host(block_hash)
+            if data is None:
+                tier = "volume"
+                data = self._lookup_volume(block_hash)
+            if data is None:
+                break
+            try:
+                block = deserialize_block(data)
+            except TransportError as e:
+                _log.warning(
+                    "dropping corrupt tier block %s: %s", block_hash, e
+                )
+                with self._lock:
+                    stale = self._host.pop(block_hash, None)
+                    if stale is not None:
+                        self._host_used -= len(stale)
+                break
+            if block.kv_dtype != self.cache.kv_dtype:
+                break  # cache was rebuilt at a different dtype: stale tier
+            try:
+                page = self.cache.allocator.alloc(1)
+            except Exception:
+                break  # no room to promote into; callers alloc what's left
+            adopt_pages(self.cache, block, page)
+            out.append(page[0])
+            self.tier_hits[tier] += 1
+            _obs.record_tier_hit(tier)
+            if tier == "volume":
+                # promote the bytes up a tier too: next hit is RAM-speed
+                self._host_put(block_hash, data)
+        if out:
+            self.promoted += len(out)
+            with self._lock:
+                self._emit_gauges_locked()
+        return out
+
+    # -- introspection -------------------------------------------------------
+
+    def stats(self) -> dict:
+        with self._lock:
+            return {
+                "host": {
+                    "blocks": len(self._host),
+                    "bytes": self._host_used,
+                    "budget_bytes": self.host_bytes_budget,
+                },
+                "volume": {
+                    "blocks": len(self._volume_index),
+                    "bytes": sum(self._volume_index.values()),
+                    "enabled": self.volume is not None,
+                },
+                "hits": dict(self.tier_hits),
+                "spilled": self.spilled,
+                "promoted": self.promoted,
+                "registered_pages": len(self._by_page),
+            }

@@ -1,0 +1,424 @@
+"""KV-page transport: the wire format for migrating paged-KV blocks.
+
+A finished prefill is a set of physical pages in the prefill replica's
+:class:`~..kv_cache.PagedKVCache` plus a little sampler state (position,
+first token, seed). This module turns that into bytes and back:
+
+- :func:`wire_leaves` enumerates every DEVICE LEAF of the cache pytree —
+  2 for bf16 (``k_pages``/``v_pages``), 4 for int8 (``k_pages.data``/
+  ``.scale`` and the v pair). It is built on ``jax.tree_util`` flattening,
+  not a hand-kept list, so a future 5th leaf shows up here automatically —
+  and a static guard (tests/test_static.py) asserts the codec's leaf set
+  equals the pytree's, the int8-scales lesson from PR 5 made structural.
+- :func:`extract_pages` slices ``n`` pages out of each leaf (the page axis
+  is axis 1 on every leaf by layout) into host numpy — one
+  :class:`PageBlock`.
+- :func:`serialize_block` / :func:`deserialize_block` — a compact binary
+  envelope: magic + JSON header (leaf specs, per-leaf crc32, block hashes,
+  sampler meta) + raw leaf bytes. int8 blocks ship the int8 payload + f32
+  scale rows exactly as stored, so adoption is BIT-EXACT: no re-quantization
+  on either side, which is what makes disagg output token-identical to
+  unified serving.
+- :func:`iter_chunks` / :class:`ChunkAssembler` / :func:`transfer` — chunked
+  streaming with per-chunk crc32 and resumable retry: a corrupt or dropped
+  chunk is re-sent by sequence number, not the whole payload. Chunks are
+  plain picklable tuples, so the same protocol rides the process executor's
+  worker pipes or any in-process queue (:class:`LoopbackChannel`).
+- :func:`adopt_pages` writes a received block into freshly allocated pages
+  of the destination cache — the same ``.at[:, ids].set`` scatter shape the
+  prefill page writes use, applied leaf-by-leaf through the pytree.
+
+See docs/disagg.md for the byte layout and the failure matrix.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import hashlib
+import json
+import queue
+import struct
+import zlib
+
+import numpy as np
+
+from ...observability import metrics as _obs
+
+#: envelope magic + version (bump on any layout change)
+_MAGIC = b"MTKV1\n"
+#: default chunk payload size — small enough that one lost chunk is cheap
+#: to resend, large enough that header overhead stays noise
+DEFAULT_CHUNK_BYTES = 256 * 1024
+
+
+class TransportError(RuntimeError):
+    """Corrupt, incomplete, or incompatible wire data."""
+
+
+class TransferAborted(TransportError):
+    """The transfer's ``should_abort`` tripped mid-stream (client abort or
+    deadline while chunks were in flight)."""
+
+
+# -- cache pytree <-> named leaves -------------------------------------------
+
+
+def _leaf_name(path) -> str:
+    """Stable dotted name for a pytree path, e.g. ``k_pages.data``."""
+    parts = []
+    for key in path:
+        name = getattr(key, "name", None)
+        if name is None:
+            name = getattr(key, "key", None)
+        if name is None:
+            name = getattr(key, "idx", None)
+        parts.append(str(name))
+    return ".".join(parts)
+
+
+def wire_leaves(cache) -> list:
+    """``[(name, device_array)]`` for every device leaf of the cache pytree,
+    in flatten order. Built on tree flattening so the codec can never trail
+    the cache structure: a new leaf added to :class:`PagedKVCache` (or to
+    ``QuantizedKV``) appears here without this module changing."""
+    import jax
+
+    flat = jax.tree_util.tree_flatten_with_path(cache)[0]
+    return [(_leaf_name(path), leaf) for path, leaf in flat]
+
+
+@dataclasses.dataclass
+class PageBlock:
+    """``n`` cache pages worth of every leaf, on the host.
+
+    ``leaves[name]`` has the page axis (axis 1) sliced down to the block's
+    pages, in page-table order. ``block_hashes`` are the chained
+    content hashes of the full prompt pages these pages hold (prefix-cache
+    key material — the tiered cache is keyed by them); ``meta`` carries the
+    sampler state the decode side needs to continue (position, first token,
+    prompt token ids, seed)."""
+
+    leaves: dict
+    page_size: int
+    kv_dtype: str
+    block_hashes: list = dataclasses.field(default_factory=list)
+    meta: dict = dataclasses.field(default_factory=dict)
+
+    @property
+    def n_pages(self) -> int:
+        first = next(iter(self.leaves.values()))
+        return int(first.shape[1])
+
+    def nbytes(self) -> int:
+        return sum(a.nbytes for a in self.leaves.values())
+
+
+def extract_pages(cache, page_ids: list, *, block_hashes=None, meta=None) -> PageBlock:
+    """Copy ``page_ids`` (device -> host) out of every cache leaf.
+
+    Every leaf's page axis is axis 1 (``[L, P, page_size, Hkv, ...]`` for
+    data, ``[L, P, page_size, Hkv]`` for int8 scale rows), so one gather
+    expression covers all present and future leaves."""
+    ids = np.asarray(list(page_ids), np.int32)
+    leaves = {}
+    for name, leaf in wire_leaves(cache):
+        if leaf.shape[1] != cache.n_pages:
+            raise TransportError(
+                f"cache leaf {name!r} does not have the page axis at axis 1 "
+                f"(shape {leaf.shape}); the wire codec needs updating"
+            )
+        leaves[name] = np.asarray(leaf[:, ids])
+    return PageBlock(
+        leaves=leaves,
+        page_size=cache.page_size,
+        kv_dtype=cache.kv_dtype,
+        block_hashes=list(block_hashes or []),
+        meta=dict(meta or {}),
+    )
+
+
+_adopt_scatter = None  # built lazily: jitted donated per-leaf page scatter
+
+
+def _adopt_scatter_jit():
+    """One jitted ``leaf.at[:, ids].set(data)`` with the LEAF DONATED, so
+    adoption updates the cache buffer in place instead of allocating a
+    second full-size copy per leaf — at HBM-sized caches an un-donated
+    scatter would transiently double KV residency per migration. jax.jit
+    caches compiled variants per leaf shape/dtype, so one callable serves
+    every leaf of both cache forms."""
+    global _adopt_scatter
+    if _adopt_scatter is None:
+        import jax
+
+        _adopt_scatter = jax.jit(
+            lambda leaf, ids, data: leaf.at[:, ids].set(data),
+            donate_argnums=(0,),
+        )
+    return _adopt_scatter
+
+
+def adopt_pages(cache, block: PageBlock, page_ids: list) -> None:
+    """Write ``block`` into ``page_ids`` of the destination cache, leaf by
+    leaf (the receive-side mirror of :func:`extract_pages`), through a
+    donated jitted scatter so the cache is updated in place.
+
+    MUST run on the thread that owns the cache's jit lifecycle (the decode
+    engine's scheduler thread): the engine donates these arrays through its
+    decode program, and racing that donation would write deleted buffers.
+    """
+    import dataclasses as _dc
+
+    import jax
+    import jax.numpy as jnp
+
+    if block.kv_dtype != cache.kv_dtype:
+        raise TransportError(
+            f"kv_dtype mismatch: block is {block.kv_dtype}, destination "
+            f"cache is {cache.kv_dtype} — replicas must serve one cache dtype"
+        )
+    if block.page_size != cache.page_size:
+        raise TransportError(
+            f"page_size mismatch: block {block.page_size} vs cache "
+            f"{cache.page_size}"
+        )
+    if len(page_ids) != block.n_pages:
+        raise TransportError(
+            f"adopting {block.n_pages} pages into {len(page_ids)} page ids"
+        )
+    names = [name for name, _ in wire_leaves(cache)]
+    if set(names) != set(block.leaves):
+        raise TransportError(
+            f"leaf set mismatch: wire {sorted(block.leaves)} vs cache "
+            f"{sorted(names)}"
+        )
+    ids = jnp.asarray(np.asarray(list(page_ids), np.int32))
+    flat, treedef = jax.tree_util.tree_flatten(cache)
+    named = wire_leaves(cache)
+    scatter = _adopt_scatter_jit()
+    new_leaves = []
+    for (name, leaf), current in zip(named, flat):
+        data = block.leaves[name]
+        new_leaves.append(scatter(current, ids, jnp.asarray(data)))
+    adopted = jax.tree_util.tree_unflatten(treedef, new_leaves)
+    # write back EVERY field generically (meta fields unflatten to the same
+    # objects): a future data_field leaf must land here without this module
+    # changing, or it would ship over the wire and be silently dropped at
+    # adoption — the static guard round-trips through this function
+    for field in _dc.fields(cache):
+        setattr(cache, field.name, getattr(adopted, field.name))
+
+
+# -- block (de)serialization -------------------------------------------------
+
+
+def serialize_block(block: PageBlock) -> bytes:
+    """Envelope: ``MTKV1\\n`` + u32 header length + JSON header + raw leaf
+    bytes in header order. Each leaf records dtype/shape/crc32 so a flipped
+    byte is a loud :class:`TransportError`, never silent KV corruption."""
+    specs = []
+    payload = bytearray()
+    for name in sorted(block.leaves):
+        arr = np.ascontiguousarray(block.leaves[name])
+        buf = arr.tobytes()
+        specs.append(
+            {
+                "name": name,
+                "dtype": str(arr.dtype),
+                "shape": list(arr.shape),
+                "crc32": zlib.crc32(buf) & 0xFFFFFFFF,
+                "nbytes": len(buf),
+            }
+        )
+        payload += buf
+    header = json.dumps(
+        {
+            "version": 1,
+            "page_size": block.page_size,
+            "kv_dtype": block.kv_dtype,
+            "block_hashes": list(block.block_hashes),
+            "meta": block.meta,
+            "leaves": specs,
+        }
+    ).encode()
+    return _MAGIC + struct.pack("<I", len(header)) + header + bytes(payload)
+
+
+def deserialize_block(data: bytes) -> PageBlock:
+    if data[: len(_MAGIC)] != _MAGIC:
+        raise TransportError("bad magic: not a KV page block")
+    off = len(_MAGIC)
+    (hlen,) = struct.unpack_from("<I", data, off)
+    off += 4
+    try:
+        header = json.loads(data[off : off + hlen])
+    except (ValueError, UnicodeDecodeError) as e:
+        raise TransportError(f"corrupt block header: {e}") from e
+    off += hlen
+    leaves = {}
+    for spec in header["leaves"]:
+        buf = data[off : off + spec["nbytes"]]
+        if len(buf) != spec["nbytes"]:
+            raise TransportError(
+                f"truncated block: leaf {spec['name']!r} short by "
+                f"{spec['nbytes'] - len(buf)} bytes"
+            )
+        if (zlib.crc32(buf) & 0xFFFFFFFF) != spec["crc32"]:
+            raise TransportError(f"crc mismatch on leaf {spec['name']!r}")
+        leaves[spec["name"]] = np.frombuffer(
+            buf, dtype=np.dtype(spec["dtype"])
+        ).reshape(spec["shape"])
+        off += spec["nbytes"]
+    return PageBlock(
+        leaves=leaves,
+        page_size=int(header["page_size"]),
+        kv_dtype=str(header["kv_dtype"]),
+        block_hashes=list(header["block_hashes"]),
+        meta=dict(header["meta"]),
+    )
+
+
+# -- prefix block hashing ----------------------------------------------------
+
+
+def chain_hashes(tokens: list, page_size: int) -> list:
+    """Chained content hash per FULL page of ``tokens``: ``h_i =
+    sha256(h_{i-1} || page_i tokens)``. Position-dependent by construction,
+    so the same 16 tokens at different prompt depths never collide — the
+    tiered prefix cache's key, and the trie's page identity on the wire."""
+    out = []
+    prev = b""
+    n_full = len(tokens) // page_size
+    for i in range(n_full):
+        page = tokens[i * page_size : (i + 1) * page_size]
+        h = hashlib.sha256(
+            prev + b"," + b",".join(str(int(t)).encode() for t in page)
+        ).digest()
+        out.append(h.hex())
+        prev = h
+    return out
+
+
+# -- chunked streaming with resumable retry ----------------------------------
+
+
+def iter_chunks(
+    payload: bytes, transfer_id: str, chunk_bytes: int = DEFAULT_CHUNK_BYTES
+) -> list:
+    """Split ``payload`` into picklable chunk tuples
+    ``("kv_chunk", transfer_id, seq, total, crc32, bytes)``."""
+    chunk_bytes = max(1, int(chunk_bytes))
+    total = max(1, -(-len(payload) // chunk_bytes))
+    out = []
+    for seq in range(total):
+        piece = payload[seq * chunk_bytes : (seq + 1) * chunk_bytes]
+        out.append(
+            (
+                "kv_chunk",
+                transfer_id,
+                seq,
+                total,
+                zlib.crc32(piece) & 0xFFFFFFFF,
+                piece,
+            )
+        )
+    return out
+
+
+class ChunkAssembler:
+    """Receive side: collect chunks, detect gaps/corruption, reassemble.
+
+    ``add`` drops corrupt chunks (crc mismatch) and records them as missing
+    so the sender's next round re-sends exactly those — resumable retry at
+    chunk granularity."""
+
+    def __init__(self, transfer_id: str):
+        self.transfer_id = transfer_id
+        self.total: int | None = None
+        self._chunks: dict[int, bytes] = {}
+        self.corrupt = 0
+
+    def add(self, chunk) -> bool:
+        """Returns True when the chunk was accepted (valid + ours)."""
+        kind, tid, seq, total, crc, piece = chunk
+        if kind != "kv_chunk" or tid != self.transfer_id:
+            return False
+        if self.total is None:
+            self.total = int(total)
+        if (zlib.crc32(piece) & 0xFFFFFFFF) != crc:
+            self.corrupt += 1
+            return False
+        self._chunks[int(seq)] = piece
+        return True
+
+    @property
+    def complete(self) -> bool:
+        return self.total is not None and len(self._chunks) == self.total
+
+    def missing(self) -> list:
+        if self.total is None:
+            return []
+        return [s for s in range(self.total) if s not in self._chunks]
+
+    def payload(self) -> bytes:
+        if not self.complete:
+            raise TransportError(
+                f"transfer {self.transfer_id}: missing chunks {self.missing()}"
+            )
+        return b"".join(self._chunks[s] for s in range(self.total))
+
+
+class LoopbackChannel:
+    """In-process chunk channel (the inline-executor shape): ``send``
+    enqueues, ``recv`` drains. The seam where a cross-process pipe sits in
+    the process executor — and where tests inject corruption, drops, and
+    replica death."""
+
+    def __init__(self):
+        self._q: queue.Queue = queue.Queue()
+
+    def send(self, chunk) -> None:
+        self._q.put(chunk)
+
+    def recv(self, block: bool = False, timeout: float | None = None):
+        return self._q.get(block=block, timeout=timeout)
+
+
+def transfer(
+    payload: bytes,
+    channel,
+    *,
+    transfer_id: str,
+    chunk_bytes: int = DEFAULT_CHUNK_BYTES,
+    max_rounds: int = 3,
+    should_abort=None,
+) -> bytes:
+    """Stream ``payload`` through ``channel`` and reassemble it: send every
+    pending chunk, drain what arrived, re-send only the gaps. Raises
+    :class:`TransferAborted` the moment ``should_abort()`` trips (checked
+    between chunks, so an abort never waits for the tail of a large block)
+    and :class:`TransportError` when ``max_rounds`` can't complete the set.
+    """
+    chunks = iter_chunks(payload, transfer_id, chunk_bytes)
+    asm = ChunkAssembler(transfer_id)
+    pending = list(range(len(chunks)))
+    for round_i in range(max(1, int(max_rounds))):
+        if round_i and pending:
+            _obs.record_disagg_chunk_retries(len(pending))
+        for seq in pending:
+            if should_abort is not None and should_abort():
+                raise TransferAborted(f"transfer {transfer_id} aborted")
+            channel.send(chunks[seq])
+        while True:
+            try:
+                received = channel.recv(block=False)
+            except queue.Empty:
+                break
+            asm.add(received)
+        if asm.complete:
+            return asm.payload()
+        pending = asm.missing()
+    raise TransportError(
+        f"transfer {transfer_id}: {len(asm.missing())} chunks still missing "
+        f"after {max_rounds} rounds ({asm.corrupt} corrupt)"
+    )

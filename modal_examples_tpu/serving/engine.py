@@ -317,6 +317,11 @@ class LLMEngine:
         policy: SchedulerPolicy | None = None,  # waiting-set ordering
         admission: AdmissionController | None = None,  # shed/deadline gate
         clock=None,  # injectable monotonic clock (fake-clock scheduling tests)
+        # tiered prefix cache (docs/disagg.md): True for env-default sizing,
+        # or a dict of TieredPrefixCache kwargs (host_bytes=, volume=);
+        # evicted prefix pages spill HBM -> host RAM -> Volume and promote
+        # back on the next shared-prefix prompt
+        tiered_prefix=None,
     ):
         import os as _os
 
@@ -437,6 +442,17 @@ class LLMEngine:
             if enable_prefix_cache
             else None
         )
+        # tiered prefix cache: wraps the trie with host-RAM/Volume spill
+        # tiers riding the disagg page-(de)serialization machinery
+        self.tiered = None
+        if tiered_prefix and self.prefix_cache is not None:
+            from .disagg.tiered_cache import TieredPrefixCache
+
+            kw = dict(tiered_prefix) if isinstance(tiered_prefix, dict) else {}
+            self.tiered = TieredPrefixCache(
+                self.cache, self.prefix_cache, **kw
+            )
+            self.prefix_cache.spill = self.tiered.spill_pages
 
         # multimodal serving (models.vlm; the reference's sglang_vlm.py
         # workload): image requests prefill with the vision tower's
@@ -508,6 +524,12 @@ class LLMEngine:
         self._seed_base = int(seed)
         self._submit_seq = 0  # feeds auto_seed: deterministic per submission
         self._lock = threading.Lock()
+        # serializes slot-free prefill_sync callers (disagg prefill role):
+        # the prefill jits donate the cache arrays, so two server threads
+        # must never run them concurrently. The pending count is the
+        # prefill replica's load signal (EngineReplica.outstanding).
+        self._prefill_sync_lock = threading.Lock()
+        self._prefill_sync_pending = 0
         self._running = False
         self._thread: threading.Thread | None = None
 
@@ -993,7 +1015,7 @@ class LLMEngine:
                 "implemented in the spec accept/reject kernel)"
             )
 
-    def submit(
+    def make_request(
         self,
         prompt: str,
         params: SamplingParams | None = None,
@@ -1002,12 +1024,12 @@ class LLMEngine:
         priority: str = DEFAULT_CLASS,
         tenant: str = "default",
     ) -> Request:
-        """Enqueue one request through admission control.
+        """Build (but do not enqueue) one validated, tokenized request.
 
-        ``priority`` (interactive|default|batch) and ``tenant`` drive the
-        fair-share policy; ``params.deadline_s`` arms a deadline. Raises
-        :class:`~modal_examples_tpu.scheduling.admission.ShedError` when
-        admission rejects the request (servers surface it as HTTP 429)."""
+        The first half of :meth:`submit`, exposed so the disaggregation
+        coordinator can hold a request OBJECT through prefill + page
+        migration before it ever enters this engine's admission path — the
+        deadline arms here, so migration time counts against it."""
         req = Request(
             prompt=prompt,
             params=params or SamplingParams(),
@@ -1059,17 +1081,27 @@ class LLMEngine:
             req.prompt_tokens = self.tokenizer.encode(prompt)[
                 : self.max_model_len - 1
             ]
-        now = self._clock()
         if req.params.deadline_s is not None:
-            req.deadline = now + float(req.params.deadline_s)
+            req.deadline = self._clock() + float(req.params.deadline_s)
+        return req
+
+    def request_cost(self, req: Request) -> int:
+        """Estimated KV-page cost of ``req`` on THIS engine (admission's
+        reservation unit): pages for the full prompt + generation budget."""
         max_total = min(
             len(req.prompt_tokens) + req.params.max_tokens, self.max_model_len
         )
+        return self.cache.pages_for(max_total)
+
+    def submit_request(self, req: Request) -> Request:
+        """Enqueue a :meth:`make_request`-built request through admission
+        control (the second half of :meth:`submit`)."""
+        now = self._clock()
         entry = ScheduledRequest(
             payload=req,
             priority=req.priority,
             tenant=req.tenant,
-            cost=self.cache.pages_for(max_total),
+            cost=self.request_cost(req),
             deadline=req.deadline,
             enqueued_at=now,
         )
@@ -1087,6 +1119,26 @@ class LLMEngine:
         req._sched_entry = entry
         self.policy.submit(entry)
         return req
+
+    def submit(
+        self,
+        prompt: str,
+        params: SamplingParams | None = None,
+        image=None,  # PIL image or [H, W, 3] array: multimodal request
+        *,
+        priority: str = DEFAULT_CLASS,
+        tenant: str = "default",
+    ) -> Request:
+        """Enqueue one request through admission control.
+
+        ``priority`` (interactive|default|batch) and ``tenant`` drive the
+        fair-share policy; ``params.deadline_s`` arms a deadline. Raises
+        :class:`~modal_examples_tpu.scheduling.admission.ShedError` when
+        admission rejects the request (servers surface it as HTTP 429)."""
+        req = self.make_request(
+            prompt, params, image, priority=priority, tenant=tenant
+        )
+        return self.submit_request(req)
 
     def generate(self, prompt: str, params: SamplingParams | None = None) -> str:
         """Blocking convenience: submit and collect the full completion."""
@@ -1249,6 +1301,152 @@ class LLMEngine:
             self.admission.release(entry)
             _obs.set_sched_queue_depths(self.policy.depths())
             request.out_queue.put(_FINISH)
+
+    # -- disaggregated prefill/decode (serving/disagg, docs/disagg.md) -------
+
+    def prefill_sync(self, req: Request) -> dict:
+        """Run ``req``'s prefill WITHOUT taking a decode slot: claim pages,
+        fill their KV (bucketed or chunked path), sample the first token,
+        and return the claim + sampler state for page extraction — the
+        prefill-replica half of disaggregated serving.
+
+        Only legal while the scheduler loop is NOT running: the loop and
+        this method donate the same cache buffers through their jits, and
+        racing that donation would pass deleted arrays. Prefill-role
+        replicas never ``start()`` their engine; concurrent server threads
+        serialize on an internal lock."""
+        if self.spec_gamma:
+            raise ValueError(
+                "disaggregated prefill is incompatible with speculative=: "
+                "the draft model's KV is not on the wire"
+            )
+        if req.image is not None:
+            raise ValueError(
+                "multimodal requests do not take the disagg prefill path "
+                "(image-token KV keys by content hash, not position)"
+            )
+        self._prefill_sync_pending += 1
+        try:
+            return self._prefill_sync_locked(req)
+        finally:
+            self._prefill_sync_pending -= 1
+
+    def _prefill_sync_locked(self, req: Request) -> dict:
+        with self._prefill_sync_lock:
+            if self._running:
+                raise RuntimeError(
+                    "prefill_sync requires a stopped engine: prefill-role "
+                    "replicas never start their scheduler loop"
+                )
+            claim = self._claim_pages(req)
+            if claim is None:
+                raise OutOfPages(
+                    f"prefill replica out of KV pages for {req.request_id}"
+                )
+            t_start = time.monotonic()
+            try:
+                first = self._prefill_pages(req, claim)
+            except Exception:
+                # same contract as _fail_claims: a failed prefill must not
+                # leak the claim or poison the trie with unwritten pages
+                self.release_claim(claim, valid=False)
+                raise
+            self.stats.prompt_tokens += claim["n_prompt"]
+            _obs.record_engine_phase("prefill", time.monotonic() - t_start)
+            return {
+                "claim": claim,
+                "position": claim["n_prompt"],
+                "first_token": first,
+                # only pages holding real prompt KV ship; decode growth
+                # pages are allocated (empty) on the decode side
+                "n_kv_pages": self.cache.pages_for(claim["n_prompt"]),
+            }
+
+    def release_claim(self, claim: dict, *, valid: bool = True) -> None:
+        """Free a slot-less page claim (the disagg mirror of
+        ``_release_slot_pages``/``_fail_claims``). ``valid=True``: the pages
+        hold real KV — trie refs release but stay cached, keeping the
+        prefill replica's prefix cache warm for the next shared-prefix
+        prompt; private pages free. ``valid=False``: the prefill never
+        completed — trie pages invalidate so no later request shares
+        never-written KV."""
+        if valid and self.prefix_cache is not None:
+            self.prefix_cache.release(claim["trie_pages"])
+            self.cache.allocator.free(claim["private_pages"])
+        elif valid:
+            self.cache.allocator.free(claim["pages"])
+        else:
+            self._unwind_claim(claim)
+
+    def _unwind_claim(self, claim: dict) -> None:
+        """Invalidate + free a claim whose pages never received valid KV —
+        the ONE ownership rule shared by the slot failure path
+        (``_fail_claims``) and the slot-free one (``release_claim``): trie
+        pages another live request still holds stay theirs; everything this
+        claim exclusively owns goes back to the allocator."""
+        if self.prefix_cache is not None:
+            self.prefix_cache.invalidate(claim["trie_pages"])
+            owned = list(claim["private_pages"]) + [
+                p for p in claim["trie_pages"]
+                if p not in self.prefix_cache._by_page
+            ]
+            self.cache.allocator.free(owned)
+        else:
+            self.cache.allocator.free(claim["pages"])
+
+    def extract_request_pages(self, req: Request, state: dict):
+        """Pull the prefilled pages of a :meth:`prefill_sync` result off the
+        device as a wire-ready :class:`~.disagg.transport.PageBlock` (page
+        data + every other cache leaf, block hashes, sampler meta)."""
+        from .disagg.transport import chain_hashes, extract_pages
+
+        claim = state["claim"]
+        used = claim["pages"][: state["n_kv_pages"]]
+        return extract_pages(
+            self.cache,
+            used,
+            block_hashes=chain_hashes(
+                req.cache_key_tokens or req.prompt_tokens,
+                self.cache.page_size,
+            ),
+            meta={
+                "request_id": req.request_id,
+                "prompt_tokens": [int(t) for t in req.prompt_tokens],
+                "position": int(state["position"]),
+                "first_token": int(state["first_token"]),
+                "auto_seed": req.auto_seed,
+            },
+        )
+
+    def submit_adopted(self, req: Request, entry, block) -> Request:
+        """Enqueue a request whose prompt KV was prefilled elsewhere.
+
+        ``block`` (a deserialized ``PageBlock``) is adopted into this cache
+        at admission ON the scheduler thread — the only thread that may
+        touch the cache arrays alongside the decode jits — and decode
+        continues from the migrated position with the migrated first token
+        riding the fresh-slot override lane, exactly like a local prefill's
+        first sample. ``entry`` is the migration's admission reservation,
+        taken by the coordinator BEFORE any byte moved so decode-side KV
+        headroom was guaranteed while the transfer was in flight."""
+        if self.spec_gamma:
+            raise ValueError(
+                "adopting migrated pages into a speculative engine is "
+                "unsupported: the draft cache's KV is not on the wire"
+            )
+        if block.kv_dtype != self.cache.kv_dtype:
+            raise ValueError(
+                f"migrated block is {block.kv_dtype}, this cache is "
+                f"{self.cache.kv_dtype}: disagg peers must share a kv_dtype"
+            )
+        req._adopted_state = {
+            "block": block,
+            "position": int(block.meta["position"]),
+            "first_token": int(block.meta["first_token"]),
+        }
+        req._sched_entry = entry
+        self.policy.submit(entry)
+        return req
 
     def start(self) -> "LLMEngine":
         with self._lock:
@@ -1416,6 +1614,8 @@ class LLMEngine:
             self.policy.next_batch(len(free_slots)) if free_slots else []
         )
         now = self._clock()
+        taken = 0  # free_slots consumed (grouped prefills + adoptions)
+        adopted_any = False
         for pos, entry in enumerate(entries):
             req: Request = entry.payload
             # popped = the reservation converts into a real page claim (or
@@ -1425,6 +1625,22 @@ class LLMEngine:
                 req.out_queue.put(
                     _Finish("deadline") if req.deadline_expired else _FINISH
                 )
+                continue
+            adopted = getattr(req, "_adopted_state", None)
+            if adopted is not None:
+                # migrated request (disagg): its prompt KV arrives as a wire
+                # block, not a prompt to prefill — adopt on THIS thread, the
+                # only one that may write cache arrays next to the decode jits
+                status = self._admit_adopted(
+                    free_slots[taken], req, adopted, entry, now
+                )
+                if status == "retry":
+                    self.admission.reserve(entry)
+                    self.policy.requeue(entries[pos:])
+                    break
+                if status == "ok":
+                    taken += 1
+                    adopted_any = True
                 continue
             claim = self._claim_pages(req)
             if claim is None:
@@ -1441,7 +1657,8 @@ class LLMEngine:
             _obs.record_sched_queue_wait(
                 entry.priority, max(0.0, now - entry.enqueued_at)
             )
-            assignments.append((free_slots[len(assignments)], req, claim))
+            assignments.append((free_slots[taken], req, claim))
+            taken += 1
 
         long_ones = [
             a for a in assignments
@@ -1478,24 +1695,67 @@ class LLMEngine:
 
                     traceback.print_exc()
                     self._fail_claims(chunk)
-        return bool(assignments)
+        return bool(assignments) or adopted_any
+
+    def _admit_adopted(
+        self, slot_idx: int, req: Request, state: dict, entry, now: float
+    ) -> str:
+        """Install a migrated request into a slot: allocate its full page
+        budget, adopt the shipped KV block into the leading pages, and
+        start decode from the migrated position. Returns ``"ok"``,
+        ``"retry"`` (no pages free — caller requeues, preemption-safe), or
+        ``"failed"`` (corrupt/incompatible block — the caller's stream ends
+        with finish_reason="error" and no slot is consumed)."""
+        from .disagg.transport import TransportError, adopt_pages
+
+        block = state["block"]
+        n_pages = self.request_cost(req)
+        try:
+            pages = self.cache.allocator.alloc(n_pages)
+        except OutOfPages:
+            if self.prefix_cache is not None:
+                self.prefix_cache.evict(n_pages)
+                try:
+                    pages = self.cache.allocator.alloc(n_pages)
+                except OutOfPages:
+                    return "retry"
+            else:
+                return "retry"
+        try:
+            adopt_pages(self.cache, block, pages[: block.n_pages])
+        except TransportError as e:
+            self.cache.allocator.free(pages)
+            _log.error(
+                "adopting migrated pages for %s failed: %s", req.request_id, e
+            )
+            req.out_queue.put(_Finish("error"))
+            return "failed"
+        slot = self.slots[slot_idx]
+        slot.request = req
+        # adopted pages are all privately owned: this replica's prefix trie
+        # never saw them (tier/trie integration is the PREFILL side's job)
+        slot.pages = list(pages)
+        slot.trie_pages = []
+        slot.private_pages = list(pages)
+        slot.generated = []
+        slot.emitted_text_len = 0
+        table = np.zeros((self.pages_per_slot,), np.int32)
+        table[: len(pages)] = pages
+        self._page_tables[slot_idx] = table
+        slot.position = state["position"]
+        slot.last_token = state["first_token"]
+        slot.fresh = True  # first token rides the override lane, like prefill
+        _obs.record_sched_queue_wait(
+            entry.priority, max(0.0, now - entry.enqueued_at)
+        )
+        self._accept_token(slot_idx, state["first_token"])
+        return "ok"
 
     def _fail_claims(self, chunk: list) -> None:
         """Unwind failed prefill claims: invalidate trie pages, free privately
         owned pages, clear the slot, and release the caller."""
         for slot_idx, req, claim in chunk:
-            if self.prefix_cache is not None:
-                self.prefix_cache.invalidate(claim["trie_pages"])
-            # trie pages another request still holds stay theirs;
-            # free everything this claim exclusively owns
-            owned = [
-                p for p in claim["private_pages"]
-            ] + [
-                p for p in claim["trie_pages"]
-                if self.prefix_cache is None
-                or p not in self.prefix_cache._by_page
-            ]
-            self.cache.allocator.free(owned)
+            self._unwind_claim(claim)
             slot = self.slots[slot_idx]
             slot.request = None
             slot.pages = slot.trie_pages = slot.private_pages = []
@@ -1516,9 +1776,22 @@ class LLMEngine:
         pc = self.prefix_cache
         key_tokens = req.cache_key_tokens or req.prompt_tokens
         shared: list[int] = []
+        promoted: list[int] = []
         if pc is not None:
             shared, _ = pc.acquire(key_tokens)
-        need = n_pages - len(shared)
+            if self.tiered is not None and shared:
+                # per-PAGE units, matching the host/volume counts promote
+                # records — the three tiers' hit counters are comparable
+                _obs.record_tier_hit("hbm", n=len(shared))
+            if self.tiered is not None:
+                # lower-tier promotion: consecutive full-prompt pages past
+                # the HBM trie hit, restored from host RAM / Volume with
+                # their content pre-written — they join the trie as fresh
+                # inserts below (refcount 1 via insert)
+                promoted = self.tiered.promote(
+                    key_tokens, n_have=len(shared)
+                )
+        need = n_pages - len(shared) - len(promoted)
         try:
             fresh = self.cache.allocator.alloc(need)
         except OutOfPages:
@@ -1528,11 +1801,12 @@ class LLMEngine:
                     fresh = self.cache.allocator.alloc(need)
                 except OutOfPages:
                     pc.release(shared)
+                    self.cache.allocator.free(promoted)
                     return None
             else:
                 return None
-        pages = shared + fresh
-        trie_pages, private_pages = list(shared), list(fresh)
+        pages = shared + promoted + fresh
+        trie_pages, private_pages = list(shared), list(promoted) + list(fresh)
         if pc is not None:
             pc.hits += bool(shared)
             pc.misses += not shared
@@ -1544,6 +1818,8 @@ class LLMEngine:
             trie_pages = list(final)
             private_pages = pages[n_full:]  # everything past the full-prompt
             pages = final + private_pages   # pages is trie-tracked
+            if self.tiered is not None:
+                self.tiered.register(key_tokens, final)
         return {
             "pages": pages,
             "trie_pages": trie_pages,
@@ -1560,35 +1836,19 @@ class LLMEngine:
         slot.pages, slot.trie_pages, slot.private_pages = [], [], []
         slot.ngram = None
 
-    def _prefill_long(self, slot_idx: int, req: Request, claim: dict) -> None:
-        """Chunked prefill for prompts beyond the largest bucket: bucket-
-        sized chunks attend to the cached prefix via the rectangular flash
-        kernel (llama.prefill_chunk) — bounded VMEM at any prompt length."""
+    def _run_prefill_chunks(self, prompt_tokens: list, table) -> "jax.Array":
+        """The chunked-prefill inner loop (bucket-sized chunks attending to
+        the cached prefix via the rectangular flash kernel), shared by the
+        slot path (``_prefill_long``) and the slot-free disagg path
+        (``_prefill_pages``). Returns the final chunk's last-token logits."""
         import functools
 
-        t_start = time.monotonic()
-        _obs.record_engine_queue_wait(t_start - req.created)
-        pages, n_prompt = claim["pages"], claim["n_prompt"]
-        slot = self.slots[slot_idx]
-        slot.request = req
-        slot.pages = pages
-        slot.trie_pages = claim["trie_pages"]
-        slot.private_pages = claim["private_pages"]
-        slot.generated = []
-        slot.emitted_text_len = 0
-        if self.spec_mode == "ngram":
-            slot.ngram = _NgramIndex(
-                self.ngram_n, req.prompt_tokens or [], self.NGRAM_LOOKBACK
-            )
-        table = np.zeros((self.pages_per_slot,), np.int32)
-        table[: len(pages)] = pages
-        self._page_tables[slot_idx] = table
-
+        n_prompt = len(prompt_tokens)
         C = self.prefill_buckets[-1]
         pad_tok = self.tokenizer.pad_id % self.cfg.vocab_size
         logits = None
         for offset in range(0, n_prompt, C):
-            chunk = req.prompt_tokens[offset : offset + C]
+            chunk = prompt_tokens[offset : offset + C]
             toks = np.full((1, C), pad_tok, np.int32)
             toks[0, : len(chunk)] = chunk
             fn = self._chunk_jits.get(offset)
@@ -1624,6 +1884,85 @@ class LLMEngine:
                     jnp.asarray([len(chunk)], np.int32),
                     cfg=self.draft_cfg,
                 )
+        return logits
+
+    def _prefill_pages(self, req: Request, claim: dict) -> int:
+        """Fill ``claim``'s pages with ``req``'s prompt KV and sample the
+        first token — no slot touched (the disagg prefill path). Reuses the
+        engine's compiled prefill shapes: short prompts ride row 0 of the
+        ``(bucket, prefill_batch)`` program, long prompts take the chunked
+        path. Returns the first sampled token."""
+        pages, n_prompt = claim["pages"], claim["n_prompt"]
+        table = np.zeros((self.pages_per_slot,), np.int32)
+        table[: len(pages)] = pages
+        p = req.params
+        if n_prompt > self.prefill_buckets[-1]:
+            logits = self._run_prefill_chunks(req.prompt_tokens, table)
+            first = sample(
+                logits,
+                self._next_key(),
+                jnp.asarray([p.temperature], np.float32),
+                jnp.asarray([p.top_p], np.float32),
+                jnp.asarray([p.top_k], np.int32),
+                seeds=jnp.asarray([_req_seed(req)], np.int32),
+                step_ids=jnp.asarray([n_prompt], np.int32),
+            )
+            return int(np.asarray(first)[0])
+        bucket = self._bucket_for(n_prompt)
+        B = self.prefill_batch
+        pad_tok = self.tokenizer.pad_id % self.cfg.vocab_size
+        tokens = np.full((B, bucket), pad_tok, np.int32)
+        tokens[0, :n_prompt] = req.prompt_tokens
+        tables = np.zeros((B, self.pages_per_slot), np.int32)
+        tables[0] = table
+        seq_lens = np.ones((B,), np.int32)
+        seq_lens[0] = n_prompt
+        temps = np.ones((B,), np.float32)
+        top_ps = np.ones((B,), np.float32)
+        top_ks = np.zeros((B,), np.int32)
+        seeds = np.full((B,), -1, np.int32)
+        temps[0], top_ps[0], top_ks[0] = p.temperature, p.top_p, p.top_k
+        seeds[0] = _req_seed(req)
+        next_tok, self.cache.k_pages, self.cache.v_pages = self._prefill_jit(
+            (bucket, B)
+        )(
+            self.params,
+            self.cache.k_pages,
+            self.cache.v_pages,
+            jnp.asarray(tokens),
+            jnp.asarray(tables),
+            jnp.asarray(seq_lens),
+            self._next_key(),
+            jnp.asarray(temps),
+            jnp.asarray(top_ps),
+            jnp.asarray(top_ks),
+            jnp.asarray(seeds),
+        )
+        return int(np.asarray(next_tok)[0])
+
+    def _prefill_long(self, slot_idx: int, req: Request, claim: dict) -> None:
+        """Chunked prefill for prompts beyond the largest bucket: bucket-
+        sized chunks attend to the cached prefix via the rectangular flash
+        kernel (llama.prefill_chunk) — bounded VMEM at any prompt length."""
+        t_start = time.monotonic()
+        _obs.record_engine_queue_wait(t_start - req.created)
+        pages, n_prompt = claim["pages"], claim["n_prompt"]
+        slot = self.slots[slot_idx]
+        slot.request = req
+        slot.pages = pages
+        slot.trie_pages = claim["trie_pages"]
+        slot.private_pages = claim["private_pages"]
+        slot.generated = []
+        slot.emitted_text_len = 0
+        if self.spec_mode == "ngram":
+            slot.ngram = _NgramIndex(
+                self.ngram_n, req.prompt_tokens or [], self.NGRAM_LOOKBACK
+            )
+        table = np.zeros((self.pages_per_slot,), np.int32)
+        table[: len(pages)] = pages
+        self._page_tables[slot_idx] = table
+
+        logits = self._run_prefill_chunks(req.prompt_tokens, table)
         p = req.params
         first = sample(
             logits,

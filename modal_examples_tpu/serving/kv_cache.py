@@ -185,7 +185,12 @@ class PagedKVCache:
 
 # a jax pytree (device leaves: k/v pages — 2 for bf16, 4 for int8 with the
 # scale arrays riding alongside) so tree utilities (jax.tree.leaves,
-# utils.sync.force, snapshot codecs) see the device state. CAUTION: the
+# utils.sync.force, snapshot codecs) see the device state. The leaf set is
+# also the WIRE CONTRACT of disaggregated serving: the KV-page transport
+# (serving/disagg/transport.wire_leaves) enumerates these leaves by tree
+# flattening and ships every one per migrated page, with every leaf's page
+# axis at axis 1 — keep that invariant when adding leaves (a static guard
+# asserts codec leaves == pytree leaves; docs/disagg.md). CAUTION: the
 # allocator rides in meta_fields and compares by IDENTITY (mutable host
 # state, no __eq__) — do NOT pass a whole cache as a jit argument; every
 # distinct allocator would be a distinct static key (silent retraces).

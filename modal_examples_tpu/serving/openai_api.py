@@ -477,9 +477,16 @@ class OpenAIServer:
         if (engine is None) == (router is None):
             raise ValueError("pass exactly one of engine= or router=")
         self.router = router
-        self.engine = engine if engine is not None else (
-            router.replicas[0].engine
-        )
+        if engine is not None:
+            self.engine = engine
+        else:
+            # primary = the first replica that can own a request end to end
+            # (skips prefill-role replicas under a disagg coordinator)
+            serving = [
+                r for r in router.replicas
+                if getattr(r, "serves_requests", True)
+            ]
+            self.engine = (serving or router.replicas)[0].engine
         self.model_name = model_name
         handler = type("BoundHandler", (_Handler,), {"server_ref": self})
         self.httpd = ThreadingHTTPServer((host, port), handler)
@@ -498,7 +505,15 @@ class OpenAIServer:
         )
 
     def _engines(self):
+        """Engines whose scheduler loop this server owns. A role-aware
+        front (``DisaggCoordinator``) exposes ``serving_engines()`` so
+        prefill-role replicas are NEVER started: their engines run the
+        synchronous prefill path, and a scheduler loop racing it would
+        donate the same cache buffers twice."""
         if self.router is not None:
+            serving = getattr(self.router, "serving_engines", None)
+            if serving is not None:
+                return serving()
             return [r.engine for r in self.router.replicas]
         return [self.engine]
 

@@ -59,6 +59,13 @@ class PrefixCache:
         self.hits = 0
         self.misses = 0
         self.evictions = 0  # monotonic: pages reclaimed by evict()
+        #: optional spill hook (set by the engine when a TieredPrefixCache
+        #: wraps this trie): called with the page ids of each eviction wave
+        #: BEFORE their pages return to the allocator, so the lower tier can
+        #: read the device pages while they still hold valid KV. Runs under
+        #: this cache's lock and on the cache-owning thread (evict is only
+        #: reached from the engine's claim path) — it must not call back in.
+        self.spill = None
 
     def _page_keys(self, tokens: list[int]) -> list[tuple]:
         n_full = len(tokens) // self.page_size
@@ -199,6 +206,10 @@ class PrefixCache:
                 # one allocator call per wave: per-page frees would pay a
                 # lock round-trip + 3 gauge writes per page on the
                 # allocator-pressure path
+                if self.spill is not None:
+                    # HBM -> lower tier: serialize the evicted pages while
+                    # their KV is still resident (docs/disagg.md)
+                    self.spill(batch)
                 self.allocator.free(batch)
             self.evictions += freed
             _obs.set_prefix_cache_pages(len(self._by_page))

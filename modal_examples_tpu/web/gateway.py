@@ -49,6 +49,44 @@ def _coerce_kwargs(fn, raw: dict) -> dict:
     return out
 
 
+def _disagg_snapshot() -> dict:
+    """Disaggregated-serving snapshot from the process registry: replica
+    roles, migration counters/latency, and prefix-tier occupancy + hits —
+    the ``/disagg`` route's payload (``tpurun disagg`` renders the same
+    series from pushed metrics)."""
+    from ..observability import catalog as C
+    from ..utils.prometheus import default_registry as reg
+
+    roles = {
+        labels.get("replica", "?"): labels.get("role", "?")
+        for labels, _v in reg.series(C.REPLICA_ROLE)
+    }
+    by_result = {
+        labels.get("result", "?"): v
+        for labels, v in reg.series(C.DISAGG_MIGRATIONS_TOTAL)
+    }
+    tiers: dict = {}
+    for labels, v in reg.series(C.PREFIX_TIER_PAGES):
+        tiers.setdefault(labels.get("tier", "?"), {})["pages"] = v
+    for labels, v in reg.series(C.PREFIX_TIER_BYTES):
+        tiers.setdefault(labels.get("tier", "?"), {})["bytes"] = v
+    hits = {
+        labels.get("tier", "?"): v
+        for labels, v in reg.series(C.PREFIX_TIER_HITS_TOTAL)
+    }
+    return {
+        "replicas": roles,
+        "migrations": {
+            "by_result": by_result,
+            "inflight": reg.value(C.DISAGG_MIGRATIONS_INFLIGHT),
+            "pages": reg.total(C.DISAGG_PAGES_MIGRATED_TOTAL),
+            "bytes": reg.total(C.DISAGG_MIGRATION_BYTES_TOTAL),
+            "latency": reg.histogram_quantiles(C.DISAGG_MIGRATION_SECONDS),
+        },
+        "tiers": {"occupancy": tiers, "hits": hits},
+    }
+
+
 class _Handler(BaseHTTPRequestHandler):
     gateway: "Gateway"
 
@@ -182,15 +220,20 @@ class _Handler(BaseHTTPRequestHandler):
         """Built-in observability routes: ``/metrics`` (prometheus
         exposition: this process's registry + every pushed job file),
         ``/traces[/<call_id>]`` (call-lifecycle span JSON), ``/healthz``
-        (SLO pass/fail + burn rates), and ``/autoscaler[?function=tag]``
-        (the autoscaler decision journal). User endpoints with the same
-        label win — these only answer when no route claimed the path."""
+        (SLO pass/fail + burn rates), ``/autoscaler[?function=tag]``
+        (the autoscaler decision journal), and ``/disagg`` (replica roles,
+        migration counters, prefix-tier occupancy — docs/disagg.md). User
+        endpoints with the same label win — these only answer when no
+        route claimed the path."""
         parts = parsed.path.strip("/").split("/")
         label = parts[0] if parts else ""
         if method != "GET" or label not in (
-            "metrics", "traces", "healthz", "autoscaler"
+            "metrics", "traces", "healthz", "autoscaler", "disagg"
         ):
             return False
+        if label == "disagg":
+            self._respond_json(200, _disagg_snapshot())
+            return True
         if label == "healthz":
             from ..observability.slo import healthz
 

@@ -66,6 +66,43 @@ def test_bench_emits_schema_json():
 
 
 @pytest.mark.slow
+def test_bench_disagg_config_emits_disagg_section():
+    """The two-replica disagg config must ride the same schema plus a
+    ``disagg`` section: migration volume, latency quantiles, and the tiered
+    prefix cache's hit mix (docs/disagg.md)."""
+    out = subprocess.run(
+        [sys.executable, str(REPO / "bench.py")],
+        capture_output=True,
+        text=True,
+        timeout=500,
+        env={
+            **os.environ,
+            "BENCH_CPU": "1",
+            "BENCH_MODEL": "tiny-disagg",
+            "BENCH_NO_SECONDARY": "1",
+        },
+        cwd=str(REPO),
+    )
+    assert out.returncode == 0, out.stderr[-500:]
+    lines = [l for l in out.stdout.strip().splitlines() if l.strip()]
+    assert len(lines) == 1, lines
+    payload = json.loads(lines[0])
+    assert payload["value"] > 0 and payload["unit"] == "tok/s"
+    disagg = payload.get("disagg")
+    assert disagg, payload
+    assert {"pages_migrated", "migration_bytes", "migrations",
+            "migration_latency", "tier_hits", "tier_hit_rates"} <= set(disagg)
+    assert disagg["pages_migrated"] > 0
+    assert disagg["migrations"]["ok"] > 0
+    # bench traffic must migrate cleanly, not limp through fallback
+    assert disagg["migrations"]["fallback"] == 0
+    lat = disagg["migration_latency"]
+    assert lat and lat["p50"] <= lat["p95"] and lat["count"] > 0
+    rates = disagg["tier_hit_rates"]
+    assert all(0.0 <= v <= 1.0 for v in rates.values())
+
+
+@pytest.mark.slow
 def test_image_child_emits_schema_json():
     """The images/sec secondary metric (BASELINE.json: 'SDXL images/sec'):
     the txt2img pipeline child must print one JSON line; the tiny CPU

@@ -290,6 +290,81 @@ def test_kv_cache_consumers_handle_quantized_pytree():
     assert len(consumers) >= 8, consumers
 
 
+def test_disagg_wire_codec_covers_every_cache_pytree_leaf():
+    """The disagg wire codec must enumerate EVERY device leaf of the
+    ``PagedKVCache`` pytree and carry each one through
+    extract -> serialize -> deserialize intact — for BOTH cache forms
+    (2-leaf bf16, 4-leaf int8). This is the int8-scales lesson from PR 5
+    made structural: a future 5th leaf (new scale layout, metadata plane)
+    that the wire silently failed to ship would corrupt every migrated
+    request; here it fails the suite instead."""
+    import jax
+    import numpy as np
+
+    from modal_examples_tpu.serving.disagg.transport import (
+        adopt_pages,
+        deserialize_block,
+        extract_pages,
+        serialize_block,
+        wire_leaves,
+    )
+    from modal_examples_tpu.serving.kv_cache import PagedKVCache
+
+    def make(kv_dtype):
+        cache = PagedKVCache.create(
+            n_layers=1, n_kv_heads=1, head_dim=4, n_pages=4, page_size=2,
+            kv_dtype=kv_dtype, prefer_native=False,
+        )
+        # distinguishable leaf contents, so a dropped leaf can't hide
+        # behind matching zeros
+        import jax.numpy as jnp
+
+        flat, treedef = jax.tree_util.tree_flatten(cache)
+        rng = np.random.default_rng(7)
+        filled = jax.tree_util.tree_unflatten(
+            treedef,
+            [
+                jnp.asarray(
+                    rng.normal(size=leaf.shape).astype(np.float32)
+                ).astype(leaf.dtype)
+                for leaf in flat
+            ],
+        )
+        cache.k_pages, cache.v_pages = filled.k_pages, filled.v_pages
+        return cache
+
+    for kv_dtype, expected_leaves in (("bfloat16", 2), ("int8", 4)):
+        cache = make(kv_dtype)
+        tree_leaves = jax.tree_util.tree_leaves(cache)
+        named = wire_leaves(cache)
+        assert len(tree_leaves) == expected_leaves, (
+            f"{kv_dtype}: cache leaf count changed — update this guard AND "
+            "audit every consumer (docs/kv_cache.md)"
+        )
+        assert len(named) == len(tree_leaves), (
+            f"{kv_dtype}: wire codec enumerates {len(named)} leaves but the "
+            f"cache pytree has {len(tree_leaves)} — a leaf is not shipped"
+        )
+        block = deserialize_block(
+            serialize_block(extract_pages(cache, [1, 2]))
+        )
+        assert set(block.leaves) == {n for n, _ in named}, (
+            f"{kv_dtype}: leaves lost in (de)serialization"
+        )
+        # the FULL round trip must reproduce every leaf on the receiving
+        # cache too: adoption writing back only a hardcoded subset of
+        # fields would ship a future leaf and then silently drop it
+        dst = make(kv_dtype)
+        adopt_pages(dst, block, [1, 2])
+        for (name, src_leaf), (_, dst_leaf) in zip(
+            wire_leaves(cache), wire_leaves(dst)
+        ):
+            assert np.array_equal(
+                np.asarray(src_leaf[:, np.asarray([1, 2])]),
+                np.asarray(dst_leaf[:, np.asarray([1, 2])]),
+            ), f"{kv_dtype}: leaf {name} not adopted"
+
+
 def test_no_bare_print_in_framework_code():
     """Framework code under ``core/`` and ``serving/`` must not ``print()``:
     diagnostics go through ``utils.log.get_logger`` so they carry a level
