@@ -64,6 +64,37 @@ CONFIGS = {
         slots=16, max_len=1024, max_tokens=128, timeout=1500, quant="int8",
         kv_dtype="int8", prompt_mult=40,
     ),
+    "llama2-7b-tp2-int8-ctx1024": dict(
+        # tensor parallelism on the sharded Pallas fast path (round 7): the
+        # ROADMAP-named TP=2 on-chip A/B partner of the ctx-1024 int8
+        # config — same slots/context/dtype, cache + kernels sharded over
+        # the kv-head ICI axis via shard_map (ops.sharded). Per-shard
+        # Hkv=16, so int8 runs the grouped ragged variant (the plan rides
+        # in the json's impl_plan). Needs >= 2 chips; on a 1-chip host the
+        # mesh build fails and the supervisor degrades to the next config.
+        slots=16, max_len=1024, max_tokens=128, timeout=1500, quant="int8",
+        kv_dtype="int8", prompt_mult=40, tp=2,
+    ),
+    "llama2-7b-int8-spec-ngram": dict(
+        # speculative decoding as a measured lever (ROADMAP open item #4):
+        # prompt-lookup ngram proposals against the repetitive bench prompt
+        # give high acceptance, so this is the config where acceptance-rate
+        # -> tok/s becomes a real, driver-captured delta vs
+        # llama2-7b-int8-kv8-s36 (same shape, no spec). The json's `spec`
+        # section carries {mode, gamma, acceptance_rate}.
+        slots=16, max_len=256, max_tokens=128, timeout=1500, quant="int8",
+        kv_dtype="int8", spec=("ngram", 4),
+    ),
+    "llama2-7b-int8-spec-draft1b": dict(
+        # draft-model speculation: a 1B-shape draft (same 32000 vocab)
+        # proposes, the 7B verifies. Random draft weights (zero-egress)
+        # floor the acceptance rate, so this config measures the MECHANISM
+        # cost (draft decode + verify pass per tick); the ngram config
+        # above carries the acceptance-driven win. Real checkpoints would
+        # only raise acceptance, never the per-tick cost.
+        slots=16, max_len=256, max_tokens=128, timeout=1500, quant="int8",
+        kv_dtype="int8", spec=("draft-1b", 4),
+    ),
     "llama2-7b-disagg-2rep": dict(
         # disaggregated prefill/decode at the ctx-1024 int8-KV shape (the
         # A/B partner of llama2-7b-int8-kv8-ctx1024): a prefill replica
@@ -97,10 +128,28 @@ CONFIGS = {
     "tiny-disagg": dict(
         slots=4, max_len=128, max_tokens=16, timeout=420, disagg=True
     ),
+    # CPU path-proofs (test_bench_contract): the sharded-pallas TP=2 code
+    # shape on a forced 8-device host mesh, and the ngram-spec code shape —
+    # same engine wiring the 7B configs run on chip
+    "tiny-tp2": dict(
+        slots=4, max_len=128, max_tokens=16, timeout=420, tp=2,
+    ),
+    "tiny-spec-ngram": dict(
+        slots=4, max_len=128, max_tokens=16, timeout=420, spec=("ngram", 2),
+    ),
 }
 
 
 def _child(model: str) -> None:
+    spec = CONFIGS[model]
+    if spec.get("tp", 1) > 1 and os.environ.get("BENCH_CPU"):
+        # CPU TP path-proof needs virtual devices BEFORE jax imports
+        flags = os.environ.get("XLA_FLAGS", "")
+        if "xla_force_host_platform_device_count" not in flags:
+            os.environ["XLA_FLAGS"] = (
+                flags + " --xla_force_host_platform_device_count=8"
+            ).strip()
+
     import jax
 
     if os.environ.get("BENCH_CPU"):
@@ -111,8 +160,6 @@ def _child(model: str) -> None:
     from modal_examples_tpu.models import llama
     from modal_examples_tpu.models.quantize import param_bytes
     from modal_examples_tpu.serving import LLMEngine, SamplingParams
-
-    spec = CONFIGS[model]
     if model.startswith("llama2-7b"):
         cfg = llama.LlamaConfig.llama2_7b()
     elif model.startswith("llama3.1-8b"):
@@ -124,6 +171,33 @@ def _child(model: str) -> None:
         )
     else:
         cfg = llama.LlamaConfig.tiny()
+
+    # tensor parallelism (round 7): a "tensor"-axis mesh shards weights,
+    # cache, and — via ops.sharded's shard_map dispatch — the Pallas
+    # kernels over the kv-head axis; the SAME engine flags otherwise
+    mesh = None
+    if spec.get("tp", 1) > 1:
+        from modal_examples_tpu.parallel import make_mesh
+
+        mesh = make_mesh(
+            {"tensor": spec["tp"]}, devices=jax.devices()[: spec["tp"]]
+        )
+
+    # speculative decoding configs (ROADMAP open item #4): "ngram" =
+    # prompt-lookup (no draft weights); "draft-1b" = a 1B-shape draft with
+    # the target's 32000 vocab, random weights (mechanism-cost floor —
+    # the engine random-inits the draft when no draft_params are given)
+    speculative = None
+    if spec.get("spec"):
+        mode, gamma = spec["spec"]
+        if mode == "ngram":
+            speculative = ("ngram", gamma)
+        else:
+            draft_cfg = llama.LlamaConfig(
+                vocab_size=cfg.vocab_size, dim=2048, n_layers=16,
+                n_heads=16, n_kv_heads=8, ffn_dim=5632, max_seq_len=2048,
+            )
+            speculative = (draft_cfg, gamma)
 
     t0 = time.time()
     engine = LLMEngine(
@@ -138,8 +212,10 @@ def _child(model: str) -> None:
         quantization=spec.get("quant"),
         # the v3 ragged kernel + pallas scatter decode structure (round 4);
         # models whose shapes don't fit the kernel fall back to XLA inside
-        # decode_step
+        # decode_step — under mesh= the kernels run per head shard
         paged_impl="pallas",
+        mesh=mesh,
+        speculative=speculative,
     )
     build_s = time.time() - t0
     weight_bytes = param_bytes(engine.params)
@@ -291,6 +367,17 @@ def _child(model: str) -> None:
         "sheds_total": int(sheds),
         "admitted_total": int(admitted),
     }
+    # speculative decoding (ROADMAP open item #4): the acceptance-rate ->
+    # tok/s story needs both numbers in the same json line
+    spec_info = None
+    if engine.spec_gamma:
+        spec_info = {
+            "mode": engine.spec_mode,
+            "gamma": engine.spec_gamma,
+            "proposed": int(engine.stats.spec_proposed),
+            "accepted": int(engine.stats.spec_accepted),
+            "acceptance_rate": round(engine.stats.acceptance_rate(), 4),
+        }
     # disaggregated serving (docs/disagg.md): migration volume + latency and
     # the tiered prefix cache's per-tier hit mix, only for disagg configs
     disagg_info = None
@@ -338,11 +425,21 @@ def _child(model: str) -> None:
                 "compile_s": round(compile_s, 1),
                 "pct_hbm_ceiling": round(stream_gbps / V5E_HBM_GBPS, 4),
                 "engine_errors": errors,
+                # the RESOLVED decode plan (paged_impl_plan(mesh=...)):
+                # benches must report the per-shard variant actually run,
+                # incl. the tensor-parallel degree, not the requested impl
+                "tp": engine.impl_plan.get("tp", 1),
+                "impl_plan": {
+                    k: v
+                    for k, v in engine.impl_plan.items()
+                    if k != "downgraded"
+                },
                 "phase_latency": phase_latency,
                 "token_latency": token_latency,
                 "scheduling": scheduling,
                 "kv_cache": kv_cache_info,
                 "tokens_per_second": round(tok_s, 2),
+                **({"spec": spec_info} if spec_info else {}),
                 **({"disagg": disagg_info} if disagg_info else {}),
             }
         )
@@ -770,7 +867,10 @@ def main() -> int:
             "llama2-7b-int4-s36",
             "llama2-7b-int8-s36",
             "llama2-7b-int8-kv8-ctx1024",
+            "llama2-7b-tp2-int8-ctx1024",
+            "llama2-7b-int8-spec-ngram",
             "llama2-7b-disagg-2rep",
+            "llama2-7b-int8-spec-draft1b",
             "llama2-7b-int8-s32",
             "llama2-7b-int8-s16",
             "llama3.1-8b-int8-s32",

@@ -30,6 +30,18 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 
 def main() -> int:
+    # CPU TP smoke (--tp N + BENCH_CPU) needs virtual devices BEFORE any
+    # jax import — and the argparse setup below already imports the package
+    tp_requested = any(
+        a == "--tp" or a.startswith("--tp=") for a in sys.argv
+    )
+    if tp_requested and os.environ.get("BENCH_CPU"):
+        flags = os.environ.get("XLA_FLAGS", "")
+        if "xla_force_host_platform_device_count" not in flags:
+            os.environ["XLA_FLAGS"] = (
+                flags + " --xla_force_host_platform_device_count=8"
+            ).strip()
+
     ap = argparse.ArgumentParser()
     ap.add_argument("--model", default="llama2-7b")
     from modal_examples_tpu.models.quantize import SUPPORTED
@@ -51,6 +63,13 @@ def main() -> int:
         help="page-cache dtype A/B: int8 = quantized KV (int8 pages + f32 "
         "scale rows — half the KV HBM traffic and residency, "
         "docs/kv_cache.md)",
+    )
+    ap.add_argument(
+        "--tp", type=int, default=1,
+        help="tensor-parallel degree: weights take the Megatron specs, the "
+        "cache shards by kv head, and the pallas impls run per head shard "
+        "via ops.sharded's shard_map dispatch (round 7) — the TP A/B lever "
+        "for revalidate_chip.sh",
     )
     ap.add_argument("--steps", type=int, default=8, help="decode_block")
     ap.add_argument("--max-len", type=int, default=256)
@@ -89,12 +108,34 @@ def main() -> int:
         needed = []
         if "pallas" in args.impl:
             kvd = "int8" if args.kv_dtype == "int8" else "bfloat16"
-            variant = args.variant or ragged_variant_for(_cfg.n_kv_heads, kvd)
-            suffix = "_int8kv" if args.kv_dtype == "int8" else ""
-            needed.append(
-                ("ragged_decode" if variant == "flat" else "ragged_decode_gqa")
-                + suffix
+            # under --tp the kernel compiles at the SHARD-local head count
+            # (Hkv // tp), so the probed variant must match that shape
+            tp = max(1, args.tp)
+            hkv = _cfg.n_kv_heads // tp if _cfg.n_kv_heads % tp == 0 else (
+                _cfg.n_kv_heads
             )
+            variant = args.variant or ragged_variant_for(hkv, kvd)
+            suffix = "_int8kv" if args.kv_dtype == "int8" else ""
+            hq_shard = (
+                _cfg.n_heads // tp if _cfg.n_heads % tp == 0 else _cfg.n_heads
+            )
+            if suffix and variant == "grouped" and hq_shard == hkv == 16:
+                # MHA-as-grouped at the TP=2 7B shard shape (Hq=Hkv=16,
+                # G=1) is its own Mosaic shape family with a dedicated
+                # registry probe — first compiles stay in the killable
+                # harness (the wedge-proof rule). Other shard shapes fall
+                # through to the generic variant probes below (same
+                # approximation level single-chip GQA shapes already use).
+                needed.append("ragged_decode_tp_shard_int8kv")
+            else:
+                needed.append(
+                    (
+                        "ragged_decode"
+                        if variant == "flat"
+                        else "ragged_decode_gqa"
+                    )
+                    + suffix
+                )
         if os.environ.get("MTPU_SCATTER_IMPL") == "pallas":
             needed.append(
                 "scatter_kv_int8" if args.kv_dtype == "int8" else "scatter_kv"
@@ -140,6 +181,17 @@ def main() -> int:
         )
     else:
         params = llama.init_params(jax.random.PRNGKey(0), cfg)
+    mesh = None
+    if args.tp > 1:
+        from modal_examples_tpu.parallel import make_mesh
+        from modal_examples_tpu.serving.engine import _shard_params
+
+        mesh = make_mesh(
+            {"tensor": args.tp}, devices=jax.devices()[: args.tp]
+        )
+        # Megatron specs, QuantizedWeight-aware (scales keep the output
+        # dim's sharding) — the same placement the engine uses
+        params = _shard_params(params, cfg, mesh)
     force(params)  # truly drain the build queue before timing anything
     weight_bytes = param_bytes(params)
     print(
@@ -163,6 +215,7 @@ def main() -> int:
                 logits, kp, vp = llama.decode_step(
                     params, tok, pos, kp, vp, tables, active, cfg, impl=impl,
                     scatter_impl=scatter_impl, ragged_variant=args.variant,
+                    mesh=mesh,
                 )
                 nxt = sample(
                     logits, k_i, temps, top_ps, top_ks, seeds=seeds,
@@ -194,6 +247,12 @@ def main() -> int:
                 kv_dt = "int8" if args.kv_dtype == "int8" else jnp.bfloat16
                 kp = kv_empty(cache_shape, kv_dt)
                 vp = kv_empty(cache_shape, kv_dt)
+                if mesh is not None:
+                    # the ONE canonical kv-head cache placement, shared
+                    # with engine._shard_cache
+                    from modal_examples_tpu.ops import shard_cache_pages
+
+                    kp, vp = shard_cache_pages(mesh, kp, vp)
                 tables = jnp.asarray(
                     1 + np.arange(slots * pp).reshape(slots, pp), jnp.int32
                 )
@@ -248,6 +307,7 @@ def main() -> int:
                                     kv_dtype=args.kv_dtype
                                     if args.kv_dtype == "int8"
                                     else "bfloat16",
+                                    mesh=mesh,
                                     warn=False,
                                 ).items()
                                 if k != "downgraded"

@@ -38,5 +38,23 @@ timeout 900 python benchmarks/decode_micro.py --quant int8 --slots 8,16 --max-le
 #    migration on real hardware (docs/disagg.md)
 timeout 1500 env BENCH_MODEL=llama2-7b-int8-kv8-ctx1024 BENCH_NO_SECONDARY=1 python bench.py || exit 12
 timeout 1500 env BENCH_MODEL=llama2-7b-disagg-2rep BENCH_NO_SECONDARY=1 python bench.py || exit 13
-# 9. full bench (includes the kv_cache + disagg sections)
-timeout 1500 python bench.py || exit 14
+# 9. tensor parallelism (TP=2) on the sharded pallas fast path (round 7,
+#    ops.sharded): pallas-vs-xla A/B at bf16 and int8 KV — per-shard Hkv=16
+#    compiles ride the probe harness (stage 1 covers
+#    ragged_decode_tp_shard_int8kv) — then the ctx-1024 int8 TP bench
+#    config, the ROADMAP-named A/B partner of stage 7's single-chip run.
+#    Gated on device count: a 1-chip host SKIPS these stages (the later
+#    single-chip stages must still run) instead of aborting the script.
+if timeout 120 python -c "import jax; raise SystemExit(0 if len(jax.devices()) >= 2 else 1)"; then
+  timeout 900 python benchmarks/decode_micro.py --quant int8 --slots 8 --tp 2 --impl xla,pallas --kv-dtype bf16 || exit 14
+  timeout 900 python benchmarks/decode_micro.py --probe --quant int8 --slots 8 --tp 2 --impl xla,pallas --kv-dtype int8 || exit 15
+  timeout 1500 env BENCH_MODEL=llama2-7b-tp2-int8-ctx1024 BENCH_NO_SECONDARY=1 python bench.py || exit 16
+else
+  echo "stage 9 SKIPPED: fewer than 2 devices (TP stages need a multi-chip host)"
+fi
+# 10. speculative decoding as a measured lever (ROADMAP open item #4): the
+#     ngram config (acceptance-driven win) vs its no-spec A/B partner
+#     llama2-7b-int8-kv8-s36 from the full bench below
+timeout 1500 env BENCH_MODEL=llama2-7b-int8-spec-ngram BENCH_NO_SECONDARY=1 python bench.py || exit 17
+# 11. full bench (includes the kv_cache + disagg + spec + tp sections)
+timeout 1500 python bench.py || exit 18

@@ -566,6 +566,17 @@ def cmd_top(argv: list[str]) -> int:
             f"ttft p50/p95 ms {fmt_q(C.TTFT_SECONDS)}   "
             f"tpot p50/p95 ms {fmt_q(C.TPOT_SECONDS)}"
         )
+        # the resolved decode plan, incl. the tensor-parallel degree and the
+        # PER-SHARD ragged variant (paged_impl_plan(mesh=...)) — so a TP
+        # deployment's dashboard shows the sharded plan actually running
+        for labels, _v in merged.series(C.DECODE_IMPL):
+            print(
+                f"decode impl: attention={labels.get('attention', '?')} "
+                f"variant={labels.get('variant', '-')} "
+                f"scatter={labels.get('scatter', '?')} "
+                f"kv_dtype={labels.get('kv_dtype', '?')} "
+                f"tp={labels.get('tp', '1')}"
+            )
         print()
         print(f"{'SLO':<22} {'TARGET':>10} {'OBSERVED':>10} {'BURN':>6}  OK")
         for r in evaluate(merged, burn_rate_registry=merged):

@@ -33,14 +33,16 @@ import jax.numpy as jnp
 from jax.sharding import PartitionSpec as P
 
 from ..ops import (
-    flash_attention,
     is_quantized,
     kv_gather,
     kv_scatter,
-    paged_decode_attention,
+    mesh_tp_degree,
     paged_decode_attention_inflight,
-    paged_decode_attention_ragged,
-    scatter_kv_pages,
+    sharded_flash_attention,
+    sharded_flash_attention_chunked,
+    sharded_paged_decode_attention,
+    sharded_ragged_decode,
+    sharded_scatter_kv_pages,
 )
 from . import layers
 
@@ -400,11 +402,16 @@ def prefill(
     page_tables: jax.Array,  # [B, pages_per_seq]
     seq_lens: jax.Array,  # [B] true lengths
     cfg: LlamaConfig,
-    attn_impl: str = "flash",  # "xla": auto-partitionable (TP prefill)
+    attn_impl: str = "flash",  # "xla": the einsum reference path
     input_embeds: jax.Array | None = None,  # [B, P, D]: multimodal prefix
+    mesh=None,  # jax Mesh with a "tensor" axis: flash runs per head shard
 ):
     """Process prompts, filling the paged KV cache; returns (logits_last,
     k_pages, v_pages). Padded positions write to reserved trash page 0.
+
+    Under ``mesh=`` tensor parallelism the flash kernel runs inside
+    ``shard_map`` over the kv-head axis (ops.sharded) — TP prefill keeps
+    the Pallas fast path instead of downgrading to the XLA attention.
 
     ``input_embeds`` replaces the embedding lookup for the FIRST P
     positions — the multimodal path (models.vlm image tokens occupy
@@ -447,7 +454,7 @@ def prefill(
         q = layers.apply_rope(q, cos, sin)
         k = layers.apply_rope(k, cos, sin)
         if attn_impl == "flash":
-            o = flash_attention(q, k, v, True)
+            o = sharded_flash_attention(mesh, q, k, v, True)
         else:
             from ..ops import reference as _ref
 
@@ -498,13 +505,16 @@ def prefill_chunk(
     cfg: LlamaConfig,
     *,
     q_offset: int,  # global position of the chunk's first token (static)
-    attn_impl: str = "flash",  # "xla": auto-partitionable (TP prefill)
+    attn_impl: str = "flash",  # "xla": the einsum reference path
+    mesh=None,  # jax Mesh with a "tensor" axis: flash runs per head shard
 ):
     """One chunk of a long prompt: attends to the already-cached prefix (via
     page gather) + itself (rectangular flash kernel with q_offset), writes
     its K/V into the pages. Bounded VMEM for arbitrarily long prompts —
     the chunked-prefill half of the serving engine (vLLM chunked prefill
-    analog). Returns (last_logits [B, vocab], k_pages, v_pages)."""
+    analog). Under ``mesh=`` the chunked flash kernel runs per head shard
+    (ops.sharded), so TP chunked prefill stays on the fast path. Returns
+    (last_logits [B, vocab], k_pages, v_pages)."""
     B, C = tokens.shape
     page_size = k_pages.shape[2]
     positions = q_offset + jnp.broadcast_to(jnp.arange(C), (B, C))
@@ -556,9 +566,9 @@ def prefill_chunk(
         else:
             k_full, v_full = k, v
         if attn_impl == "flash":
-            from ..ops import flash_attention_chunked
-
-            o = flash_attention_chunked(q, k_full, v_full, q_offset=q_offset)
+            o = sharded_flash_attention_chunked(
+                mesh, q, k_full, v_full, q_offset=q_offset
+            )
         else:
             from ..ops import reference as _ref
 
@@ -588,6 +598,14 @@ def prefill_chunk(
 _impl_downgrades_warned: set = set()
 
 
+def tp_shard_ok(cfg: LlamaConfig, tp: int) -> bool:
+    """Whether this model's heads divide the tensor-parallel degree — the
+    ONE predicate behind every head-sharding legality decision
+    (``paged_impl_plan`` and the writeback dispatch share it, so the plan
+    and the runtime path cannot drift)."""
+    return cfg.n_kv_heads % tp == 0 and cfg.n_heads % tp == 0
+
+
 def paged_impl_plan(
     cfg: LlamaConfig,
     page_size: int,
@@ -595,6 +613,7 @@ def paged_impl_plan(
     scatter_impl: str = "xla",
     *,
     kv_dtype="bfloat16",
+    mesh=None,
     warn: bool = True,
 ) -> dict:
     """Resolve the decode structure that will ACTUALLY run for these shapes
@@ -606,31 +625,57 @@ def paged_impl_plan(
     ``kv_dtype`` ("int8" = the quantized QuantizedKV cache) affects the
     flat-variant Hkv legality (int8 page flattens need Hkv%32, not %16).
 
+    ``mesh`` (a jax Mesh with a "tensor" axis) makes the plan PER-SHARD
+    aware: under ``shard_map`` tensor parallelism the kernels see
+    ``Hkv // tp`` / ``Hq // tp`` heads, so flat-variant legality and GQA
+    grouping evaluate against the shard-local head counts — the plan
+    reports the variant each device actually runs, with ``"tp"`` carrying
+    the degree. Head counts not divisible by tp downgrade loudly to the
+    auto-partitioned XLA paths (the only genuinely illegal sharding).
+
     Returns ``{"attention": "ragged"|"xla-gather"|"writeback",
     "ragged_variant": "flat"|"grouped"|None, "scatter": "pallas"|"xla",
-    "kv_dtype": str, "downgraded": [...]}``.
+    "kv_dtype": str, "tp": int, "downgraded": [...]}``.
     """
     from ..ops.kv_quant import resolve_kv_dtype
 
     kvd = resolve_kv_dtype(kv_dtype)
     kvd_name = "int8" if kvd == "int8" else str(kvd)
     on_tpu = jax.default_backend() == "tpu"
+    tp = mesh_tp_degree(mesh)
+    shard_ok = tp_shard_ok(cfg, tp)
+    hkv_shard = cfg.n_kv_heads // tp if shard_ok else cfg.n_kv_heads
     downgraded = []
     ragged_variant = None
     if impl in ("xla-writeback", "pallas-writeback"):
         attention = "writeback"
+        if impl == "pallas-writeback" and not shard_ok:
+            downgraded.append(
+                f"pallas-writeback -> xla-writeback (n_kv_heads="
+                f"{cfg.n_kv_heads}/n_heads={cfg.n_heads} not divisible by "
+                f"tp={tp})"
+            )
     elif impl == "pallas":
         # legality predicates live with the kernels (ops.paged_attention)
         # so the plan and the wrappers cannot drift. Hkv no longer gates
         # the kernel (round 5): Hkv%16 shapes take the "flat" all-heads
         # formulation, others (GQA Hkv=8, the llama-3-era serving targets)
-        # the "grouped" per-kv-head one.
+        # the "grouped" per-kv-head one. Under TP the SHARD-local Hkv
+        # decides (round 7): the kernel inside shard_map sees Hkv // tp.
         from ..ops.paged_attention import ragged_shapes_ok, ragged_variant_for
 
-        ok = not on_tpu or ragged_shapes_ok(cfg.head_dim, page_size)
+        ok = (not on_tpu or ragged_shapes_ok(cfg.head_dim, page_size)) and (
+            shard_ok
+        )
         attention = "ragged" if ok else "xla-gather"
         if ok:
-            ragged_variant = ragged_variant_for(cfg.n_kv_heads, kvd_name)
+            ragged_variant = ragged_variant_for(hkv_shard, kvd_name)
+        elif not shard_ok:
+            downgraded.append(
+                f"paged_impl=pallas -> xla-gather (n_kv_heads="
+                f"{cfg.n_kv_heads}/n_heads={cfg.n_heads} not divisible by "
+                f"tp={tp}: head-sharded kernels need whole heads per shard)"
+            )
         else:
             downgraded.append(
                 f"paged_impl=pallas -> xla-gather (head_dim={cfg.head_dim}, "
@@ -642,8 +687,13 @@ def paged_impl_plan(
     if scatter_impl == "pallas":
         from ..ops.paged_attention import scatter_shapes_ok
 
-        if not on_tpu or scatter_shapes_ok(cfg.head_dim):
+        if (not on_tpu or scatter_shapes_ok(cfg.head_dim)) and shard_ok:
             scatter = "pallas"
+        elif not shard_ok:
+            downgraded.append(
+                f"scatter_impl=pallas -> xla (n_kv_heads={cfg.n_kv_heads} "
+                f"not divisible by tp={tp})"
+            )
         else:
             downgraded.append(
                 f"scatter_impl=pallas -> xla (head_dim={cfg.head_dim} "
@@ -660,7 +710,8 @@ def paged_impl_plan(
                 )
     return {
         "attention": attention, "ragged_variant": ragged_variant,
-        "scatter": scatter, "kv_dtype": kvd_name, "downgraded": downgraded,
+        "scatter": scatter, "kv_dtype": kvd_name, "tp": tp,
+        "downgraded": downgraded,
     }
 
 
@@ -676,6 +727,7 @@ def decode_step(
     impl: str = "xla",
     scatter_impl: str = "xla",
     ragged_variant: str | None = None,  # None: auto (flat | grouped by Hkv)
+    mesh=None,  # jax Mesh with a "tensor" axis: kernels run per head shard
 ):
     """One token of batched decode against the paged cache.
 
@@ -712,7 +764,7 @@ def decode_step(
     if impl in ("xla-writeback", "pallas-writeback"):
         return _decode_step_writeback(
             params, tokens, positions, k_pages, v_pages, page_tables, active,
-            cfg, impl=impl,
+            cfg, impl=impl, mesh=mesh,
         )
     B = tokens.shape[0]
     page_size = k_pages.shape[2]
@@ -720,9 +772,11 @@ def decode_step(
     # as the default path (in-flight token as an extra softmax column, one
     # scatter after the scan); shape legality + downgrade reporting live in
     # paged_impl_plan (single source of truth with the engine's stats).
+    # mesh= makes both per-shard aware: the pallas paths go through the
+    # ops.sharded shard_map dispatchers, so TP serving keeps the kernels.
     kv_dtype = "int8" if is_quantized(k_pages) else str(k_pages.dtype)
     plan = paged_impl_plan(
-        cfg, page_size, impl, scatter_impl, kv_dtype=kv_dtype
+        cfg, page_size, impl, scatter_impl, kv_dtype=kv_dtype, mesh=mesh
     )
     use_ragged = plan["attention"] == "ragged"
     x = params["embed"][tokens]  # [B, D]
@@ -756,10 +810,13 @@ def decode_step(
         if use_ragged:
             # kernel reads exactly ceil(prefix/ps) pages straight from the
             # full [L, P, ...] cache (layer via scalar prefetch — no slice
-            # copy, no gather materialization)
-            o = paged_decode_attention_ragged(
-                q[:, :, 0], k_pages, v_pages, li, page_tables, prefix_lens,
-                k_tok, v_tok, variant=ragged_variant,
+            # copy, no gather materialization). Under mesh= TP the dispatch
+            # shard_maps over the kv-head axis: each device's kernel reads
+            # only its local head shard of the cache (auto-variant inside
+            # the shard resolves against the LOCAL Hkv — what plan reports)
+            o = sharded_ragged_decode(
+                mesh, q[:, :, 0], k_pages, v_pages, li, page_tables,
+                prefix_lens, k_tok, v_tok, variant=ragged_variant,
             )  # [B, H, D]
         else:
             # one gather from the full [L, P, ...] arrays (layer scalar +
@@ -792,8 +849,8 @@ def decode_step(
     # Independent of the attention impl — both structures end in the same
     # post-scan scatter; only the (Hkv, D) minor-dim tile legality gates it.
     if plan["scatter"] == "pallas":
-        k_pages, v_pages = scatter_kv_pages(
-            k_pages, v_pages, k_all, v_all, page_idx, slot
+        k_pages, v_pages = sharded_scatter_kv_pages(
+            mesh, k_pages, v_pages, k_all, v_all, page_idx, slot
         )
     else:
         # XLA scatter: adjacent advanced indices (dims 1, 2) keep their
@@ -811,7 +868,7 @@ def decode_step(
 
 def _decode_step_writeback(
     params, tokens, positions, k_pages, v_pages, page_tables, active, cfg,
-    impl: str = "xla-writeback",
+    impl: str = "xla-writeback", mesh=None,
 ):
     """Write-then-attend decode (Pallas paged kernel path): each layer lands
     its KV in the pages before calling the kernel, which reads the current
@@ -819,6 +876,12 @@ def _decode_step_writeback(
     avoids threading the caches through the scan."""
     B = tokens.shape[0]
     page_size = k_pages.shape[2]
+    # the plan's downgrade contract via the SHARED predicate: heads not
+    # divisible by tp fall back to the auto-partitioned xla-writeback
+    # (exactly what paged_impl_plan reports), never a trace error
+    pallas_wb = impl == "pallas-writeback" and tp_shard_ok(
+        cfg, mesh_tp_degree(mesh)
+    )
     x = params["embed"][tokens]  # [B, D]
     cos, sin = layers.rotary_embedding(
         positions[:, None], cfg.head_dim, cfg.rope_theta, dtype=jnp.float32,
@@ -852,9 +915,12 @@ def _decode_step_writeback(
                           leading_layer=False)
         v_pg = kv_scatter(v_pg, v[:, :, 0], page_idx, slot,
                           leading_layer=False)
-        o = paged_decode_attention(
+        # xla-writeback stays auto-partitioned (the gather needs no manual
+        # sharding); pallas-writeback goes through the shard_map dispatch
+        o = sharded_paged_decode_attention(
+            mesh if pallas_wb else None,
             q[:, :, 0], k_pg, v_pg, page_tables, ctx_lens,
-            impl="pallas" if impl == "pallas-writeback" else "xla",
+            impl="pallas" if pallas_wb else "xla",
         )  # [B, H, D]
         o = o.reshape(B, cfg.n_heads * D)
         x = x + layers.mm(o, layer["wo"]).astype(x.dtype)

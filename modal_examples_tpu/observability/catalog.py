@@ -382,8 +382,13 @@ CATALOG: dict[str, dict] = {
         "help": "free pages in the paged KV cache",
     },
     DECODE_IMPL: {
-        "type": "gauge", "labels": ["attention", "scatter", "kv_dtype"],
-        "help": "resolved decode implementation plan (info metric, value 1)",
+        "type": "gauge",
+        "labels": ["attention", "scatter", "kv_dtype", "tp", "variant"],
+        "help": (
+            "resolved decode implementation plan (info metric, value 1); "
+            "tp = tensor-parallel degree, variant = the PER-SHARD ragged "
+            "kernel formulation actually run"
+        ),
     },
     SPEC_PROPOSED_TOTAL: {
         "type": "counter", "labels": [],

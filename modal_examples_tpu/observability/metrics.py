@@ -131,6 +131,25 @@ def set_engine_gauges(
     )
 
 
+def set_decode_impl(plan: dict, *, registry: Registry | None = None) -> None:
+    """Info gauge for the engine's resolved decode plan: the attention /
+    scatter impls, cache dtype, tensor-parallel degree, and the PER-SHARD
+    ragged variant (``paged_impl_plan(mesh=...)``) — so dashboards and
+    benches report the sharded plan actually run, not the requested one."""
+    _reg(registry).gauge_set(
+        C.DECODE_IMPL,
+        1.0,
+        labels={
+            "attention": str(plan["attention"]),
+            "scatter": str(plan["scatter"]),
+            "kv_dtype": str(plan["kv_dtype"]),
+            "tp": str(plan.get("tp", 1)),
+            "variant": str(plan.get("ragged_variant") or "-"),
+        },
+        help=C.CATALOG[C.DECODE_IMPL]["help"],
+    )
+
+
 def record_scheduler_error(*, registry: Registry | None = None) -> None:
     _reg(registry).counter_inc(
         C.SCHEDULER_ERRORS_TOTAL,

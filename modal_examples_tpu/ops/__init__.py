@@ -27,6 +27,15 @@ from .paged_attention import (
     scatter_kv_pages,
 )
 from .quantized_matmul import dequantize_int8, quantize_int8, quantized_matmul
+from .sharded import (
+    mesh_tp_degree,
+    shard_cache_pages,
+    sharded_flash_attention,
+    sharded_flash_attention_chunked,
+    sharded_paged_decode_attention,
+    sharded_ragged_decode,
+    sharded_scatter_kv_pages,
+)
 from .ring_attention import (
     ring_attention,
     ring_attention_sharded,
@@ -49,7 +58,14 @@ __all__ = [
     "kv_empty",
     "kv_gather",
     "kv_scatter",
+    "mesh_tp_degree",
     "scatter_kv_pages",
+    "shard_cache_pages",
+    "sharded_flash_attention",
+    "sharded_flash_attention_chunked",
+    "sharded_paged_decode_attention",
+    "sharded_ragged_decode",
+    "sharded_scatter_kv_pages",
     "quantize_int8",
     "quantize_kv",
     "quantized_matmul",

@@ -189,6 +189,9 @@ def kv_scatter(pages, update, page_idx, slot, *, leading_layer: bool = True):
             data=pages.data.at[page_idx, slot].set(q.data),
             scale=pages.scale.at[page_idx, slot].set(q.scale),
         )
+    # cast to the page dtype explicitly (no-op when they already match):
+    # jax deprecates implicit down-cast in scatter, and a f32-model +
+    # bf16-cache engine would otherwise warn (then error) on every write
     if leading_layer:
-        return pages.at[:, page_idx, slot].set(update)
-    return pages.at[page_idx, slot].set(update)
+        return pages.at[:, page_idx, slot].set(update.astype(pages.dtype))
+    return pages.at[page_idx, slot].set(update.astype(pages.dtype))

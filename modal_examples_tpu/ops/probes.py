@@ -34,6 +34,11 @@ KERNEL_PROBES: dict[str, str] = {
         "modal_examples_tpu.ops.probes:probe_ragged_decode_int8kv",
     "ragged_decode_gqa_int8kv":
         "modal_examples_tpu.ops.probes:probe_ragged_decode_gqa_int8kv",
+    # the TP=2 shard of the 7B int8 head geometry (Hq=Hkv=16, G=1): what
+    # each device compiles inside the shard_map dispatch (ops.sharded) —
+    # int8 flat needs Hkv%32, so the 16-head shard runs grouped
+    "ragged_decode_tp_shard_int8kv":
+        "modal_examples_tpu.ops.probes:probe_ragged_decode_tp_shard_int8kv",
     "scatter_kv": "modal_examples_tpu.ops.probes:probe_scatter_kv",
     "scatter_kv_int8": "modal_examples_tpu.ops.probes:probe_scatter_kv_int8",
 }
@@ -47,8 +52,8 @@ PROBED_MODULES: dict[str, list[str]] = {
     ],
     "modal_examples_tpu.ops.paged_attention": [
         "paged_decode", "ragged_decode", "ragged_decode_gqa",
-        "ragged_decode_int8kv", "ragged_decode_gqa_int8kv", "scatter_kv",
-        "scatter_kv_int8",
+        "ragged_decode_int8kv", "ragged_decode_gqa_int8kv",
+        "ragged_decode_tp_shard_int8kv", "scatter_kv", "scatter_kv_int8",
     ],
     "modal_examples_tpu.ops.quantized_matmul": ["int8_matmul"],
 }
@@ -297,6 +302,15 @@ def probe_ragged_decode_gqa_int8kv() -> dict:
     """int8-KV grouped variant at the GQA shape (Hkv=8, G=4): per-head
     strided int8 slices + their (chunk, ps) scale slices."""
     return _int8kv_ragged_probe(Hq=32, Hkv=8, variant="grouped")
+
+
+def probe_ragged_decode_tp_shard_int8kv() -> dict:
+    """int8-KV grouped variant at the TP=2 shard of the 7B head geometry
+    (Hq=Hkv=16, G=1): the per-device compile shape of the shard_map'd
+    decode under tensor parallelism (ops.sharded, round 7). MHA-as-grouped
+    is a distinct Mosaic shape family — 16 single-row head matmuls — so
+    its first compile goes through the harness like every other."""
+    return _int8kv_ragged_probe(Hq=16, Hkv=16, variant="grouped")
 
 
 def probe_scatter_kv_int8() -> dict:

@@ -82,9 +82,10 @@ def attention_chunked(
     q_offset: int,
     sm_scale: float | None = None,
 ) -> jax.Array:
-    """Rectangular causal attention (XLA ground truth / auto-partitionable
-    alternative for ops.flash_attention_chunked — tensor-parallel prefill
-    uses this path since a pallas_call cannot be auto-partitioned)."""
+    """Rectangular causal attention: the XLA ground truth for
+    ops.flash_attention_chunked (TP prefill now keeps the flash kernel via
+    ops.sharded's shard_map dispatch; this reference stays the
+    auto-partitionable fallback and the exactness oracle)."""
     B, Hq, Sq, D = q.shape
     Hkv, Skv = k.shape[1], k.shape[2]
     if sm_scale is None:

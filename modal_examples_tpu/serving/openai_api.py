@@ -210,12 +210,18 @@ class _Handler(BaseHTTPRequestHandler):
                 for name, value in hand_built
                 if name not in reg_names
             ]
-            lines.append(
-                f'{_C.DECODE_IMPL}{{attention="'
-                f'{eng.impl_plan["attention"]}",scatter='
-                f'"{eng.impl_plan["scatter"]}",kv_dtype='
-                f'"{eng.impl_plan["kv_dtype"]}"}} 1'
-            )
+            if _C.DECODE_IMPL not in reg_names:
+                # the engine normally owns this gauge in the registry (with
+                # tp + per-shard variant labels); hand-build only when this
+                # process' registry never saw an engine init
+                lines.append(
+                    f'{_C.DECODE_IMPL}{{attention="'
+                    f'{eng.impl_plan["attention"]}",scatter='
+                    f'"{eng.impl_plan["scatter"]}",kv_dtype='
+                    f'"{eng.impl_plan["kv_dtype"]}",tp='
+                    f'"{eng.impl_plan.get("tp", 1)}",variant='
+                    f'"{eng.impl_plan.get("ragged_variant") or "-"}"}} 1'
+                )
             body = ("\n".join(lines) + "\n" + reg_text).encode()
             self.send_response(200)
             self.send_header("content-type", "text/plain; version=0.0.4")

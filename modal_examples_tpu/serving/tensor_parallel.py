@@ -11,8 +11,10 @@ per-rank code.
 The dense cache ([L, B, Hkv, S, D], in-place dynamic-update-slice writes)
 is the multi-chip counterpart of the single-chip paged cache: kv-head
 sharding keeps every cache byte and its attention math on the chip that owns
-the head. (Paged attention stays the single-chip fast path; a TP paged
-kernel via shard_map is a later-round item.)
+the head. (Since round 7 the PAGED engine also keeps its Pallas kernels
+under TP — shard_map'd over the same kv-head axis via ops.sharded, see
+docs/tensor_parallel.md; this dense path remains the simple, fully
+auto-partitioned alternative.)
 
 ``kv_dtype="int8"`` stores the dense cache quantized, exactly like the
 paged cache: int8 ``[L, B, Hkv, S, D]`` data plus per-token-head f32

@@ -103,6 +103,71 @@ def test_bench_disagg_config_emits_disagg_section():
 
 
 @pytest.mark.slow
+def test_bench_tp_config_emits_sharded_plan():
+    """The TP=2 config must ride the same schema plus the resolved
+    per-shard plan: ``tp`` at the top level and ``impl_plan`` reporting the
+    variant each device actually runs (paged_impl_plan(mesh=...)) — the
+    CPU path-proof of llama2-7b-tp2-int8-ctx1024's code shape."""
+    out = subprocess.run(
+        [sys.executable, str(REPO / "bench.py")],
+        capture_output=True,
+        text=True,
+        timeout=500,
+        env={
+            **os.environ,
+            "BENCH_CPU": "1",
+            "BENCH_MODEL": "tiny-tp2",
+            "BENCH_NO_SECONDARY": "1",
+        },
+        cwd=str(REPO),
+    )
+    assert out.returncode == 0, out.stderr[-500:]
+    lines = [l for l in out.stdout.strip().splitlines() if l.strip()]
+    assert len(lines) == 1, lines
+    payload = json.loads(lines[0])
+    assert payload["value"] > 0 and payload["unit"] == "tok/s"
+    assert payload["tp"] == 2
+    plan = payload.get("impl_plan")
+    assert plan, payload
+    assert plan["tp"] == 2
+    # tiny (Hkv=2) shards to 1 head/device: the grouped formulation
+    assert plan["attention"] == "ragged"
+    assert plan["ragged_variant"] == "grouped"
+    assert payload["engine_errors"] == 0
+
+
+@pytest.mark.slow
+def test_bench_spec_config_emits_spec_section():
+    """The speculative configs must carry the acceptance-rate -> tok/s
+    story: a ``spec`` section with mode/gamma/acceptance alongside the
+    throughput number (ROADMAP open item #4's measurability half)."""
+    out = subprocess.run(
+        [sys.executable, str(REPO / "bench.py")],
+        capture_output=True,
+        text=True,
+        timeout=500,
+        env={
+            **os.environ,
+            "BENCH_CPU": "1",
+            "BENCH_MODEL": "tiny-spec-ngram",
+            "BENCH_NO_SECONDARY": "1",
+        },
+        cwd=str(REPO),
+    )
+    assert out.returncode == 0, out.stderr[-500:]
+    lines = [l for l in out.stdout.strip().splitlines() if l.strip()]
+    assert len(lines) == 1, lines
+    payload = json.loads(lines[0])
+    assert payload["value"] > 0 and payload["unit"] == "tok/s"
+    spec = payload.get("spec")
+    assert spec, payload
+    assert spec["mode"] == "ngram" and spec["gamma"] == 2
+    assert spec["proposed"] >= 0 and spec["accepted"] >= 0
+    assert 0.0 <= spec["acceptance_rate"] <= 1.0
+    assert payload["engine_errors"] == 0
+
+
+@pytest.mark.slow
 def test_image_child_emits_schema_json():
     """The images/sec secondary metric (BASELINE.json: 'SDXL images/sec'):
     the txt2img pipeline child must print one JSON line; the tiny CPU

@@ -53,9 +53,8 @@ class TestCollectives:
     def test_psum_and_axis_index(self, jax):
         import jax.numpy as jnp
         from jax.sharding import PartitionSpec as P
-        from jax import shard_map
-
         from modal_examples_tpu.parallel import collectives as col, make_mesh
+        from modal_examples_tpu.parallel.mesh import shard_map_compat
 
         mesh = make_mesh({"data": 8})
 
@@ -64,7 +63,7 @@ class TestCollectives:
             total = col.psum(x, "data")
             return total + 0 * r
 
-        out = shard_map(
+        out = shard_map_compat(
             f, mesh=mesh, in_specs=P("data"), out_specs=P("data")
         )(jnp.ones((8, 4)))
         np.testing.assert_allclose(np.asarray(out), 8.0)
@@ -72,13 +71,12 @@ class TestCollectives:
     def test_ring_shift(self, jax):
         import jax.numpy as jnp
         from jax.sharding import PartitionSpec as P
-        from jax import shard_map
-
         from modal_examples_tpu.parallel import collectives as col, make_mesh
+        from modal_examples_tpu.parallel.mesh import shard_map_compat
 
         mesh = make_mesh({"data": 8})
         x = jnp.arange(8.0).reshape(8, 1)
-        out = shard_map(
+        out = shard_map_compat(
             lambda s: col.ring_shift(s, "data", 1),
             mesh=mesh,
             in_specs=P("data"),
@@ -92,14 +90,13 @@ class TestCollectives:
     def test_all_gather_and_reduce_scatter(self, jax):
         import jax.numpy as jnp
         from jax.sharding import PartitionSpec as P
-        from jax import shard_map
-
         from modal_examples_tpu.parallel import collectives as col, make_mesh
+        from modal_examples_tpu.parallel.mesh import shard_map_compat
 
         mesh = make_mesh({"data": 8})
         x = jnp.arange(16.0).reshape(8, 2)
 
-        gathered = shard_map(
+        gathered = shard_map_compat(
             lambda s: col.all_gather(s, "data"),
             mesh=mesh,
             in_specs=P("data"),
@@ -108,7 +105,7 @@ class TestCollectives:
         )(x)
         np.testing.assert_allclose(np.asarray(gathered), np.asarray(x))
 
-        scattered = shard_map(
+        scattered = shard_map_compat(
             lambda s: col.reduce_scatter(s, "data"),
             mesh=mesh,
             in_specs=P(None),

