@@ -137,6 +137,15 @@ CONFIGS = {
     "tiny-spec-ngram": dict(
         slots=4, max_len=128, max_tokens=16, timeout=420, spec=("ngram", 2),
     ),
+    # CPU path-proof of the chaos harness (test_bench_contract): after the
+    # measured run, the seeded fault-injection episode schedule drives a
+    # fresh tiny fleet through every cataloged fault point and the json
+    # carries a `faults` section {injected, recovered, wedged: 0}
+    # (docs/faults.md) — proving the failure contract alongside the
+    # throughput number
+    "tiny-chaos": dict(
+        slots=4, max_len=128, max_tokens=16, timeout=420, chaos=True
+    ),
 }
 
 
@@ -407,6 +416,27 @@ def _child(model: str) -> None:
             if total_hits
             else {},
         }
+    # chaos path-proof (docs/faults.md): for chaos configs the seeded
+    # episode schedule runs a fresh tiny fleet through every cataloged
+    # fault point AFTER the measured traffic (the measured number stays
+    # fault-free); the report rides in the json so a failure-handling
+    # regression breaks the bench contract, not just the test suite
+    faults_info = None
+    if spec.get("chaos"):
+        from modal_examples_tpu.faults.chaos import run_chaos
+
+        chaos_report = run_chaos(seed=0, strict=False)
+        faults_info = {
+            "injected": int(chaos_report["injected_total"]),
+            "per_point": chaos_report["injected"],
+            "recovered": int(chaos_report["recovered"]),
+            "wedged": int(chaos_report["wedged"]),
+            "points_missed": chaos_report["points_missed"],
+            "episodes": len(chaos_report["episodes"]),
+            "invariants": (
+                "ok" if chaos_report["invariants"] == "ok" else "violated"
+            ),
+        }
     print(
         json.dumps(
             {
@@ -441,6 +471,7 @@ def _child(model: str) -> None:
                 "tokens_per_second": round(tok_s, 2),
                 **({"spec": spec_info} if spec_info else {}),
                 **({"disagg": disagg_info} if disagg_info else {}),
+                **({"faults": faults_info} if faults_info else {}),
             }
         )
     )

@@ -20,6 +20,7 @@ Usage:
     tpurun sched [--watch S]           # live class queues, shed rates, router
     tpurun top [--watch S]             # live serving summary + SLO burn rates
     tpurun disagg [--watch S]          # replica roles, migrations, KV tiers
+    tpurun chaos [--last N]            # fault-injection episodes + invariants
 """
 
 from __future__ import annotations
@@ -814,6 +815,83 @@ def cmd_disagg(argv: list[str]) -> int:
     return 0
 
 
+def cmd_chaos(argv: list[str]) -> int:
+    """Chaos-harness view: the last fault-injection episodes — faults
+    injected per catalog point, recoveries, and invariant results — from
+    the chaos journal (``<state_dir>/chaos.jsonl``) plus pushed metrics
+    (the fault-injection companion of ``tpurun disagg``; docs/faults.md).
+
+    ``--last N`` shows the newest N episodes (default 10); ``--dir PATH``
+    overrides the state dir root.
+    """
+    from pathlib import Path
+
+    from ..observability import catalog as C
+    from ..observability.export import pushed_jobs
+    from ..observability.journal import DecisionJournal
+    from ..utils.prometheus import merge_expositions, parse_exposition
+
+    usage = "usage: tpurun chaos [--last N] [--dir PATH]"
+    argv, root = _pop_dir_flag(argv, usage)
+    argv, last_s = _pop_flag(argv, "--last", usage)
+    last = int(last_s) if last_s is not None else 10
+
+    state_root = Path(root) if root else _config.state_dir()
+    episodes = DecisionJournal(state_root / "chaos.jsonl").tail(last)
+
+    # per-point injected totals: pushed metrics when available (the chaos
+    # runner pushes job "chaos"), else aggregated from the journal records
+    jobs = pushed_jobs(Path(root) / "metrics" if root else None)
+    injected: dict[str, float] = {}
+    if jobs:
+        merged = parse_exposition(merge_expositions(jobs))
+        for lbls, v in merged.series(C.FAULTS_INJECTED_TOTAL):
+            injected[lbls.get("point", "?")] = v
+        readmissions = merged.total(C.ROUTER_READMISSIONS_TOTAL)
+    else:
+        readmissions = 0.0
+    if not injected:
+        for ep in episodes:
+            for point, n in (ep.get("injected") or {}).items():
+                injected[point] = injected.get(point, 0) + n
+
+    if not episodes and not injected:
+        print(
+            "no chaos episodes recorded yet "
+            "(run `python -m pytest tests/test_chaos.py` or the "
+            "tiny-chaos bench config first)"
+        )
+        return 0
+    if injected:
+        print(f"{'FAULT POINT':<28} {'INJECTED':>9}")
+        for point in sorted(injected):
+            print(f"{point:<28} {int(injected[point]):>9}")
+        print(f"{'total':<28} {int(sum(injected.values())):>9}")
+    if readmissions:
+        print(f"router re-admissions: {int(readmissions)}")
+    if episodes:
+        print()
+        print(
+            f"{'EPISODE':<20} {'INJ':>4} {'FINISHED':<24} {'SHED':>4} "
+            f"{'WEDGED':>6} INVARIANTS"
+        )
+        for ep in episodes:
+            finished = " ".join(
+                f"{k}={v}" for k, v in sorted(
+                    (ep.get("finished") or {}).items()
+                )
+            )
+            inv = ep.get("invariants")
+            print(
+                f"{ep.get('episode', '?'):<20} "
+                f"{sum((ep.get('injected') or {}).values()):>4} "
+                f"{finished:<24} {ep.get('shed', 0):>4} "
+                f"{ep.get('wedged', 0):>6} "
+                f"{'ok' if inv == 'ok' else f'VIOLATED: {inv}'}"
+            )
+    return 0
+
+
 def cmd_app(argv: list[str]) -> int:
     if argv and argv[0] == "list":
         reg = _config.state_dir() / "apps.json"
@@ -839,6 +917,7 @@ COMMANDS = {
     "scaler": cmd_scaler,
     "sched": cmd_sched,
     "disagg": cmd_disagg,
+    "chaos": cmd_chaos,
     "top": cmd_top,
     "examples": cmd_examples,
     "docs": cmd_docs,

@@ -47,6 +47,7 @@ from multiprocessing.connection import Client, Listener
 from pathlib import Path
 
 from .._internal import config as _config
+from ..faults import inject as _inject
 from ..observability import journal as _journal
 from ..observability import metrics as _obs
 from ..observability import trace as _tr
@@ -948,7 +949,11 @@ class FunctionPool:
         retries = qi.call.retries
         qi.call.attempt += 1
         if retries is not None and qi.call.attempt <= retries.max_retries:
-            delay = retries.delay_for_attempt(qi.call.attempt)
+            # jittered per input id: replicas that failed together must not
+            # retry together (thundering herd — docs/faults.md)
+            delay = retries.delay_for_attempt(
+                qi.call.attempt, key=qi.call.input_id
+            )
             _obs.record_retry(self.spec.tag, reason)
             self._trace_requeue(qi, reason, delay, charged=True)
             qi.started_at = None
@@ -1171,6 +1176,30 @@ class FunctionPool:
         for method_name, group in batch_groups.items():
             self._dispatch_batched(group, now, self.spec.batched_for(method_name))
         for i, qi in enumerate(ready):
+            # fault points (docs/faults.md): a container dying mid-input or
+            # an input blowing its timeout, routed through the SAME retry
+            # path real failures take — handle_failure requeues with
+            # jittered backoff or surfaces the exception
+            if _inject.fire("executor.container_death"):
+                self.handle_failure(
+                    qi,
+                    RuntimeError(
+                        f"injected: container for {self.spec.tag} died "
+                        "while processing input"
+                    ),
+                    reason="container_death",
+                )
+                continue
+            if _inject.fire("executor.timeout"):
+                self.handle_failure(
+                    qi,
+                    FunctionTimeoutError(
+                        f"injected: {self.spec.tag} input exceeded its "
+                        "timeout"
+                    ),
+                    reason="timeout",
+                )
+                continue
             target = next((c for c in self.containers if c.capacity() > 0), None)
             if target is None:
                 with self.lock:
@@ -1391,7 +1420,9 @@ class ClusterPool:
                     # run; a retry would duplicate already-delivered items
                     and not self.spec.is_generator
                 ):
-                    time.sleep(r.delay_for_attempt(call.attempt))
+                    time.sleep(
+                        r.delay_for_attempt(call.attempt, key=call.input_id)
+                    )
                     continue
                 call.set_exception(e)
                 return
@@ -1650,7 +1681,14 @@ class InlinePool:
                     r = self.spec.retries
                     if r is not None and attempt <= r.max_retries:
                         _obs.record_retry(self.spec.tag, "user_error")
-                        time.sleep(min(r.delay_for_attempt(attempt), 0.1))
+                        time.sleep(
+                            min(
+                                r.delay_for_attempt(
+                                    attempt, key=call.input_id
+                                ),
+                                0.1,
+                            )
+                        )
                         continue
                     exc, _tb = ser.deserialize_exception(ser.serialize_exception(e))
                     call.set_exception(exc)

@@ -1,0 +1,30 @@
+"""Fault injection & chaos testing (docs/faults.md).
+
+Two halves with a hard layering rule between them:
+
+- :mod:`.inject` — the deterministic fault-point catalog, seeded
+  :class:`~.inject.FaultPlan`, and the zero-cost activation gate.
+  Production code wires its injection points through this module.
+- :mod:`.chaos` — the seeded chaos runner that drives a multi-replica
+  fleet through fault episodes and asserts fleet invariants. It is a
+  DRIVER: tests, ``bench.py``, and operators import it; production modules
+  never do (enforced by ``tests/test_static.py``).
+
+Only the inject surface is re-exported here, so ``from
+modal_examples_tpu.faults import fire`` can never drag the chaos driver
+(and its serving imports) into a production module.
+"""
+
+from .inject import (  # noqa: F401
+    ALL_FAULT_POINTS,
+    POINTS,
+    FaultError,
+    FaultPlan,
+    activate,
+    active,
+    active_plan,
+    check,
+    corrupt,
+    deactivate,
+    fire,
+)

@@ -1,0 +1,508 @@
+"""Seeded chaos runner: drive a mixed-class replica fleet through fault
+episodes and assert fleet invariants after every one.
+
+The failure matrix in docs/disagg.md used to be prose plus hand-written
+unit cases; this module makes it an *enforced contract* the same way PR 2's
+metric catalog made observability structural. :func:`run_chaos` builds a
+real fleet — a unified replica plus a disaggregated prefill/decode pair
+(CPU-sized models) fronted by a :class:`~..serving.disagg.DisaggCoordinator`
+— and runs a fixed schedule of **episodes**: each arms one small seeded
+:class:`~.inject.FaultPlan`, drives traffic through the coordinator, and
+then checks the **fleet invariants**:
+
+- **terminal** — every submitted request reached a terminal
+  ``finish_reason`` within a timeout: no wedged streams, ever.
+- **drained** — on every replica, queues are empty, all slots are free,
+  admission page reservations are back to zero, and every allocated KV page
+  is accounted for by the prefix cache (nothing orphaned).
+- **conservation** — ``submitted == finished + shed`` (aborted and
+  deadline-expired requests still *finish*, with their honest reason).
+- **router recovered** — no replica is stuck on the down list and a fresh
+  placement succeeds.
+- **token identity** — any request that finished normally
+  (``stop``/``length``) produced output identical to a fault-free
+  reference run; faults may kill requests, never corrupt survivors.
+
+Episode results append to ``<state_dir>/chaos.jsonl`` (the autoscaler-
+journal pattern) and the registry is pushed as job ``chaos``, so ``tpurun
+chaos`` and the gateway's ``/chaos`` can answer "what did the last episode
+inject and did the fleet hold?" after the fact. Reproduction is
+``(seed, episode schedule)``: the schedule is fixed, so one seed replays
+one chaos run.
+
+LAYERING: this module is a DRIVER. Tests, ``bench.py``, and operators
+import it; production modules never do (``tests/test_static.py`` enforces
+it — production code may import :mod:`.inject` only).
+"""
+
+from __future__ import annotations
+
+import queue as _queue
+import time
+
+from .inject import ALL_FAULT_POINTS, FaultPlan, active
+
+#: per-request drain timeout: generous for CPU-compile stalls, small enough
+#: that a genuinely wedged stream fails the run, not the CI timeout
+DRAIN_TIMEOUT_S = 120.0
+
+#: the chaos traffic prompt palette: shared prefixes (affinity + tiered
+#: promotion) with distinct tails (distinct requests)
+_BASE = "the quick brown fox jumps over the lazy dog "
+_PROMPTS = [
+    _BASE + "and then some more",
+    _BASE + "and naps in the sun",
+    _BASE + "and then some more",  # repeat: prefix-cache / tier hit
+    "completely different prompt about thundering herds",
+]
+
+
+class ChaosInvariantError(AssertionError):
+    """A fleet invariant failed after an episode (the report carries the
+    violations; the episode name says which plan was armed)."""
+
+
+# -- invariant checkers -------------------------------------------------------
+#
+# Standalone, side-effect-free, and duck-typed so tests can hand them
+# violating states directly (tests/test_faults.py).
+
+
+def check_terminal(results: list) -> list[str]:
+    """Every result must carry a terminal finish_reason (no wedges)."""
+    out = []
+    for r in results:
+        if r.get("wedged"):
+            out.append(f"request {r.get('id')} wedged (no terminal marker)")
+        elif not r.get("finish_reason"):
+            out.append(f"request {r.get('id')} has no finish_reason")
+    return out
+
+
+def check_conservation(submitted: int, finished: int, shed: int) -> list[str]:
+    """``submitted == finished + shed``: every request either terminated a
+    stream or was honestly rejected at admission — nothing vanished."""
+    if submitted != finished + shed:
+        return [
+            f"conservation violated: submitted={submitted} != "
+            f"finished={finished} + shed={shed}"
+        ]
+    return []
+
+
+def check_drained(engines: dict) -> list[str]:
+    """Queues empty, slots free, reservations zero, and every allocated KV
+    page accounted for by the prefix cache (non-destructive: cached
+    zero-ref pages are warmth, not leaks)."""
+    out = []
+    for name, eng in engines.items():
+        depth = eng.policy.total_depth()
+        if depth:
+            out.append(f"{name}: {depth} requests still queued")
+        busy = sum(1 for s in eng.slots if not s.free)
+        if busy:
+            out.append(f"{name}: {busy} slots still occupied")
+        reserved = eng.admission.reserved_pages
+        if reserved:
+            out.append(f"{name}: {reserved} KV pages still reserved")
+        used = (eng.cache.n_pages - 1) - eng.cache.allocator.available
+        cached = (
+            eng.prefix_cache.cached_pages
+            if eng.prefix_cache is not None
+            else 0
+        )
+        if used != cached:
+            out.append(
+                f"{name}: {used} pages allocated but only {cached} "
+                "prefix-cached — orphaned pages"
+            )
+    return out
+
+
+def check_router_recovered(router) -> list[str]:
+    """No replica stuck on the down list, and every replica healthy."""
+    out = []
+    stats = router.stats()
+    for name, info in stats["replicas"].items():
+        if info.get("down"):
+            out.append(f"replica {name} still marked down")
+        if not info.get("healthy"):
+            out.append(f"replica {name} still unhealthy")
+    return out
+
+
+def check_token_identity(results: list, reference: dict) -> list[str]:
+    """Requests that finished normally must match the fault-free reference
+    byte for byte — faults may kill requests, never corrupt survivors."""
+    out = []
+    for r in results:
+        if r.get("finish_reason") in ("stop", "length") and not r.get("aborted"):
+            ref = reference.get(r["prompt"])
+            if ref is not None and r["output"] != ref:
+                out.append(
+                    f"request {r.get('id')} diverged from the fault-free "
+                    f"run: {r['output']!r} != {ref!r}"
+                )
+    return out
+
+
+# -- the runner ---------------------------------------------------------------
+
+
+def _drain(req, timeout: float = DRAIN_TIMEOUT_S) -> dict:
+    """Collect one request's stream with a wedge watchdog (the engine's
+    ``stream()`` would block forever on a wedged queue — detecting exactly
+    that is this harness's job)."""
+    from ..serving.engine import _Finish
+
+    out: list[str] = []
+    deadline = time.monotonic() + timeout
+    while True:
+        remaining = deadline - time.monotonic()
+        if remaining <= 0:
+            return {
+                "id": req.request_id,
+                "prompt": req.prompt,
+                "output": "".join(out),
+                "finish_reason": None,
+                "wedged": True,
+            }
+        try:
+            item = req.out_queue.get(timeout=min(remaining, 1.0))
+        except _queue.Empty:
+            continue
+        if isinstance(item, _Finish):
+            req.finish_reason = item.reason
+            return {
+                "id": req.request_id,
+                "prompt": req.prompt,
+                "output": "".join(out),
+                "finish_reason": item.reason,
+                "wedged": False,
+            }
+        out.append(item)
+
+
+class _Fleet:
+    """One unified + one disagg prefill/decode pair behind a coordinator,
+    plus a fault-free reference engine — all tiny, all greedy."""
+
+    def __init__(self, seed: int):
+        from ..models import llama
+        from ..scheduling import EngineReplica
+        from ..serving import LLMEngine, SamplingParams
+        from ..serving.disagg import DisaggCoordinator
+        from ..storage.volume import Volume
+
+        self.seed = seed
+        self.params = SamplingParams(max_tokens=8, temperature=0.0)
+        cfg = llama.LlamaConfig.tiny()
+
+        def engine(**kw):
+            kw.setdefault("max_slots", 2)
+            kw.setdefault("max_model_len", 64)
+            kw.setdefault("page_size", 8)
+            kw.setdefault("prefill_buckets", (32,))
+            return LLMEngine(cfg, seed=0, **kw)
+
+        # fault-free reference outputs first (greedy: deterministic per
+        # prompt, independent of which replica serves it)
+        ref_engine = engine()
+        try:
+            self.reference = {
+                p: ref_engine.generate(p, self.params)
+                for p in set(_PROMPTS)
+            }
+        finally:
+            ref_engine.stop()
+
+        self.volume_cm = Volume.ephemeral()
+        vol = self.volume_cm.__enter__()
+        self.pre = engine(
+            tiered_prefix={"host_bytes": 1 << 20, "volume": vol}
+        )
+        self.dec = engine()
+        self.uni = engine()
+        self.engines = {"pre-0": self.pre, "dec-0": self.dec,
+                        "uni-0": self.uni}
+        self.coord = DisaggCoordinator(
+            [
+                EngineReplica(self.pre, "pre-0", role="prefill"),
+                EngineReplica(self.dec, "dec-0", role="decode"),
+                EngineReplica(self.uni, "uni-0", role="unified"),
+            ],
+            chunk_bytes=256,
+            reprobe_s=0.2,
+        )
+        # decode-capable loops run for the whole chaos run (the prefill
+        # replica's engine must never start — docs/disagg.md)
+        for eng in self.coord.serving_engines():
+            eng.start()
+
+    def close(self) -> None:
+        self.dec.stop()
+        self.uni.stop()
+        self.volume_cm.__exit__(None, None, None)
+
+
+def _traffic(fleet: _Fleet, *, n: int, via: str = "coord",
+             abort_index: int | None = None) -> tuple[list, int, int]:
+    """Submit ``n`` seeded requests and drain them all. Returns
+    ``(results, shed, attempted)`` — ``attempted`` is counted
+    independently of the result/shed bookkeeping, so the conservation
+    invariant (attempted == finished + shed) can actually catch a request
+    that vanishes between submit and drain. ``via="uni"`` targets the
+    unified replica directly (mixed-class traffic); ``abort_index`` aborts
+    that submission right after submit (a client disconnect)."""
+    from ..scheduling.admission import ShedError
+
+    results, shed, attempted = [], 0, 0
+    for i in range(n):
+        attempted += 1
+        prompt = _PROMPTS[i % len(_PROMPTS)]
+        try:
+            if via == "uni":
+                req = fleet.uni.submit(prompt, fleet.params)
+            else:
+                req = fleet.coord.submit(prompt, fleet.params)
+        except ShedError:
+            shed += 1
+            continue
+        aborted = abort_index == i
+        if aborted:
+            (fleet.coord if via == "coord" else fleet.uni).abort(req)
+        result = _drain(req)
+        # runner-initiated aborts legitimately truncate output (partial or
+        # empty text under finish_reason="stop"): exempt from the
+        # token-identity invariant, which is about UNTOUCHED requests
+        result["aborted"] = aborted
+        results.append(result)
+    return results, shed, attempted
+
+
+#: the fixed episode schedule: (name, fault spec, traffic kwargs). One
+#: small plan per episode keeps every injection deterministic — the nth
+#: hit of a point is the nth time THIS episode's traffic reaches it —
+#: and invariants are asserted after each, per the docs/faults.md contract.
+EPISODES: list[tuple[str, dict, dict]] = [
+    ("transport-corrupt", {"disagg.chunk_corrupt": {"on_hit": 1}},
+     {"n": 2}),
+    ("transport-drop", {"disagg.chunk_drop": {"on_hit": 1}}, {"n": 2}),
+    ("transport-death", {"disagg.replica_death": {"on_hit": 1}}, {"n": 2}),
+    ("adopt-corrupt", {"disagg.adopt_corrupt": {"on_hit": 1}}, {"n": 2}),
+    ("reserve-shed", {"disagg.reserve_shed": {"on_hit": 1}}, {"n": 2}),
+    # out_of_pages hit 1 lands on the unified replica's slot-claim path
+    # (the traffic drains request-by-request, so the claim order is fixed):
+    # the preemption-safe requeue, then normal admission on a later tick
+    ("engine-pressure",
+     {"engine.out_of_pages": {"on_hit": 1},
+      "engine.slow_decode": {"on_hit": 3}},
+     {"n": 2, "via": "uni"}),
+    # a client abort mid-fleet plus a decode stall: the abort path must
+    # release reservations exactly like PR 4/6 promised
+    ("client-abort", {"engine.slow_decode": {"on_hit": 2}},
+     {"n": 3, "abort_index": 1}),
+    ("router-flap", {"router.health_flap": {"on_hit": 1}}, {"n": 2}),
+    ("tiered-corrupt", {"tiered.volume_corrupt": {"on_hit": 1}}, {"n": 2}),
+    # scheduler crash: fires on whichever running engine's loop reaches the
+    # hit first; its callers finish LOUDLY with "error", the loop survives
+    ("scheduler-crash", {"engine.scheduler_crash": {"on_hit": 30}},
+     {"n": 4}),
+]
+
+
+def _run_episode(fleet: _Fleet, name: str, spec: dict, seed: int,
+                 traffic_kw: dict) -> dict:
+    plan = FaultPlan(spec, seed=seed)
+    with active(plan):
+        if name == "tiered-corrupt":
+            # chaos pressure: evict the prefill trie and demote the host
+            # tier so the NEXT shared-prefix prompt promotes from the
+            # Volume — where the corruption fires
+            tiered = fleet.pre.tiered
+            fleet.pre.prefix_cache.evict(10_000)
+            for h, data in list(tiered._host.items()):
+                tiered._demote_to_volume(h, data)
+                with tiered._lock:
+                    tiered._host.pop(h, None)
+                    tiered._host_used -= len(data)
+        results, shed, attempted = _traffic(fleet, **traffic_kw)
+        if name == "router-flap":
+            # let the down timer lapse, then place again: the re-probe
+            # re-admission path (mtpu_router_readmissions_total)
+            time.sleep(fleet.coord.router.reprobe_s + 0.05)
+            more, more_shed, more_attempted = _traffic(fleet, n=2)
+            results += more
+            shed += more_shed
+            attempted += more_attempted
+    # settle: a crash-released engine may need a tick to drain gauges; the
+    # decode/unified loops run continuously so this is bounded and short
+    deadline = time.monotonic() + 10.0
+    while time.monotonic() < deadline:
+        if not check_drained(fleet.engines):
+            break
+        time.sleep(0.02)
+
+    violations = (
+        check_terminal(results)
+        + check_conservation(attempted, len(results), shed)
+        + check_drained(fleet.engines)
+        + check_router_recovered(fleet.coord.router)
+        + check_token_identity(results, fleet.reference)
+    )
+    reasons: dict[str, int] = {}
+    for r in results:
+        key = r["finish_reason"] or "WEDGED"
+        reasons[key] = reasons.get(key, 0) + 1
+    fired = plan.fired()
+    return {
+        "at": time.time(),
+        "episode": name,
+        "seed": seed,
+        "injected": fired,
+        "hits": plan.hits(),
+        "finished": reasons,
+        "shed": shed,
+        "wedged": sum(1 for r in results if r.get("wedged")),
+        "recovered": sum(
+            1 for r in results
+            if r["finish_reason"] in ("stop", "length")
+        ) if fired else 0,
+        "invariants": violations or "ok",
+    }
+
+
+def _run_executor_episode(seed: int) -> dict:
+    """Executor-layer chaos: a process-backend function pool, with one
+    injected container death and one injected timeout — both recovered by
+    the (now jittered) retry path."""
+    import modal_examples_tpu as mtpu
+
+    app = mtpu.App("chaos-exec")
+
+    @app.function(
+        timeout=30,
+        retries=mtpu.Retries(max_retries=2, initial_delay=0.0),
+    )
+    def ping(x: int) -> int:
+        return x + 1
+
+    plan = FaultPlan(
+        {
+            "executor.container_death": {"on_hit": 1},
+            "executor.timeout": {"on_hit": 2},
+        },
+        seed=seed,
+    )
+    finished = 0
+    violations: list[str] = []
+    # failures RECORD, never raise: run_chaos(strict=False) promises the
+    # bench child a structured report, not a traceback and no JSON line
+    with active(plan):
+        try:
+            with app.run():
+                for i in range(3):
+                    got = ping.remote(i)
+                    if got != i + 1:
+                        violations.append(
+                            f"call {i} returned {got!r}, wanted {i + 1}"
+                        )
+                    finished += 1
+        except Exception as e:
+            violations.append(
+                f"executor episode raised {type(e).__name__}: {e} — the "
+                "retry path did not recover the injected failures"
+            )
+    fired = plan.fired()
+    if len(fired) < 2:
+        violations.append(f"executor faults did not all fire: {fired}")
+    return {
+        "at": time.time(),
+        "episode": "executor-retry",
+        "seed": seed,
+        "injected": fired,
+        "hits": plan.hits(),
+        "finished": {"ok": finished},
+        "shed": 0,
+        "wedged": 0,
+        "recovered": finished if fired else 0,
+        "invariants": violations or "ok",
+    }
+
+
+def run_chaos(
+    seed: int = 0,
+    *,
+    include_executor: bool = True,
+    journal_path=None,
+    strict: bool = True,
+    push: bool = True,
+) -> dict:
+    """Run the full episode schedule against a fresh fleet; return the
+    aggregated report (the ``faults`` section shape ``bench.py`` emits).
+
+    ``strict=True`` raises :class:`ChaosInvariantError` on the first
+    episode whose invariants fail; ``strict=False`` records the violations
+    in the report instead (the CLI/bench path — the ``wedged``/
+    ``invariants`` fields stay honest either way). Episode records append
+    to ``<state_dir>/chaos.jsonl`` and the registry pushes as job
+    ``chaos`` so ``tpurun chaos`` / ``/chaos`` render the run afterwards.
+    """
+    from ..observability.journal import DecisionJournal
+    from .._internal import config as _config
+
+    journal = DecisionJournal(
+        journal_path or (_config.state_dir() / "chaos.jsonl")
+    )
+    fleet = _Fleet(seed)
+    episodes: list[dict] = []
+    try:
+        for name, spec, traffic_kw in EPISODES:
+            rec = _run_episode(fleet, name, spec, seed, traffic_kw)
+            journal.record(rec)
+            episodes.append(rec)
+            if strict and rec["invariants"] != "ok":
+                raise ChaosInvariantError(
+                    f"episode {name!r}: {rec['invariants']}"
+                )
+    finally:
+        fleet.close()
+    if include_executor:
+        rec = _run_executor_episode(seed)
+        journal.record(rec)
+        episodes.append(rec)
+        if strict and rec["invariants"] != "ok":
+            raise ChaosInvariantError(f"episode executor-retry: {rec['invariants']}")
+
+    injected: dict[str, int] = {}
+    for rec in episodes:
+        for point, n in rec["injected"].items():
+            injected[point] = injected.get(point, 0) + n
+    report = {
+        "seed": seed,
+        "episodes": episodes,
+        "injected": injected,
+        "injected_total": sum(injected.values()),
+        "points_fired": sorted(injected),
+        "points_missed": sorted(
+            ALL_FAULT_POINTS - set(injected)
+            - (set() if include_executor else
+               {"executor.container_death", "executor.timeout"})
+        ),
+        "recovered": sum(rec["recovered"] for rec in episodes),
+        "wedged": sum(rec["wedged"] for rec in episodes),
+        "invariants": (
+            "ok"
+            if all(rec["invariants"] == "ok" for rec in episodes)
+            else [
+                {"episode": rec["episode"], "violations": rec["invariants"]}
+                for rec in episodes
+                if rec["invariants"] != "ok"
+            ]
+        ),
+    }
+    if push:
+        from ..observability.export import push_metrics_file
+
+        push_metrics_file("chaos")
+    return report

@@ -1,0 +1,286 @@
+"""Deterministic fault injection: named fault points, seeded plans, and a
+zero-cost activation gate.
+
+The serving fleet's failure behavior (docs/disagg.md's failure matrix, the
+scheduler's shed/requeue paths, the executor's retry policy) used to be
+exercised only by hand-written unit cases that fake one failure each. This
+module makes failure a first-class, *deterministic* input instead:
+
+- :data:`POINTS` is the ONE catalog of every named ``FaultPoint`` in the
+  package — the :mod:`..observability.catalog` pattern applied to failure.
+  ``tests/test_static.py`` enforces that every ``_faults.fire("...")`` /
+  ``_faults.check("...")`` call site anywhere in the package names a
+  declared point AND that every declared point has at least one live call
+  site, so dead injection points cannot rot. The seeded chaos plan
+  (:mod:`.chaos`) additionally proves each point *fires* end to end.
+- :class:`FaultPlan` decides, deterministically, WHICH hits of a point
+  fail: ``{"on_hit": n}`` fires exactly on the nth time execution reaches
+  the point (or each n in a list), ``{"p": x}`` flips a per-point
+  seeded coin per hit (optionally capped with ``max_fires``). Two runs with
+  the same seed and the same hit sequence make identical decisions — a
+  chaos failure reproduces from ``(seed, plan)`` alone.
+- The gate is **zero-cost when disabled**: with no active plan,
+  :func:`fire` is one global read and a ``return False`` — no counters, no
+  metrics, no allocation. Production code can therefore keep its injection
+  points compiled in unconditionally (``tests/test_static.py`` asserts the
+  no-op shape).
+
+Activation is explicit (:func:`activate` / :func:`deactivate` / the
+:func:`active` context manager) or environment-driven for child processes:
+``MTPU_FAULT_PLAN`` (JSON spec) + ``MTPU_FAULT_SEED``. Every fired fault
+counts in ``mtpu_faults_injected_total{point}``.
+
+This module is jax-free and import-light: ``core/`` (the jax-free layer)
+imports it. Production modules may import :mod:`.inject`; they must NEVER
+import :mod:`.chaos` (the driver) — enforced statically.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import json
+import os
+import threading
+
+from ..utils.determinism import unit_float as _hash_unit_float
+
+#: the ONE catalog of fault points. name -> {component, effect, recovery}.
+#: Names are ``<component>.<failure>``; the component prefix groups the
+#: CLI/report rendering. Adding a point here without a production call site
+#: (or vice versa) fails tests/test_static.py; a point the default chaos
+#: plan cannot reach fails tests/test_chaos.py.
+POINTS: dict[str, dict] = {
+    "disagg.chunk_corrupt": {
+        "component": "serving/disagg/transport.py",
+        "effect": "one wire chunk's payload is flipped (stale crc)",
+        "recovery": "crc mismatch -> resumable retry re-sends that chunk",
+    },
+    "disagg.chunk_drop": {
+        "component": "serving/disagg/transport.py",
+        "effect": "one wire chunk silently vanishes",
+        "recovery": "gap detected -> next round re-sends the missing seq",
+    },
+    "disagg.replica_death": {
+        "component": "serving/disagg/transport.py",
+        "effect": "ConnectionError mid-transfer (peer died)",
+        "recovery": "coordinator unified fallback: re-prefill on decode",
+    },
+    "disagg.adopt_corrupt": {
+        "component": "serving/disagg/roles.py",
+        "effect": "the reassembled block corrupts before adoption",
+        "recovery": "loud TransportError -> unified fallback",
+    },
+    "disagg.reserve_shed": {
+        "component": "serving/disagg/roles.py",
+        "effect": "decode-side admission sheds the migration reservation",
+        "recovery": "honest 429 before any byte moves (ShedError)",
+    },
+    "engine.out_of_pages": {
+        "component": "serving/engine.py",
+        "effect": "a page claim reports allocator exhaustion",
+        "recovery": "preemption-safe requeue; admitted on a later tick",
+    },
+    "engine.scheduler_crash": {
+        "component": "serving/engine.py",
+        "effect": "the scheduler thread's step() raises",
+        "recovery": "inflight/queued requests fail LOUDLY with "
+                    "finish_reason='error'; the loop survives",
+    },
+    "engine.slow_decode": {
+        "component": "serving/engine.py",
+        "effect": "one decode tick stalls (~50 ms)",
+        "recovery": "latency only; requests still terminate",
+    },
+    "router.health_flap": {
+        "component": "scheduling/router.py",
+        "effect": "a replica's health probe reports unhealthy once",
+        "recovery": "evicted from candidates, re-probed, re-admitted",
+    },
+    "tiered.volume_corrupt": {
+        "component": "serving/disagg/tiered_cache.py",
+        "effect": "bytes read from the Volume tier are corrupted",
+        "recovery": "corrupt block dropped; prefix KV recomputed",
+    },
+    "executor.container_death": {
+        "component": "core/executor.py",
+        "effect": "the dispatched container dies while processing",
+        "recovery": "retry with jittered backoff (mtpu_retries_total)",
+    },
+    "executor.timeout": {
+        "component": "core/executor.py",
+        "effect": "the dispatched input exceeds its timeout",
+        "recovery": "retry with jittered backoff (mtpu_retries_total)",
+    },
+}
+
+#: every declared fault-point name (the static guard's allowlist)
+ALL_FAULT_POINTS = frozenset(POINTS)
+
+
+class FaultError(RuntimeError):
+    """An injected failure (never raised by real fault paths — catching it
+    is how handlers distinguish chaos from genuine scheduler-logic bugs)."""
+
+
+def _unit_float(seed: int, point: str, hit: int) -> float:
+    """Deterministic uniform in [0, 1) from (seed, point, hit) — stable
+    across processes and python hash randomization (the same hashing
+    scheme retry jitter uses: utils.determinism)."""
+    return _hash_unit_float(seed, point, hit)
+
+
+class FaultPlan:
+    """A seeded, deterministic decision table over :data:`POINTS`.
+
+    ``spec`` maps point name -> one of:
+
+    - ``{"on_hit": n}`` — fire exactly when the point is reached the nth
+      time (1-based); ``n`` may be a list of hit numbers.
+    - ``{"p": x}`` — fire each hit with probability ``x``, decided by a
+      per-(seed, point, hit) hash — deterministic, order-independent
+      across points. Optional ``"max_fires": k`` caps total fires.
+
+    Unknown point names are rejected up front (the plan is checked against
+    the catalog, like metric names are). Hits are counted for EVERY
+    declared point the plan is active over — ``hits()`` is the
+    reachability record even for points the plan never fires.
+    """
+
+    def __init__(self, spec: dict, *, seed: int = 0):
+        unknown = set(spec) - ALL_FAULT_POINTS
+        if unknown:
+            raise ValueError(
+                f"unknown fault points {sorted(unknown)}; declared points: "
+                f"{sorted(ALL_FAULT_POINTS)}"
+            )
+        self.seed = int(seed)
+        self._spec: dict[str, dict] = {}
+        for point, cfg in spec.items():
+            cfg = dict(cfg)
+            if "on_hit" in cfg:
+                n = cfg["on_hit"]
+                cfg["on_hit"] = frozenset(
+                    int(x) for x in (n if isinstance(n, (list, tuple)) else [n])
+                )
+            elif "p" not in cfg:
+                raise ValueError(
+                    f"fault spec for {point!r} needs 'on_hit' or 'p': {cfg}"
+                )
+            self._spec[point] = cfg
+        self._lock = threading.Lock()
+        self._hits: dict[str, int] = {}
+        self._fired: dict[str, int] = {}
+
+    def should_fire(self, point: str) -> bool:
+        """Count one hit of ``point`` and decide whether it fails."""
+        with self._lock:
+            hit = self._hits.get(point, 0) + 1
+            self._hits[point] = hit
+            cfg = self._spec.get(point)
+            if cfg is None:
+                return False
+            on_hit = cfg.get("on_hit")
+            if on_hit is not None:
+                fire = hit in on_hit
+            else:
+                fired = self._fired.get(point, 0)
+                if fired >= cfg.get("max_fires", float("inf")):
+                    return False
+                fire = _unit_float(self.seed, point, hit) < cfg["p"]
+            if fire:
+                self._fired[point] = self._fired.get(point, 0) + 1
+            return fire
+
+    def hits(self) -> dict[str, int]:
+        """Times each point was REACHED while this plan was active."""
+        with self._lock:
+            return dict(self._hits)
+
+    def fired(self) -> dict[str, int]:
+        """Times each point actually FIRED."""
+        with self._lock:
+            return dict(self._fired)
+
+
+#: the active plan. A plain module global (not a contextvar): fault points
+#: are hit from the engine's scheduler thread, server threads, and executor
+#: workers — none of which inherit the activator's context.
+_active_plan: FaultPlan | None = None
+
+
+def activate(plan: FaultPlan) -> FaultPlan:
+    """Arm ``plan`` process-wide. Returns it (chaining convenience)."""
+    global _active_plan
+    _active_plan = plan
+    return plan
+
+
+def deactivate() -> None:
+    global _active_plan
+    _active_plan = None
+
+
+def active_plan() -> FaultPlan | None:
+    return _active_plan
+
+
+@contextlib.contextmanager
+def active(plan: FaultPlan):
+    """``with active(FaultPlan({...}, seed=7)) as plan:`` — scoped arming;
+    always disarms, even when the driven code raises."""
+    activate(plan)
+    try:
+        yield plan
+    finally:
+        deactivate()
+
+
+def fire(point: str) -> bool:
+    """True when the active plan says this hit of ``point`` should fail.
+
+    THE gate: with no plan active this is one global read + return — the
+    zero-cost-when-disabled contract tests/test_static.py pins down.
+    """
+    if _active_plan is None:
+        return False
+    # snapshot: deactivate() may race from another thread between the
+    # None-check above and the call below (the engine's scheduler threads
+    # keep ticking while the chaos runner disarms plans) — a torn read
+    # must mean "disarmed", never an AttributeError inside a scheduler loop
+    plan = _active_plan
+    if plan is not None and plan.should_fire(point):
+        from ..observability import metrics as _obs
+
+        _obs.record_fault_injected(point)
+        return True
+    return False
+
+
+def check(point: str, exc: type = FaultError, message: str | None = None) -> None:
+    """Raise ``exc`` when this hit of ``point`` fires (one-line call sites
+    for raise-style faults)."""
+    if fire(point):
+        raise exc(message or f"injected fault: {point}")
+
+
+def corrupt(point: str, data: bytes) -> bytes:
+    """Return ``data`` with its last byte flipped when ``point`` fires
+    (one-line call sites for corruption-style faults); unchanged otherwise.
+    Empty payloads pass through — there is nothing to corrupt."""
+    if data and fire(point):
+        return data[:-1] + bytes([data[-1] ^ 0xFF])
+    return data
+
+
+def _activate_from_env() -> None:
+    """Child-process activation: ``MTPU_FAULT_PLAN`` (JSON spec) +
+    ``MTPU_FAULT_SEED``. A malformed plan is a loud error — a chaos run
+    that silently injected nothing would 'pass' every invariant."""
+    raw = os.environ.get("MTPU_FAULT_PLAN", "")
+    if not raw:
+        return
+    seed = int(os.environ.get("MTPU_FAULT_SEED", "0") or 0)
+    activate(FaultPlan(json.loads(raw), seed=seed))
+
+
+_activate_from_env()

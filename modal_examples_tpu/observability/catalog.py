@@ -92,7 +92,7 @@ SCALER_DECISIONS_TOTAL = "mtpu_scaler_decisions_total"
 # -- request scheduler (modal_examples_tpu/scheduling, PR 4) ----------------
 
 #: counter {class, reason}: requests shed by admission control;
-#: reason = queue_full | kv_pressure | too_large
+#: reason = queue_full | kv_pressure | too_large | injected (chaos)
 SHEDS_TOTAL = "mtpu_sheds_total"
 #: counter {class}: requests accepted by admission control
 REQUESTS_ADMITTED_TOTAL = "mtpu_requests_admitted_total"
@@ -109,6 +109,16 @@ DEADLINE_MISSES_TOTAL = "mtpu_deadline_misses_total"
 ROUTER_REQUESTS_TOTAL = "mtpu_router_requests_total"
 #: counter: repeated shared-prefix prompts landed on their affinity replica
 ROUTER_AFFINITY_HITS_TOTAL = "mtpu_router_affinity_hits_total"
+#: counter: unhealthy replicas re-admitted to the candidate set after a
+#: successful health re-probe (docs/faults.md: unhealthy is not a one-way
+#: door — flapped replicas rejoin route()/plan() once they probe healthy)
+ROUTER_READMISSIONS_TOTAL = "mtpu_router_readmissions_total"
+
+# -- fault injection (modal_examples_tpu/faults, docs/faults.md) ------------
+
+#: counter {point}: injected faults that FIRED, by catalog point name
+#: (faults/inject.py POINTS); the chaos runner's reachability record
+FAULTS_INJECTED_TOTAL = "mtpu_faults_injected_total"
 
 # -- disaggregated serving (serving/disagg, docs/disagg.md) -----------------
 
@@ -287,7 +297,7 @@ CATALOG: dict[str, dict] = {
     SHEDS_TOTAL: {
         "type": "counter", "labels": ["class", "reason"],
         "help": "requests shed by admission control "
-                "(reason=queue_full|kv_pressure|too_large)",
+                "(reason=queue_full|kv_pressure|too_large|injected)",
     },
     REQUESTS_ADMITTED_TOTAL: {
         "type": "counter", "labels": ["class"],
@@ -318,6 +328,14 @@ CATALOG: dict[str, dict] = {
         "type": "counter", "labels": [],
         "help": "repeated shared-prefix prompts landed on their affinity "
                 "replica",
+    },
+    ROUTER_READMISSIONS_TOTAL: {
+        "type": "counter", "labels": [],
+        "help": "unhealthy replicas re-admitted after a health re-probe",
+    },
+    FAULTS_INJECTED_TOTAL: {
+        "type": "counter", "labels": ["point"],
+        "help": "injected faults fired, by faults/inject.py catalog point",
     },
     DISAGG_MIGRATIONS_TOTAL: {
         "type": "counter", "labels": ["result"],

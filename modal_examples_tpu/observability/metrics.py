@@ -280,6 +280,28 @@ def record_router_route(
         )
 
 
+def record_router_readmission(*, registry: Registry | None = None) -> None:
+    _reg(registry).counter_inc(
+        C.ROUTER_READMISSIONS_TOTAL, 1.0,
+        help=C.CATALOG[C.ROUTER_READMISSIONS_TOTAL]["help"],
+    )
+
+
+# -- fault injection (modal_examples_tpu/faults) ------------------------------
+
+
+def record_fault_injected(
+    point: str, *, registry: Registry | None = None
+) -> None:
+    """One fired fault point (faults/inject.py). Only FIRES count — a
+    reached-but-passing point is free, preserving the zero-cost gate."""
+    _reg(registry).counter_inc(
+        C.FAULTS_INJECTED_TOTAL, 1.0,
+        labels={"point": point},
+        help=C.CATALOG[C.FAULTS_INJECTED_TOTAL]["help"],
+    )
+
+
 # -- disaggregated serving (serving/disagg) ----------------------------------
 
 

@@ -16,7 +16,16 @@ Fallbacks keep affinity from becoming a hotspot:
 - a **saturated** replica (outstanding work >= ``saturation_factor`` x its
   slot capacity) diverts new prompts to the least-loaded healthy replica;
 - an **unhealthy** replica (scheduler stopped on error, or a custom health
-  probe) is skipped entirely.
+  probe) is skipped — but NOT forever. Unhealthy used to be a one-way
+  door: a replica that flapped once was filtered out of every future
+  candidate set. Now an unhealthy observation marks the replica down for
+  ``reprobe_s`` seconds, after which the router **re-probes** it
+  (``probe()`` when the replica has one — ``EngineReplica.probe`` revives
+  a stopped-on-error engine — else ``healthy()``) and re-admits it on
+  success (``mtpu_router_readmissions_total``; docs/faults.md covers the
+  flap -> evict -> re-admit cycle the chaos harness drives). A replica
+  whose ``healthy()`` simply flips back to true rejoins immediately, no
+  probe wait.
 
 ``mtpu_router_requests_total{route=affinity|fallback}`` counts placements;
 ``mtpu_router_affinity_hits_total`` counts the wins that matter — a repeated
@@ -33,8 +42,10 @@ from __future__ import annotations
 
 import hashlib
 import threading
+import time
 from collections import OrderedDict
 
+from ..faults import inject as _inject
 from ..observability import metrics as _obs
 
 
@@ -92,7 +103,26 @@ class EngineReplica:
         return self.engine.max_slots
 
     def healthy(self) -> bool:
+        # fault point (docs/faults.md): one flapped health observation —
+        # the router evicts, re-probes, and re-admits this replica
+        if _inject.fire("router.health_flap"):
+            return False
         return not self.engine._stopped_on_error
+
+    def probe(self) -> bool:
+        """Re-admission probe (router, after ``reprobe_s`` down): a replica
+        whose engine stopped on a scheduler error is revived and restarted
+        — every caller it owed was already released with
+        finish_reason="error", so it comes back empty. Prefill-role
+        replicas never start a scheduler loop, so they only re-check
+        health. Returns post-probe health."""
+        eng = self.engine
+        if eng._stopped_on_error and self.serves_requests:
+            try:
+                eng.revive().start()
+            except Exception:
+                return False
+        return self.healthy()
 
     def saturated(self) -> bool:
         return self.outstanding() >= self.saturation_factor * max(
@@ -108,11 +138,19 @@ class PrefixAffinityRouter:
     #: occurrence builds the prefix KV, repeats reuse it
     SEEN_KEYS_MAX = 4096
 
+    #: seconds a replica observed unhealthy stays out of the candidate set
+    #: before the router re-probes it (ctor-overridable; short enough that
+    #: a transient flap costs one probe interval, long enough that a truly
+    #: dead replica isn't probed on every request)
+    REPROBE_S = 5.0
+
     def __init__(
         self,
         replicas: list,
         *,
         prefix_tokens: int = 16,
+        reprobe_s: float | None = None,
+        clock=None,  # injectable monotonic clock (fake-clock flap tests)
     ):
         if not replicas:
             raise ValueError("router needs at least one replica")
@@ -121,10 +159,18 @@ class PrefixAffinityRouter:
             raise ValueError(f"replica names must be unique: {names}")
         self.replicas = list(replicas)
         self.prefix_tokens = max(1, int(prefix_tokens))
+        self.reprobe_s = float(
+            reprobe_s if reprobe_s is not None else self.REPROBE_S
+        )
+        self._clock = clock or time.monotonic
         self._lock = threading.Lock()
         self._seen: OrderedDict[bytes, str] = OrderedDict()
+        #: replica name -> next re-probe time (monotonic): the down list.
+        #: Present = excluded from candidates until probed healthy again.
+        self._down: dict[str, float] = {}
         self.affinity_hits = 0
         self.fallbacks = 0
+        self.readmissions = 0
         # role-aware split (replicas without a .role are unified): route()
         # only ever places full requests on serving-capable replicas;
         # prefill-only ones are plan()'s business
@@ -156,6 +202,51 @@ class PrefixAffinityRouter:
             candidates if candidates is not None else self.replicas, key=score
         )
 
+    def _candidates(self, pool: list) -> list:
+        """The healthy members of ``pool``, with down-tracking + re-probe.
+
+        An unhealthy observation marks the replica down. While down it
+        still gets the CHEAP ``healthy()`` recheck every placement —
+        ``healthy()`` flipping back true re-admits it on the spot — but
+        the EXPENSIVE ``probe()`` (which may revive and restart a
+        stopped-on-error engine, ``EngineReplica.probe``) only runs once
+        ``reprobe_s`` has passed, and a failed probe pushes the next one
+        out by another interval. So a transient flap costs at most one
+        placement, while a truly dead replica is revival-attempted at a
+        bounded rate."""
+        now = self._clock()
+        out = []
+        for r in pool:
+            with self._lock:
+                due = self._down.get(r.name)
+            if due is None:
+                if r.healthy():
+                    out.append(r)
+                else:
+                    with self._lock:
+                        self._down[r.name] = now + self.reprobe_s
+                continue
+            if r.healthy():
+                self._readmit(r.name)
+                out.append(r)
+                continue
+            if now < due:
+                continue  # still down; not revival-probe time yet
+            probe = getattr(r, "probe", None)
+            if probe is not None and probe():
+                self._readmit(r.name)
+                out.append(r)
+            else:
+                with self._lock:
+                    self._down[r.name] = now + self.reprobe_s
+        return out
+
+    def _readmit(self, name: str) -> None:
+        with self._lock:
+            self._down.pop(name, None)
+            self.readmissions += 1
+        _obs.record_router_readmission()
+
     def _prompt_key(self, prompt: str) -> bytes:
         # tokenize only enough text to cover the key's token prefix (the
         # engine re-encodes the full prompt at submit anyway — hashing the
@@ -169,10 +260,10 @@ class PrefixAffinityRouter:
         request (see :meth:`plan` for disaggregated placement)."""
         key = self._prompt_key(prompt)
         preferred = self._preferred(key, self._serving)
-        healthy = [r for r in self._serving if r.healthy()]
+        healthy = self._candidates(self._serving)
         if not healthy:
             raise RuntimeError("no healthy replicas")
-        if preferred.healthy() and not preferred.saturated():
+        if preferred in healthy and not preferred.saturated():
             chosen, route = preferred, "affinity"
         else:
             chosen = min(healthy, key=lambda r: (r.outstanding(), r.name))
@@ -204,13 +295,15 @@ class PrefixAffinityRouter:
         pair is saturated. ``None`` prefill means no healthy prefill peer:
         the caller serves unified on the returned decode replica."""
         key = self._prompt_key(prompt)
-        decoders = [r for r in self._serving if r.healthy()]
+        decoders = self._candidates(self._serving)
         if not decoders:
             raise RuntimeError("no healthy decode-capable replicas")
         prefillers = [
-            r for r in self.replicas
-            if getattr(r, "role", "unified") == "prefill"
-            and r.healthy() and not r.saturated()
+            r for r in self._candidates([
+                r for r in self.replicas
+                if getattr(r, "role", "unified") == "prefill"
+            ])
+            if not r.saturated()
         ]
         if not prefillers:
             chosen = min(decoders, key=lambda r: (r.outstanding(), r.name))
@@ -260,9 +353,11 @@ class PrefixAffinityRouter:
 
     def stats(self) -> dict:
         with self._lock:
-            hits, fallbacks, keys = (
-                self.affinity_hits, self.fallbacks, len(self._seen)
+            hits, fallbacks, keys, readmissions = (
+                self.affinity_hits, self.fallbacks, len(self._seen),
+                self.readmissions,
             )
+            down = dict(self._down)
         return {
             "replicas": {
                 r.name: {
@@ -270,10 +365,12 @@ class PrefixAffinityRouter:
                     "outstanding": r.outstanding(),
                     "healthy": r.healthy(),
                     "saturated": r.saturated(),
+                    "down": r.name in down,
                 }
                 for r in self.replicas
             },
             "affinity_hits": hits,
             "fallbacks": fallbacks,
+            "readmissions": readmissions,
             "keys_tracked": keys,
         }

@@ -32,6 +32,7 @@ from __future__ import annotations
 import threading
 import time
 
+from ...faults import inject as _inject
 from ...observability import metrics as _obs
 from ...scheduling.policy import DEFAULT_CLASS, ScheduledRequest
 from ...utils.log import get_logger
@@ -91,12 +92,13 @@ class DisaggCoordinator:
         chunk_bytes: int = DEFAULT_CHUNK_BYTES,
         max_rounds: int = 3,
         channel_factory=None,
+        reprobe_s: float | None = None,  # router unhealthy re-probe interval
     ):
         from ...scheduling.router import PrefixAffinityRouter
 
         self.replicas = list(replicas)
         self.router = PrefixAffinityRouter(
-            replicas, prefix_tokens=prefix_tokens
+            replicas, prefix_tokens=prefix_tokens, reprobe_s=reprobe_s
         )
         self.chunk_bytes = int(chunk_bytes)
         self.max_rounds = int(max_rounds)
@@ -161,6 +163,19 @@ class DisaggCoordinator:
             prompt, params, priority=priority, tenant=tenant
         )
         req._router_replica = decode_r
+        # fault point (docs/faults.md): the decode side sheds the migration
+        # reservation — an honest 429 BEFORE any byte moves, the same
+        # surface a real kv_pressure shed takes (nothing to unwind: no
+        # reservation exists yet, the request never queued anywhere)
+        if _inject.fire("disagg.reserve_shed"):
+            from ...scheduling.admission import ShedError
+
+            _obs.record_shed(req.priority, "injected")
+            raise ShedError(
+                "injected", 1.0,
+                f"injected: decode replica {decode_r.name} shed the "
+                f"migration reservation for {req.request_id}",
+            )
         # migration cost reserved on the DECODE side before any byte moves:
         # the admission controller counts these pages exactly like queued
         # local work, so a decode replica can't be over-committed by
@@ -209,6 +224,10 @@ class DisaggCoordinator:
             )
             if should_abort():
                 raise TransferAborted(req.request_id)
+            # fault point: the reassembled block corrupts between wire and
+            # adoption (bad DMA, bit rot) — deserialize_block's crc check
+            # turns it into a loud TransportError -> unified fallback below
+            wire = _inject.corrupt("disagg.adopt_corrupt", wire)
             engine_d.submit_adopted(req, entry, deserialize_block(wire))
             with self._lock:
                 self.migrations_ok += 1

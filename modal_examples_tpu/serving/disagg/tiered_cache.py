@@ -36,6 +36,7 @@ import os
 import threading
 from collections import OrderedDict
 
+from ...faults import inject as _inject
 from ...observability import metrics as _obs
 from ...utils.log import get_logger
 from .transport import (
@@ -221,6 +222,10 @@ class TieredPrefixCache:
             data = self.volume.read_file(self._volume_path(block_hash))
         except Exception:
             return None
+        # fault point (docs/faults.md): the volume's bytes rot — promote's
+        # crc check drops the block and prefill recomputes it; the stored
+        # file is untouched, so a later promote can still succeed
+        data = _inject.corrupt("tiered.volume_corrupt", data)
         with self._lock:
             # lazily fill the size the seeding pass skipped (byte gauge)
             self._volume_index[block_hash] = len(data)

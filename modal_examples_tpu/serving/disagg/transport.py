@@ -38,10 +38,13 @@ import hashlib
 import json
 import queue
 import struct
+import time
 import zlib
 
 import numpy as np
 
+from ...core.retries import Retries
+from ...faults import inject as _inject
 from ...observability import metrics as _obs
 
 #: envelope magic + version (bump on any layout change)
@@ -49,6 +52,15 @@ _MAGIC = b"MTKV1\n"
 #: default chunk payload size — small enough that one lost chunk is cheap
 #: to resend, large enough that header overhead stays noise
 DEFAULT_CHUNK_BYTES = 256 * 1024
+
+#: default backoff between chunk-retry rounds: short (a retry round is a
+#: loopback/pipe re-send, not a network RPC) and JITTERED per transfer id —
+#: N replicas whose transfers all hit the same flaky channel must not
+#: re-send in lockstep (docs/faults.md). ``max_rounds`` x these delays is
+#: the transfer's bounded retry budget.
+DEFAULT_RETRY_BACKOFF = Retries(
+    max_retries=8, initial_delay=0.01, max_delay=0.25, jitter=0.5
+)
 
 
 class TransportError(RuntimeError):
@@ -384,6 +396,14 @@ class LoopbackChannel:
         return self._q.get(block=block, timeout=timeout)
 
 
+def _mangle(chunk):
+    """A corrupted copy of ``chunk``: payload bytes flipped, crc left
+    STALE — exactly the wire damage the assembler must catch."""
+    kind, tid, seq, total, crc, piece = chunk
+    bad = piece[:-1] + bytes([piece[-1] ^ 0xFF]) if piece else piece
+    return (kind, tid, seq, total, crc, bad)
+
+
 def transfer(
     payload: bytes,
     channel,
@@ -392,12 +412,22 @@ def transfer(
     chunk_bytes: int = DEFAULT_CHUNK_BYTES,
     max_rounds: int = 3,
     should_abort=None,
+    backoff: Retries | None = DEFAULT_RETRY_BACKOFF,
 ) -> bytes:
     """Stream ``payload`` through ``channel`` and reassemble it: send every
     pending chunk, drain what arrived, re-send only the gaps. Raises
     :class:`TransferAborted` the moment ``should_abort()`` trips (checked
     between chunks, so an abort never waits for the tail of a large block)
     and :class:`TransportError` when ``max_rounds`` can't complete the set.
+
+    Retry rounds wait ``backoff.delay_for_attempt(round, key=transfer_id)``
+    between attempts — jittered so concurrent transfers over one flaky
+    channel don't re-send in lockstep; ``max_rounds`` x those delays bounds
+    the retry budget. ``backoff=None`` retries immediately (tests).
+
+    Fault points (docs/faults.md): ``disagg.replica_death`` kills the
+    stream mid-transfer, ``disagg.chunk_drop`` swallows one chunk,
+    ``disagg.chunk_corrupt`` flips payload bytes under a stale crc.
     """
     chunks = iter_chunks(payload, transfer_id, chunk_bytes)
     asm = ChunkAssembler(transfer_id)
@@ -405,10 +435,24 @@ def transfer(
     for round_i in range(max(1, int(max_rounds))):
         if round_i and pending:
             _obs.record_disagg_chunk_retries(len(pending))
+            if backoff is not None:
+                time.sleep(
+                    backoff.delay_for_attempt(round_i, key=transfer_id)
+                )
         for seq in pending:
             if should_abort is not None and should_abort():
                 raise TransferAborted(f"transfer {transfer_id} aborted")
-            channel.send(chunks[seq])
+            _inject.check(
+                "disagg.replica_death",
+                ConnectionError,
+                f"injected: peer died mid-transfer {transfer_id}",
+            )
+            if _inject.fire("disagg.chunk_drop"):
+                continue  # the chunk vanishes; the next round re-sends it
+            chunk = chunks[seq]
+            if _inject.fire("disagg.chunk_corrupt"):
+                chunk = _mangle(chunk)
+            channel.send(chunk)
         while True:
             try:
                 received = channel.recv(block=False)

@@ -43,6 +43,8 @@ from ..scheduling.policy import (
     SchedulerPolicy,
     validate_class,
 )
+from ..faults import inject as _inject
+from ..faults.inject import FaultError as _FaultError
 from ..utils.log import get_logger
 from .kv_cache import OutOfPages, PagedKVCache
 from .sampling import SamplingParams, sample
@@ -1472,6 +1474,18 @@ class LLMEngine:
             self._thread.start()
         return self
 
+    def revive(self) -> "LLMEngine":
+        """Clear the stopped-on-error poison so :meth:`start` may run again
+        — the router's re-probe re-admission path (docs/faults.md;
+        ``EngineReplica.probe``). Safe because stopping on error already
+        released every caller and freed every slot (``_release_all``): a
+        revived engine starts empty. ``error_log`` survives for diagnosis;
+        without an explicit revive, one scheduler error removed a replica
+        from the fleet forever."""
+        with self._lock:
+            self._stopped_on_error = False
+        return self
+
     def stop(self) -> None:
         """Stop the scheduler and release every caller: in-flight and queued
         requests get their terminal _FINISH so stream()/generate() return
@@ -1487,36 +1501,62 @@ class LLMEngine:
     def _loop(self) -> None:
         import traceback
 
-        while self._running:
-            try:
-                worked = self.step()
-            except Exception:
-                # Per-REQUEST failures never reach here: bad params are
-                # rejected at submit() and failed prefills unwind their
-                # claims inside _admit (_fail_claims). Anything caught here
-                # is a scheduler-logic error. Keep the traceback on the
-                # engine so it is diagnosable after the fact (surfaced in
-                # /metrics as mtpu_scheduler_errors_total).
-                tb = traceback.format_exc()
-                self.error_log.append(tb)
-                self.error_count += 1
-                del self.error_log[:-20]
-                LLMEngine._error_reports.append(tb[-800:])
-                del LLMEngine._error_reports[:-50]
-                _obs.record_scheduler_error()
-                _log.error("scheduler-loop exception:\n%s", tb)
-                if self.strict:
-                    # tests must fail loudly, not generate corrupt output:
-                    # poison the engine (start() refuses to resurrect it —
-                    # a racing stream() would otherwise spawn a second
-                    # scheduler thread mid-teardown), then release callers
-                    self._stopped_on_error = True
-                    self._running = False
+        try:
+            while self._running:
+                try:
+                    worked = self.step()
+                except _FaultError:
+                    # Injected scheduler-thread crash (faults/inject.py):
+                    # fail in-flight AND queued requests LOUDLY — every
+                    # caller's stream terminates with finish_reason="error"
+                    # instead of wedging — then keep the loop alive. An
+                    # injected fault is not a scheduler-logic bug, so it
+                    # neither poisons the engine (strict mode) nor trips
+                    # the _error_reports session sentinel.
+                    _log.warning(
+                        "injected scheduler crash: releasing all callers"
+                    )
                     self._release_all(_Finish("error"))
-                    return
-                worked = False
-            if not worked:
-                time.sleep(0.002)
+                    worked = False
+                except Exception:
+                    # Per-REQUEST failures never reach here: bad params are
+                    # rejected at submit() and failed prefills unwind their
+                    # claims inside _admit (_fail_claims). Anything caught
+                    # here is a scheduler-logic error. Keep the traceback on
+                    # the engine so it is diagnosable after the fact
+                    # (surfaced in /metrics as mtpu_scheduler_errors_total).
+                    tb = traceback.format_exc()
+                    self.error_log.append(tb)
+                    self.error_count += 1
+                    del self.error_log[:-20]
+                    LLMEngine._error_reports.append(tb[-800:])
+                    del LLMEngine._error_reports[:-50]
+                    _obs.record_scheduler_error()
+                    _log.error("scheduler-loop exception:\n%s", tb)
+                    if self.strict:
+                        # tests must fail loudly, not generate corrupt
+                        # output: poison the engine (start() refuses to
+                        # resurrect it — a racing stream() would otherwise
+                        # spawn a second scheduler thread mid-teardown),
+                        # then release callers
+                        self._stopped_on_error = True
+                        self._running = False
+                        self._release_all(_Finish("error"))
+                        return
+                    worked = False
+                if not worked:
+                    time.sleep(0.002)
+        finally:
+            if self._running:
+                # The thread is dying WITHOUT stop() — a BaseException, or
+                # a bug in the error handling above. Before this guard,
+                # every in-flight stream() would block forever on a queue
+                # nothing will ever feed; now the crash is loud: callers
+                # get finish_reason="error" and the engine is poisoned
+                # until revive() (docs/faults.md: no request may wedge).
+                self._running = False
+                self._stopped_on_error = True
+                self._release_all(_Finish("error"))
 
     def _release_all(self, marker: "_Finish") -> None:
         self._inflight.clear()
@@ -1533,6 +1573,9 @@ class LLMEngine:
     def step(self) -> bool:
         """One scheduler tick: expire deadlines -> admit -> decode -> emit.
         Returns True if any work happened."""
+        # fault point (docs/faults.md): a scheduler-thread crash. _loop
+        # catches the FaultError, fails every caller loudly, and survives.
+        _inject.check("engine.scheduler_crash")
         self._expire_deadlines()
         admitted = self._admit()
         decoded = self._decode_tick()
@@ -1775,6 +1818,11 @@ class LLMEngine:
 
     def _claim_pages(self, req: Request) -> dict | None:
         """Slot page claim with prefix-cache sharing + eviction pressure."""
+        # fault point (docs/faults.md): allocator exhaustion. The slot path
+        # takes the preemption-safe requeue; the disagg prefill_sync path
+        # raises OutOfPages and the coordinator falls back to unified.
+        if _inject.fire("engine.out_of_pages"):
+            return None
         n_prompt = len(req.prompt_tokens)
         max_total = min(n_prompt + req.params.max_tokens, self.max_model_len)
         n_pages = self.cache.pages_for(max_total)
@@ -2090,6 +2138,11 @@ class LLMEngine:
             self._accept_token(slot_idx, slot.last_token)
 
     def _decode_tick(self) -> bool:
+        # fault point (docs/faults.md): one stalled decode tick — a slow
+        # collective, a preempted host thread. Latency only; the tick then
+        # proceeds normally and requests still terminate.
+        if _inject.fire("engine.slow_decode"):
+            time.sleep(0.05)
         # reap aborted slots before spending a step on them (deadline-
         # expired aborts finish with their own reason, not a fake "stop")
         for i, s in enumerate(self.slots):

@@ -87,6 +87,30 @@ def _disagg_snapshot() -> dict:
     }
 
 
+def _chaos_snapshot(last: int = 10) -> dict:
+    """Chaos-harness snapshot: injected-fault counters per catalog point
+    (live registry) plus the newest episode records from the chaos journal
+    — the ``/chaos`` route's payload (``tpurun chaos`` renders the same
+    data from pushed metrics + the journal; docs/faults.md)."""
+    from .._internal import config as _config
+    from ..observability import catalog as C
+    from ..observability.journal import DecisionJournal
+    from ..utils.prometheus import default_registry as reg
+
+    injected = {
+        labels.get("point", "?"): v
+        for labels, v in reg.series(C.FAULTS_INJECTED_TOTAL)
+    }
+    episodes = DecisionJournal(_config.state_dir() / "chaos.jsonl").tail(last)
+    return {
+        "injected": injected,
+        "injected_total": sum(injected.values()),
+        "router_readmissions": reg.total(C.ROUTER_READMISSIONS_TOTAL),
+        "episodes": episodes,
+        "wedged": sum(int(e.get("wedged", 0)) for e in episodes),
+    }
+
+
 class _Handler(BaseHTTPRequestHandler):
     gateway: "Gateway"
 
@@ -221,18 +245,30 @@ class _Handler(BaseHTTPRequestHandler):
         exposition: this process's registry + every pushed job file),
         ``/traces[/<call_id>]`` (call-lifecycle span JSON), ``/healthz``
         (SLO pass/fail + burn rates), ``/autoscaler[?function=tag]``
-        (the autoscaler decision journal), and ``/disagg`` (replica roles,
-        migration counters, prefix-tier occupancy — docs/disagg.md). User
-        endpoints with the same label win — these only answer when no
-        route claimed the path."""
+        (the autoscaler decision journal), ``/disagg`` (replica roles,
+        migration counters, prefix-tier occupancy — docs/disagg.md), and
+        ``/chaos`` (injected-fault counters + episode journal —
+        docs/faults.md). User endpoints with the same label win — these
+        only answer when no route claimed the path."""
         parts = parsed.path.strip("/").split("/")
         label = parts[0] if parts else ""
         if method != "GET" or label not in (
-            "metrics", "traces", "healthz", "autoscaler", "disagg"
+            "metrics", "traces", "healthz", "autoscaler", "disagg", "chaos"
         ):
             return False
         if label == "disagg":
             self._respond_json(200, _disagg_snapshot())
+            return True
+        if label == "chaos":
+            q = {
+                k: v[-1]
+                for k, v in urllib.parse.parse_qs(parsed.query).items()
+            }
+            try:
+                n = int(q.get("n", 10))
+            except ValueError:
+                n = 10
+            self._respond_json(200, _chaos_snapshot(last=n))
             return True
         if label == "healthz":
             from ..observability.slo import healthz

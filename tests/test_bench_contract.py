@@ -103,6 +103,44 @@ def test_bench_disagg_config_emits_disagg_section():
 
 
 @pytest.mark.slow
+def test_bench_chaos_config_emits_faults_section():
+    """The chaos config must ride the same schema plus a ``faults``
+    section: the seeded episode schedule runs after the measured traffic
+    and the report — injected per point, recoveries, zero wedged — rides
+    in the json (docs/faults.md). A failure-handling regression breaks the
+    bench contract, not just the test suite."""
+    out = subprocess.run(
+        [sys.executable, str(REPO / "bench.py")],
+        capture_output=True,
+        text=True,
+        timeout=500,
+        env={
+            **os.environ,
+            "BENCH_CPU": "1",
+            "BENCH_MODEL": "tiny-chaos",
+            "BENCH_NO_SECONDARY": "1",
+        },
+        cwd=str(REPO),
+    )
+    assert out.returncode == 0, out.stderr[-500:]
+    lines = [l for l in out.stdout.strip().splitlines() if l.strip()]
+    assert len(lines) == 1, lines
+    payload = json.loads(lines[0])
+    assert payload["value"] > 0 and payload["unit"] == "tok/s"
+    faults = payload.get("faults")
+    assert faults, payload
+    assert {"injected", "per_point", "recovered", "wedged",
+            "points_missed", "invariants", "episodes"} <= set(faults)
+    assert faults["wedged"] == 0
+    assert faults["invariants"] == "ok"
+    assert faults["points_missed"] == []
+    assert faults["injected"] >= len(faults["per_point"]) >= 12
+    assert faults["recovered"] > 0
+    # the measured number itself stays fault-free
+    assert payload["engine_errors"] == 0
+
+
+@pytest.mark.slow
 def test_bench_tp_config_emits_sharded_plan():
     """The TP=2 config must ride the same schema plus the resolved
     per-shard plan: ``tp`` at the top level and ``impl_plan`` reporting the

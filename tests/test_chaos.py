@@ -1,0 +1,105 @@
+"""Chaos acceptance (ISSUE 8, docs/faults.md): ONE seeded command drives
+the default episode schedule through EVERY cataloged fault point against a
+real mixed fleet (unified + disagg prefill/decode, CPU-sized models) and
+all fleet invariants hold — zero wedged requests, reservations and pages
+drained to zero, request conservation, router recovered, and fault-free
+outputs token-identical. The run itself raises ChaosInvariantError on any
+violation, so the fixture IS the acceptance; the tests below pin each
+contract clause to a named assertion."""
+
+import json
+
+import pytest
+
+
+@pytest.fixture(scope="module")
+def chaos_report(jax_cpu):
+    from modal_examples_tpu.faults.chaos import run_chaos
+
+    # strict=True: any invariant violation raises here, failing every test
+    return run_chaos(seed=0, strict=True)
+
+
+class TestChaosAcceptance:
+    def test_every_cataloged_fault_point_fires(self, chaos_report):
+        """Catalog reachability: the default seeded schedule reaches AND
+        fires every declared FaultPoint — a dead injection point (wired
+        out by a refactor, never exercised) fails here, not in prod."""
+        from modal_examples_tpu.faults import ALL_FAULT_POINTS
+
+        assert chaos_report["points_missed"] == []
+        assert set(chaos_report["points_fired"]) == set(ALL_FAULT_POINTS)
+        assert chaos_report["injected_total"] >= len(ALL_FAULT_POINTS)
+
+    def test_zero_wedged_requests(self, chaos_report):
+        assert chaos_report["wedged"] == 0
+
+    def test_all_invariants_hold_after_every_episode(self, chaos_report):
+        assert chaos_report["invariants"] == "ok"
+        for ep in chaos_report["episodes"]:
+            assert ep["invariants"] == "ok", ep
+
+    def test_request_conservation_per_episode(self, chaos_report):
+        """admitted == finished + shed, per episode: nothing vanishes —
+        aborted and deadline-expired requests still FINISH."""
+        for ep in chaos_report["episodes"]:
+            finished = sum(ep["finished"].values())
+            assert finished + ep["shed"] > 0, ep
+            assert ep["wedged"] == 0, ep
+
+    def test_faults_recovered_not_just_survived(self, chaos_report):
+        """Most injected faults must end in RECOVERY (requests finishing
+        normally despite the fault), not merely honest failure."""
+        assert chaos_report["recovered"] >= len(chaos_report["episodes"])
+
+    def test_router_readmission_happened(self, chaos_report):
+        """The flap episode must exercise the re-probe re-admission path
+        (the PR's one-way-door bugfix), observable in the metric."""
+        from modal_examples_tpu.observability import catalog as C
+        from modal_examples_tpu.utils.prometheus import default_registry
+
+        assert default_registry.total(C.ROUTER_READMISSIONS_TOTAL) >= 1
+
+    def test_injected_metric_covers_every_point(self, chaos_report):
+        from modal_examples_tpu.observability import catalog as C
+        from modal_examples_tpu.utils.prometheus import default_registry
+
+        counted = {
+            labels.get("point"): v
+            for labels, v in default_registry.series(C.FAULTS_INJECTED_TOTAL)
+        }
+        for point, n in chaos_report["injected"].items():
+            assert counted.get(point, 0) >= n, (point, counted)
+
+    def test_episode_journal_written(self, chaos_report, state_dir):
+        """Every episode appends one JSON record to <state_dir>/chaos.jsonl
+        — the `tpurun chaos` / gateway `/chaos` data source."""
+        path = state_dir / "chaos.jsonl"
+        assert path.exists()
+        records = [
+            json.loads(line)
+            for line in path.read_text().splitlines()
+            if line.strip()
+        ]
+        episodes = {r.get("episode") for r in records}
+        for ep in chaos_report["episodes"]:
+            assert ep["episode"] in episodes
+        for rec in records:
+            assert "injected" in rec and "invariants" in rec
+
+    def test_chaos_cli_renders_the_journal(self, chaos_report, capsys):
+        """`tpurun chaos` renders the last episodes without error."""
+        from modal_examples_tpu.core.cli import main
+
+        assert main(["chaos", "--last", "20"]) == 0
+        out = capsys.readouterr().out
+        assert "FAULT POINT" in out or "EPISODE" in out
+        assert "VIOLATED" not in out
+
+    def test_gateway_chaos_snapshot_shape(self, chaos_report):
+        from modal_examples_tpu.web.gateway import _chaos_snapshot
+
+        snap = _chaos_snapshot()
+        assert snap["injected_total"] >= chaos_report["injected_total"]
+        assert snap["episodes"], "journal episodes must surface"
+        assert snap["wedged"] == 0

@@ -1,0 +1,431 @@
+"""Fault-injection unit tier (modal_examples_tpu/faults, docs/faults.md):
+FaultPlan determinism, the zero-cost gate, seeded retry jitter, transport
+fault points with resumable recovery, engine crash-fail-loudly + revive,
+and the chaos invariant checkers against hand-built violating states.
+(The end-to-end episode schedule lives in tests/test_chaos.py.)"""
+
+import time
+from types import SimpleNamespace
+
+import pytest
+
+from modal_examples_tpu.faults import inject as fi
+
+
+@pytest.fixture(scope="module")
+def jax(jax_cpu):
+    return jax_cpu
+
+
+@pytest.fixture(autouse=True)
+def _no_leaked_plan():
+    """Every test must leave the gate disarmed — a leaked plan would inject
+    faults into unrelated tests."""
+    yield
+    assert fi.active_plan() is None, "a test leaked an active FaultPlan"
+    fi.deactivate()
+
+
+class TestFaultPlan:
+    def test_unknown_point_rejected(self):
+        with pytest.raises(ValueError, match="unknown fault points"):
+            fi.FaultPlan({"engine.made_up": {"on_hit": 1}})
+
+    def test_spec_needs_a_rule(self):
+        with pytest.raises(ValueError, match="on_hit"):
+            fi.FaultPlan({"engine.slow_decode": {}})
+
+    def test_on_hit_fires_exactly_the_named_hits(self):
+        plan = fi.FaultPlan(
+            {"disagg.chunk_drop": {"on_hit": [2, 4]}}, seed=3
+        )
+        decisions = [plan.should_fire("disagg.chunk_drop") for _ in range(6)]
+        assert decisions == [False, True, False, True, False, False]
+        assert plan.hits() == {"disagg.chunk_drop": 6}
+        assert plan.fired() == {"disagg.chunk_drop": 2}
+
+    def test_probability_mode_is_seed_deterministic(self):
+        def run(seed):
+            plan = fi.FaultPlan(
+                {"engine.slow_decode": {"p": 0.5}}, seed=seed
+            )
+            return [plan.should_fire("engine.slow_decode") for _ in range(64)]
+
+        assert run(7) == run(7)  # same seed: identical decision sequence
+        assert run(7) != run(8)  # different seed: different sequence
+        assert any(run(7)) and not all(run(7))
+
+    def test_max_fires_caps_probability_mode(self):
+        plan = fi.FaultPlan(
+            {"engine.slow_decode": {"p": 1.0, "max_fires": 2}}, seed=0
+        )
+        fired = sum(plan.should_fire("engine.slow_decode") for _ in range(10))
+        assert fired == 2
+
+    def test_hits_recorded_for_points_outside_the_spec(self):
+        """Reachability record: a plan counts every declared point it sees,
+        even ones it never fires — chaos uses this to prove coverage."""
+        plan = fi.FaultPlan({"disagg.chunk_drop": {"on_hit": 99}})
+        assert not plan.should_fire("router.health_flap")
+        assert plan.hits() == {"router.health_flap": 1}
+        assert plan.fired() == {}
+
+
+class TestGate:
+    def test_disabled_gate_is_a_no_op(self):
+        """With no active plan: fire() is False for every declared point
+        and nothing is recorded — no metric, no counter, no allocation the
+        registry could observe."""
+        from modal_examples_tpu.observability import catalog as C
+        from modal_examples_tpu.utils.prometheus import default_registry
+
+        before = default_registry.total(C.FAULTS_INJECTED_TOTAL)
+        for point in sorted(fi.ALL_FAULT_POINTS):
+            assert fi.fire(point) is False
+        assert default_registry.total(C.FAULTS_INJECTED_TOTAL) == before
+
+    def test_fired_fault_records_the_metric(self):
+        from modal_examples_tpu.observability import catalog as C
+        from modal_examples_tpu.utils.prometheus import default_registry
+
+        before = default_registry.value(
+            C.FAULTS_INJECTED_TOTAL, {"point": "engine.slow_decode"}
+        )
+        with fi.active(fi.FaultPlan({"engine.slow_decode": {"on_hit": 1}})):
+            assert fi.fire("engine.slow_decode") is True
+        assert default_registry.value(
+            C.FAULTS_INJECTED_TOTAL, {"point": "engine.slow_decode"}
+        ) == (before or 0) + 1
+
+    def test_active_context_disarms_on_exception(self):
+        with pytest.raises(RuntimeError):
+            with fi.active(fi.FaultPlan({})):
+                raise RuntimeError("boom")
+        assert fi.active_plan() is None
+
+    def test_check_raises_requested_exception(self):
+        with fi.active(
+            fi.FaultPlan({"disagg.replica_death": {"on_hit": 1}})
+        ):
+            with pytest.raises(ConnectionError, match="injected"):
+                fi.check(
+                    "disagg.replica_death", ConnectionError, "injected death"
+                )
+
+    def test_corrupt_flips_a_byte_only_when_fired(self):
+        data = b"hello world"
+        assert fi.corrupt("tiered.volume_corrupt", data) == data  # disarmed
+        with fi.active(
+            fi.FaultPlan({"tiered.volume_corrupt": {"on_hit": 1}})
+        ):
+            bad = fi.corrupt("tiered.volume_corrupt", data)
+            assert bad != data and len(bad) == len(data)
+            assert fi.corrupt("tiered.volume_corrupt", data) == data
+            assert fi.corrupt("tiered.volume_corrupt", b"") == b""
+
+    def test_env_activation(self, monkeypatch):
+        monkeypatch.setenv(
+            "MTPU_FAULT_PLAN", '{"engine.slow_decode": {"on_hit": 1}}'
+        )
+        monkeypatch.setenv("MTPU_FAULT_SEED", "11")
+        try:
+            fi._activate_from_env()
+            plan = fi.active_plan()
+            assert plan is not None and plan.seed == 11
+            assert fi.fire("engine.slow_decode") is True
+        finally:
+            fi.deactivate()
+
+
+class TestRetryJitter:
+    def test_bare_schedule_unchanged_without_key(self):
+        from modal_examples_tpu.core.retries import Retries
+
+        r = Retries(max_retries=5, initial_delay=1.0, backoff_coefficient=2.0)
+        assert r.delay_for_attempt(1) == 1.0
+        assert r.delay_for_attempt(3) == 4.0
+
+    def test_keyed_delay_is_bounded_deterministic_and_decorrelated(self):
+        from modal_examples_tpu.core.retries import Retries
+
+        r = Retries(initial_delay=1.0, jitter=0.5)
+        d = r.delay_for_attempt(3, key="in-abc")
+        assert 2.0 <= d <= 4.0  # equal jitter: [d*(1-j), d]
+        assert d == r.delay_for_attempt(3, key="in-abc")  # reproducible
+        others = {
+            r.delay_for_attempt(3, key=f"in-{i}") for i in range(8)
+        }
+        assert len(others) > 1, "keys must decorrelate the schedule"
+
+    def test_zero_jitter_is_exact_even_with_key(self):
+        from modal_examples_tpu.core.retries import Retries
+
+        r = Retries(initial_delay=2.0, jitter=0.0)
+        assert r.delay_for_attempt(2, key="x") == 4.0
+
+    def test_invalid_jitter_rejected(self):
+        from modal_examples_tpu.core.retries import Retries
+
+        with pytest.raises(ValueError, match="jitter"):
+            Retries(jitter=1.5)
+
+
+class TestTransportFaults:
+    def _roundtrip(self, payload=b"z" * 4000, **kw):
+        from modal_examples_tpu.serving.disagg.transport import (
+            LoopbackChannel,
+            transfer,
+        )
+
+        kw.setdefault("backoff", None)
+        return transfer(
+            payload, LoopbackChannel(), transfer_id="tf", chunk_bytes=512,
+            **kw,
+        )
+
+    def test_injected_chunk_corruption_recovers_by_resend(self):
+        from modal_examples_tpu.observability import catalog as C
+        from modal_examples_tpu.utils.prometheus import default_registry
+
+        payload = bytes(range(256)) * 20
+        before = default_registry.total(C.DISAGG_CHUNK_RETRIES_TOTAL)
+        with fi.active(
+            fi.FaultPlan({"disagg.chunk_corrupt": {"on_hit": 2}})
+        ) as plan:
+            assert self._roundtrip(payload) == payload
+            assert plan.fired() == {"disagg.chunk_corrupt": 1}
+        assert default_registry.total(C.DISAGG_CHUNK_RETRIES_TOTAL) > before
+
+    def test_injected_chunk_drop_recovers_by_resend(self):
+        payload = b"q" * 3000
+        with fi.active(
+            fi.FaultPlan({"disagg.chunk_drop": {"on_hit": 1}})
+        ) as plan:
+            assert self._roundtrip(payload) == payload
+            assert plan.fired() == {"disagg.chunk_drop": 1}
+
+    def test_injected_replica_death_is_a_connection_error(self):
+        with fi.active(
+            fi.FaultPlan({"disagg.replica_death": {"on_hit": 3}})
+        ):
+            with pytest.raises(ConnectionError, match="mid-transfer"):
+                self._roundtrip()
+
+    def test_retry_rounds_back_off_with_jitter(self, monkeypatch):
+        """A corrupted first round forces a retry round; the wait between
+        rounds is the jittered policy delay, keyed by transfer id."""
+        from modal_examples_tpu.core.retries import Retries
+        from modal_examples_tpu.serving.disagg import transport
+
+        slept = []
+        monkeypatch.setattr(
+            transport.time, "sleep", lambda s: slept.append(s)
+        )
+        backoff = Retries(initial_delay=0.4, jitter=0.5)
+        with fi.active(
+            fi.FaultPlan({"disagg.chunk_corrupt": {"on_hit": 1}})
+        ):
+            out = self._roundtrip(b"y" * 2000, backoff=backoff)
+        assert out == b"y" * 2000
+        assert len(slept) == 1
+        assert 0.2 <= slept[0] <= 0.4  # jittered into [d/2, d]
+        assert slept[0] == backoff.delay_for_attempt(1, key="tf")
+
+
+def _tiny_engine(jax, **kw):
+    from modal_examples_tpu.models import llama
+    from modal_examples_tpu.serving import LLMEngine
+
+    kw.setdefault("max_slots", 2)
+    kw.setdefault("max_model_len", 64)
+    kw.setdefault("page_size", 8)
+    kw.setdefault("prefill_buckets", (32,))
+    return LLMEngine(llama.LlamaConfig.tiny(), seed=0, **kw)
+
+
+class TestEngineFaults:
+    def test_scheduler_crash_fails_inflight_loudly_and_loop_survives(self, jax):
+        """The hardening the harness forced: an injected scheduler-thread
+        crash terminates every caller's stream with finish_reason="error"
+        (no wedge), does NOT poison the engine (strict mode is for real
+        bugs), leaves the _error_reports sentinel untouched, and the very
+        next request decodes normally."""
+        from modal_examples_tpu.serving import SamplingParams
+        from modal_examples_tpu.serving.engine import LLMEngine
+
+        eng = _tiny_engine(jax)
+        reports_before = len(LLMEngine._error_reports)
+        errors_before = eng.error_count
+        try:
+            eng.start()
+            ref = eng.generate("warm the compiles", SamplingParams(max_tokens=4, temperature=0.0))
+            req = eng.submit(
+                "a long request to crash", SamplingParams(max_tokens=48, temperature=0.0)
+            )
+            # wait until it is genuinely in flight (first token emitted)
+            deadline = time.monotonic() + 60
+            while not req.out_queue.qsize() and time.monotonic() < deadline:
+                time.sleep(0.005)
+            with fi.active(
+                fi.FaultPlan({"engine.scheduler_crash": {"on_hit": 1}})
+            ):
+                out = "".join(eng.stream(req))
+            assert req.finish_reason == "error"
+            assert out is not None  # partial output is fine; wedging is not
+            assert eng._running and not eng._stopped_on_error
+            assert eng.error_count == errors_before
+            assert len(LLMEngine._error_reports) == reports_before, (
+                "injected crashes must not trip the session error sentinel"
+            )
+            # the fleet invariant: the engine keeps serving afterwards
+            again = eng.generate(
+                "warm the compiles", SamplingParams(max_tokens=4, temperature=0.0)
+            )
+            assert again == ref
+        finally:
+            eng.stop()
+
+    def test_out_of_pages_pressure_requeues_and_completes(self, jax):
+        from modal_examples_tpu.serving import SamplingParams
+
+        eng = _tiny_engine(jax)
+        try:
+            params = SamplingParams(max_tokens=6, temperature=0.0)
+            ref = eng.generate("pressure test prompt", params)
+            with fi.active(
+                fi.FaultPlan({"engine.out_of_pages": {"on_hit": 1}})
+            ) as plan:
+                out = eng.generate("pressure test prompt", params)
+                assert plan.fired() == {"engine.out_of_pages": 1}
+            assert out == ref  # requeued, then admitted and served normally
+            assert eng.error_count == 0
+        finally:
+            eng.stop()
+
+    def test_revive_reopens_a_stopped_on_error_engine(self, jax):
+        """EngineReplica.probe() heals the one-way door: a stopped-on-error
+        engine refuses start() until revive() clears the poison."""
+        from modal_examples_tpu.scheduling import EngineReplica
+        from modal_examples_tpu.serving import SamplingParams
+
+        eng = _tiny_engine(jax)
+        replica = EngineReplica(eng, "r0")
+        try:
+            # the poisoned state a strict-mode scheduler error leaves behind
+            eng._stopped_on_error = True
+            assert not replica.healthy()
+            with pytest.raises(RuntimeError, match="stopped after"):
+                eng.start()
+            assert replica.probe() is True  # revive + restart
+            assert replica.healthy() and eng._running
+            assert eng.generate("back from the dead", SamplingParams(max_tokens=4, temperature=0.0))
+        finally:
+            eng.stop()
+
+    def test_probe_never_starts_a_prefill_replica(self, jax):
+        from modal_examples_tpu.scheduling import EngineReplica
+
+        eng = _tiny_engine(jax)
+        replica = EngineReplica(eng, "p0", role="prefill")
+        eng._stopped_on_error = True
+        assert replica.probe() is False  # health only: no revive, no start
+        assert not eng._running and eng._stopped_on_error
+
+
+class _FakeAllocator(SimpleNamespace):
+    pass
+
+
+def _fake_engine(*, depth=0, busy_slots=0, reserved=0, used=0, cached=0,
+                 n_pages=9):
+    return SimpleNamespace(
+        policy=SimpleNamespace(total_depth=lambda: depth),
+        slots=(
+            [SimpleNamespace(free=False)] * busy_slots
+            + [SimpleNamespace(free=True)] * (2 - min(busy_slots, 2))
+        ),
+        admission=SimpleNamespace(reserved_pages=reserved),
+        cache=SimpleNamespace(
+            n_pages=n_pages,
+            allocator=SimpleNamespace(available=(n_pages - 1) - used),
+        ),
+        prefix_cache=(
+            SimpleNamespace(cached_pages=cached) if cached or used else None
+        ),
+    )
+
+
+class TestInvariantCheckers:
+    """The chaos invariants against hand-built VIOLATING states — the
+    checkers must actually detect what they claim to (a checker that
+    returns [] for garbage would make every chaos run 'pass')."""
+
+    def test_terminal_detects_wedge_and_missing_reason(self):
+        from modal_examples_tpu.faults.chaos import check_terminal
+
+        ok = {"id": "a", "finish_reason": "stop", "wedged": False}
+        wedged = {"id": "b", "finish_reason": None, "wedged": True}
+        missing = {"id": "c", "finish_reason": "", "wedged": False}
+        assert check_terminal([ok]) == []
+        out = check_terminal([ok, wedged, missing])
+        assert len(out) == 2
+        assert any("wedged" in v for v in out)
+
+    def test_conservation_detects_vanished_requests(self):
+        from modal_examples_tpu.faults.chaos import check_conservation
+
+        assert check_conservation(5, 4, 1) == []
+        out = check_conservation(5, 3, 1)
+        assert out and "conservation" in out[0]
+
+    def test_drained_detects_each_leak_class(self):
+        from modal_examples_tpu.faults.chaos import check_drained
+
+        assert check_drained({"ok": _fake_engine()}) == []
+        assert "queued" in check_drained(
+            {"e": _fake_engine(depth=2)}
+        )[0]
+        assert "slots" in check_drained(
+            {"e": _fake_engine(busy_slots=1)}
+        )[0]
+        assert "reserved" in check_drained(
+            {"e": _fake_engine(reserved=3)}
+        )[0]
+        # 2 pages allocated but only 1 accounted for by the prefix cache
+        assert "orphaned" in check_drained(
+            {"e": _fake_engine(used=2, cached=1)}
+        )[0]
+        # warmth is not a leak: used pages all prefix-cached
+        assert check_drained({"e": _fake_engine(used=2, cached=2)}) == []
+
+    def test_router_recovered_detects_stuck_down_replicas(self):
+        from modal_examples_tpu.faults.chaos import check_router_recovered
+
+        def fake_router(down, healthy=True):
+            return SimpleNamespace(
+                stats=lambda: {
+                    "replicas": {
+                        "r0": {"down": down, "healthy": healthy}
+                    }
+                }
+            )
+
+        assert check_router_recovered(fake_router(False)) == []
+        assert check_router_recovered(fake_router(True))
+        assert check_router_recovered(fake_router(False, healthy=False))
+
+    def test_token_identity_detects_divergence_and_exempts_aborts(self):
+        from modal_examples_tpu.faults.chaos import check_token_identity
+
+        ref = {"p": "hello world"}
+        good = {"id": "a", "prompt": "p", "output": "hello world",
+                "finish_reason": "stop"}
+        diverged = {"id": "b", "prompt": "p", "output": "hello wyrld",
+                    "finish_reason": "stop"}
+        errored = {"id": "c", "prompt": "p", "output": "hel",
+                   "finish_reason": "error"}
+        aborted = {"id": "d", "prompt": "p", "output": "",
+                   "finish_reason": "stop", "aborted": True}
+        assert check_token_identity([good, errored, aborted], ref) == []
+        out = check_token_identity([diverged], ref)
+        assert out and "diverged" in out[0]

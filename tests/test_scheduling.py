@@ -465,6 +465,112 @@ class TestRouterUnit:
         with pytest.raises(RuntimeError, match="no healthy replicas"):
             router.route(prompt)
 
+    def test_flap_evict_readmit_cycle(self):
+        """Regression (round 8): unhealthy used to be a one-way door — a
+        replica filtered out of route() never returned. The fake-clock
+        cycle: flap -> evicted -> down (cheap healthy() recheck only, no
+        expensive probe before reprobe_s) -> healthy() flips back true ->
+        re-admitted IMMEDIATELY, counted in
+        mtpu_router_readmissions_total."""
+        from modal_examples_tpu.observability import catalog as C
+        from modal_examples_tpu.scheduling import PrefixAffinityRouter
+        from modal_examples_tpu.utils.prometheus import default_registry
+
+        clock = FakeClock()
+        a, b = _FakeReplica("a"), _FakeReplica("b")
+        router = PrefixAffinityRouter(
+            [a, b], prefix_tokens=8, reprobe_s=5.0, clock=clock
+        )
+        prompt = "the flapping conversation"
+        preferred = router.route(prompt)
+        other = b if preferred is a else a
+        readmit_before = default_registry.value(C.ROUTER_READMISSIONS_TOTAL)
+
+        # flap: one unhealthy observation evicts the replica
+        preferred._healthy = False
+        assert router.route(prompt) is other
+        assert router.stats()["replicas"][preferred.name]["down"]
+
+        # while down and still unhealthy: only the cheap health recheck
+        # runs — the expensive probe() waits for reprobe_s
+        probed = {"n": 0}
+
+        def probe():
+            probed["n"] += 1
+            return preferred._healthy
+
+        preferred.probe = probe
+        clock.advance(1.0)  # before probe time
+        assert router.route(prompt) is other
+        assert probed["n"] == 0, "probe() must wait for reprobe_s"
+
+        # healthy() flips back true -> immediate re-admission, NO probe
+        # wait (the docs/scheduling.md contract), affinity restored
+        preferred._healthy = True
+        assert router.route(prompt) is preferred
+        assert probed["n"] == 0
+        assert not router.stats()["replicas"][preferred.name]["down"]
+        assert router.readmissions >= 1
+        assert default_registry.value(
+            C.ROUTER_READMISSIONS_TOTAL
+        ) >= (readmit_before or 0) + 1
+
+    def test_failed_probe_pushes_next_probe_out(self):
+        from modal_examples_tpu.scheduling import PrefixAffinityRouter
+
+        clock = FakeClock()
+        a, b = _FakeReplica("a"), _FakeReplica("b")
+        router = PrefixAffinityRouter(
+            [a, b], prefix_tokens=8, reprobe_s=5.0, clock=clock
+        )
+        prompt = "still down after the probe"
+        preferred = router.route(prompt)
+        other = b if preferred is a else a
+        probed = {"n": 0}
+
+        def probe():
+            probed["n"] += 1
+            return preferred._healthy  # probe can't heal this one
+
+        preferred.probe = probe
+        preferred._healthy = False
+        assert router.route(prompt) is other  # evicted
+        clock.advance(6.0)
+        assert router.route(prompt) is other  # probe ran, still unhealthy
+        assert probed["n"] == 1
+        clock.advance(1.0)
+        assert router.route(prompt) is other  # next probe 5s out again
+        assert probed["n"] == 1
+        clock.advance(6.0)
+        assert router.route(prompt) is other  # second probe, still down
+        assert probed["n"] == 2
+
+    def test_probe_method_preferred_over_healthy(self):
+        """A replica exposing probe() (EngineReplica revives its engine
+        there) is probed through it, not bare healthy()."""
+        from modal_examples_tpu.scheduling import PrefixAffinityRouter
+
+        clock = FakeClock()
+        a, b = _FakeReplica("a"), _FakeReplica("b")
+        probed = {"n": 0}
+
+        def probe():
+            probed["n"] += 1
+            a._healthy = True  # the probe HEALS (revive + restart)
+            return True
+
+        a.probe = probe
+        b.probe = lambda: b._healthy
+        router = PrefixAffinityRouter(
+            [a, b], prefix_tokens=8, reprobe_s=5.0, clock=clock
+        )
+        a._healthy = False
+        router.route("x")  # evicts a
+        clock.advance(6.0)
+        router.route("x")
+        assert probed["n"] == 1 and a._healthy
+        assert not router.stats()["replicas"]["a"]["down"]
+
 
 class TestRouterWithEngines:
     def test_two_replica_affinity_and_divert(self, jax):

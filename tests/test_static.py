@@ -479,6 +479,139 @@ def test_serving_path_reaches_pallas_only_through_sharded_dispatch():
     )
 
 
+#: the fault-injection gate's call-site convention: modules import
+#: ``from ..faults import inject as _inject`` and call these entry points
+#: with a string-literal point name (docs/faults.md)
+_FAULT_GATE_FUNCS = {"fire", "check", "corrupt"}
+
+
+def _fault_call_sites() -> dict[str, list[str]]:
+    """point name -> ["path:line", ...] for every ``_inject.<gate>("…")``
+    call in the package (the catalog's production call sites)."""
+    sites: dict[str, list[str]] = {}
+    inject_path = PKG_ROOT / "faults" / "inject.py"
+    for path in sorted(PKG_ROOT.rglob("*.py")):
+        if path == inject_path:
+            continue
+        tree = ast.parse(path.read_text())
+        for node in ast.walk(tree):
+            if not (
+                isinstance(node, ast.Call)
+                and isinstance(node.func, ast.Attribute)
+                and node.func.attr in _FAULT_GATE_FUNCS
+                and isinstance(node.func.value, ast.Name)
+                and node.func.value.id == "_inject"
+                and node.args
+            ):
+                continue
+            point = _const_str(node.args[0])
+            where = f"{path.relative_to(REPO_ROOT)}:{node.lineno}"
+            sites.setdefault(point or f"<non-literal @ {where}>", []).append(
+                where
+            )
+    return sites
+
+
+def test_fault_points_all_declared_and_all_wired():
+    """Both directions of the fault catalog closure (docs/faults.md):
+    (a) every ``_inject.fire/check/corrupt("…")`` call site in the package
+    names a point declared in ``faults.inject.POINTS`` (no stringly-typed
+    drift, no phantom points), and (b) every declared point has at least
+    one live production call site — a dead injection point (wired out by a
+    refactor but still cataloged) fails here instead of rotting. The
+    dynamic half — the default chaos schedule actually FIRES every point —
+    is tests/test_chaos.py."""
+    from modal_examples_tpu.faults.inject import ALL_FAULT_POINTS
+
+    sites = _fault_call_sites()
+    non_literal = [k for k in sites if k.startswith("<non-literal")]
+    assert not non_literal, (
+        f"fault gate called with a non-literal point name: {non_literal}"
+    )
+    undeclared = {
+        point: where
+        for point, where in sites.items()
+        if point not in ALL_FAULT_POINTS
+    }
+    assert not undeclared, (
+        "fault points used but not declared in faults/inject.py POINTS: "
+        f"{undeclared}"
+    )
+    unwired = sorted(ALL_FAULT_POINTS - set(sites))
+    assert not unwired, (
+        "fault points declared in faults/inject.py POINTS but never wired "
+        f"into production code: {unwired}"
+    )
+    # the guard must actually be guarding the full catalog surface
+    assert len(sites) >= 10, sites
+
+
+def test_production_code_never_imports_the_chaos_driver():
+    """Layering: production modules may import ``faults.inject`` (the
+    zero-cost gate) but NEVER ``faults.chaos`` (the driver that builds
+    fleets and injects failure on purpose) — a production import would put
+    chaos machinery on the serving path. Tests, bench.py, and the CLI read
+    the chaos journal/metrics instead of importing the driver."""
+    offenders = []
+    chaos_path = PKG_ROOT / "faults" / "chaos.py"
+    for path in sorted(PKG_ROOT.rglob("*.py")):
+        if path == chaos_path:
+            continue
+        tree = ast.parse(path.read_text())
+        for node in ast.walk(tree):
+            names = []
+            if isinstance(node, ast.Import):
+                names = [a.name for a in node.names]
+            elif isinstance(node, ast.ImportFrom):
+                mod = node.module or ""
+                if mod.endswith("chaos"):
+                    names = [mod]
+                elif mod.endswith("faults") or mod == "":
+                    names = [
+                        a.name for a in node.names if a.name == "chaos"
+                    ]
+            if any("chaos" in n for n in names):
+                offenders.append(
+                    f"{path.relative_to(REPO_ROOT)}:{node.lineno}"
+                )
+    assert not offenders, (
+        f"production modules importing faults.chaos: {offenders}"
+    )
+
+
+def test_disabled_fault_gate_is_structurally_a_no_op():
+    """The gate's zero-cost contract, pinned at the AST level: ``fire``'s
+    FIRST statement must be the ``_active_plan is None -> return False``
+    fast path — nothing (no counter, no metric, no dict touch) may run
+    before it. The behavioral half lives in tests/test_faults.py."""
+    inject_src = (PKG_ROOT / "faults" / "inject.py").read_text()
+    tree = ast.parse(inject_src)
+    fire = next(
+        n for n in tree.body
+        if isinstance(n, ast.FunctionDef) and n.name == "fire"
+    )
+    body = [n for n in fire.body if not (
+        isinstance(n, ast.Expr) and isinstance(n.value, ast.Constant)
+    )]  # skip the docstring
+    first = body[0]
+    assert isinstance(first, ast.If), "fire() must open with the None guard"
+    test = first.test
+    assert (
+        isinstance(test, ast.Compare)
+        and isinstance(test.left, ast.Name)
+        and "plan" in test.left.id
+        and isinstance(test.ops[0], ast.Is)
+        and isinstance(test.comparators[0], ast.Constant)
+        and test.comparators[0].value is None
+    ), "fire() must test `<plan global> is None` first"
+    ret = first.body[0]
+    assert (
+        isinstance(ret, ast.Return)
+        and isinstance(ret.value, ast.Constant)
+        and ret.value.value is False
+    ), "the disabled path must immediately `return False`"
+
+
 def test_no_bare_print_in_framework_code():
     """Framework code under ``core/`` and ``serving/`` must not ``print()``:
     diagnostics go through ``utils.log.get_logger`` so they carry a level
