@@ -151,6 +151,12 @@ CONFIGS = {
 
 def _child(model: str) -> None:
     spec = CONFIGS[model]
+    # measured runs keep the distributed request tracer sampled OUT
+    # (observability/reqtrace.py): the headline tok/s must not pay
+    # per-request span file writes. Override with MTPU_TRACE_SAMPLE=1 to
+    # bench-with-tracing deliberately; `tpurun benchdiff` then shows what
+    # the instrumentation costs.
+    os.environ.setdefault("MTPU_TRACE_SAMPLE", "0")
     if spec.get("tp", 1) > 1 and os.environ.get("BENCH_CPU"):
         # CPU TP path-proof needs virtual devices BEFORE jax imports
         flags = os.environ.get("XLA_FLAGS", "")

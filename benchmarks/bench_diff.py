@@ -1,0 +1,21 @@
+#!/usr/bin/env python
+"""Compare two BENCH_r*.json files section-by-section and exit nonzero
+past a regression threshold (ROADMAP #1's revalidation companion):
+
+    python benchmarks/bench_diff.py BENCH_r03.json BENCH_r06.json
+    python benchmarks/bench_diff.py old.json new.json --threshold 5
+
+`tpurun benchdiff` is the installed entry point; the logic lives in
+modal_examples_tpu/utils/bench_diff.py (jax-free) so both share one
+implementation.
+"""
+
+import pathlib
+import sys
+
+sys.path.insert(0, str(pathlib.Path(__file__).resolve().parent.parent))
+
+from modal_examples_tpu.utils.bench_diff import run_diff  # noqa: E402
+
+if __name__ == "__main__":
+    raise SystemExit(run_diff(sys.argv[1:]))

@@ -14,7 +14,9 @@ Usage:
     tpurun secret create NAME K=V ...
     tpurun app list
     tpurun snapshot [list | inspect KEY | clear [KEY]]   # memory-snapshot store
-    tpurun trace [CALL_ID [--perfetto] | list [--limit N]]  # call traces
+    tpurun trace [ID [--perfetto] | list [--limit N]]  # call/request traces
+    tpurun explain REQUEST_ID          # request lifecycle narrative (either id kind)
+    tpurun benchdiff OLD NEW [--threshold PCT]  # BENCH json regression diff
     tpurun metrics [--json]            # merged pushed prometheus expositions
     tpurun scaler [N] [--function TAG] # autoscaler decision journal
     tpurun sched [--watch S]           # live class queues, shed rates, router
@@ -347,21 +349,31 @@ def cmd_snapshot(argv: list[str]) -> int:
 
 
 def cmd_trace(argv: list[str]) -> int:
-    """Render one call's lifecycle trace as an indented span tree.
+    """Render one trace as an indented span tree — either id namespace:
+    executor calls (``in-...``, ``FunctionCall.call_id``) and serving
+    requests (``req-...``, ``x-mtpu-request-id``) live in the same store,
+    and a unique id PREFIX resolves too.
 
-    trace CALL_ID      — the spans of one call (CALL_ID is the ``in-...`` id
-                         from ``FunctionCall.call_id`` / ``tpurun trace list``)
-    trace CALL_ID --perfetto [-o FILE]
+    trace ID           — the spans of one call/request
+    trace ID --perfetto [-o FILE]
                        — emit the trace as Chrome-trace/Perfetto JSON
-                         (loads in chrome://tracing and ui.perfetto.dev)
+                         (loads in chrome://tracing and ui.perfetto.dev;
+                         request traces get one track per replica)
     trace list [--limit N]
                        — most recently active traces, newest first
-    ``--dir PATH`` overrides the trace root (default ``<state_dir>/traces``).
+    ``--dir PATH`` overrides the trace root (default ``<state_dir>/traces``;
+    ``os.pathsep``-separated roots merge per-replica stores, like explain).
     """
+    from ..observability import reqtrace as _reqtrace
     from ..observability.trace import TraceStore
 
     argv, root = _pop_dir_flag(argv, "usage: tpurun trace ... --dir PATH")
-    store = TraceStore(root=root)
+    stores = (
+        [TraceStore(root=p) for p in root.split(os.pathsep) if p]
+        if root
+        else [TraceStore()]
+    )
+    store = stores[0]
     if not argv or argv[0] == "list":
         rest, limit_s = _pop_flag(
             argv[1:], "--limit", "usage: tpurun trace list [--limit N]"
@@ -385,18 +397,24 @@ def cmd_trace(argv: list[str]) -> int:
                 f"{dur * 1000:>9.1f}ms  {status}  ({len(spans)} spans)"
             )
         return 0
-    trace_id = argv[0]
-    spans = store.read(trace_id)
+    # by-id: resolve either namespace (whitelisted token, no raw-path
+    # fallback) and MERGE the given stores — a per-replica-store fleet's
+    # request trace renders/exports complete, not one store's slice
+    trace_id = _reqtrace.resolve(argv[0], stores=stores)
+    spans = _reqtrace.read_trace(trace_id, stores=stores) if trace_id else []
     if not spans:
-        raise SystemExit(f"no trace {trace_id!r} in {store.root}")
+        raise SystemExit(f"no trace {argv[0]!r} in {store.root}")
     if "--perfetto" in argv:
-        from ..observability.export import export_chrome_trace
+        from ..observability.export import spans_to_chrome_trace
 
         argv, out_file = _pop_flag(
-            argv, "-o", "usage: tpurun trace CALL_ID --perfetto [-o FILE]"
+            argv, "-o", "usage: tpurun trace ID --perfetto [-o FILE]"
         )
-        doc = export_chrome_trace(trace_id, out_file, store=store)
+        doc = spans_to_chrome_trace(spans, trace_id)
         if out_file:
+            from pathlib import Path as _Path
+
+            _Path(out_file).write_text(json.dumps(doc, indent=1))
             print(
                 f"wrote {len(doc['traceEvents'])} events to {out_file} "
                 "(open in chrome://tracing or ui.perfetto.dev)"
@@ -435,6 +453,55 @@ def cmd_trace(argv: list[str]) -> int:
         if pid is not None and pid not in known:
             render(s, 0)
     return 0
+
+
+def cmd_explain(argv: list[str]) -> int:
+    """Merge one request's spans across trace stores and render the
+    lifecycle narrative (docs/observability.md):
+
+        $ tpurun explain req-4f2a...
+        request req-4f2a...: serving request trace — stop in 412.0ms ...
+          +   0.0ms  queued 12.1ms (class=interactive, replica dec-0)
+          +  12.3ms  placed: prefill=pre-0 decode=dec-0
+          +  13.0ms  prefill on pre-0 340.2ms (512 prompt tokens)
+          ...
+
+    Takes either id namespace — a serving request id (``req-…``, from the
+    ``x-mtpu-request-id`` response header) or an executor call id
+    (``in-…``) — full or unique prefix, and says which kind it found.
+    ``--dir`` accepts one or more store roots (``os.pathsep``-separated)
+    for merging per-replica trace dirs; default is ``<state_dir>/traces``.
+    """
+    from ..observability import reqtrace as _reqtrace
+    from ..observability.trace import TraceStore
+
+    usage = "usage: tpurun explain REQUEST_ID [--dir PATH[:PATH...]]"
+    argv, root = _pop_dir_flag(argv, usage)
+    if not argv:
+        raise SystemExit(usage)
+    stores = (
+        [TraceStore(root=p) for p in root.split(os.pathsep) if p]
+        if root
+        else None
+    )
+    rid = _reqtrace.resolve(argv[0], stores=stores)
+    if rid is None:
+        raise SystemExit(f"no trace matching {argv[0]!r}")
+    spans = _reqtrace.read_trace(rid, stores=stores)
+    for line in _reqtrace.explain_lines(spans, rid):
+        print(line)
+    return 0
+
+
+def cmd_benchdiff(argv: list[str]) -> int:
+    """Round-over-round bench regression diff: compare two BENCH json
+    files section-by-section (tok/s, ttft/tpot p95, migration p95,
+    shed_rate, per-config throughputs) and exit 1 past the threshold —
+    the automatic companion of a revalidation run (ROADMAP #1);
+    ``benchmarks/bench_diff.py`` is the same tool as a script."""
+    from ..utils.bench_diff import run_diff
+
+    return run_diff(argv)
 
 
 def cmd_metrics(argv: list[str]) -> int:
@@ -913,6 +980,8 @@ COMMANDS = {
     "app": cmd_app,
     "snapshot": cmd_snapshot,
     "trace": cmd_trace,
+    "explain": cmd_explain,
+    "benchdiff": cmd_benchdiff,
     "metrics": cmd_metrics,
     "scaler": cmd_scaler,
     "sched": cmd_sched,

@@ -250,8 +250,13 @@ def fire(point: str) -> bool:
     plan = _active_plan
     if plan is not None and plan.should_fire(point):
         from ..observability import metrics as _obs
+        from ..observability import reqtrace as _reqtrace
 
         _obs.record_fault_injected(point)
+        # a FIRED fault becomes a span event on the request whose
+        # operation this thread is running (no-op without an ambient
+        # frame) — the disabled gate above never reaches this branch
+        _reqtrace.note_fault(point)
         return True
     return False
 

@@ -7,6 +7,10 @@ jax-free):
   (enforced by ``tests/test_static.py``);
 - :mod:`.trace`   — span model, per-call JSONL trace files, cross-process
   context propagation (``tpurun trace <call_id>`` reads these);
+- :mod:`.reqtrace` — request-scoped DISTRIBUTED tracing over the serving
+  fleet: one trace id per request (== the request id) stitched across
+  gateway, scheduler queues, router, prefill/decode replicas, and the
+  disagg page-migration wire (``tpurun explain <request_id>``);
 - :mod:`.metrics` — recorder functions the executor/engine call to emit
   catalog series into the prometheus registry;
 - :mod:`.export`  — file-backed push gateway for ephemeral processes
@@ -61,6 +65,8 @@ from .metrics import (
     set_prefix_cache_pages,
     set_snapshot_store_size,
 )
+from . import reqtrace
+from .reqtrace import explain_lines, finish_request, start_request_trace
 from .slo import DEFAULT_SLOS, SLO, evaluate as evaluate_slos, healthz
 from .trace import (
     Span,
@@ -87,7 +93,9 @@ __all__ = [
     "default_journal",
     "default_store",
     "evaluate_slos",
+    "explain_lines",
     "export_chrome_trace",
+    "finish_request",
     "healthz",
     "live_and_pushed_metrics",
     "push_metrics_file",
@@ -107,8 +115,10 @@ __all__ = [
     "record_token_totals",
     "record_tpot",
     "record_ttft",
+    "reqtrace",
     "sample_host_rss",
     "set_context",
+    "start_request_trace",
     "set_engine_gauges",
     "set_inflight",
     "set_kv_occupancy",

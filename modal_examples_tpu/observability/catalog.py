@@ -437,6 +437,99 @@ CATALOG: dict[str, dict] = {
 #: every declared metric name (the static guard's allowlist)
 ALL_METRIC_NAMES = frozenset(CATALOG)
 
+
+# -- request-trace span schema (observability/reqtrace.py, docs/observability)
+#
+# The metric-catalog discipline applied to the distributed request tracer:
+# ONE table owns every span NAME the serving fleet may mint and the
+# ATTRIBUTE KEYS each span may carry. ``tests/test_static.py`` enforces the
+# closure in both directions (every reqtrace call site names a declared
+# span with declared attrs; every declared span has a live call site), so
+# the trace schema — what `tpurun explain` parses, what the Perfetto
+# export groups into tracks — cannot drift span-by-span the way metric
+# names used to.
+
+SPAN_CATALOG: dict[str, dict] = {
+    "request": {
+        "attrs": ["request_id", "priority", "tenant", "replica",
+                  "finish_reason", "n_generated", "ttft_s"],
+        "help": "root: one serving request end to end (trace id == request "
+                "id); finish_reason lands at close",
+    },
+    "queue": {
+        "attrs": ["priority", "tenant", "replica", "wait_s"],
+        "help": "admission queue residency on one replica (opened at "
+                "submit, closed when the scheduler pops the entry)",
+    },
+    "placement": {
+        "attrs": ["replica", "route", "prefill_replica", "decode_replica"],
+        "help": "router placement decision (route() or disagg plan())",
+    },
+    "prefill": {
+        "attrs": ["replica", "n_prompt", "bucket", "chunked"],
+        "help": "prompt KV fill on the owning replica (slot, chunked, or "
+                "slot-free disagg path)",
+    },
+    "decode": {
+        "attrs": ["replica", "spec_mode"],
+        "help": "first token to finish on the decoding replica",
+    },
+    "migrate": {
+        "attrs": ["replica", "source", "target", "pages", "wire_bytes",
+                  "result"],
+        "help": "one disagg page migration end to end "
+                "(result=ok|fallback|aborted)",
+    },
+    "transfer": {
+        "attrs": ["replica", "chunks", "rounds", "wire_bytes"],
+        "help": "chunked wire transfer of a serialized page block",
+    },
+    "chunk": {
+        "attrs": ["replica", "seq", "nbytes", "round"],
+        "help": "one wire chunk send (child of transfer)",
+    },
+    "adopt": {
+        "attrs": ["replica", "pages"],
+        "help": "migrated block scattered into the decode replica's cache "
+                "(on its scheduler thread)",
+    },
+    "spec_verify": {
+        "attrs": ["replica", "proposed", "accepted"],
+        "help": "one speculative verify tick's outcome for this request "
+                "(event)",
+    },
+    "fault": {
+        "attrs": ["replica", "point"],
+        "help": "an injected fault (faults/inject.py POINTS) fired on this "
+                "request's path (event)",
+    },
+    "retry_wait": {
+        "attrs": ["replica", "round", "pending", "delay_s"],
+        "help": "jittered backoff before a transfer chunk-retry round "
+                "(event)",
+    },
+    "shed": {
+        "attrs": ["replica", "reason"],
+        "help": "admission rejected the request (the 429 path; event)",
+    },
+    "tier_promote": {
+        "attrs": ["replica", "tier", "pages"],
+        "help": "prefix pages promoted from a lower cache tier during the "
+                "claim (event)",
+    },
+}
+
+#: every declared request-span name (the static guard's allowlist)
+ALL_SPAN_NAMES = frozenset(SPAN_CATALOG)
+
+#: span names the EXECUTOR call tracer mints (PR 2; core/executor.py +
+#: container worker) — a separate namespace from the request spans above
+#: (trace id ``in-…`` vs ``req-…``), listed so renderers/exporters can
+#: tell the two trace kinds apart
+CALL_SPAN_NAMES = frozenset(
+    {"call", "queue", "boot", "dispatch", "execute", "serialize", "retry"}
+)
+
 #: buckets for batch-size-style histograms (counts, not seconds)
 COUNT_BUCKETS = (1, 2, 4, 8, 16, 32, 64, 128)
 

@@ -120,16 +120,28 @@ _CONTAINER_SPAN_NAMES = ("execute", "serialize")
 
 
 def spans_to_chrome_trace(spans: list[dict], trace_id: str = "") -> dict:
-    """Convert one call's JSONL spans to Chrome-trace / Perfetto JSON.
+    """Convert one trace's JSONL spans to Chrome-trace / Perfetto JSON.
 
     Output is the Trace Event Format object (``{"traceEvents": [...]}``)
-    that loads directly in ``chrome://tracing`` and ui.perfetto.dev. Two
-    tracks: supervisor-side phases (queue/boot/dispatch/retry) on tid 1,
-    container-worker phases (execute/serialize + user spans) on tid 2 —
-    complete ("X") events nest by timestamp within a track, instantaneous
-    spans (retry markers) become instant ("i") events. Timestamps are
-    microseconds relative to the earliest span.
+    that loads directly in ``chrome://tracing`` and ui.perfetto.dev.
+    Complete ("X") events nest by timestamp within a track, instantaneous
+    spans (retry markers, fault events) become instant ("i") events.
+    Timestamps are microseconds relative to the earliest span.
+
+    Track assignment is REPLICA-AWARE and deterministic: request-scoped
+    spans (observability/reqtrace.py) carry a ``replica`` attribute, and
+    each distinct replica gets its own named track — tids assigned in
+    sorted replica order, so a merged FLEET trace (gateway + prefill
+    replica + decode replica stores) renders one track per replica
+    instead of interleaving every event onto one. Executor call traces
+    (no replica attrs) keep the legacy two-track layout: supervisor-side
+    phases (queue/boot/dispatch/retry) on tid 1, container-worker phases
+    (execute/serialize + user spans) on tid 2. Migrations additionally get
+    span LINKS: a flow arrow from the transfer (or prefill) span on the
+    source replica's track to the adopt span on the destination's.
     """
+    import zlib as _zlib
+
     if not spans:
         return {"traceEvents": [], "displayTimeUnit": "ms"}
     by_id = {s.get("span_id"): s for s in spans}
@@ -145,18 +157,51 @@ def spans_to_chrome_trace(spans: list[dict], trace_id: str = "") -> dict:
         return False
 
     t0 = min(s.get("start") or 0.0 for s in spans)
+    replicas = sorted(
+        {
+            (s.get("attrs") or {}).get("replica")
+            for s in spans
+            if (s.get("attrs") or {}).get("replica")
+        }
+    )
     events: list[dict] = [
         {"ph": "M", "pid": 1, "tid": 0, "name": "process_name",
-         "args": {"name": f"mtpu call {trace_id}".strip()}},
-        {"ph": "M", "pid": 1, "tid": 1, "name": "thread_name",
-         "args": {"name": "supervisor"}},
-        {"ph": "M", "pid": 1, "tid": 2, "name": "thread_name",
-         "args": {"name": "container"}},
+         "args": {"name": f"mtpu trace {trace_id}".strip()}},
     ]
+    if replicas:
+        # one track per replica, deterministic: sorted name order
+        tid_of_replica = {name: i + 1 for i, name in enumerate(replicas)}
+        other_tid = len(replicas) + 1
+        for name, tid in tid_of_replica.items():
+            events.append(
+                {"ph": "M", "pid": 1, "tid": tid, "name": "thread_name",
+                 "args": {"name": name}}
+            )
+
+        def tid_for(span: dict) -> int:
+            return tid_of_replica.get(
+                (span.get("attrs") or {}).get("replica"), other_tid
+            )
+
+        if any(tid_for(s) == other_tid for s in spans):
+            events.append(
+                {"ph": "M", "pid": 1, "tid": other_tid,
+                 "name": "thread_name", "args": {"name": "other"}}
+            )
+    else:
+        events += [
+            {"ph": "M", "pid": 1, "tid": 1, "name": "thread_name",
+             "args": {"name": "supervisor"}},
+            {"ph": "M", "pid": 1, "tid": 2, "name": "thread_name",
+             "args": {"name": "container"}},
+        ]
+
+        def tid_for(span: dict) -> int:
+            return 2 if is_container_side(span) else 1
+
     for s in sorted(spans, key=lambda s: s.get("start") or 0.0):
         start = s.get("start") or t0
         end = s.get("end")
-        tid = 2 if is_container_side(s) else 1
         args = dict(s.get("attrs") or {})
         args["span_id"] = s.get("span_id")
         if s.get("status") and s["status"] != "ok":
@@ -165,7 +210,7 @@ def spans_to_chrome_trace(spans: list[dict], trace_id: str = "") -> dict:
             "name": s.get("name", "?"),
             "cat": "mtpu",
             "pid": 1,
-            "tid": tid,
+            "tid": tid_for(s),
             "ts": round((start - t0) * 1e6, 3),
             "args": args,
         }
@@ -177,6 +222,41 @@ def spans_to_chrome_trace(spans: list[dict], trace_id: str = "") -> dict:
             ev["ph"] = "X"
             ev["dur"] = dur_us
         events.append(ev)
+
+    if replicas:
+        # span links for migrations: flow arrows source -> destination,
+        # binding the k-th transfer (falling back to the k-th prefill) to
+        # the k-th adopt — perfetto draws the cross-track arrow
+        def of_name(name):
+            return sorted(
+                (s for s in spans if s.get("name") == name),
+                key=lambda s: s.get("start") or 0.0,
+            )
+
+        transfers, prefills, adopts = (
+            of_name("transfer"), of_name("prefill"), of_name("adopt")
+        )
+        for k, adopt in enumerate(adopts):
+            src = (
+                transfers[k]
+                if k < len(transfers)
+                else (prefills[k] if k < len(prefills) else None)
+            )
+            if src is None:
+                continue
+            fid = _zlib.crc32(f"{trace_id}:migration:{k}".encode())
+            src_end = src.get("end") or src.get("start") or t0
+            events.append(
+                {"ph": "s", "id": fid, "pid": 1, "tid": tid_for(src),
+                 "ts": round((src_end - t0) * 1e6, 3), "name": "migration",
+                 "cat": "mtpu"}
+            )
+            events.append(
+                {"ph": "f", "bp": "e", "id": fid, "pid": 1,
+                 "tid": tid_for(adopt),
+                 "ts": round(((adopt.get("start") or t0) - t0) * 1e6, 3),
+                 "name": "migration", "cat": "mtpu"}
+            )
     return {
         "traceEvents": events,
         "displayTimeUnit": "ms",

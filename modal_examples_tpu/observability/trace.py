@@ -24,6 +24,7 @@ import contextvars
 import dataclasses
 import json
 import os
+import re
 import threading
 import time
 import uuid
@@ -155,6 +156,24 @@ class TraceStore:
             except json.JSONDecodeError:
                 continue  # torn tail line from a concurrent writer
         return spans
+
+    #: the only shape a trace id can have (both namespaces); resolve()
+    #: rejects anything else up front — the token reaches Path/glob, so a
+    #: separator or glob metachar must mean "no such trace", not a
+    #: traversal or an unhandled pattern error
+    _ID_TOKEN_RE = re.compile(r"^[A-Za-z0-9._-]+$")
+
+    def resolve(self, token: str) -> str | None:
+        """Resolve ``token`` to a stored trace id: exact match first, then
+        a UNIQUE prefix. Both id namespaces live in one store — executor
+        calls (``in-…``) and serving requests (``req-…``) — so ``tpurun
+        trace``/``explain`` take either kind, abbreviated."""
+        if not token or not self._ID_TOKEN_RE.match(token):
+            return None
+        if (self.root / f"{token}.jsonl").exists():
+            return token
+        matches = sorted(p.stem for p in self.root.glob(f"{token}*.jsonl"))
+        return matches[0] if len(matches) == 1 else None
 
     def list_traces(self, limit: int = 50) -> list[str]:
         files = sorted(

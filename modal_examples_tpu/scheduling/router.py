@@ -47,6 +47,7 @@ from collections import OrderedDict
 
 from ..faults import inject as _inject
 from ..observability import metrics as _obs
+from ..observability import reqtrace as _rt
 
 
 #: disaggregated-serving roles (docs/disagg.md): a ``prefill`` replica only
@@ -73,6 +74,11 @@ class EngineReplica:
         self.name = name
         self.role = role
         self.saturation_factor = float(saturation_factor)
+        # request-trace spans carry the FLEET name of the replica that
+        # recorded them (track assignment in the Perfetto export); adopt
+        # the engine unless something already named it
+        if getattr(engine, "trace_name", "engine") == "engine":
+            engine.trace_name = name
 
     @property
     def serves_requests(self) -> bool:
@@ -258,6 +264,11 @@ class PrefixAffinityRouter:
         """Pick the serving replica for ``prompt``; records routing metrics.
         Prefill-only replicas are never chosen here — they cannot own a
         request (see :meth:`plan` for disaggregated placement)."""
+        return self._route_ex(prompt)[0]
+
+    def _route_ex(self, prompt: str):
+        """:meth:`route` plus the placement kind — ``(replica,
+        "affinity"|"fallback")`` — for the submit path's placement span."""
         key = self._prompt_key(prompt)
         preferred = self._preferred(key, self._serving)
         healthy = self._candidates(self._serving)
@@ -279,7 +290,7 @@ class PrefixAffinityRouter:
             if route == "fallback":
                 self.fallbacks += 1
         _obs.record_router_route(route, affinity_hit=hit)
-        return chosen
+        return chosen, route
 
     def plan(self, prompt: str):
         """Disaggregated placement: ``(prefill_replica | None,
@@ -330,9 +341,23 @@ class PrefixAffinityRouter:
 
     # -- request lifecycle (delegates to the owning replica) -----------------
 
-    def submit(self, prompt: str, params=None, image=None, **kw):
-        replica = self.route(prompt)
-        req = replica.submit(prompt, params, image=image, **kw)
+    def submit(
+        self, prompt: str, params=None, image=None, *, trace=_rt.UNSET, **kw
+    ):
+        # distributed tracing: mint the request's context HERE when no
+        # entry point upstream did (trace id becomes the request id; an
+        # upstream None means SAMPLED OUT and passes through); the routing
+        # decision itself is a `placement` span, and a health flap
+        # observed during it lands as a fault event via the ambient frame
+        ctx = _rt.resolve_entry_trace(trace, "router")
+        t0 = time.time()
+        with _rt.active(ctx, replica="router"):
+            replica, route = self._route_ex(prompt)
+        _rt.record_span(
+            ctx, "placement", start=t0, replica="router", route=route,
+            decode_replica=replica.name,
+        )
+        req = replica.submit(prompt, params, image=image, trace=ctx, **kw)
         # ownership rides ON the request (not a router-side map that would
         # grow one entry per request forever): the request's lifetime IS
         # the mapping's lifetime

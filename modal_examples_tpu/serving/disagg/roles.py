@@ -34,6 +34,8 @@ import time
 
 from ...faults import inject as _inject
 from ...observability import metrics as _obs
+from ...observability import reqtrace as _rt
+from ...scheduling.admission import ShedError
 from ...scheduling.policy import DEFAULT_CLASS, ScheduledRequest
 from ...utils.log import get_logger
 from .transport import (
@@ -93,6 +95,7 @@ class DisaggCoordinator:
         max_rounds: int = 3,
         channel_factory=None,
         reprobe_s: float | None = None,  # router unhealthy re-probe interval
+        trace_store=None,  # where gateway-side migration spans land
     ):
         from ...scheduling.router import PrefixAffinityRouter
 
@@ -103,6 +106,11 @@ class DisaggCoordinator:
         self.chunk_bytes = int(chunk_bytes)
         self.max_rounds = int(max_rounds)
         self._channel_factory = channel_factory or LoopbackChannel
+        self._trace_store = (
+            trace_store if trace_store is not None else _rt.default_store
+        )
+        if trace_store is not None:
+            _rt.register_store(self._trace_store)
         self._lock = threading.Lock()
         self._inflight: dict[str, Migration] = {}
         self.migrations_ok = 0
@@ -134,43 +142,66 @@ class DisaggCoordinator:
         *,
         priority: str = DEFAULT_CLASS,
         tenant: str = "default",
+        trace=_rt.UNSET,
     ):
         """Place one request: disaggregated when a healthy prefill/decode
         pair exists, unified otherwise. Multimodal requests always serve
         unified (image KV does not take the migration path). Raises
         ``ShedError`` when the owning replica's admission rejects it."""
+        # the fleet entry point mints the request's distributed trace (the
+        # trace id becomes the request id; an upstream None = sampled out
+        # and passes through); the disagg plan is a `placement` span, the
+        # migration pipeline below opens migrate/transfer/chunk spans, and
+        # the prefill/decode replicas parent their own spans under it
+        ctx = _rt.resolve_entry_trace(trace, "gateway", store=self._trace_store)
         if image is not None:
             return self.router.submit(
-                prompt, params, image=image, priority=priority, tenant=tenant
+                prompt, params, image=image, priority=priority,
+                tenant=tenant, trace=ctx,
             )
-        prefill_r, decode_r = self.router.plan(prompt)
+        t0_place = time.time()
+        with _rt.active(ctx, replica="gateway"):
+            prefill_r, decode_r = self.router.plan(prompt)
+        _rt.record_span(
+            ctx, "placement", start=t0_place, store=self._trace_store,
+            replica="gateway",
+            prefill_replica=prefill_r.name if prefill_r else "-",
+            decode_replica=decode_r.name,
+        )
         if prefill_r is None:
             req = decode_r.submit(
-                prompt, params, priority=priority, tenant=tenant
+                prompt, params, priority=priority, tenant=tenant, trace=ctx
             )
             req._router_replica = decode_r
             return req
         return self._submit_disagg(
             prompt, params, prefill_r, decode_r,
-            priority=priority, tenant=tenant,
+            priority=priority, tenant=tenant, trace=ctx,
         )
 
     def _submit_disagg(
-        self, prompt, params, prefill_r, decode_r, *, priority, tenant
+        self, prompt, params, prefill_r, decode_r, *, priority, tenant,
+        trace=None,
     ):
         engine_d = decode_r.engine
         req = engine_d.make_request(
-            prompt, params, priority=priority, tenant=tenant
+            prompt, params, priority=priority, tenant=tenant, trace=trace
         )
         req._router_replica = decode_r
+        ctx = req.trace
         # fault point (docs/faults.md): the decode side sheds the migration
         # reservation — an honest 429 BEFORE any byte moves, the same
         # surface a real kv_pressure shed takes (nothing to unwind: no
         # reservation exists yet, the request never queued anywhere)
         if _inject.fire("disagg.reserve_shed"):
-            from ...scheduling.admission import ShedError
-
             _obs.record_shed(req.priority, "injected")
+            _rt.event(
+                ctx, "shed", store=self._trace_store, replica="gateway",
+                reason="injected",
+            )
+            _rt.finish_root(
+                ctx, "shed", store=self._trace_store, finish_reason="shed"
+            )
             raise ShedError(
                 "injected", 1.0,
                 f"injected: decode replica {decode_r.name} shed the "
@@ -189,52 +220,95 @@ class DisaggCoordinator:
             enqueued_at=engine_d._clock(),
         )
         occ = engine_d.cache.occupancy()
-        engine_d.admission.admit(  # ShedError propagates: honest 429
-            entry,
-            depths=engine_d.policy.depths(),
-            pages_used=occ["pages_used"],
-            pages_total=occ["pages_total"],
-        )
+        try:
+            engine_d.admission.admit(  # ShedError propagates: honest 429
+                entry,
+                depths=engine_d.policy.depths(),
+                pages_used=occ["pages_used"],
+                pages_total=occ["pages_total"],
+            )
+        except ShedError as e:
+            # ONLY real sheds close the trace as "shed" (anything else
+            # here is a bug reaching the client as a 500 — the trace must
+            # not claim an admission decision that never happened)
+            _rt.event(
+                ctx, "shed", store=self._trace_store, replica="gateway",
+                reason=e.reason,
+            )
+            _rt.finish_root(
+                ctx, "shed", store=self._trace_store, finish_reason="shed"
+            )
+            raise
         migration = Migration(req, prefill_r.name, decode_r.name)
         with self._lock:
             self._inflight[req.request_id] = migration
             _obs.set_migrations_inflight(len(self._inflight))
         t0 = time.monotonic()
+        # the migrate span: prefill + transfer + adopt nest under it —
+        # prefill-replica spans parent through req._trace_parent, and the
+        # wire context in the block meta carries the same parent across
+        # the hop. The ambient frame attaches injected transport faults
+        # (chunk corrupt/drop, replica death) to THIS request.
+        mig_sp = _rt.begin(
+            ctx, "migrate", replica="gateway",
+            source=prefill_r.name, target=decode_r.name,
+        )
+        req._trace_parent = mig_sp.span_id if mig_sp is not None else None
+        tr_sp = None
         try:
-            block, payload = self._prefill_and_pack(prefill_r, req)
+            with _rt.active(ctx, parent=req._trace_parent, replica="gateway"):
+                block, payload = self._prefill_and_pack(prefill_r, req)
 
-            def should_abort() -> bool:
-                if req.aborted:
-                    return True
-                if (
-                    req.deadline is not None
-                    and engine_d._clock() >= req.deadline
+                def should_abort() -> bool:
+                    if req.aborted:
+                        return True
+                    if (
+                        req.deadline is not None
+                        and engine_d._clock() >= req.deadline
+                    ):
+                        req.deadline_expired = True
+                        return True
+                    return False
+
+                tr_sp = _rt.begin(
+                    ctx, "transfer", parent=req._trace_parent,
+                    replica="gateway", wire_bytes=len(payload),
+                )
+                with _rt.active(
+                    ctx,
+                    parent=tr_sp.span_id if tr_sp is not None else None,
+                    replica="gateway",
                 ):
-                    req.deadline_expired = True
-                    return True
-                return False
-
-            wire = transfer(
-                payload,
-                self._channel_factory(),
-                transfer_id=req.request_id,
-                chunk_bytes=self.chunk_bytes,
-                max_rounds=self.max_rounds,
-                should_abort=should_abort,
-            )
-            if should_abort():
-                raise TransferAborted(req.request_id)
-            # fault point: the reassembled block corrupts between wire and
-            # adoption (bad DMA, bit rot) — deserialize_block's crc check
-            # turns it into a loud TransportError -> unified fallback below
-            wire = _inject.corrupt("disagg.adopt_corrupt", wire)
-            engine_d.submit_adopted(req, entry, deserialize_block(wire))
+                    wire = transfer(
+                        payload,
+                        self._channel_factory(),
+                        transfer_id=req.request_id,
+                        chunk_bytes=self.chunk_bytes,
+                        max_rounds=self.max_rounds,
+                        should_abort=should_abort,
+                    )
+                _rt.finish(
+                    ctx, tr_sp, store=self._trace_store,
+                    chunks=-(-len(payload) // max(1, self.chunk_bytes)),
+                )
+                if should_abort():
+                    raise TransferAborted(req.request_id)
+                # fault point: the reassembled block corrupts between wire
+                # and adoption (bad DMA, bit rot) — deserialize_block's crc
+                # check turns it into a loud TransportError -> unified
+                # fallback below
+                wire = _inject.corrupt("disagg.adopt_corrupt", wire)
+                engine_d.submit_adopted(req, entry, deserialize_block(wire))
             with self._lock:
                 self.migrations_ok += 1
                 self.pages_migrated += block.n_pages
                 self.bytes_migrated += len(payload)
             _obs.record_migration(
                 "ok", pages=block.n_pages, wire_bytes=len(payload)
+            )
+            _rt.finish(
+                ctx, mig_sp, store=self._trace_store, result="ok",
+                pages=block.n_pages, wire_bytes=len(payload),
             )
             return req
         except TransferAborted:
@@ -244,11 +318,14 @@ class DisaggCoordinator:
             _obs.record_migration("aborted")
             if req.deadline_expired:
                 _obs.record_deadline_miss("migrating")
-            req.out_queue.put(
-                _finish_marker(
-                    "deadline" if req.deadline_expired else "stop"
-                )
+            reason = "deadline" if req.deadline_expired else "stop"
+            _rt.finish(ctx, tr_sp, status="aborted", store=self._trace_store)
+            _rt.finish(
+                ctx, mig_sp, status="aborted", store=self._trace_store,
+                result="aborted",
             )
+            _rt.finish_request(req, reason, store=self._trace_store)
+            req.out_queue.put(_finish_marker(reason))
             return req
         except Exception as e:
             # replica death, wire corruption beyond retry, OutOfPages on the
@@ -260,7 +337,13 @@ class DisaggCoordinator:
             with self._lock:
                 self.migrations_fallback += 1
             _obs.record_migration("fallback")
+            _rt.finish(ctx, tr_sp, status="error", store=self._trace_store)
+            _rt.finish(
+                ctx, mig_sp, status="error", store=self._trace_store,
+                result="fallback",
+            )
             if req.aborted:
+                _rt.finish_request(req, "stop", store=self._trace_store)
                 req.out_queue.put(_finish_marker("stop"))
                 return req
             _log.warning(
@@ -269,6 +352,7 @@ class DisaggCoordinator:
                 req.request_id, prefill_r.name, decode_r.name,
                 type(e).__name__, e, decode_r.name,
             )
+            req._trace_parent = None  # fallback spans parent at the root
             return engine_d.submit_request(req)  # ShedError propagates
         finally:
             with self._lock:

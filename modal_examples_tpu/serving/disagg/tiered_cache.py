@@ -38,6 +38,7 @@ from collections import OrderedDict
 
 from ...faults import inject as _inject
 from ...observability import metrics as _obs
+from ...observability import reqtrace as _rt
 from ...utils.log import get_logger
 from .transport import (
     PageBlock,
@@ -240,6 +241,7 @@ class TieredPrefixCache:
         Stops at the first miss, corrupt block, or allocator exhaustion."""
         hashes = chain_hashes(key_tokens, self.cache.page_size)
         out: list[int] = []
+        by_tier = {"host": 0, "volume": 0}
         for block_hash in hashes[n_have:]:
             tier = "host"
             data = self._lookup_host(block_hash)
@@ -268,12 +270,18 @@ class TieredPrefixCache:
             adopt_pages(self.cache, block, page)
             out.append(page[0])
             self.tier_hits[tier] += 1
+            by_tier[tier] += 1
             _obs.record_tier_hit(tier)
             if tier == "volume":
                 # promote the bytes up a tier too: next hit is RAM-speed
                 self._host_put(block_hash, data)
         if out:
             self.promoted += len(out)
+            # the claim path scopes the request's ambient trace frame
+            # around promotion: the restore shows up on its timeline
+            for tier, n in by_tier.items():
+                if n:
+                    _rt.ambient_event("tier_promote", tier=tier, pages=n)
             with self._lock:
                 self._emit_gauges_locked()
         return out

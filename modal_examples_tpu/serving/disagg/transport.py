@@ -46,6 +46,7 @@ import numpy as np
 from ...core.retries import Retries
 from ...faults import inject as _inject
 from ...observability import metrics as _obs
+from ...observability import reqtrace as _rt
 
 #: envelope magic + version (bump on any layout change)
 _MAGIC = b"MTKV1\n"
@@ -436,9 +437,15 @@ def transfer(
         if round_i and pending:
             _obs.record_disagg_chunk_retries(len(pending))
             if backoff is not None:
-                time.sleep(
-                    backoff.delay_for_attempt(round_i, key=transfer_id)
+                delay = backoff.delay_for_attempt(round_i, key=transfer_id)
+                # retry backoff as a span event on the ambient request
+                # (the coordinator scopes the migration's trace frame
+                # around this call — docs/observability.md)
+                _rt.ambient_event(
+                    "retry_wait", round=round_i, pending=len(pending),
+                    delay_s=round(delay, 6),
                 )
+                time.sleep(delay)
         for seq in pending:
             if should_abort is not None and should_abort():
                 raise TransferAborted(f"transfer {transfer_id} aborted")
@@ -452,7 +459,17 @@ def transfer(
             chunk = chunks[seq]
             if _inject.fire("disagg.chunk_corrupt"):
                 chunk = _mangle(chunk)
-            channel.send(chunk)
+            # per-chunk span (child of the ambient transfer span): a dead
+            # channel mid-send still closes it with status=error
+            sp = _rt.begin_ambient(
+                "chunk", seq=seq, nbytes=len(chunk[5]), round=round_i
+            )
+            try:
+                channel.send(chunk)
+            except BaseException:
+                _rt.finish_ambient(sp, status="error")
+                raise
+            _rt.finish_ambient(sp)
         while True:
             try:
                 received = channel.recv(block=False)

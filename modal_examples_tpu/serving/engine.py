@@ -35,7 +35,8 @@ import numpy as np
 
 from ..models import llama
 from ..observability import metrics as _obs
-from ..scheduling.admission import AdmissionController
+from ..observability import reqtrace as _rt
+from ..scheduling.admission import AdmissionController, ShedError
 from ..scheduling.policy import (
     DEFAULT_CLASS,
     FairSharePolicy,
@@ -95,6 +96,10 @@ class Request:
     tenant: str = "default"
     deadline: float | None = None
     deadline_expired: bool = False
+    # distributed request tracing (observability/reqtrace.py): the
+    # RequestTraceContext minted at the entry point, or None when tracing
+    # is disabled/sampled out — every trace touch point is None-safe
+    trace: object | None = None
 
 
 @dataclasses.dataclass
@@ -325,6 +330,10 @@ class LLMEngine:
         # evicted prefix pages spill HBM -> host RAM -> Volume and promote
         # back on the next shared-prefix prompt
         tiered_prefix=None,
+        # request tracing: where THIS replica's spans land (default: the
+        # process-wide store). A per-replica store still stitches — the
+        # trace id is the request id, and reqtrace.read_trace merges
+        trace_store=None,
     ):
         import os as _os
 
@@ -517,6 +526,14 @@ class LLMEngine:
             clock=self._clock
         )
         self.admission = admission or AdmissionController(clock=self._clock)
+        # replica identity on request-trace spans ("engine" until an
+        # EngineReplica adopts this engine under its fleet name)
+        self.trace_name = "engine"
+        self._trace_store = (
+            trace_store if trace_store is not None else _rt.default_store
+        )
+        if trace_store is not None:
+            _rt.register_store(self._trace_store)
         self.stats = EngineStats()
         self.error_log: list[str] = []  # recent scheduler tracebacks
         self.error_count = 0  # monotonic (error_log is capped at 20)
@@ -988,6 +1005,12 @@ class LLMEngine:
             take = int(n_np[i])
             self.stats.spec_proposed += int(n_prop[i])
             self.stats.spec_accepted += max(0, take - 1)
+            if s.request is not None and s.request.trace is not None:
+                _rt.event(
+                    s.request.trace, "spec_verify",
+                    store=self._trace_store, replica=self.trace_name,
+                    proposed=int(n_prop[i]), accepted=max(0, take - 1),
+                )
             for t in range(take):
                 if s.request is None:
                     break  # finished mid-chain (eos/stop/length)
@@ -1035,6 +1058,10 @@ class LLMEngine:
         *,
         priority: str = DEFAULT_CLASS,
         tenant: str = "default",
+        # entry-minted RequestTraceContext; None = the entry point already
+        # SAMPLED THIS REQUEST OUT (don't re-roll); UNSET = no entry point
+        # upstream, mint here
+        trace=_rt.UNSET,
     ) -> Request:
         """Build (but do not enqueue) one validated, tokenized request.
 
@@ -1049,6 +1076,18 @@ class LLMEngine:
             tenant=tenant,
         )
         self.validate_params(req.params)
+        if trace is _rt.UNSET:
+            req.trace = _rt.start_request_trace(
+                req.request_id, entry=self.trace_name,
+                store=self._trace_store,
+                priority=req.priority, tenant=req.tenant,
+            )
+        elif trace is not None:
+            # entry-point-minted context: the request ADOPTS the trace id
+            # as its id, so trace id == request id holds fleet-wide
+            req.request_id = trace.trace_id
+            req.trace = trace
+        # else: the entry point decided (sampled out) — stay untraced
         if req.params.seed is None:
             with self._lock:
                 self._submit_seq += 1
@@ -1122,13 +1161,28 @@ class LLMEngine:
         # the depth read and the enqueue are not one atomic step, so bounds
         # are approximate by up to the number of racing submitters — fine
         # for overload control, which only needs to stop unbounded growth
-        self.admission.admit(
-            entry,
-            depths=self.policy.depths(),
-            pages_used=occ["pages_used"],
-            pages_total=occ["pages_total"],
-        )
+        try:
+            self.admission.admit(
+                entry,
+                depths=self.policy.depths(),
+                pages_used=occ["pages_used"],
+                pages_total=occ["pages_total"],
+            )
+        except ShedError as e:
+            _rt.event(
+                req.trace, "shed", store=self._trace_store,
+                replica=self.trace_name, reason=e.reason,
+            )
+            _rt.finish_root(
+                req.trace, "shed", store=self._trace_store,
+                finish_reason="shed",
+            )
+            raise
         req._sched_entry = entry
+        req._queue_span = _rt.begin(
+            req.trace, "queue", replica=self.trace_name,
+            priority=req.priority, tenant=req.tenant,
+        )
         self.policy.submit(entry)
         return req
 
@@ -1140,6 +1194,7 @@ class LLMEngine:
         *,
         priority: str = DEFAULT_CLASS,
         tenant: str = "default",
+        trace=_rt.UNSET,
     ) -> Request:
         """Enqueue one request through admission control.
 
@@ -1148,7 +1203,8 @@ class LLMEngine:
         :class:`~modal_examples_tpu.scheduling.admission.ShedError` when
         admission rejects the request (servers surface it as HTTP 429)."""
         req = self.make_request(
-            prompt, params, image, priority=priority, tenant=tenant
+            prompt, params, image, priority=priority, tenant=tenant,
+            trace=trace,
         )
         return self.submit_request(req)
 
@@ -1298,6 +1354,29 @@ class LLMEngine:
         force(self.cache.k_pages)  # block_until_ready is a no-op on axon
         return time.monotonic() - t0
 
+    def _finish_stream(self, req: Request, marker: "_Finish") -> None:
+        """THE terminal delivery: close the request's trace (sweeping any
+        still-open spans — queue, decode — so no failure path can leak a
+        dangling span) and only then release the caller's stream. Every
+        ``_Finish`` put in this engine goes through here."""
+        _rt.finish_request(req, marker.reason, store=self._trace_store)
+        req.out_queue.put(marker)
+
+    def _close_queue_span(self, req: Request) -> None:
+        """Close the admission-queue span when the scheduler pops the
+        request for a slot (the one non-terminal close; terminal paths
+        sweep it in ``_finish_stream`` instead). ``wait_s`` comes from the
+        span's OWN start — for adopted (disagg) requests the sched entry's
+        ``enqueued_at`` predates the whole migration, which is the migrate
+        span's story, not this queue's."""
+        sp = getattr(req, "_queue_span", None)
+        if sp is not None:
+            req._queue_span = None
+            _rt.finish(
+                req.trace, sp, store=self._trace_store,
+                wait_s=round(max(0.0, time.time() - sp.start), 6),
+            )
+
     def abort(self, request: Request) -> None:
         """Cancel a request (the engine-abort surface vLLM exposes for
         client disconnects). Queued (never-scheduled) ones are removed from
@@ -1312,7 +1391,7 @@ class LLMEngine:
             # reservation back to the pool, caller released now
             self.admission.release(entry)
             _obs.set_sched_queue_depths(self.policy.depths())
-            request.out_queue.put(_FINISH)
+            self._finish_stream(request, _FINISH)
 
     # -- disaggregated prefill/decode (serving/disagg, docs/disagg.md) -------
 
@@ -1356,6 +1435,7 @@ class LLMEngine:
                     f"prefill replica out of KV pages for {req.request_id}"
                 )
             t_start = time.monotonic()
+            t_wall = time.time()
             try:
                 first = self._prefill_pages(req, claim)
             except Exception:
@@ -1365,6 +1445,12 @@ class LLMEngine:
                 raise
             self.stats.prompt_tokens += claim["n_prompt"]
             _obs.record_engine_phase("prefill", time.monotonic() - t_start)
+            _rt.record_span(
+                req.trace, "prefill", start=t_wall,
+                parent=getattr(req, "_trace_parent", None),
+                store=self._trace_store, replica=self.trace_name,
+                n_prompt=claim["n_prompt"],
+            )
             return {
                 "claim": claim,
                 "position": claim["n_prompt"],
@@ -1427,6 +1513,12 @@ class LLMEngine:
                 "position": int(state["position"]),
                 "first_token": int(state["first_token"]),
                 "auto_seed": req.auto_seed,
+                # the trace context rides the MTKV1 envelope: a decode
+                # replica in ANOTHER process reconstructs it from here
+                # (reqtrace.from_wire) and keeps stitching the same trace
+                "trace": _rt.wire(
+                    req.trace, parent=getattr(req, "_trace_parent", None)
+                ),
             },
         )
 
@@ -1457,6 +1549,10 @@ class LLMEngine:
             "first_token": int(block.meta["first_token"]),
         }
         req._sched_entry = entry
+        req._queue_span = _rt.begin(
+            req.trace, "queue", replica=self.trace_name,
+            priority=req.priority, tenant=req.tenant,
+        )
         self.policy.submit(entry)
         return req
 
@@ -1516,6 +1612,16 @@ class LLMEngine:
                     _log.warning(
                         "injected scheduler crash: releasing all callers"
                     )
+                    # the crash hits every in-flight request: mark each
+                    # traced one before the release sweep closes its spans
+                    for s in self.slots:
+                        if s.request is not None:
+                            _rt.event(
+                                s.request.trace, "fault",
+                                store=self._trace_store,
+                                replica=self.trace_name,
+                                point="engine.scheduler_crash",
+                            )
                     self._release_all(_Finish("error"))
                     worked = False
                 except Exception:
@@ -1563,12 +1669,12 @@ class LLMEngine:
         self._device_tokens = None
         for slot in self.slots:
             if not slot.free:
-                slot.request.out_queue.put(marker)
+                self._finish_stream(slot.request, marker)
                 self._release_slot_pages(slot)
                 slot.request = None
         for entry in self.policy.drain():
             self.admission.release(entry)
-            entry.payload.out_queue.put(marker)
+            self._finish_stream(entry.payload, marker)
 
     def step(self) -> bool:
         """One scheduler tick: expire deadlines -> admit -> decode -> emit.
@@ -1593,7 +1699,7 @@ class LLMEngine:
             req = entry.payload
             req.deadline_expired = True
             _obs.record_deadline_miss("queued")
-            req.out_queue.put(_Finish("deadline"))
+            self._finish_stream(req, _Finish("deadline"))
         for s in self.slots:
             req = s.request
             if (
@@ -1675,8 +1781,9 @@ class LLMEngine:
             # is dropped with the request); either way it's off the books
             self.admission.release(entry)
             if req.aborted:
-                req.out_queue.put(
-                    _Finish("deadline") if req.deadline_expired else _FINISH
+                self._finish_stream(
+                    req,
+                    _Finish("deadline") if req.deadline_expired else _FINISH,
                 )
                 continue
             adopted = getattr(req, "_adopted_state", None)
@@ -1710,6 +1817,7 @@ class LLMEngine:
             _obs.record_sched_queue_wait(
                 entry.priority, max(0.0, now - entry.enqueued_at)
             )
+            self._close_queue_span(req)
             assignments.append((free_slots[taken], req, claim))
             taken += 1
 
@@ -1774,6 +1882,13 @@ class LLMEngine:
                     return "retry"
             else:
                 return "retry"
+        if req.trace is None:
+            # cross-process migration: the context rides the MTKV1 meta —
+            # reconstruct it so decode-side spans keep stitching
+            req.trace = _rt.from_wire(
+                block.meta.get("trace"), store=self._trace_store
+            )
+        t_wall = time.time()
         try:
             adopt_pages(self.cache, block, pages[: block.n_pages])
         except TransportError as e:
@@ -1781,8 +1896,19 @@ class LLMEngine:
             _log.error(
                 "adopting migrated pages for %s failed: %s", req.request_id, e
             )
-            req.out_queue.put(_Finish("error"))
+            _rt.record_span(
+                req.trace, "adopt", start=t_wall, status="error",
+                parent=getattr(req, "_trace_parent", None),
+                store=self._trace_store, replica=self.trace_name,
+            )
+            self._finish_stream(req, _Finish("error"))
             return "failed"
+        _rt.record_span(
+            req.trace, "adopt", start=t_wall,
+            parent=getattr(req, "_trace_parent", None),
+            store=self._trace_store, replica=self.trace_name,
+            pages=block.n_pages,
+        )
         slot = self.slots[slot_idx]
         slot.request = req
         # adopted pages are all privately owned: this replica's prefix trie
@@ -1801,6 +1927,11 @@ class LLMEngine:
         _obs.record_sched_queue_wait(
             entry.priority, max(0.0, now - entry.enqueued_at)
         )
+        self._close_queue_span(req)
+        req._decode_span = _rt.begin(
+            req.trace, "decode", replica=self.trace_name,
+            spec_mode=self.spec_mode or "-",
+        )
         self._accept_token(slot_idx, state["first_token"])
         return "ok"
 
@@ -1814,10 +1945,17 @@ class LLMEngine:
             slot.pages = slot.trie_pages = slot.private_pages = []
             slot.ngram = None
             self._active[slot_idx] = False
-            req.out_queue.put(_Finish("error"))
+            self._finish_stream(req, _Finish("error"))
 
     def _claim_pages(self, req: Request) -> dict | None:
-        """Slot page claim with prefix-cache sharing + eviction pressure."""
+        """Slot page claim with prefix-cache sharing + eviction pressure.
+        Runs under the request's ambient trace frame so fault firings in
+        here (allocator exhaustion, tier corruption) land as ``fault``
+        events on this request."""
+        with _rt.active(req.trace, replica=self.trace_name):
+            return self._claim_pages_traced(req)
+
+    def _claim_pages_traced(self, req: Request) -> dict | None:
         # fault point (docs/faults.md): allocator exhaustion. The slot path
         # takes the preemption-safe requeue; the disagg prefill_sync path
         # raises OutOfPages and the coordinator falls back to unified.
@@ -2003,6 +2141,7 @@ class LLMEngine:
         sized chunks attend to the cached prefix via the rectangular flash
         kernel (llama.prefill_chunk) — bounded VMEM at any prompt length."""
         t_start = time.monotonic()
+        t_wall = time.time()
         _obs.record_engine_queue_wait(t_start - req.created)
         pages, n_prompt = claim["pages"], claim["n_prompt"]
         slot = self.slots[slot_idx]
@@ -2036,10 +2175,19 @@ class LLMEngine:
         slot.last_token = int(first[0])
         slot.fresh = True
         _obs.record_engine_phase("prefill_chunked", time.monotonic() - t_start)
+        _rt.record_span(
+            req.trace, "prefill", start=t_wall, store=self._trace_store,
+            replica=self.trace_name, n_prompt=n_prompt, chunked=True,
+        )
+        req._decode_span = _rt.begin(
+            req.trace, "decode", replica=self.trace_name,
+            spec_mode=self.spec_mode or "-",
+        )
         self._accept_token(slot_idx, slot.last_token)
 
     def _prefill_group(self, bucket: int, group: list, is_mm: bool = False) -> None:
         t_start = time.monotonic()
+        t_wall = time.time()  # span timestamps are wall-clock
         for _slot_idx, req, _claim in group:
             _obs.record_engine_queue_wait(t_start - req.created)
         B = self.prefill_batch  # fixed compile shape; short groups pad
@@ -2135,6 +2283,15 @@ class LLMEngine:
             slot.position = claim["n_prompt"]
             slot.last_token = int(next_np[i])
             slot.fresh = True
+            _rt.record_span(
+                req.trace, "prefill", start=t_wall,
+                store=self._trace_store, replica=self.trace_name,
+                n_prompt=claim["n_prompt"], bucket=bucket,
+            )
+            req._decode_span = _rt.begin(
+                req.trace, "decode", replica=self.trace_name,
+                spec_mode=self.spec_mode or "-",
+            )
             self._accept_token(slot_idx, slot.last_token)
 
     def _decode_tick(self) -> bool:
@@ -2142,15 +2299,22 @@ class LLMEngine:
         # collective, a preempted host thread. Latency only; the tick then
         # proceeds normally and requests still terminate.
         if _inject.fire("engine.slow_decode"):
+            for s in self.slots:
+                if s.request is not None:
+                    _rt.event(
+                        s.request.trace, "fault", store=self._trace_store,
+                        replica=self.trace_name, point="engine.slow_decode",
+                    )
             time.sleep(0.05)
         # reap aborted slots before spending a step on them (deadline-
         # expired aborts finish with their own reason, not a fake "stop")
         for i, s in enumerate(self.slots):
             if not s.free and s.request.aborted:
-                s.request.out_queue.put(
+                self._finish_stream(
+                    s.request,
                     _Finish("deadline")
                     if s.request.deadline_expired
-                    else _FINISH
+                    else _FINISH,
                 )
                 self._release_slot_pages(s)
                 s.request = None
@@ -2297,6 +2461,12 @@ class LLMEngine:
             take = int(n_np[i])
             self.stats.spec_proposed += self.spec_gamma
             self.stats.spec_accepted += max(0, take - 1)
+            if s.request is not None and s.request.trace is not None:
+                _rt.event(
+                    s.request.trace, "spec_verify",
+                    store=self._trace_store, replica=self.trace_name,
+                    proposed=self.spec_gamma, accepted=max(0, take - 1),
+                )
             for t in range(take):
                 if s.request is None:
                     break  # finished mid-chain (eos/stop/length)
@@ -2317,6 +2487,8 @@ class LLMEngine:
         if req.first_token_at is None:
             req.first_token_at = now
             _obs.record_ttft(now - req.created)
+            if req.trace is not None:
+                req.trace.root.attrs["ttft_s"] = round(now - req.created, 6)
         else:
             _obs.record_tpot(now - req.last_token_at)
         req.last_token_at = now
@@ -2356,7 +2528,7 @@ class LLMEngine:
             req.out_queue.put(new)
             slot.emitted_text_len = slot.emitted_text_len + len(new)
         if finished:
-            req.out_queue.put(_Finish(reason))
+            self._finish_stream(req, _Finish(reason))
             self._release_slot_pages(slot)
             slot.request = None
             self._active[slot_idx] = False

@@ -19,6 +19,7 @@ from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 import math
 
 from ..observability import catalog as _C
+from ..observability import reqtrace as _rt
 from ..scheduling.admission import ShedError
 from ..utils.prometheus import default_registry
 from .engine import LLMEngine
@@ -360,6 +361,9 @@ class _Handler(BaseHTTPRequestHandler):
             self.send_response(200)
             self.send_header("content-type", "text/event-stream")
             self.send_header("cache-control", "no-cache")
+            # the engine request id (== distributed trace id): curl it back
+            # into `tpurun explain` / GET /traces/<id> to see the lifecycle
+            self.send_header("x-mtpu-request-id", req.request_id)
             self.end_headers()
             def chunk_of(**fields) -> dict:
                 chunk = {
@@ -438,7 +442,7 @@ class _Handler(BaseHTTPRequestHandler):
             self._json(500, {"error": {
                 "message": "engine error while processing the request",
                 "type": "server_error",
-            }})
+            }}, extra_headers={"x-mtpu-request-id": req.request_id})
             return
         n_prompt = len(req.prompt_tokens or [])
         n_out = len(srv.engine.tokenizer.encode(text, add_bos=False))
@@ -464,6 +468,7 @@ class _Handler(BaseHTTPRequestHandler):
                     "total_tokens": n_prompt + n_out,
                 },
             },
+            extra_headers={"x-mtpu-request-id": req.request_id},
         )
 
 
@@ -501,12 +506,22 @@ class OpenAIServer:
 
     def submit(self, prompt, params, image=None, **sched):
         """Place one request; returns (request, owning engine). Raises
-        ShedError when the target engine's admission rejects it."""
+        ShedError when the target engine's admission rejects it.
+
+        The distributed request trace is minted HERE — the fleet entry
+        point — and propagated down through router placement, queues, and
+        (under a disagg coordinator) the page-migration wire; the trace id
+        becomes the request id, echoed to the client as
+        ``x-mtpu-request-id`` so ``tpurun explain <id>`` finds it."""
+        trace = _rt.start_request_trace(entry="api")
         if self.router is not None:
-            req = self.router.submit(prompt, params, image=image, **sched)
+            req = self.router.submit(
+                prompt, params, image=image, trace=trace, **sched
+            )
             return req, self.router.replica_for(req).engine
         return (
-            self.engine.submit(prompt, params, image=image, **sched),
+            self.engine.submit(prompt, params, image=image, trace=trace,
+                               **sched),
             self.engine,
         )
 

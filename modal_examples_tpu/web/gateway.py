@@ -309,17 +309,37 @@ class _Handler(BaseHTTPRequestHandler):
             self.end_headers()
             self.wfile.write(body)
             return True
-        from ..observability.trace import default_store
+        from ..observability import reqtrace as _reqtrace
 
         if len(parts) > 1 and parts[1]:
-            trace_id = urllib.parse.unquote(parts[1])
-            spans = default_store.read(trace_id)
+            # either id namespace resolves here — executor calls (in-…)
+            # AND serving requests (req-…) — and request traces merge
+            # across every registered per-replica store, so a disagg
+            # request's prefill/transfer/decode spans come back as ONE tree
+            token = urllib.parse.unquote(parts[1])
+            # resolve() whitelists the token shape and already matches
+            # exact ids first — an unresolvable token is a 404, NEVER a
+            # raw-path fallback (that would reopen traversal reads)
+            trace_id = _reqtrace.resolve(token)
+            spans = _reqtrace.read_trace(trace_id) if trace_id else []
             if not spans:
-                self._respond_json(404, {"error": f"no trace {trace_id!r}"})
+                self._respond_json(404, {"error": f"no trace {token!r}"})
             else:
-                self._respond_json(200, {"trace_id": trace_id, "spans": spans})
+                payload = {
+                    "trace_id": trace_id,
+                    "kind": _reqtrace.trace_kind(trace_id),
+                    "spans": spans,
+                }
+                q = urllib.parse.parse_qs(parsed.query)
+                if q.get("explain"):
+                    payload["narrative"] = _reqtrace.explain_lines(
+                        spans, trace_id
+                    )
+                self._respond_json(200, payload)
         else:
-            self._respond_json(200, {"traces": default_store.list_traces()})
+            # same store set as the by-id fetch: ids served by
+            # /traces/<id> must also show up in the index
+            self._respond_json(200, {"traces": _reqtrace.list_traces()})
         return True
 
     def _handle(self, method: str) -> None:

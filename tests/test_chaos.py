@@ -103,3 +103,72 @@ class TestChaosAcceptance:
         assert snap["injected_total"] >= chaos_report["injected_total"]
         assert snap["episodes"], "journal episodes must surface"
         assert snap["wedged"] == 0
+
+
+class TestTraceUnderChaos:
+    def test_chaos_requests_carry_fault_events(self, chaos_report):
+        """Acceptance: a chaos episode's injected faults appear as span
+        EVENTS on the affected requests' distributed traces — the fleet
+        timeline shows per-request what was injected, not just a
+        counter."""
+        from modal_examples_tpu.observability.trace import default_store
+
+        points_seen = set()
+        for tid in default_store.list_traces(limit=2000):
+            if not tid.startswith("req-"):
+                continue
+            for s in default_store.read(tid):
+                if s["name"] == "fault":
+                    points_seen.add(s["attrs"].get("point"))
+        assert points_seen, (
+            "no request trace recorded a fault event during the chaos run"
+        )
+
+
+    """ISSUE 9: trace-context propagation under failure — an injected
+    scheduler-thread crash must still close every open span of every
+    in-flight traced request (no dangling span leak), mark the crash as a
+    ``fault`` event on each, and finish the roots with the same honest
+    finish_reason="error" the stream reports."""
+
+    def test_scheduler_crash_closes_all_spans_and_marks_fault(self, jax_cpu):
+        from modal_examples_tpu.faults.inject import FaultPlan, active
+        from modal_examples_tpu.models import llama
+        from modal_examples_tpu.observability import reqtrace as rt
+        from modal_examples_tpu.serving import LLMEngine, SamplingParams
+
+        eng = LLMEngine(
+            llama.LlamaConfig.tiny(), max_slots=2, max_model_len=64,
+            prefill_buckets=(16, 32), page_size=4,
+        )
+        try:
+            # crash a few ticks in: the request is mid-decode, its queue
+            # span closed and its decode span OPEN when the crash lands
+            plan = FaultPlan({"engine.scheduler_crash": {"on_hit": 4}})
+            with active(plan):
+                req = eng.submit(
+                    "crash victim", SamplingParams(max_tokens=64)
+                )
+                out = "".join(eng.stream(req))
+            assert req.finish_reason == "error"
+            assert plan.fired().get("engine.scheduler_crash") == 1
+            assert req.trace is not None
+            assert req.trace.open_spans() == [], "dangling span leaked"
+            spans = rt.read_trace(req.request_id)
+            assert all(s["end"] is not None for s in spans)
+            by = {}
+            for s in spans:
+                by.setdefault(s["name"], []).append(s)
+            root = by["request"][0]
+            assert root["attrs"]["finish_reason"] == "error"
+            faults = by.get("fault", [])
+            assert faults and faults[0]["attrs"]["point"] == (
+                "engine.scheduler_crash"
+            )
+            # the decode span was open at the crash: swept closed with the
+            # terminal status, not abandoned
+            if "decode" in by:
+                assert by["decode"][0]["status"] == "error"
+            del out  # partial output is fine; the contract is closure
+        finally:
+            eng.stop()

@@ -645,6 +645,216 @@ class TestTieredCache:
             e.stop()
 
 
+class TestRequestTracing:
+    """ISSUE 9 acceptance: one disagg request = ONE distributed trace id
+    whose merged span tree covers queue, placement, prefill, per-chunk
+    transfer, adoption, and decode with correct parentage — across
+    per-replica TraceStores — plus span closure under failure (mid-
+    transfer death, abort-during-migration: no dangling spans)."""
+
+    def _traced_pair(self, jax, tmp_path, coord_kw=None, prefill_kw=None):
+        from modal_examples_tpu.observability.trace import TraceStore
+
+        stores = {
+            "pre": TraceStore(root=tmp_path / "pre"),
+            "dec": TraceStore(root=tmp_path / "dec"),
+            "gw": TraceStore(root=tmp_path / "gw"),
+        }
+        ep, ed, co = _pair(
+            jax, "int8", seed=0,
+            prefill_kw={"trace_store": stores["pre"], **(prefill_kw or {})},
+            decode_kw={"trace_store": stores["dec"]},
+            coord_kw={"trace_store": stores["gw"], **(coord_kw or {})},
+        )
+        return ep, ed, co, list(stores.values())
+
+    def test_disagg_request_yields_one_stitched_trace(self, jax, tmp_path):
+        from modal_examples_tpu.observability import reqtrace as rt
+        from modal_examples_tpu.observability.export import (
+            spans_to_chrome_trace,
+        )
+        from modal_examples_tpu.serving import SamplingParams
+
+        params = SamplingParams(max_tokens=6, temperature=0.0)
+        ep, ed, co, stores = self._traced_pair(
+            jax, tmp_path,
+            prefill_kw={"tiered_prefix": {"host_bytes": 1 << 20}},
+        )
+        try:
+            seed_req = co.submit(PROMPT, params)  # warms the prefix trie
+            "".join(co.stream(seed_req))
+            # spill the prefill replica's trie so the NEXT request's claim
+            # promotes from the host tier — the acceptance's tiered hit
+            ep.prefix_cache.evict(10_000)
+            assert ep.tiered.stats()["host"]["blocks"] > 0
+            req = co.submit(PROMPT, params)
+            "".join(co.stream(req))
+            assert req.finish_reason in ("stop", "length")
+            assert req.trace is not None and req.trace.open_spans() == []
+        finally:
+            ed.stop()
+
+        spans = rt.read_trace(req.request_id, stores=stores)
+        assert spans and {s["trace_id"] for s in spans} == {req.request_id}
+        by = {}
+        for s in spans:
+            by.setdefault(s["name"], []).append(s)
+        assert {
+            "request", "queue", "placement", "prefill", "migrate",
+            "transfer", "chunk", "adopt", "decode", "tier_promote",
+        } <= set(by), sorted(by)
+        # every recorded span is CLOSED
+        assert all(s["end"] is not None for s in spans)
+        # parentage: queue/placement/migrate/decode under the root;
+        # prefill + transfer + adopt under the migrate span; every chunk
+        # under the transfer span
+        root = by["request"][0]
+        mig = by["migrate"][0]
+        tr = by["transfer"][0]
+        assert root["parent_id"] is None
+        for name in ("queue", "placement", "migrate", "decode"):
+            assert by[name][0]["parent_id"] == root["span_id"], name
+        for name in ("prefill", "transfer", "adopt"):
+            assert by[name][0]["parent_id"] == mig["span_id"], name
+        assert all(c["parent_id"] == tr["span_id"] for c in by["chunk"])
+        assert len(by["chunk"]) == -(-mig["attrs"]["wire_bytes"] // 512)
+        # replica attribution: the spans landed in DIFFERENT stores yet
+        # stitch — prefill on rep A, adopt/decode on rep B
+        assert by["prefill"][0]["attrs"]["replica"] == "pre-0"
+        assert by["adopt"][0]["attrs"]["replica"] == "dec-0"
+        assert by["decode"][0]["attrs"]["replica"] == "dec-0"
+        assert by["tier_promote"][0]["attrs"]["tier"] == "host"
+        # the queue span's wait_s is ITS OWN residency, not the whole
+        # migration (which is the migrate span's story)
+        q = by["queue"][0]
+        assert q["attrs"]["wait_s"] == pytest.approx(
+            q["end"] - q["start"], abs=0.05
+        )
+        assert mig["attrs"]["result"] == "ok"
+        assert mig["attrs"]["pages"] > 0
+        assert root["attrs"]["finish_reason"] == req.finish_reason
+        assert root["attrs"]["ttft_s"] > 0
+
+        # `tpurun explain` renders the narrative from the merged stores
+        lines = rt.explain_lines(spans, req.request_id)
+        text = "\n".join(lines)
+        assert "prefill on pre-0" in text
+        assert "migrated" in text and "pre-0 -> dec-0" in text
+        assert "decode on dec-0" in text and "TTFT" in text
+
+        # the Perfetto export passes the existing schema check, with one
+        # track per replica and the migration flow link
+        doc = spans_to_chrome_trace(spans, req.request_id)
+        assert doc["traceEvents"] and doc["displayTimeUnit"] in ("ms", "ns")
+        for ev in doc["traceEvents"]:
+            assert {"ph", "pid", "tid", "name"} <= set(ev), ev
+            assert ev["ph"] in ("X", "i", "M", "s", "f"), ev
+            if ev["ph"] == "X":
+                assert ev["dur"] > 0 and ev["ts"] >= 0
+        tracks = {
+            ev["args"]["name"]
+            for ev in doc["traceEvents"]
+            if ev["ph"] == "M" and ev["name"] == "thread_name"
+        }
+        assert {"gateway", "pre-0", "dec-0"} <= tracks
+        assert any(ev["ph"] == "s" for ev in doc["traceEvents"])
+
+    def test_mid_transfer_death_closes_all_spans(self, jax, tmp_path):
+        """Failure propagation: the channel dies mid-stream — unified
+        fallback serves the request, and the trace closes every span
+        (migrate/transfer marked error, no dangling chunk span)."""
+        from modal_examples_tpu.observability import reqtrace as rt
+        from modal_examples_tpu.serving import SamplingParams
+
+        class DiesMidStream(LoopbackChannel):
+            def __init__(self):
+                super().__init__()
+                self.n = 0
+
+            def send(self, chunk):
+                self.n += 1
+                if self.n == 2:
+                    raise ConnectionError("prefill replica died")
+                super().send(chunk)
+
+        ep, ed, co, stores = self._traced_pair(
+            jax, tmp_path, coord_kw={"channel_factory": DiesMidStream}
+        )
+        try:
+            req = co.submit(PROMPT, SamplingParams(max_tokens=6,
+                                                   temperature=0.0))
+            out = "".join(co.stream(req))
+            assert out and req.finish_reason in ("stop", "length")
+            assert co.migrations_fallback == 1
+            assert req.trace is not None and req.trace.open_spans() == []
+        finally:
+            ed.stop()
+        spans = rt.read_trace(req.request_id, stores=stores)
+        assert all(s["end"] is not None for s in spans)
+        by = {s["name"]: s for s in spans}
+        assert by["migrate"]["attrs"]["result"] == "fallback"
+        assert by["migrate"]["status"] == "error"
+        assert by["transfer"]["status"] == "error"
+        # the fallback re-prefill recorded on the DECODE replica, at root
+        prefills = [s for s in spans if s["name"] == "prefill"]
+        fallback = [p for p in prefills if p["attrs"]["replica"] == "dec-0"]
+        assert fallback and fallback[0]["parent_id"] == by["request"]["span_id"]
+        assert by["request"]["attrs"]["finish_reason"] == req.finish_reason
+
+    def test_abort_mid_migration_closes_all_spans(self, jax, tmp_path):
+        from modal_examples_tpu.observability import reqtrace as rt
+        from modal_examples_tpu.serving import SamplingParams
+
+        hook = {"fn": None}
+
+        class Gated(LoopbackChannel):
+            def __init__(self):
+                super().__init__()
+                self.n = 0
+
+            def send(self, chunk):
+                self.n += 1
+                if self.n == 1 and hook["fn"] is not None:
+                    hook["fn"]()
+                super().send(chunk)
+
+        ep, ed, co, stores = self._traced_pair(
+            jax, tmp_path,
+            coord_kw={"channel_factory": Gated, "chunk_bytes": 64},
+        )
+        try:
+            hook["fn"] = lambda: co.migrations()[0].request.__setattr__(
+                "aborted", True
+            )
+            req = co.submit(PROMPT, SamplingParams(max_tokens=16))
+            assert "".join(co.stream(req)) == ""
+            assert req.finish_reason == "stop"
+            assert req.trace is not None and req.trace.open_spans() == []
+        finally:
+            ed.stop()
+        spans = rt.read_trace(req.request_id, stores=stores)
+        assert all(s["end"] is not None for s in spans)
+        by = {s["name"]: s for s in spans}
+        assert by["migrate"]["attrs"]["result"] == "aborted"
+        assert by["request"]["attrs"]["finish_reason"] == "stop"
+        assert "decode" not in by  # nothing ever decoded
+
+    def test_wire_context_rides_the_mtkv1_envelope(self, jax):
+        """The block meta carries {trace_id, parent_id} — what a
+        cross-process decode replica reconstructs the context from."""
+        from modal_examples_tpu.serving import SamplingParams
+
+        eng = _tiny_engine(jax, seed=0)
+        req = eng.make_request("hello wire", SamplingParams(max_tokens=2))
+        req._trace_parent = "sp-migrate-x"
+        state = eng.prefill_sync(req)
+        block = eng.extract_request_pages(req, state)
+        eng.release_claim(state["claim"])
+        assert block.meta["trace"] == {
+            "trace_id": req.request_id, "parent_id": "sp-migrate-x",
+        }
+
+
 class TestGatewaySnapshot:
     def test_disagg_snapshot_shape(self, jax):
         """The gateway /disagg payload renders from the live registry."""

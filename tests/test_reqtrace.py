@@ -1,0 +1,482 @@
+"""Distributed request tracing (observability/reqtrace.py, ISSUE 9): the
+context/span unit surface (sampling, wire round trip, no-dangling-span
+sweep), multi-store stitching + id-namespace resolution, the engine-level
+trace of a unified request, `tpurun explain`, the replica-aware Perfetto
+export, and the bench regression detector (`tpurun benchdiff`)."""
+
+import json
+
+import pytest
+
+from modal_examples_tpu.observability import catalog as C
+from modal_examples_tpu.observability import reqtrace as rt
+from modal_examples_tpu.observability.trace import TraceStore
+
+
+@pytest.fixture()
+def store(tmp_path):
+    return TraceStore(root=tmp_path / "traces")
+
+
+# ---------------------------------------------------------------------------
+# context / sampling / wire unit surface
+# ---------------------------------------------------------------------------
+
+
+class TestContext:
+    def test_mint_records_nothing_until_a_span_lands(self, store, tmp_path):
+        ctx = rt.start_request_trace(store=store)
+        assert ctx is not None and ctx.trace_id.startswith("req-")
+        assert list((tmp_path / "traces").glob("*.jsonl")) == []
+        rt.event(ctx, "shed", reason="queue_full")
+        assert store.read(ctx.trace_id), "event must land in the store"
+
+    def test_sampling_is_deterministic_and_env_driven(self, monkeypatch):
+        monkeypatch.setenv("MTPU_TRACE_SAMPLE", "0")
+        assert rt.start_request_trace("req-abc") is None
+        monkeypatch.setenv("MTPU_TRACE_SAMPLE", "1")
+        assert rt.start_request_trace("req-abc") is not None
+        monkeypatch.setenv("MTPU_TRACE_SAMPLE", "0.5")
+        # same id -> same decision, every time, everywhere
+        decisions = {rt.sampled(f"req-{i:04d}") for i in range(64)}
+        assert decisions == {True, False}  # a real split at 0.5
+        for i in range(16):
+            rid = f"req-{i:04d}"
+            assert rt.sampled(rid) == rt.sampled(rid)
+
+    def test_trace_disabled_kills_request_tracing(self, monkeypatch):
+        monkeypatch.setenv("MTPU_TRACE", "0")
+        assert rt.start_request_trace() is None
+
+    def test_finish_root_sweeps_open_spans_and_is_idempotent(self, store):
+        ctx = rt.start_request_trace(store=store)
+        sp = rt.begin(ctx, "queue", priority="default")
+        assert ctx.open_spans() == ["queue"]
+        rt.finish_root(ctx, "error", finish_reason="error")
+        assert ctx.open_spans() == []
+        rt.finish_root(ctx, "ok", finish_reason="stop")  # no-op
+        spans = store.read(ctx.trace_id)
+        roots = [s for s in spans if s["name"] == "request"]
+        assert len(roots) == 1
+        assert roots[0]["attrs"]["finish_reason"] == "error"
+        queue = [s for s in spans if s["name"] == "queue"]
+        assert len(queue) == 1 and queue[0]["status"] == "error"
+        # a finish after the sweep must not duplicate the record
+        rt.finish(ctx, sp)
+        assert len(store.read(ctx.trace_id)) == len(spans)
+
+    def test_wire_round_trip_does_not_duplicate_the_root(self, store, tmp_path):
+        ctx = rt.start_request_trace(store=store)
+        mig = rt.begin(ctx, "migrate", source="a", target="b")
+        w = rt.wire(ctx, parent=mig.span_id)
+        assert w == {"trace_id": ctx.trace_id, "parent_id": mig.span_id}
+        other = TraceStore(root=tmp_path / "other")
+        remote = rt.from_wire(json.loads(json.dumps(w)), store=other)
+        sp = rt.begin(remote, "adopt", replica="dec-0")
+        rt.finish(remote, sp)
+        rt.finish_root(remote, "ok", finish_reason="stop")
+        remote_spans = other.read(ctx.trace_id)
+        # the receiving side records its span PARENTED at the wire parent,
+        # but never a second root — the minting side owns it
+        assert [s["name"] for s in remote_spans] == ["adopt"]
+        assert remote_spans[0]["parent_id"] == mig.span_id
+        assert rt.wire(None) is None and rt.from_wire(None) is None
+
+    def test_from_wire_rejects_hostile_trace_ids(self, store):
+        """The wire is untrusted peer input and the trace id becomes a
+        filename: ids that aren't request-id-shaped are rejected, never
+        written."""
+        for tid in ("../../../home/user/x", "in-abc", "", "req-a/b", None):
+            assert rt.from_wire({"trace_id": tid}) is None, tid
+        assert rt.from_wire(
+            {"trace_id": "req-abc123", "parent_id": "sp-1"}, store=store
+        ) is not None
+
+    def test_ambient_frame_attaches_fault_events(self, store):
+        ctx = rt.start_request_trace(store=store)
+        rt.note_fault("engine.out_of_pages")  # no frame: no-op
+        with rt.active(ctx, replica="rep-a"):
+            rt.note_fault("engine.out_of_pages")
+        with rt.active(None):
+            rt.note_fault("engine.out_of_pages")  # unsampled: must not leak
+        faults = [s for s in store.read(ctx.trace_id) if s["name"] == "fault"]
+        assert len(faults) == 1
+        assert faults[0]["attrs"] == {
+            "replica": "rep-a", "point": "engine.out_of_pages",
+        }
+
+
+class TestStoresAndResolve:
+    def _record(self, store, trace_id, name, span_id, parent=None, t=1.0):
+        store.record({
+            "trace_id": trace_id, "span_id": span_id, "parent_id": parent,
+            "name": name, "start": t, "end": t + 0.1, "status": "ok",
+            "attrs": {},
+        })
+
+    def test_read_trace_merges_and_dedupes_across_stores(self, tmp_path):
+        a = TraceStore(root=tmp_path / "a")
+        b = TraceStore(root=tmp_path / "b")
+        self._record(a, "req-xyz", "request", "sp-1", t=1.0)
+        self._record(a, "req-xyz", "prefill", "sp-2", "sp-1", t=2.0)
+        self._record(b, "req-xyz", "decode", "sp-3", "sp-1", t=3.0)
+        self._record(b, "req-xyz", "prefill", "sp-2", "sp-1", t=2.0)  # dup
+        merged = rt.read_trace("req-xyz", stores=[a, b])
+        assert [s["span_id"] for s in merged] == ["sp-1", "sp-2", "sp-3"]
+
+    def test_resolve_either_namespace_and_unique_prefix(self, tmp_path):
+        st = TraceStore(root=tmp_path)
+        self._record(st, "in-aabbcc", "call", "sp-1")
+        self._record(st, "req-ddeeff", "request", "sp-2")
+        self._record(st, "req-ddee00", "request", "sp-3")
+        assert st.resolve("in-aabbcc") == "in-aabbcc"
+        assert st.resolve("in-aab") == "in-aabbcc"
+        assert st.resolve("req-ddeeff") == "req-ddeeff"
+        assert st.resolve("req-ddee") is None  # ambiguous prefix
+        assert st.resolve("nope") is None
+        # hostile tokens resolve to None, never a glob/path error — these
+        # arrive straight off the gateway URL
+        for evil in ("/etc/passwd", "**", "a/b", "..", "in-*", "req-["):
+            assert st.resolve(evil) is None, evil
+        assert rt.resolve("in-aab", stores=[st]) == "in-aabbcc"
+        assert rt.trace_kind("in-aabbcc") == "call"
+        assert rt.trace_kind("req-ddeeff") == "request"
+
+    def test_merged_list_traces_covers_every_store(self, tmp_path):
+        a = TraceStore(root=tmp_path / "a")
+        b = TraceStore(root=tmp_path / "b")
+        self._record(a, "req-aaa", "request", "sp-1")
+        self._record(b, "req-bbb", "request", "sp-2")
+        assert set(rt.list_traces(stores=[a, b])) == {"req-aaa", "req-bbb"}
+
+
+# ---------------------------------------------------------------------------
+# engine-level: a unified request leaves one complete, closed trace
+# ---------------------------------------------------------------------------
+
+
+@pytest.fixture(scope="module")
+def traced_engine(jax_cpu):
+    from modal_examples_tpu.models import llama
+    from modal_examples_tpu.serving import LLMEngine
+
+    eng = LLMEngine(
+        llama.LlamaConfig.tiny(), max_slots=2, max_model_len=64,
+        prefill_buckets=(16, 32), page_size=4,
+    )
+    yield eng
+    eng.stop()
+
+
+class TestEngineTrace:
+    def test_unified_request_trace_tree(self, traced_engine):
+        from modal_examples_tpu.serving import SamplingParams
+
+        req = traced_engine.submit(
+            "hello trace", SamplingParams(max_tokens=4, temperature=0.0),
+            priority="interactive", tenant="t1",
+        )
+        "".join(traced_engine.stream(req))
+        assert req.trace is not None
+        assert req.trace.open_spans() == []
+        spans = rt.read_trace(req.request_id)
+        by = {}
+        for s in spans:
+            by.setdefault(s["name"], []).append(s)
+        assert {"request", "queue", "prefill", "decode"} <= set(by)
+        root = by["request"][0]
+        assert root["parent_id"] is None
+        assert root["attrs"]["finish_reason"] in ("stop", "length")
+        assert root["attrs"]["n_generated"] == req.n_generated
+        assert root["attrs"]["ttft_s"] > 0
+        for name in ("queue", "prefill", "decode"):
+            assert by[name][0]["parent_id"] == root["span_id"], name
+            assert by[name][0]["end"] is not None
+        assert by["queue"][0]["attrs"]["priority"] == "interactive"
+        assert by["queue"][0]["attrs"]["tenant"] == "t1"
+
+    def test_shed_finishes_the_root_with_status_shed(self, jax_cpu):
+        from modal_examples_tpu.models import llama
+        from modal_examples_tpu.scheduling.admission import (
+            AdmissionConfig, AdmissionController, ShedError,
+        )
+        from modal_examples_tpu.serving import LLMEngine, SamplingParams
+
+        eng = LLMEngine(
+            llama.LlamaConfig.tiny(), max_slots=1, max_model_len=32,
+            prefill_buckets=(16,), page_size=4,
+            admission=AdmissionController(
+                AdmissionConfig(max_queue={
+                    "interactive": 0, "default": 0, "batch": 0,
+                })
+            ),
+        )
+        with pytest.raises(ShedError):
+            eng.submit("shed me", SamplingParams(max_tokens=2))
+        # the request never entered a queue, but its trace closed honestly
+        shed_traces = [
+            tid for tid in rt.default_store.list_traces(limit=10)
+            for s in rt.default_store.read(tid)
+            if s["name"] == "request"
+            and s["attrs"].get("finish_reason") == "shed"
+        ]
+        assert shed_traces
+
+    def test_abort_of_queued_request_closes_the_queue_span(
+        self, traced_engine
+    ):
+        from modal_examples_tpu.serving import SamplingParams
+
+        # never start()ed scheduler? engine runs; submit then abort fast —
+        # the queued-removal path releases the caller AND the spans
+        req = traced_engine.make_request(
+            "abort me", SamplingParams(max_tokens=4)
+        )
+        traced_engine.submit_request(req)
+        traced_engine.abort(req)
+        "".join(traced_engine.stream(req))
+        assert req.trace is not None and req.trace.open_spans() == []
+        spans = rt.read_trace(req.request_id)
+        assert all(s["end"] is not None for s in spans)
+
+    def test_sampled_out_request_serves_without_a_trace(
+        self, traced_engine, monkeypatch
+    ):
+        from modal_examples_tpu.serving import SamplingParams
+
+        monkeypatch.setenv("MTPU_TRACE_SAMPLE", "0")
+        req = traced_engine.submit("untraced", SamplingParams(max_tokens=2))
+        out = "".join(traced_engine.stream(req))
+        assert req.trace is None
+        assert req.finish_reason in ("stop", "length")
+        assert isinstance(out, str)
+
+    def test_sampled_out_decision_propagates_without_a_reroll(
+        self, traced_engine
+    ):
+        """An entry point that sampled the request OUT passes trace=None
+        down the chain — no layer may re-mint (re-rolling would inflate
+        the effective sample rate and split entry attribution)."""
+        from modal_examples_tpu.serving import SamplingParams
+
+        req = traced_engine.submit(
+            "decided untraced", SamplingParams(max_tokens=2), trace=None
+        )
+        "".join(traced_engine.stream(req))
+        assert req.trace is None
+        # UNSET (the default) still mints at the engine
+        req2 = traced_engine.submit("minted", SamplingParams(max_tokens=2))
+        "".join(traced_engine.stream(req2))
+        assert req2.trace is not None
+        assert rt.resolve_entry_trace(None, "router") is None
+
+
+# ---------------------------------------------------------------------------
+# explain + CLI + perfetto export
+# ---------------------------------------------------------------------------
+
+
+class TestExplainAndExport:
+    def test_explain_cli_renders_request_narrative(
+        self, traced_engine, capsys
+    ):
+        from modal_examples_tpu.core.cli import main as cli_main
+        from modal_examples_tpu.serving import SamplingParams
+
+        req = traced_engine.submit(
+            "explain me please", SamplingParams(max_tokens=3, temperature=0.0)
+        )
+        "".join(traced_engine.stream(req))
+        assert cli_main(["explain", req.request_id]) == 0
+        out = capsys.readouterr().out
+        assert req.request_id in out and "serving request trace" in out
+        assert "queued" in out and "prefill on" in out and "decode on" in out
+        # unique-prefix resolution works too
+        assert cli_main(["explain", req.request_id[:10]]) == 0
+        assert req.request_id in capsys.readouterr().out
+
+    def test_explain_says_which_kind_for_call_traces(self, tmp_path, capsys):
+        from modal_examples_tpu.core.cli import main as cli_main
+
+        st = TraceStore(root=tmp_path)
+        st.record({
+            "trace_id": "in-123456", "span_id": "sp-1", "parent_id": None,
+            "name": "call", "start": 1.0, "end": 2.0, "status": "ok",
+            "attrs": {"function": "f"},
+        })
+        assert cli_main(["explain", "in-123456", "--dir", str(tmp_path)]) == 0
+        out = capsys.readouterr().out
+        assert "executor call trace" in out
+
+    def test_explain_unknown_id_exits_loudly(self):
+        from modal_examples_tpu.core.cli import main as cli_main
+
+        with pytest.raises(SystemExit):
+            cli_main(["explain", "req-doesnotexist"])
+
+    def test_perfetto_export_replica_tracks_are_deterministic(self):
+        from modal_examples_tpu.observability.export import (
+            spans_to_chrome_trace,
+        )
+
+        spans = [
+            {"trace_id": "req-x", "span_id": "sp-1", "parent_id": None,
+             "name": "request", "start": 1.0, "end": 2.0, "status": "ok",
+             "attrs": {"replica": "gateway"}},
+            {"trace_id": "req-x", "span_id": "sp-2", "parent_id": "sp-1",
+             "name": "prefill", "start": 1.1, "end": 1.4, "status": "ok",
+             "attrs": {"replica": "pre-0"}},
+            {"trace_id": "req-x", "span_id": "sp-3", "parent_id": "sp-1",
+             "name": "adopt", "start": 1.5, "end": 1.6, "status": "ok",
+             "attrs": {"replica": "dec-0"}},
+        ]
+        doc1 = spans_to_chrome_trace(spans, "req-x")
+        doc2 = spans_to_chrome_trace(list(reversed(spans)), "req-x")
+        assert doc1 == doc2, "track assignment must be deterministic"
+        tracks = {
+            ev["args"]["name"]: ev["tid"]
+            for ev in doc1["traceEvents"]
+            if ev["ph"] == "M" and ev["name"] == "thread_name"
+        }
+        assert set(tracks) == {"gateway", "pre-0", "dec-0"}
+        assert len(set(tracks.values())) == 3, "one track per replica"
+        tid_of = {
+            ev["args"]["span_id"]: ev["tid"]
+            for ev in doc1["traceEvents"]
+            if ev["ph"] == "X"
+        }
+        assert tid_of["sp-2"] == tracks["pre-0"]
+        assert tid_of["sp-3"] == tracks["dec-0"]
+        # migration span link: flow start on the prefill track, finish on
+        # the adopt track, matching ids
+        flows = [e for e in doc1["traceEvents"] if e["ph"] in ("s", "f")]
+        assert len(flows) == 2
+        s_ev = next(e for e in flows if e["ph"] == "s")
+        f_ev = next(e for e in flows if e["ph"] == "f")
+        assert s_ev["id"] == f_ev["id"]
+        assert s_ev["tid"] == tracks["pre-0"]
+        assert f_ev["tid"] == tracks["dec-0"]
+
+    def test_call_traces_keep_the_legacy_two_track_layout(self):
+        from modal_examples_tpu.observability.export import (
+            spans_to_chrome_trace,
+        )
+
+        spans = [
+            {"trace_id": "in-1", "span_id": "a", "parent_id": None,
+             "name": "call", "start": 1.0, "end": 2.0, "status": "ok",
+             "attrs": {}},
+            {"trace_id": "in-1", "span_id": "b", "parent_id": "a",
+             "name": "execute", "start": 1.2, "end": 1.8, "status": "ok",
+             "attrs": {}},
+        ]
+        doc = spans_to_chrome_trace(spans, "in-1")
+        tid_of = {
+            ev["args"]["span_id"]: ev["tid"]
+            for ev in doc["traceEvents"]
+            if ev["ph"] == "X"
+        }
+        assert tid_of["a"] == 1 and tid_of["b"] == 2
+
+
+# ---------------------------------------------------------------------------
+# bench regression detector (`tpurun benchdiff` / benchmarks/bench_diff.py)
+# ---------------------------------------------------------------------------
+
+
+def _bench_doc(tok_s, ttft_p95, shed_rate, mig_p95=None):
+    doc = {
+        "value": tok_s,
+        "all_configs": {"tiny": tok_s, "llama2-7b": tok_s * 0.4},
+        "token_latency": {
+            "ttft": {"p50": ttft_p95 / 2, "p95": ttft_p95, "count": 8},
+            "tpot": {"p50": 0.01, "p95": 0.02, "count": 100},
+        },
+        "scheduling": {"shed_rate": shed_rate},
+    }
+    if mig_p95 is not None:
+        doc["disagg"] = {
+            "migration_latency": {"p50": mig_p95 / 2, "p95": mig_p95}
+        }
+    return doc
+
+
+class TestBenchDiff:
+    def test_no_regression_exits_zero(self, tmp_path, capsys):
+        from modal_examples_tpu.core.cli import main as cli_main
+
+        old = tmp_path / "old.json"
+        new = tmp_path / "new.json"
+        old.write_text(json.dumps(_bench_doc(100.0, 0.5, 0.0, 0.010)))
+        new.write_text(json.dumps(_bench_doc(104.0, 0.48, 0.0, 0.009)))
+        assert cli_main(["benchdiff", str(old), str(new)]) == 0
+        out = capsys.readouterr().out
+        assert "no regressions" in out and "all_configs.tiny" in out
+
+    def test_throughput_regression_exits_nonzero(self, tmp_path, capsys):
+        from modal_examples_tpu.core.cli import main as cli_main
+
+        old = tmp_path / "old.json"
+        new = tmp_path / "new.json"
+        old.write_text(json.dumps(_bench_doc(100.0, 0.5, 0.0)))
+        new.write_text(json.dumps(_bench_doc(70.0, 0.5, 0.0)))
+        assert cli_main(["benchdiff", str(old), str(new)]) == 1
+        out = capsys.readouterr().out
+        assert "REGRESSED" in out and "value" in out
+
+    def test_latency_and_rate_regressions_detected(self, tmp_path):
+        from modal_examples_tpu.utils.bench_diff import compare
+
+        old = _bench_doc(100.0, 0.5, 0.0, 0.010)
+        new = _bench_doc(100.0, 0.9, 0.25, 0.030)
+        regressed = {
+            r["metric"] for r in compare(old, new) if r["regressed"]
+        }
+        assert "token_latency.ttft.p95" in regressed
+        assert "scheduling.shed_rate" in regressed  # abs: 0 -> 0.25
+        assert "disagg.migration_latency.p95" in regressed
+
+    def test_threshold_flag_and_wrapper_format(self, tmp_path):
+        from modal_examples_tpu.utils.bench_diff import load_bench, run_diff
+
+        # the BENCH_r*.json driver wrapper resolves through "parsed"
+        wrapped = tmp_path / "wrapped.json"
+        wrapped.write_text(json.dumps(
+            {"n": 3, "parsed": _bench_doc(100.0, 0.5, 0.0)}
+        ))
+        assert load_bench(wrapped)["value"] == 100.0
+        new = tmp_path / "new.json"
+        new.write_text(json.dumps(_bench_doc(94.0, 0.5, 0.0)))
+        # -6% tok/s: regression at 5%, fine at 10%
+        assert run_diff([str(wrapped), str(new), "--threshold", "5"]) == 1
+        assert run_diff([str(wrapped), str(new), "--threshold", "10"]) == 0
+
+    def test_missing_sections_are_skipped_not_fatal(self):
+        from modal_examples_tpu.utils.bench_diff import compare
+
+        rows = compare({"value": 10.0}, _bench_doc(10.0, 0.5, 0.0))
+        assert [r["metric"] for r in rows] == ["value"]
+
+    def test_usage_errors_exit_two(self, tmp_path):
+        from modal_examples_tpu.utils.bench_diff import run_diff
+
+        assert run_diff([]) == 2
+        assert run_diff([str(tmp_path / "nope.json"),
+                         str(tmp_path / "nope2.json")]) == 2
+
+
+# ---------------------------------------------------------------------------
+# catalog hygiene (the span-side mirror of TestCatalog)
+# ---------------------------------------------------------------------------
+
+
+class TestSpanCatalog:
+    def test_span_catalog_shape(self):
+        for name, meta in C.SPAN_CATALOG.items():
+            assert name.isidentifier(), name
+            assert isinstance(meta["attrs"], list) and meta["help"], name
+            assert "replica" in meta["attrs"] or name == "request", (
+                f"{name}: every span should be replica-attributable"
+            )
+        assert C.ALL_SPAN_NAMES == frozenset(C.SPAN_CATALOG)
+        assert rt.ROOT_SPAN in C.ALL_SPAN_NAMES

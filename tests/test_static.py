@@ -612,6 +612,156 @@ def test_disabled_fault_gate_is_structurally_a_no_op():
     ), "the disabled path must immediately `return False`"
 
 
+#: the request-tracer's call-site convention (docs/observability.md):
+#: modules import ``from ..observability import reqtrace as _rt`` and mint
+#: spans/events through these helpers with a string-literal span name at
+#: the given positional index
+_SPAN_GATE_FUNCS = {
+    "begin": 1, "record_span": 1, "event": 1,
+    "begin_ambient": 0, "ambient_event": 0,
+}
+#: helper kwargs that are plumbing, not span attributes
+_SPAN_CONTROL_KWARGS = {"parent", "store", "start", "end", "status"}
+
+
+def _span_call_sites():
+    """span name -> ["path:line", ...] plus attr-key violations, for every
+    ``_rt.<helper>("name", attr=...)`` call in the package (and the bare
+    helper calls inside reqtrace.py itself)."""
+    from modal_examples_tpu.observability.catalog import SPAN_CATALOG
+
+    reqtrace_path = PKG_ROOT / "observability" / "reqtrace.py"
+    sites: dict[str, list[str]] = {}
+    violations: list[str] = []
+    for path in sorted(PKG_ROOT.rglob("*.py")):
+        tree = ast.parse(path.read_text())
+        in_reqtrace = path == reqtrace_path
+        for node in ast.walk(tree):
+            if not isinstance(node, ast.Call):
+                continue
+            fn = node.func
+            fname = None
+            if (
+                isinstance(fn, ast.Attribute)
+                and isinstance(fn.value, ast.Name)
+                and fn.value.id == "_rt"
+                and fn.attr in _SPAN_GATE_FUNCS
+            ):
+                fname = fn.attr
+            elif (
+                in_reqtrace
+                and isinstance(fn, ast.Name)
+                and fn.id in _SPAN_GATE_FUNCS
+            ):
+                fname = fn.id
+            if fname is None:
+                continue
+            idx = _SPAN_GATE_FUNCS[fname]
+            where = f"{path.relative_to(REPO_ROOT)}:{node.lineno}"
+            name_node = node.args[idx] if len(node.args) > idx else None
+            if (
+                in_reqtrace
+                and isinstance(name_node, ast.Name)
+                and name_node.id == "name"
+            ):
+                continue  # a helper delegating to another (name variable)
+            name = _const_str(name_node) if name_node is not None else None
+            if name is None:
+                violations.append(f"{where}: non-literal span name")
+                continue
+            sites.setdefault(name, []).append(where)
+            declared = set(SPAN_CATALOG.get(name, {}).get("attrs", ()))
+            for kw in node.keywords:
+                if kw.arg is None or kw.arg in _SPAN_CONTROL_KWARGS:
+                    continue  # **kwargs / plumbing
+                if kw.arg not in declared:
+                    violations.append(
+                        f"{where}: attr {kw.arg!r} not declared for span "
+                        f"{name!r} (declared: {sorted(declared)})"
+                    )
+    return sites, violations
+
+
+def test_span_names_and_attr_keys_declared_in_catalog():
+    """Both directions of the request-span schema closure, the metric-
+    catalog discipline applied to the distributed tracer: (a) every span
+    minted through the reqtrace helpers names a ``SPAN_CATALOG`` entry and
+    passes only its declared attribute keys (so `tpurun explain` and the
+    Perfetto export parse a schema that cannot drift call-site by
+    call-site), and (b) every cataloged span name has at least one live
+    call site — a span wired out by a refactor fails here instead of
+    rotting in the catalog."""
+    from modal_examples_tpu.observability.catalog import ALL_SPAN_NAMES
+
+    sites, violations = _span_call_sites()
+    assert not violations, violations
+    undeclared = sorted(set(sites) - ALL_SPAN_NAMES)
+    assert not undeclared, (
+        f"span names minted but not declared in catalog.SPAN_CATALOG: "
+        f"{undeclared}"
+    )
+    # the root span is minted by start_request_trace via the ROOT_SPAN
+    # constant, not a helper call with a literal — count it as wired after
+    # verifying the constant still says so
+    reqtrace_src = (PKG_ROOT / "observability" / "reqtrace.py").read_text()
+    m = re.search(r'^ROOT_SPAN = "([a-z_]+)"', reqtrace_src, re.M)
+    assert m is not None, "reqtrace.ROOT_SPAN constant is gone"
+    wired = set(sites) | {m.group(1)}
+    unwired = sorted(ALL_SPAN_NAMES - wired)
+    assert not unwired, (
+        "span names declared in catalog.SPAN_CATALOG but never minted "
+        f"anywhere in the package: {unwired}"
+    )
+    # the guard must actually be guarding the full span surface
+    assert len(sites) >= 10, sorted(sites)
+
+
+#: serving-fleet modules that must mint spans ONLY through the reqtrace
+#: layer: a raw Span/contextvar-span here would float outside any request
+#: context — unparented, store-less, invisible to `tpurun explain`
+_REQTRACE_ONLY_SCOPE = ("serving", "scheduling", "faults")
+
+
+def test_serving_code_never_mints_raw_spans():
+    """Serving/scheduling/faults code may not import the raw span layer
+    (``observability.trace``: ``Span``, the contextvar ``span`` manager,
+    ``set_context``, ``default_store``) — request-path spans go through
+    :mod:`observability.reqtrace`, which anchors every span to a request
+    context, registers it for the no-dangling-span sweep, and records it
+    to the owning replica's store. The executor call tracer (core/) keeps
+    its direct access; this scope is the REQUEST side."""
+    banned_names = {
+        "Span", "TraceContext", "set_context", "span", "default_store",
+        "current_context",
+    }
+    offenders = []
+    for scope in _REQTRACE_ONLY_SCOPE:
+        for path in sorted((PKG_ROOT / scope).rglob("*.py")):
+            tree = ast.parse(path.read_text())
+            for node in ast.walk(tree):
+                bad = None
+                if isinstance(node, ast.Import):
+                    for a in node.names:
+                        if a.name.endswith("observability.trace"):
+                            bad = a.name
+                elif isinstance(node, ast.ImportFrom):
+                    mod = node.module or ""
+                    if mod.endswith("observability.trace"):
+                        bad = mod
+                    elif mod.endswith("observability"):
+                        hit = banned_names & {a.name for a in node.names}
+                        if hit:
+                            bad = f"{mod} ({sorted(hit)})"
+                if bad is not None:
+                    offenders.append(
+                        f"{path.relative_to(REPO_ROOT)}:{node.lineno}: {bad}"
+                    )
+    assert not offenders, (
+        "serving-path code imports the raw span layer — mint request "
+        f"spans through observability.reqtrace instead: {offenders}"
+    )
+
+
 def test_no_bare_print_in_framework_code():
     """Framework code under ``core/`` and ``serving/`` must not ``print()``:
     diagnostics go through ``utils.log.get_logger`` so they carry a level
