@@ -95,6 +95,17 @@ CONFIGS = {
         slots=16, max_len=256, max_tokens=128, timeout=1500, quant="int8",
         kv_dtype="int8", spec=("draft-1b", 4),
     ),
+    "llama2-7b-mixed-ctx1024": dict(
+        # stall-free admission at the long-context shape (docs/scheduling.md):
+        # the ctx-1024 int8-KV config under MIXED traffic — one interactive
+        # stream's observed TPOT captured while ~1k-token prompts arrive and
+        # chunk-prefill, with the per-tick prefill budget ON (256 = one
+        # chunk per tick) vs OFF. The json's `interference` section carries
+        # both arms' p50/p95 plus the decode-stall histogram; staged into
+        # revalidate_chip.sh as its own A/B stage.
+        slots=16, max_len=1024, max_tokens=128, timeout=1500, quant="int8",
+        kv_dtype="int8", prompt_mult=40, mixed=True, budget=256,
+    ),
     "llama2-7b-disagg-2rep": dict(
         # disaggregated prefill/decode at the ctx-1024 int8-KV shape (the
         # A/B partner of llama2-7b-int8-kv8-ctx1024): a prefill replica
@@ -137,6 +148,14 @@ CONFIGS = {
     "tiny-spec-ngram": dict(
         slots=4, max_len=128, max_tokens=16, timeout=420, spec=("ngram", 2),
     ),
+    # CPU path-proof of stall-free admission (test_bench_contract): the
+    # same mixed-traffic interference A/B the 7B config above runs on chip
+    # — an interactive stream's TPOT while long prompts chunk-prefill,
+    # budget on (64 tokens/tick) vs off
+    "tiny-mixed": dict(
+        slots=4, max_len=512, max_tokens=16, timeout=420, prompt_mult=12,
+        mixed=True, budget=64,
+    ),
     # CPU path-proof of the chaos harness (test_bench_contract): after the
     # measured run, the seeded fault-injection episode schedule drives a
     # fresh tiny fleet through every cataloged fault point and the json
@@ -147,6 +166,132 @@ CONFIGS = {
         slots=4, max_len=128, max_tokens=16, timeout=420, chaos=True
     ),
 }
+
+
+def _measure_interference(engine, spec: dict) -> dict:
+    """Stall-free admission A/B (docs/scheduling.md): while one interactive
+    stream decodes, long-prompt arrivals force chunked prefills; the gaps
+    between the stream's emitted pieces are its OBSERVED inter-token
+    latency. Arm one runs the classic unbudgeted admission, arm two the
+    config's per-tick prefill budget — the p95 gap is exactly the
+    prefill/decode interference the budget exists to bound (~one chunk
+    instead of the whole prompt). Runs on the same warm engine as the
+    measured throughput loop; chunk jits are pre-warmed so neither arm
+    pays first-compile."""
+    import time as _time
+
+    from modal_examples_tpu.serving import SamplingParams
+
+    budget = int(spec.get("budget") or engine.prefill_buckets[-1])
+    long_prompt = (
+        "The quick brown fox jumps over the lazy dog. "
+        * spec.get("prompt_mult", 12)
+    )
+    warm = engine.submit(
+        long_prompt, SamplingParams(max_tokens=2, temperature=1.0)
+    )
+    for _ in engine.stream(warm):
+        pass
+
+    def run_arm(arm_budget: int) -> dict:
+        engine.prefill_budget = arm_budget
+        fg = engine.submit(
+            "interactive stream under interference",
+            SamplingParams(max_tokens=6 * spec["max_tokens"], temperature=1.0),
+            priority="interactive",
+        )
+        stamped: list[tuple[float, float]] = []  # (gap end, gap seconds)
+        longs: list = []
+        last = None
+        t_submit = None
+        n_pieces = 0
+        for _piece in engine.stream(fg):
+            now = _time.monotonic()
+            if last is not None:
+                stamped.append((now, now - last))
+            last = now
+            n_pieces += 1
+            if n_pieces == 2:
+                # the stream is demonstrably decoding: drop a burst of
+                # long-prompt prefills on it
+                t_submit = _time.monotonic()
+                longs = [
+                    engine.submit(
+                        long_prompt,
+                        SamplingParams(max_tokens=4, temperature=1.0),
+                        priority="batch",
+                    )
+                    for _ in range(4)
+                ]
+        for r in longs:
+            for _ in engine.stream(r):
+                pass
+        # quantiles over the INTERFERENCE WINDOW only — submission of the
+        # long prompts until the last one's prefill completed (its first
+        # token is engine-stamped) — so the stream's steady-state tail
+        # can't dilute the stall the A/B exists to expose. A gap counts if
+        # it overlaps the window.
+        t_end = max(
+            [r.first_token_at or 0.0 for r in longs] or [float("inf")]
+        )
+        gaps = [
+            g for t, g in stamped
+            if t_submit is not None and t >= t_submit and t - g <= t_end
+        ] or [g for _, g in stamped]
+        gaps.sort()
+
+        def q(p: float) -> float:
+            if not gaps:
+                return 0.0
+            return gaps[min(len(gaps) - 1, int(p * len(gaps)))]
+
+        return {
+            "tpot_p50": round(q(0.50), 6),
+            "tpot_p95": round(q(0.95), 6),
+            "tpot_max": round(gaps[-1], 6) if gaps else 0.0,
+            "pieces": n_pieces,
+        }
+
+    from modal_examples_tpu.observability import catalog as _C
+    from modal_examples_tpu.utils.prometheus import default_registry
+
+    saved = engine.prefill_budget
+    try:
+        # budgeted arm FIRST: the decode-stall histogram snapshotted right
+        # after it covers only budgeted traffic (the measured run + this
+        # arm — mixed configs run the measured loop budgeted too), so its
+        # quantiles can evidence the "no gap exceeds ~one chunk" contract.
+        # Snapshotting after the unbudgeted arm would bake that arm's
+        # whole-prompt stalls into the very histogram the budget exists to
+        # bound.
+        budgeted = run_arm(budget)
+        stall_q = default_registry.histogram_quantiles(
+            _C.DECODE_STALL_SECONDS
+        )
+        unbudgeted = run_arm(0)
+    finally:
+        engine.prefill_budget = saved
+    return {
+        "budget_tokens": budget,
+        "chunk_tokens": engine.prefill_buckets[-1],
+        "unbudgeted": unbudgeted,
+        "budgeted": budgeted,
+        # >1 means the budget cut the interactive stream's tail latency
+        "improvement_p95": round(
+            unbudgeted["tpot_p95"] / max(budgeted["tpot_p95"], 1e-9), 3
+        ),
+        **(
+            {
+                "decode_stall": {
+                    k: stall_q[k]
+                    for k in ("p50", "p95", "p99", "count")
+                    if k in stall_q
+                }
+            }
+            if stall_q
+            else {}
+        ),
+    }
 
 
 def _child(model: str) -> None:
@@ -231,6 +376,9 @@ def _child(model: str) -> None:
         paged_impl="pallas",
         mesh=mesh,
         speculative=speculative,
+        # stall-free admission (docs/scheduling.md): mixed configs run the
+        # measured traffic budgeted; 0 keeps the classic unlimited admit
+        max_prefill_tokens_per_tick=spec.get("budget", 0),
     )
     build_s = time.time() - t0
     weight_bytes = param_bytes(engine.params)
@@ -300,35 +448,13 @@ def _child(model: str) -> None:
             pass
     elapsed = time.time() - t0
     generated = engine.stats.generated_tokens - base_tokens
-    errors = engine.error_count
-    engine.stop()
-
-    tok_s = generated / elapsed
-    # decode is weight-streaming-bound: every step reads the full weight set
-    # once for up to `slots` tokens. steps/s * weight_bytes over the HBM
-    # ceiling says how close the whole serving stack runs to the hardware.
-    stream_gbps = (tok_s / spec["slots"]) * weight_bytes / 1e9
-
-    # KV-cache footprint (dtype-aware: int8 counts int8 payload + f32 scale
-    # rows): the residency half of the int8-KV win. max_slots_at_hbm = how
-    # many slots of THIS config's context length fit in v5e HBM after the
-    # weights — ~2x at kv_dtype="int8", measurable the moment the bytes
-    # halve, no chip required.
-    cache_occ = engine.cache.occupancy()
-    bytes_per_page = cache_occ["bytes_total"] // engine.cache.n_pages
-    bytes_per_slot = engine.pages_per_slot * bytes_per_page
-    kv_cache_info = {
-        "dtype": engine.cache.kv_dtype,
-        "bytes": int(cache_occ["bytes_total"]),
-        "bytes_per_slot": int(bytes_per_slot),
-        "max_slots_at_hbm": int(
-            max(0.0, V5E_HBM_BYTES - weight_bytes) // max(bytes_per_slot, 1)
-        ),
-    }
 
     # per-phase latency distributions (p50/p95/p99) from the engine's
     # observability histograms — phase-attributed perf trajectory in every
-    # BENCH_*.json from here on (docs/observability.md)
+    # BENCH_*.json from here on (docs/observability.md). Snapshotted NOW,
+    # before the interference A/B below: its unbudgeted arm generates
+    # deliberately-degraded traffic that must not pollute the headline
+    # token_latency/scheduling sections benchdiff gates on.
     from modal_examples_tpu.observability import catalog as C
     from modal_examples_tpu.utils.prometheus import default_registry
 
@@ -382,6 +508,40 @@ def _child(model: str) -> None:
         "sheds_total": int(sheds),
         "admitted_total": int(admitted),
     }
+
+    # stall-free admission interference A/B (mixed configs): measured on
+    # the same warm engine BEFORE it stops — budget on vs off TPOT for an
+    # interactive stream under long-prompt arrivals (docs/scheduling.md)
+    interference = None
+    if spec.get("mixed"):
+        interference = _measure_interference(engine, spec)
+
+    errors = engine.error_count
+    engine.stop()
+
+    tok_s = generated / elapsed
+    # decode is weight-streaming-bound: every step reads the full weight set
+    # once for up to `slots` tokens. steps/s * weight_bytes over the HBM
+    # ceiling says how close the whole serving stack runs to the hardware.
+    stream_gbps = (tok_s / spec["slots"]) * weight_bytes / 1e9
+
+    # KV-cache footprint (dtype-aware: int8 counts int8 payload + f32 scale
+    # rows): the residency half of the int8-KV win. max_slots_at_hbm = how
+    # many slots of THIS config's context length fit in v5e HBM after the
+    # weights — ~2x at kv_dtype="int8", measurable the moment the bytes
+    # halve, no chip required.
+    cache_occ = engine.cache.occupancy()
+    bytes_per_page = cache_occ["bytes_total"] // engine.cache.n_pages
+    bytes_per_slot = engine.pages_per_slot * bytes_per_page
+    kv_cache_info = {
+        "dtype": engine.cache.kv_dtype,
+        "bytes": int(cache_occ["bytes_total"]),
+        "bytes_per_slot": int(bytes_per_slot),
+        "max_slots_at_hbm": int(
+            max(0.0, V5E_HBM_BYTES - weight_bytes) // max(bytes_per_slot, 1)
+        ),
+    }
+
     # speculative decoding (ROADMAP open item #4): the acceptance-rate ->
     # tok/s story needs both numbers in the same json line
     spec_info = None
@@ -478,6 +638,7 @@ def _child(model: str) -> None:
                 **({"spec": spec_info} if spec_info else {}),
                 **({"disagg": disagg_info} if disagg_info else {}),
                 **({"faults": faults_info} if faults_info else {}),
+                **({"interference": interference} if interference else {}),
             }
         )
     )
@@ -906,6 +1067,7 @@ def main() -> int:
             "llama2-7b-int8-kv8-ctx1024",
             "llama2-7b-tp2-int8-ctx1024",
             "llama2-7b-int8-spec-ngram",
+            "llama2-7b-mixed-ctx1024",
             "llama2-7b-disagg-2rep",
             "llama2-7b-int8-spec-draft1b",
             "llama2-7b-int8-s32",

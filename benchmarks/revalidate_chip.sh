@@ -5,6 +5,7 @@
 # so a hang is killed and attributed instead of wedging the device claim.
 # Stop at the first failing stage and treat it as the trigger.
 set -x
+set -o pipefail  # stage 12 pipes bench.py through tee: its exit must win
 cd "$(dirname "$0")/.."
 # every run leaves an attributable record (which stage ran/hung/failed)
 LOG="benchmarks/revalidate_$(date -u +%Y%m%d_%H%M).log"
@@ -56,5 +57,22 @@ fi
 #     ngram config (acceptance-driven win) vs its no-spec A/B partner
 #     llama2-7b-int8-kv8-s36 from the full bench below
 timeout 1500 env BENCH_MODEL=llama2-7b-int8-spec-ngram BENCH_NO_SECONDARY=1 python bench.py || exit 17
-# 11. full bench (includes the kv_cache + disagg + spec + tp sections)
-timeout 1500 python bench.py || exit 18
+# 11. stall-free admission under mixed traffic (round 10, docs/scheduling.md):
+#     the ctx-1024 int8 shape with an interactive stream decoding while
+#     ~1k-token prompts chunk-prefill — budgeted (256 tok/tick = one chunk)
+#     vs unbudgeted TPOT in the json's `interference` section, plus the
+#     mtpu_decode_stall_seconds dispatch-gap quantiles
+timeout 1500 env BENCH_MODEL=llama2-7b-mixed-ctx1024 BENCH_NO_SECONDARY=1 python bench.py || exit 18
+# 12. full bench (kv_cache + disagg + spec + tp + interference sections),
+#     captured to a file for the regression gate below
+timeout 1500 python bench.py | tee benchmarks/BENCH_revalidate.json || exit 19
+# 13. round-over-round regression gate (ROADMAP #1): diff the fresh json
+#     against the newest committed BENCH_r*.json — tok/s, ttft/tpot p95,
+#     shed rate, migration p95, interference p95 — and FAIL loudly past
+#     15% instead of relying on eyeballs
+PREV=$(ls BENCH_r*.json 2>/dev/null | sort | tail -1)
+if [ -n "$PREV" ]; then
+  python -m modal_examples_tpu benchdiff "$PREV" benchmarks/BENCH_revalidate.json --threshold 15 || exit 20
+else
+  echo "stage 13 SKIPPED: no BENCH_r*.json to diff against"
+fi

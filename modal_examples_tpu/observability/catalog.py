@@ -55,6 +55,19 @@ TOKENS_PER_SECOND = "mtpu_tokens_per_second"
 #: counter: scheduler-loop exceptions (engine.error_count mirror)
 SCHEDULER_ERRORS_TOTAL = "mtpu_scheduler_errors_total"
 
+# -- stall-free admission (serving/engine.py prefill budget, PR 10) ---------
+
+#: histogram: gap between consecutive decode-block dispatches while
+#: decodable slots exist (the stall-free admission contract: bounded by
+#: ~one prefill chunk under a budget — docs/scheduling.md)
+DECODE_STALL_SECONDS = "mtpu_decode_stall_seconds"
+#: gauge: prompt tokens admitted to a slot whose chunked prefill has not
+#: finished yet (the sliced-prefill remainder summed over slots)
+PREFILL_BACKLOG_TOKENS = "mtpu_prefill_backlog_tokens"
+#: counter: sliced-prefill suspensions — a chunked prefill paused
+#: mid-prompt because the per-tick token budget was spent
+PREFILL_SLICED_TOTAL = "mtpu_prefill_sliced_total"
+
 # -- token-level serving telemetry (serving/engine.py) ----------------------
 
 #: histogram: request submit -> first generated token emitted (TTFT)
@@ -103,7 +116,9 @@ SCHED_QUEUE_WAIT_SECONDS = "mtpu_sched_queue_wait_seconds"
 #: gauge: KV pages reserved by queued (not-yet-claimed) admissions
 KV_PAGES_RESERVED = "mtpu_kv_pages_reserved"
 #: counter {stage}: requests that blew their deadline;
-#: stage = queued (cancelled before a slot) | inflight (aborted mid-decode)
+#: stage = queued (cancelled before a slot) | prefill (aborted while the
+#: sliced prefill was still filling KV) | inflight (aborted mid-decode) |
+#: migrating (aborted during a disagg page migration)
 DEADLINE_MISSES_TOTAL = "mtpu_deadline_misses_total"
 #: counter {route}: router placements; route = affinity | fallback
 ROUTER_REQUESTS_TOTAL = "mtpu_router_requests_total"
@@ -247,6 +262,24 @@ CATALOG: dict[str, dict] = {
         "labels": [],
         "help": "engine scheduler-loop exceptions",
     },
+    DECODE_STALL_SECONDS: {
+        "type": "histogram",
+        "labels": [],
+        "help": "gap between consecutive decode-block dispatches while "
+                "decodable slots exist (stall-free admission contract)",
+    },
+    PREFILL_BACKLOG_TOKENS: {
+        "type": "gauge",
+        "labels": [],
+        "help": "prompt tokens admitted to slots but not yet prefilled "
+                "(sliced-prefill remainder)",
+    },
+    PREFILL_SLICED_TOTAL: {
+        "type": "counter",
+        "labels": [],
+        "help": "chunked prefills suspended mid-prompt by the per-tick "
+                "token budget",
+    },
     TTFT_SECONDS: {
         "type": "histogram",
         "labels": [],
@@ -318,7 +351,7 @@ CATALOG: dict[str, dict] = {
     DEADLINE_MISSES_TOTAL: {
         "type": "counter", "labels": ["stage"],
         "help": "requests past their deadline "
-                "(stage=queued|inflight|migrating)",
+                "(stage=queued|prefill|inflight|migrating)",
     },
     ROUTER_REQUESTS_TOTAL: {
         "type": "counter", "labels": ["route"],
@@ -466,9 +499,17 @@ SPAN_CATALOG: dict[str, dict] = {
         "help": "router placement decision (route() or disagg plan())",
     },
     "prefill": {
-        "attrs": ["replica", "n_prompt", "bucket", "chunked"],
+        "attrs": ["replica", "n_prompt", "bucket", "chunked", "chunks",
+                  "budget", "sliced"],
         "help": "prompt KV fill on the owning replica (slot, chunked, or "
-                "slot-free disagg path)",
+                "slot-free disagg path); sliced=True when the per-tick "
+                "budget spread the chunks over several scheduler ticks",
+    },
+    "prefill_wait": {
+        "attrs": ["replica", "ticks", "chunks"],
+        "help": "a sliced (budgeted) chunked prefill's multi-tick "
+                "residency: admission to last chunk, spanning the decode "
+                "ticks interleaved between its chunks",
     },
     "decode": {
         "attrs": ["replica", "spec_mode"],

@@ -150,6 +150,34 @@ def set_decode_impl(plan: dict, *, registry: Registry | None = None) -> None:
     )
 
 
+def record_decode_stall(
+    seconds: float, *, registry: Registry | None = None
+) -> None:
+    """One gap between consecutive decode-block dispatches while decodable
+    slots existed — the stall-free admission contract's measurement: under
+    a prefill budget this stays bounded by ~one prefill chunk."""
+    _reg(registry).histogram_observe(
+        C.DECODE_STALL_SECONDS,
+        seconds,
+        buckets=C.TOKEN_TIME_BUCKETS,
+        help=C.CATALOG[C.DECODE_STALL_SECONDS]["help"],
+    )
+
+
+def set_prefill_backlog(tokens: int, *, registry: Registry | None = None) -> None:
+    _reg(registry).gauge_set(
+        C.PREFILL_BACKLOG_TOKENS, float(tokens),
+        help=C.CATALOG[C.PREFILL_BACKLOG_TOKENS]["help"],
+    )
+
+
+def record_prefill_sliced(*, registry: Registry | None = None) -> None:
+    _reg(registry).counter_inc(
+        C.PREFILL_SLICED_TOTAL, 1.0,
+        help=C.CATALOG[C.PREFILL_SLICED_TOTAL]["help"],
+    )
+
+
 def record_scheduler_error(*, registry: Registry | None = None) -> None:
     _reg(registry).counter_inc(
         C.SCHEDULER_ERRORS_TOTAL,

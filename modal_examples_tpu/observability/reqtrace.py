@@ -626,11 +626,22 @@ def explain_lines(spans: list[dict], trace_id: str) -> list[str]:
                     f" (route={attr(s, 'route')})"
                 )
         elif name == "prefill":
+            chunked = attr(s, "chunked", False) is True
+            sliced = attr(s, "sliced", False) is True
+            detail = ""
+            if chunked:
+                detail = f", chunked x{attr(s, 'chunks', '?')}"
+                if sliced:
+                    detail += f" sliced (budget {attr(s, 'budget', '?')})"
             text = (
                 f"prefill on {attr(s, 'replica')} {_dur_ms(s):.1f}ms "
-                f"({attr(s, 'n_prompt', '?')} prompt tokens"
-                + (", chunked" if attr(s, "chunked", False) is True else "")
-                + ")"
+                f"({attr(s, 'n_prompt', '?')} prompt tokens{detail})"
+            )
+        elif name == "prefill_wait":
+            text = (
+                f"prefill sliced over {attr(s, 'ticks', '?')} ticks "
+                f"({attr(s, 'chunks', '?')} chunks interleaved with decode, "
+                f"{_dur_ms(s):.1f}ms residency)"
             )
         elif name == "migrate":
             text = (

@@ -73,6 +73,12 @@ class EngineReplica:
         self.engine = engine
         self.name = name
         self.role = role
+        if role == "prefill" and hasattr(engine, "prefill_budget"):
+            # prefill replicas have no decode to protect: the per-tick
+            # prefill token budget (docs/scheduling.md, stall-free
+            # admission) defaults to unlimited here even when
+            # MTPU_PREFILL_BUDGET is set process-wide for the decode side
+            engine.prefill_budget = 0
         self.saturation_factor = float(saturation_factor)
         # request-trace spans carry the FLEET name of the replica that
         # recorded them (track assignment in the Perfetto export); adopt

@@ -18,6 +18,12 @@ TPU-first architecture (vs vLLM's CUDA design):
   detokenization, per-request output queues. The scheduler favors admitting
   prefills as slots free up — the same continuous-batching policy vLLM's
   scheduler applies.
+- **stall-free admission** (docs/scheduling.md): an optional per-tick
+  prefill token budget slices chunked prefills across scheduler ticks and
+  defers every prefill's first-token read until after the next decode
+  dispatch, so a long-prompt arrival can never stall in-flight streams by
+  more than ~one prefill chunk — prefill/decode interference becomes a
+  scheduled property instead of an accident of arrival order.
 """
 
 from __future__ import annotations
@@ -114,10 +120,44 @@ class _Slot:
     generated: list[int] = dataclasses.field(default_factory=list)
     emitted_text_len: int = 0
     ngram: "_NgramIndex | None" = None  # prompt-lookup spec mode only
+    #: resumable chunked-prefill state (stall-free admission): set while the
+    #: slot's prompt KV is still being filled chunk-by-chunk across ticks
+    prefill: "_PendingPrefill | None" = None
+    #: prefill dispatched, first sampled token not yet harvested (it sits on
+    #: the engine's pending-harvest queue as a device array)
+    pending_first: bool = False
 
     @property
     def free(self) -> bool:
         return self.request is None
+
+    @property
+    def decodable(self) -> bool:
+        """Admitted AND holding a first token to feed decode: slots whose
+        prefill is mid-flight (sliced chunks pending, or first token not
+        yet harvested) are excluded from decode dispatch."""
+        return (
+            self.request is not None
+            and self.prefill is None
+            and not self.pending_first
+        )
+
+
+@dataclasses.dataclass
+class _PendingPrefill:
+    """Per-slot resumable chunked-prefill state (stall-free admission):
+    ``_admit`` advances at most a budget's worth of chunks per tick, so a
+    decode dispatch always lands between chunks and the inter-token stall
+    other streams see is bounded by ONE chunk, not the whole prompt."""
+
+    req: Request
+    table: object  # np page-table row shared with self._page_tables
+    offset: int = 0  # token offset of the NEXT chunk to dispatch
+    ticks: int = 0  # scheduler ticks that dispatched at least one chunk
+    suspensions: int = 0  # times the budget paused this prefill mid-prompt
+    logits: object | None = None  # last dispatched chunk's logits (device)
+    t_start: float = 0.0  # monotonic, for the phase histogram
+    t_wall: float = 0.0  # wall-clock, for trace spans
 
 
 class _NgramIndex:
@@ -318,6 +358,18 @@ class LLMEngine:
         draft_params=None,
         draft_model_dir: str | None = None,
         decode_block: int = 8,  # decode steps rolled into one dispatch
+        # stall-free admission (docs/scheduling.md): max prompt tokens the
+        # scheduler may convert into prefill work per tick. None resolves
+        # through MTPU_PREFILL_BUDGET (empty env = unlimited); an explicit
+        # 0 forces UNLIMITED, env ignored — the classic admit-everything
+        # behavior, and what bench children pass. With a budget, chunked
+        # prefills slice across ticks and short-prompt admissions stop once
+        # the budget is spent, so a decode dispatch lands between chunks
+        # and in-flight streams never stall behind a whole long prompt.
+        # Disagg prefill-role replicas run unbudgeted by construction:
+        # prefill_sync never takes the budgeted _admit path, and
+        # EngineReplica(role="prefill") zeroes the budget explicitly.
+        max_prefill_tokens_per_tick: int | None = None,
         mesh=None,  # jax Mesh with a "tensor" axis: tensor-parallel serving
         paged_impl: str | None = None,  # decode structure; None: env/default
         scatter_impl: str | None = None,  # KV scatter; None: env/default
@@ -356,6 +408,13 @@ class LLMEngine:
                 f"unknown scatter_impl {self.scatter_impl!r} "
                 "(arg or MTPU_SCATTER_IMPL); known: xla, pallas"
             )
+        # per-tick prefill token budget, same resolve-once rule: explicit
+        # arg beats MTPU_PREFILL_BUDGET beats unlimited (0). Mutable at
+        # runtime (an int read once per _admit) so benches can A/B it.
+        if max_prefill_tokens_per_tick is None:
+            _raw_budget = _os.environ.get("MTPU_PREFILL_BUDGET", "")
+            max_prefill_tokens_per_tick = int(_raw_budget) if _raw_budget else 0
+        self.prefill_budget = max(0, int(max_prefill_tokens_per_tick))
         # cache dtype, same resolve-once rule as the impls: explicit arg
         # beats MTPU_KV_DTYPE beats the bf16 default ("int8" = quantized
         # pages + scale arrays, the 4-leaf cache)
@@ -585,6 +644,15 @@ class LLMEngine:
         import collections
 
         self._inflight = collections.deque()  # (tokens [K, B] device, snapshot)
+        # stall-free admission state: finished prefills whose sampled first
+        # token is still a device array — the blocking read is deferred
+        # until AFTER the decode block for already-running slots has been
+        # dispatched (entries: (tokens, rows, meta); rows pin request
+        # identity like _inflight's snapshots)
+        self._pending_harvest = collections.deque()
+        # last decode-block dispatch (monotonic); None while no decodable
+        # slot exists — feeds mtpu_decode_stall_seconds
+        self._last_dispatch_at: float | None = None
 
         self._block_jit = jax.jit(self._decode_block_fn, donate_argnums=(1, 2))
         self._prefill_jits: dict[int, object] = {}
@@ -1666,11 +1734,19 @@ class LLMEngine:
 
     def _release_all(self, marker: "_Finish") -> None:
         self._inflight.clear()
+        self._pending_harvest.clear()
         self._device_tokens = None
+        self._last_dispatch_at = None
         for slot in self.slots:
             if not slot.free:
                 self._finish_stream(slot.request, marker)
-                self._release_slot_pages(slot)
+                if slot.prefill is not None or slot.pending_first:
+                    # stopping mid-prefill: pages may hold partial KV —
+                    # invalidate, don't cache (a revived engine must not
+                    # share them)
+                    self._unwind_slot(slot)
+                else:
+                    self._release_slot_pages(slot)
                 slot.request = None
         for entry in self.policy.drain():
             self.admission.release(entry)
@@ -1710,7 +1786,13 @@ class LLMEngine:
             ):
                 req.deadline_expired = True
                 req.aborted = True  # reaped (pages freed) in _decode_tick
-                _obs.record_deadline_miss("inflight")
+                _obs.record_deadline_miss(
+                    # a sliced prefill can now outlive a deadline mid-fill:
+                    # its own stage label (the reap unwinds the claim)
+                    "prefill"
+                    if s.prefill is not None or s.pending_first
+                    else "inflight"
+                )
 
     def _refresh_gauges(self) -> None:
         """Engine-load gauges (queue depth, active slots, tokens/s), KV/
@@ -1742,6 +1824,15 @@ class LLMEngine:
         _obs.set_kv_cache_bytes(occ["bytes_total"], self.cache.kv_dtype)
         if self.prefix_cache is not None:
             _obs.set_prefix_cache_pages(self.prefix_cache.cached_pages)
+        # sliced-prefill remainder: tokens admitted to slots whose chunked
+        # prefill the budget is still metering out
+        backlog = 0
+        for s in self.slots:
+            if s.prefill is not None and s.request is not None:
+                backlog += max(
+                    0, len(s.request.prompt_tokens) - s.prefill.offset
+                )
+        _obs.set_prefill_backlog(backlog)
         self._flush_token_counters()
 
     def _flush_token_counters(self) -> None:
@@ -1766,17 +1857,46 @@ class LLMEngine:
         bucket's admissions as ONE batched jitted call (compile shapes:
         bucket x pow2-padded batch — continuous batching on the prefill side
         too). The pop order is the SchedulerPolicy's (priority classes +
-        tenant fair share by default), not submission order."""
+        tenant fair share by default), not submission order.
+
+        Stall-free admission (docs/scheduling.md): ``prefill_budget`` caps
+        the prompt tokens converted into prefill work per tick (0 =
+        unlimited). In-flight sliced prefills resume FIRST — their pages
+        are already held, and finishing them frees capacity — then new
+        entries convert while budget remains; the remainder goes back to
+        the front of its queues through the preemption-safe requeue, its
+        reservations untouched. Every prefill dispatched here is ASYNC:
+        the sampled first tokens park on the pending-harvest queue and are
+        read only after ``_decode_tick`` has dispatched the next decode
+        block, so in-flight streams never wait on a prefill round trip."""
+        budget = self.prefill_budget or None  # None/0 = unlimited
+        spent = self._advance_pending_prefills(budget, 0)
         assignments: list[tuple[int, "Request", dict]] = []  # (slot, req, claim)
         free_slots = [i for i, s in enumerate(self.slots) if s.free]
         entries = (
-            self.policy.next_batch(len(free_slots)) if free_slots else []
+            self.policy.next_batch(len(free_slots))
+            if free_slots and (budget is None or spent < budget)
+            else []
         )
         now = self._clock()
         taken = 0  # free_slots consumed (grouped prefills + adoptions)
         adopted_any = False
         for pos, entry in enumerate(entries):
             req: Request = entry.payload
+            if (
+                budget is not None
+                and spent >= budget
+                and not req.aborted
+                and getattr(req, "_adopted_state", None) is None
+            ):
+                # budget spent: stop converting queue entries. This entry
+                # and the not-yet-examined rest still hold their admission
+                # reservations (nothing was released for them), so the
+                # preemption-safe front-requeue is all that's needed.
+                # Aborted entries still drain (they cost no prefill) and
+                # adopted blocks ship ready-made KV — cost 0 tokens.
+                self.policy.requeue(entries[pos:])
+                break
             # popped = the reservation converts into a real page claim (or
             # is dropped with the request); either way it's off the books
             self.admission.release(entry)
@@ -1820,27 +1940,30 @@ class LLMEngine:
             self._close_queue_span(req)
             assignments.append((free_slots[taken], req, claim))
             taken += 1
+            if (
+                claim["n_prompt"] <= self.prefill_buckets[-1]
+                or req.image is not None
+            ):
+                # short (bucketed) prompts prefill atomically, so they
+                # charge the budget up front; long ones charge per chunk
+                # as their state machine advances below
+                spent += claim["n_prompt"]
 
-        long_ones = [
-            a for a in assignments
-            if a[2]["n_prompt"] > self.prefill_buckets[-1]
-            and a[1].image is None  # mm prompts are capped at submit()
-        ]
-        assignments = [a for a in assignments if a not in long_ones]
-        for a in long_ones:
-            try:
-                self._prefill_long(*a)
-            except Exception:
-                # same contract as the grouped path: a failed chunked prefill
-                # must not leave a half-initialized slot (next decode tick
-                # would read uninitialized KV), leak its page claim, or poison
-                # the prefix trie with partially-written pages
-                import traceback
-
-                traceback.print_exc()
-                self._fail_claims([a])
-        by_bucket: dict[tuple, list] = {}
+        long_ones: list[tuple] = []
+        grouped: list[tuple] = []
         for a in assignments:
+            # one-pass split on the prompt-length predicate (the old
+            # `a not in long_ones` filter re-scanned a list of tuples
+            # holding dict claims — O(n^2) equality over page lists)
+            if (
+                a[2]["n_prompt"] > self.prefill_buckets[-1]
+                and a[1].image is None  # mm prompts are capped at submit()
+            ):
+                long_ones.append(a)
+            else:
+                grouped.append(a)
+        by_bucket: dict[tuple, list] = {}
+        for a in grouped:
             key = (self._bucket_for(a[2]["n_prompt"]), a[1].image is not None)
             by_bucket.setdefault(key, []).append(a)
         for (bucket, is_mm), group in by_bucket.items():
@@ -1856,7 +1979,24 @@ class LLMEngine:
 
                     traceback.print_exc()
                     self._fail_claims(chunk)
-        return bool(assignments) or adopted_any
+        for a in long_ones:
+            try:
+                self._prefill_long(*a)
+            except Exception:
+                # same contract as the grouped path: a failed chunked prefill
+                # must not leave a half-initialized slot (next decode tick
+                # would read uninitialized KV), leak its page claim, or poison
+                # the prefix trie with partially-written pages
+                import traceback
+
+                traceback.print_exc()
+                self._fail_claims([a])
+        if long_ones:
+            # newly admitted long prompts advance with what remains of this
+            # tick's budget (at least one chunk fires when nothing else
+            # did: the progress guarantee)
+            spent = self._advance_pending_prefills(budget, spent)
+        return bool(assignments) or adopted_any or spent > 0
 
     def _admit_adopted(
         self, slot_idx: int, req: Request, state: dict, entry, now: float
@@ -1918,6 +2058,8 @@ class LLMEngine:
         slot.private_pages = list(pages)
         slot.generated = []
         slot.emitted_text_len = 0
+        slot.prefill = None
+        slot.pending_first = False
         table = np.zeros((self.pages_per_slot,), np.int32)
         table[: len(pages)] = pages
         self._page_tables[slot_idx] = table
@@ -1944,6 +2086,8 @@ class LLMEngine:
             slot.request = None
             slot.pages = slot.trie_pages = slot.private_pages = []
             slot.ngram = None
+            slot.prefill = None
+            slot.pending_first = False
             self._active[slot_idx] = False
             self._finish_stream(req, _Finish("error"))
 
@@ -2031,55 +2175,69 @@ class LLMEngine:
             self.cache.allocator.free(slot.pages)
         slot.pages, slot.trie_pages, slot.private_pages = [], [], []
         slot.ngram = None
+        slot.prefill = None
+        slot.pending_first = False
 
-    def _run_prefill_chunks(self, prompt_tokens: list, table) -> "jax.Array":
-        """The chunked-prefill inner loop (bucket-sized chunks attending to
-        the cached prefix via the rectangular flash kernel), shared by the
-        slot path (``_prefill_long``) and the slot-free disagg path
-        (``_prefill_pages``). Returns the final chunk's last-token logits."""
+    def _dispatch_prefill_chunk(
+        self, prompt_tokens: list, table, offset: int
+    ) -> "jax.Array":
+        """Dispatch ONE bucket-sized prefill chunk (async — the logits come
+        back as a device future, nothing blocks the host): the unit both
+        the atomic loop (``_run_prefill_chunks``) and the budgeted state
+        machine (``_advance_pending_prefills``) advance by, so the two
+        paths can never drift."""
         import functools
 
-        n_prompt = len(prompt_tokens)
         C = self.prefill_buckets[-1]
         pad_tok = self.tokenizer.pad_id % self.cfg.vocab_size
-        logits = None
-        for offset in range(0, n_prompt, C):
-            chunk = prompt_tokens[offset : offset + C]
-            toks = np.full((1, C), pad_tok, np.int32)
-            toks[0, : len(chunk)] = chunk
-            fn = self._chunk_jits.get(offset)
-            if fn is None:
-                fn = jax.jit(
-                    functools.partial(
-                        llama.prefill_chunk, q_offset=offset,
-                        attn_impl=self._attn_impl, mesh=self.mesh,
-                    ),
-                    static_argnames=("cfg",),
-                    donate_argnums=(2, 3),
-                )
-                self._chunk_jits[offset] = fn
-            logits, self.cache.k_pages, self.cache.v_pages = fn(
-                self.params,
+        chunk = prompt_tokens[offset : offset + C]
+        toks = np.full((1, C), pad_tok, np.int32)
+        toks[0, : len(chunk)] = chunk
+        fn = self._chunk_jits.get(offset)
+        if fn is None:
+            fn = jax.jit(
+                functools.partial(
+                    llama.prefill_chunk, q_offset=offset,
+                    attn_impl=self._attn_impl, mesh=self.mesh,
+                ),
+                static_argnames=("cfg",),
+                donate_argnums=(2, 3),
+            )
+            self._chunk_jits[offset] = fn
+        logits, self.cache.k_pages, self.cache.v_pages = fn(
+            self.params,
+            jnp.asarray(toks),
+            self.cache.k_pages,
+            self.cache.v_pages,
+            jnp.asarray(table[None, :]),
+            jnp.asarray([len(chunk)], np.int32),
+            cfg=self.cfg,
+        )
+        if self.spec_mode == "draft":
+            # the same cached jit serves the draft: cfg is a static call
+            # argument, so target and draft get separate compile-cache
+            # entries under one callable
+            _, self.draft_cache.k_pages, self.draft_cache.v_pages = fn(
+                self.draft_params,
                 jnp.asarray(toks),
-                self.cache.k_pages,
-                self.cache.v_pages,
+                self.draft_cache.k_pages,
+                self.draft_cache.v_pages,
                 jnp.asarray(table[None, :]),
                 jnp.asarray([len(chunk)], np.int32),
-                cfg=self.cfg,
+                cfg=self.draft_cfg,
             )
-            if self.spec_mode == "draft":
-                # the same cached jit serves the draft: cfg is a static call
-                # argument, so target and draft get separate compile-cache
-                # entries under one callable
-                _, self.draft_cache.k_pages, self.draft_cache.v_pages = fn(
-                    self.draft_params,
-                    jnp.asarray(toks),
-                    self.draft_cache.k_pages,
-                    self.draft_cache.v_pages,
-                    jnp.asarray(table[None, :]),
-                    jnp.asarray([len(chunk)], np.int32),
-                    cfg=self.draft_cfg,
-                )
+        return logits
+
+    def _run_prefill_chunks(self, prompt_tokens: list, table) -> "jax.Array":
+        """The atomic chunked-prefill loop (every chunk in one call), used
+        by the slot-free disagg path (``_prefill_pages``) — the slot path
+        runs the same chunks through the resumable state machine instead.
+        Returns the final chunk's last-token logits."""
+        n_prompt = len(prompt_tokens)
+        C = self.prefill_buckets[-1]
+        logits = None
+        for offset in range(0, n_prompt, C):
+            logits = self._dispatch_prefill_chunk(prompt_tokens, table, offset)
         return logits
 
     def _prefill_pages(self, req: Request, claim: dict) -> int:
@@ -2137,13 +2295,19 @@ class LLMEngine:
         return int(np.asarray(next_tok)[0])
 
     def _prefill_long(self, slot_idx: int, req: Request, claim: dict) -> None:
-        """Chunked prefill for prompts beyond the largest bucket: bucket-
-        sized chunks attend to the cached prefix via the rectangular flash
-        kernel (llama.prefill_chunk) — bounded VMEM at any prompt length."""
+        """Begin a chunked prefill (prompts beyond the largest bucket) as a
+        RESUMABLE per-slot state machine: bucket-sized chunks attend to the
+        cached prefix via the rectangular flash kernel (llama.prefill_chunk
+        — bounded VMEM at any prompt length), and
+        ``_advance_pending_prefills`` dispatches at most a budget's worth
+        of chunks per tick, so the decode stall other streams see is
+        bounded by ONE chunk instead of the whole prompt. Unbudgeted
+        engines dispatch every chunk in one tick — but the first-token
+        read still defers to the harvest queue, behind the decode
+        dispatch."""
         t_start = time.monotonic()
-        t_wall = time.time()
         _obs.record_engine_queue_wait(t_start - req.created)
-        pages, n_prompt = claim["pages"], claim["n_prompt"]
+        pages = claim["pages"]
         slot = self.slots[slot_idx]
         slot.request = req
         slot.pages = pages
@@ -2151,6 +2315,7 @@ class LLMEngine:
         slot.private_pages = claim["private_pages"]
         slot.generated = []
         slot.emitted_text_len = 0
+        slot.pending_first = False
         if self.spec_mode == "ngram":
             slot.ngram = _NgramIndex(
                 self.ngram_n, req.prompt_tokens or [], self.NGRAM_LOOKBACK
@@ -2158,11 +2323,67 @@ class LLMEngine:
         table = np.zeros((self.pages_per_slot,), np.int32)
         table[: len(pages)] = pages
         self._page_tables[slot_idx] = table
+        slot.prefill = _PendingPrefill(
+            req=req, table=table, t_start=t_start, t_wall=time.time()
+        )
 
-        logits = self._run_prefill_chunks(req.prompt_tokens, table)
+    def _advance_pending_prefills(self, budget: int | None, spent: int) -> int:
+        """Advance every mid-flight sliced prefill chunk by chunk until
+        ``budget`` prompt tokens have been dispatched this tick (None =
+        unlimited). The first chunk of an otherwise-idle tick always
+        dispatches, so a budget smaller than one chunk still makes
+        progress; slots advance in index order, so earlier admissions
+        finish first. Returns the updated token spend."""
+        C = self.prefill_buckets[-1]
+        for i, s in enumerate(self.slots):
+            pp = s.prefill
+            if pp is None or s.request is None or s.request.aborted:
+                continue  # aborted mid-prefill: the decode-tick reap unwinds
+            n_prompt = len(pp.req.prompt_tokens)
+            advanced = False
+            try:
+                while pp.offset < n_prompt and (
+                    budget is None or spent == 0 or spent < budget
+                ):
+                    pp.logits = self._dispatch_prefill_chunk(
+                        pp.req.prompt_tokens, pp.table, pp.offset
+                    )
+                    step = min(C, n_prompt - pp.offset)
+                    pp.offset += step
+                    spent += step
+                    advanced = True
+                if advanced:
+                    pp.ticks += 1
+                if pp.offset >= n_prompt:
+                    self._finish_sliced_prefill(i, s, pp)
+                elif advanced:
+                    # paused mid-prompt: the next decode block dispatches
+                    # BETWEEN this prompt's chunks — the slice the budget
+                    # exists to cut
+                    pp.suspensions += 1
+                    _obs.record_prefill_sliced()
+            except Exception:
+                # same contract as the grouped path: a failed chunk must not
+                # leave a half-initialized slot, leak its page claim, or
+                # poison the trie with partially-written pages
+                import traceback
+
+                traceback.print_exc()
+                self._fail_slot(i, s.request)
+        return spent
+
+    def _finish_sliced_prefill(
+        self, slot_idx: int, slot: _Slot, pp: _PendingPrefill
+    ) -> None:
+        """Every chunk dispatched: sample the first token (async, seeded by
+        (request seed, position) so slicing can never change it) and park
+        it on the harvest queue — the blocking read happens after the next
+        decode dispatch, exactly like a grouped prefill's."""
+        req = pp.req
         p = req.params
+        n_prompt = len(req.prompt_tokens)
         first = sample(
-            logits,
+            pp.logits,
             self._next_key(),
             jnp.asarray([p.temperature], np.float32),
             jnp.asarray([p.top_p], np.float32),
@@ -2170,20 +2391,113 @@ class LLMEngine:
             seeds=jnp.asarray([_req_seed(req)], np.int32),
             step_ids=jnp.asarray([n_prompt], np.int32),
         )
-        self.stats.prompt_tokens += n_prompt
-        slot.position = n_prompt
-        slot.last_token = int(first[0])
-        slot.fresh = True
-        _obs.record_engine_phase("prefill_chunked", time.monotonic() - t_start)
-        _rt.record_span(
-            req.trace, "prefill", start=t_wall, store=self._trace_store,
-            replica=self.trace_name, n_prompt=n_prompt, chunked=True,
-        )
-        req._decode_span = _rt.begin(
-            req.trace, "decode", replica=self.trace_name,
-            spec_mode=self.spec_mode or "-",
-        )
-        self._accept_token(slot_idx, slot.last_token)
+        slot.prefill = None
+        slot.pending_first = True
+        self._pending_harvest.append((
+            first,
+            [(slot_idx, req, 0, n_prompt)],
+            {
+                "phase": "prefill_chunked",
+                "t_start": pp.t_start,
+                "t_wall": pp.t_wall,
+                "chunks": -(-n_prompt // self.prefill_buckets[-1]),
+                "ticks": pp.ticks,
+            },
+        ))
+
+    def _harvest_prefills(self) -> bool:
+        """Materialize parked first tokens (the ONE blocking read per
+        prefill dispatch, now overlapping the decode block already queued
+        on device) and light their slots up through the fresh-slot
+        override lane. Slots recycled while the prefill was in flight
+        (abort/deadline unwound them) are skipped by request identity,
+        like ``_process_block``'s snapshots."""
+        worked = False
+        while self._pending_harvest:
+            next_tok, rows, meta = self._pending_harvest.popleft()
+            try:
+                next_np = np.asarray(next_tok)
+            except Exception:
+                # a prefill that failed ON DEVICE (materialization error):
+                # unwind every still-owned slot and release the callers —
+                # the no-hang contract of _fail_claims, post-dispatch
+                import traceback
+
+                traceback.print_exc()
+                for slot_idx, req, _row, _n in rows:
+                    if self.slots[slot_idx].request is req:
+                        self._fail_slot(slot_idx, req)
+                continue
+            _obs.record_engine_phase(
+                meta["phase"], time.monotonic() - meta["t_start"]
+            )
+            for slot_idx, req, row, n_prompt in rows:
+                s = self.slots[slot_idx]
+                if s.request is not req or req.aborted:
+                    # recycled or aborted while the prefill was in flight:
+                    # the reap (this tick or the next) owns the unwind —
+                    # same identity rule as _process_block's snapshots
+                    continue
+                s.pending_first = False
+                self.stats.prompt_tokens += n_prompt
+                s.position = n_prompt
+                s.last_token = int(next_np[row])
+                s.fresh = True
+                worked = True
+                if meta["phase"] == "prefill_chunked":
+                    sliced = meta["ticks"] > 1
+                    _rt.record_span(
+                        req.trace, "prefill", start=meta["t_wall"],
+                        store=self._trace_store, replica=self.trace_name,
+                        n_prompt=n_prompt, chunked=True,
+                        chunks=meta["chunks"], sliced=sliced,
+                        budget=self.prefill_budget,
+                    )
+                    if sliced:
+                        _rt.record_span(
+                            req.trace, "prefill_wait", start=meta["t_wall"],
+                            store=self._trace_store, replica=self.trace_name,
+                            ticks=meta["ticks"], chunks=meta["chunks"],
+                        )
+                else:
+                    _rt.record_span(
+                        req.trace, "prefill", start=meta["t_wall"],
+                        store=self._trace_store, replica=self.trace_name,
+                        n_prompt=n_prompt, bucket=meta["bucket"],
+                    )
+                req._decode_span = _rt.begin(
+                    req.trace, "decode", replica=self.trace_name,
+                    spec_mode=self.spec_mode or "-",
+                )
+                self._accept_token(slot_idx, s.last_token)
+        return worked
+
+    def _fail_slot(self, slot_idx: int, req: Request) -> None:
+        """Release one mid-prefill slot whose work failed AFTER dispatch
+        (chunk advance or harvest): unwind from the slot's own page lists
+        and fail the caller loudly — the one sequence shared by every
+        post-dispatch prefill failure path."""
+        s = self.slots[slot_idx]
+        self._unwind_slot(s)
+        s.request = None
+        self._active[slot_idx] = False
+        self._finish_stream(req, _Finish("error"))
+
+    def _unwind_slot(self, slot: _Slot) -> None:
+        """Unwind a slot whose prefill never completed (abort, deadline, or
+        failure mid-chunk / pre-harvest): the ``_fail_claims`` ownership
+        rule, reconstructed from the slot's own page lists — trie pages
+        invalidated so no later request can share never-/partially-written
+        KV, exclusively-owned pages freed."""
+        self._unwind_claim({
+            "pages": slot.pages,
+            "trie_pages": slot.trie_pages,
+            "private_pages": slot.private_pages,
+        })
+        slot.pages, slot.trie_pages, slot.private_pages = [], [], []
+        slot.prefill = None
+        slot.pending_first = False
+        slot.ngram = None
 
     def _prefill_group(self, bucket: int, group: list, is_mm: bool = False) -> None:
         t_start = time.monotonic()
@@ -2212,6 +2526,7 @@ class LLMEngine:
             slot.private_pages = claim["private_pages"]
             slot.generated = []
             slot.emitted_text_len = 0
+            slot.prefill = None
             if self.spec_mode == "ngram":
                 slot.ngram = _NgramIndex(
                     self.ngram_n, req.prompt_tokens or [], self.NGRAM_LOOKBACK
@@ -2275,24 +2590,25 @@ class LLMEngine:
                     jnp.asarray(seq_lens),
                 )
             )
-        next_np = np.asarray(next_tok)
-        _obs.record_engine_phase("prefill", time.monotonic() - t_start)
+        # first tokens stay ON DEVICE: park (next_tok, group) for harvest
+        # after the next decode dispatch — the host never blocks on a
+        # prefill read here, so already-running streams keep their cadence
+        # (this used to be a blocking np.asarray that stalled every
+        # in-flight stream for the whole prefill duration)
+        rows = []
         for i, (slot_idx, req, claim) in enumerate(group):
-            slot = self.slots[slot_idx]
-            self.stats.prompt_tokens += claim["n_prompt"]
-            slot.position = claim["n_prompt"]
-            slot.last_token = int(next_np[i])
-            slot.fresh = True
-            _rt.record_span(
-                req.trace, "prefill", start=t_wall,
-                store=self._trace_store, replica=self.trace_name,
-                n_prompt=claim["n_prompt"], bucket=bucket,
-            )
-            req._decode_span = _rt.begin(
-                req.trace, "decode", replica=self.trace_name,
-                spec_mode=self.spec_mode or "-",
-            )
-            self._accept_token(slot_idx, slot.last_token)
+            self.slots[slot_idx].pending_first = True
+            rows.append((slot_idx, req, i, claim["n_prompt"]))
+        self._pending_harvest.append((
+            next_tok,
+            rows,
+            {
+                "phase": "prefill",
+                "t_start": t_start,
+                "t_wall": t_wall,
+                "bucket": bucket,
+            },
+        ))
 
     def _decode_tick(self) -> bool:
         # fault point (docs/faults.md): one stalled decode tick — a slow
@@ -2310,20 +2626,30 @@ class LLMEngine:
         # expired aborts finish with their own reason, not a fake "stop")
         for i, s in enumerate(self.slots):
             if not s.free and s.request.aborted:
+                req = s.request
                 self._finish_stream(
-                    s.request,
-                    _Finish("deadline")
-                    if s.request.deadline_expired
-                    else _FINISH,
+                    req,
+                    _Finish("deadline") if req.deadline_expired else _FINISH,
                 )
-                self._release_slot_pages(s)
+                if s.prefill is not None or s.pending_first:
+                    # the abort landed mid-prefill (sliced chunks pending,
+                    # or first token unharvested): pages may hold PARTIAL
+                    # KV — unwind the claim (trie pages invalidated) rather
+                    # than releasing them as valid, shareable prefix KV
+                    self._unwind_slot(s)
+                else:
+                    self._release_slot_pages(s)
                 s.request = None
                 self._active[i] = False
-        live = [i for i, s in enumerate(self.slots) if not s.free]
+        live = [i for i, s in enumerate(self.slots) if s.decodable]
 
         if self.spec_gamma:
+            # no pipelined dispatch to protect in spec mode: harvest first
+            # so freshly prefilled slots join this very tick
+            worked = self._harvest_prefills()
+            live = [i for i, s in enumerate(self.slots) if s.decodable]
             if not live:
-                return False
+                return worked
             self._active[:] = False
             for i in live:
                 s = self.slots[i]
@@ -2333,7 +2659,7 @@ class LLMEngine:
                 p = s.request.params
                 self._temps[i] = p.temperature
                 self._seeds[i] = _req_seed(s.request)
-            return self._spec_tick(live)
+            return self._spec_tick(live) or worked
 
         # pipelined path: keep one decode block in flight ahead of the one
         # being read, so the device never waits on the host round trip
@@ -2341,6 +2667,14 @@ class LLMEngine:
         if live:
             self._dispatch_block(live)
             worked = True
+        else:
+            # no decodable slots: a dispatch gap from here on is idleness
+            # or prefill ramp-up, not a stall against live streams
+            self._last_dispatch_at = None
+        # harvest AFTER the dispatch: the blocking first-token reads overlap
+        # the decode block already queued on device — the deferral that
+        # makes admission stall-free
+        worked = self._harvest_prefills() or worked
         if self._inflight and (len(self._inflight) >= 2 or not live):
             worked = self._process_block() or worked
         return worked
@@ -2356,6 +2690,12 @@ class LLMEngine:
         per-block snapshot pins request identity so the host drops output
         rows whose slot was recycled.
         """
+        now = time.monotonic()
+        if self._last_dispatch_at is not None:
+            # dispatch-to-dispatch gap while decodable slots existed the
+            # whole time: the stall the prefill budget bounds to ~one chunk
+            _obs.record_decode_stall(now - self._last_dispatch_at)
+        self._last_dispatch_at = now
         _obs.record_engine_batch(len(live))
         self._active[:] = False
         self._override_mask[:] = False

@@ -42,6 +42,9 @@ METRICS: list[tuple[str, bool, str]] = [
     ("disagg.migration_latency.p95", True, "ratio"),
     ("spec.acceptance_rate", False, "abs"),
     ("kv_cache.bytes_per_slot", True, "ratio"),
+    # stall-free admission (docs/scheduling.md): the budgeted arm's
+    # interactive-stream tail latency under long-prompt interference
+    ("interference.budgeted.tpot_p95", True, "ratio"),
 ]
 
 

@@ -141,6 +141,51 @@ def test_bench_chaos_config_emits_faults_section():
 
 
 @pytest.mark.slow
+def test_bench_mixed_config_emits_interference_section():
+    """The mixed-traffic config must ride the same schema plus an
+    ``interference`` section: the budget-on vs budget-off TPOT A/B for an
+    interactive stream under long-prompt arrivals, and the decode-stall
+    dispatch-gap histogram (docs/scheduling.md, stall-free admission)."""
+    out = subprocess.run(
+        [sys.executable, str(REPO / "bench.py")],
+        capture_output=True,
+        text=True,
+        timeout=500,
+        env={
+            **os.environ,
+            "BENCH_CPU": "1",
+            "BENCH_MODEL": "tiny-mixed",
+            "BENCH_NO_SECONDARY": "1",
+        },
+        cwd=str(REPO),
+    )
+    assert out.returncode == 0, out.stderr[-500:]
+    lines = [l for l in out.stdout.strip().splitlines() if l.strip()]
+    assert len(lines) == 1, lines
+    payload = json.loads(lines[0])
+    assert payload["value"] > 0 and payload["unit"] == "tok/s"
+    inter = payload.get("interference")
+    assert inter, payload
+    assert {"budget_tokens", "chunk_tokens", "budgeted", "unbudgeted",
+            "improvement_p95", "decode_stall"} <= set(inter)
+    assert inter["budget_tokens"] == 64
+    for arm in ("budgeted", "unbudgeted"):
+        stats = inter[arm]
+        assert {"tpot_p50", "tpot_p95", "tpot_max", "pieces"} <= set(stats)
+        assert stats["pieces"] > 0
+        assert 0.0 <= stats["tpot_p50"] <= stats["tpot_p95"] <= stats["tpot_max"]
+    assert inter["improvement_p95"] > 0
+    stall = inter["decode_stall"]
+    assert {"p50", "p95", "count"} <= set(stall)
+    assert stall["count"] >= 1 and stall["p50"] <= stall["p95"]
+    # the stall-free contract itself is timing-sensitive on shared CI
+    # hardware, so the hard direction assertion (budgeted p95 < unbudgeted)
+    # lives in the on-chip revalidation stage, not here — but the mixed run
+    # must never error
+    assert payload["engine_errors"] == 0
+
+
+@pytest.mark.slow
 def test_bench_tp_config_emits_sharded_plan():
     """The TP=2 config must ride the same schema plus the resolved
     per-shard plan: ``tp`` at the top level and ``impl_plan`` reporting the

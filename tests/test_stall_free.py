@@ -1,0 +1,418 @@
+"""Stall-free continuous batching (docs/scheduling.md): the per-tick
+prefill token budget, the resumable sliced chunked prefill, the deferred
+first-token harvest, and the invariants they must preserve — budgeted
+scheduling is token-IDENTICAL to unbudgeted scheduling, an abort or
+deadline landing mid-chunked-prefill unwinds the claim without poisoning
+the prefix trie, and the new observability series record."""
+
+import pytest
+
+
+@pytest.fixture(scope="module")
+def jax(jax_cpu):
+    return jax_cpu
+
+
+def _make_engine(jax, budget=0, seed=0, **kw):
+    from modal_examples_tpu.models import llama
+    from modal_examples_tpu.serving import LLMEngine
+
+    kw.setdefault("max_slots", 4)
+    kw.setdefault("max_model_len", 256)
+    kw.setdefault("page_size", 16)
+    kw.setdefault("prefill_buckets", (16, 32))
+    return LLMEngine(
+        llama.LlamaConfig.tiny(), seed=seed,
+        max_prefill_tokens_per_tick=budget, **kw,
+    )
+
+
+#: > largest test bucket (32), so it takes the chunked-prefill path:
+#: 120 byte-tokens = 4 chunks of 32/32/32/24
+LONG_PROMPT = "x" * 120
+
+
+def _drain(req):
+    """Collect a step-driven request's stream without start()ing the
+    scheduler thread (stream() would)."""
+    import queue as _q
+
+    from modal_examples_tpu.serving.engine import _Finish
+
+    out = []
+    while True:
+        try:
+            item = req.out_queue.get_nowait()
+        except _q.Empty:
+            return out, None
+        if isinstance(item, _Finish):
+            req.finish_reason = item.reason
+            return out, item.reason
+        out.append(item)
+
+
+class TestBudgetResolution:
+    def test_ctor_kwarg_beats_env(self, jax, monkeypatch):
+        monkeypatch.setenv("MTPU_PREFILL_BUDGET", "7")
+        eng = _make_engine(jax, budget=3)
+        assert eng.prefill_budget == 3
+        eng.stop()
+
+    def test_env_resolves_when_unset(self, jax, monkeypatch):
+        monkeypatch.setenv("MTPU_PREFILL_BUDGET", "48")
+        eng = _make_engine(jax, budget=None)
+        assert eng.prefill_budget == 48
+        eng.stop()
+
+    def test_default_is_unlimited(self, jax, monkeypatch):
+        monkeypatch.delenv("MTPU_PREFILL_BUDGET", raising=False)
+        eng = _make_engine(jax, budget=None)
+        assert eng.prefill_budget == 0
+        eng.stop()
+
+    def test_prefill_role_replica_runs_unbudgeted(self, jax):
+        """Disagg prefill replicas have no decode to protect: wrapping an
+        engine as a prefill-role replica zeroes any process-wide budget."""
+        from modal_examples_tpu.scheduling import EngineReplica
+
+        eng = _make_engine(jax, budget=64)
+        EngineReplica(eng, "pre-0", role="prefill")
+        assert eng.prefill_budget == 0
+        eng.stop()
+
+
+class TestSlicedPrefill:
+    def test_budget_slices_chunked_prefill_across_ticks(self, jax):
+        """budget < one chunk: exactly one chunk dispatches per tick (the
+        progress guarantee), the backlog gauge drains chunk by chunk, and
+        the sliced counter counts each suspension."""
+        from modal_examples_tpu.observability import catalog as C
+        from modal_examples_tpu.serving import SamplingParams
+        from modal_examples_tpu.utils.prometheus import default_registry
+
+        sliced_before = default_registry.value(C.PREFILL_SLICED_TOTAL) or 0
+        eng = _make_engine(jax, budget=1)
+        try:
+            req = eng.submit(
+                LONG_PROMPT, SamplingParams(max_tokens=4, temperature=0.0)
+            )
+            n_prompt = len(req.prompt_tokens)  # 120 chars + BOS
+            eng.step()
+            slot = next(s for s in eng.slots if s.request is req)
+            assert slot.prefill is not None
+            assert slot.prefill.offset == 32  # exactly one chunk
+            assert not slot.decodable
+            eng._metrics_wall = 0.0
+            eng._refresh_gauges()
+            assert (
+                default_registry.value(C.PREFILL_BACKLOG_TOKENS)
+                == n_prompt - 32
+            )
+            eng.step()
+            assert slot.prefill.offset == 64
+            for _ in range(40):
+                eng.step()
+                if _drain(req)[1] is not None:
+                    break
+            assert req.finish_reason in ("stop", "length")
+            assert slot.prefill is None and not slot.pending_first
+            # three suspensions: chunks 1..3 each paused mid-prompt
+            assert (
+                default_registry.value(C.PREFILL_SLICED_TOTAL) or 0
+            ) >= sliced_before + 3
+            eng._metrics_wall = 0.0
+            eng._refresh_gauges()
+            assert default_registry.value(C.PREFILL_BACKLOG_TOKENS) == 0
+        finally:
+            eng.stop()
+
+    def test_budget_stops_converting_queue_entries(self, jax):
+        """Short prompts past the budget stay queued (preemption-safe
+        front-requeue, reservations intact) and are admitted on later
+        ticks — never dropped."""
+        from modal_examples_tpu.serving import SamplingParams
+
+        eng = _make_engine(jax, budget=8)
+        try:
+            p = SamplingParams(max_tokens=2, temperature=0.0)
+            reqs = [eng.submit(f"prompt {i}", p) for i in range(4)]
+            eng.step()
+            # one tick converts at most ~budget worth: not all four slots
+            occupied = sum(1 for s in eng.slots if not s.free)
+            assert occupied < 4
+            assert eng.policy.total_depth() == 4 - occupied
+            for _ in range(60):
+                eng.step()
+                if all(_drain(r)[1] or r.finish_reason for r in reqs):
+                    break
+            assert all(
+                r.finish_reason in ("stop", "length") for r in reqs
+            )
+            assert eng.policy.total_depth() == 0
+        finally:
+            eng.stop()
+
+
+class TestSchedulingInvariance:
+    """Slicing must never change results: per-request sampling is keyed by
+    (seed, position), so budget on/off — and sliced vs atomic long
+    prefills — produce token-identical outputs."""
+
+    def _run(self, jax, budget, params_fn):
+        eng = _make_engine(jax, budget=budget, seed=0)
+        try:
+            prompts = [LONG_PROMPT, "short a", "short b", "y" * 100, "zz"]
+            reqs = [eng.submit(p, params_fn()) for p in prompts]
+            outs = ["".join(eng.stream(r)) for r in reqs]
+            reasons = [r.finish_reason for r in reqs]
+            return outs, reasons
+        finally:
+            eng.stop()
+
+    def test_greedy_token_identical(self, jax):
+        from modal_examples_tpu.serving import SamplingParams
+
+        mk = lambda: SamplingParams(max_tokens=6, temperature=0.0)
+        assert self._run(jax, 0, mk) == self._run(jax, 16, mk)
+
+    def test_seeded_sampling_token_identical(self, jax):
+        from modal_examples_tpu.serving import SamplingParams
+
+        mk = lambda: SamplingParams(max_tokens=6, temperature=1.0, seed=77)
+        assert self._run(jax, 0, mk) == self._run(jax, 16, mk)
+
+    def test_auto_seeded_sampling_token_identical(self, jax):
+        """Unseeded temperature>0 requests derive (engine seed, submission
+        index) seeds, so even they must survive rescheduling unchanged."""
+        from modal_examples_tpu.serving import SamplingParams
+
+        mk = lambda: SamplingParams(max_tokens=6, temperature=1.0)
+        assert self._run(jax, 0, mk) == self._run(jax, 16, mk)
+
+    def test_budget_granularities_agree(self, jax):
+        from modal_examples_tpu.serving import SamplingParams
+
+        mk = lambda: SamplingParams(max_tokens=5, temperature=1.0)
+        a = self._run(jax, 1, mk)  # one chunk per tick
+        b = self._run(jax, 64, mk)  # several chunks per tick
+        assert a == b
+
+    def test_budget_flip_on_one_engine_token_identical(self, jax):
+        """The runtime A/B bench.py runs: flip ``prefill_budget`` on ONE
+        live engine between rounds — sliced and atomic prefills of the
+        same prompts must emit the same tokens (greedy and seeded)."""
+        from modal_examples_tpu.serving import SamplingParams
+
+        eng = _make_engine(jax, budget=0, seed=0)
+        try:
+            def round_(params):
+                reqs = [
+                    eng.submit(p, params)
+                    for p in (LONG_PROMPT, "short", "y" * 90)
+                ]
+                return ["".join(eng.stream(r)) for r in reqs]
+
+            for params in (
+                SamplingParams(max_tokens=6, temperature=0.0),
+                SamplingParams(max_tokens=6, temperature=1.0, seed=123),
+            ):
+                eng.prefill_budget = 0
+                atomic = round_(params)
+                eng.prefill_budget = 16
+                sliced = round_(params)
+                assert atomic == sliced, params
+        finally:
+            eng.stop()
+
+
+class TestMidPrefillAbortAndDeadline:
+    """Previously unreachable states (the prefill was atomic): an abort or
+    deadline landing while a chunked prefill is mid-flight must unwind the
+    claim fully, leave the trie unpoisoned, and finish the caller's stream
+    with the right reason."""
+
+    def test_abort_mid_chunk_unwinds_claim(self, jax):
+        from modal_examples_tpu.serving import SamplingParams
+
+        eng = _make_engine(jax, budget=1)
+        try:
+            req = eng.submit(
+                LONG_PROMPT, SamplingParams(max_tokens=4, temperature=0.0)
+            )
+            eng.step()
+            slot = next(s for s in eng.slots if s.request is req)
+            assert slot.prefill is not None and slot.prefill.offset < 120
+            eng.abort(req)
+            eng.step()
+            _, reason = _drain(req)
+            assert reason == "stop"
+            assert slot.free and slot.prefill is None
+            assert not slot.pending_first
+            # claim fully unwound: nothing allocated beyond what the trie
+            # legitimately caches, and none of the aborted prompt's pages
+            # stayed cached (they held partial KV)
+            occ = eng.cache.occupancy()
+            assert occ["pages_used"] == eng.prefix_cache.cached_pages
+            # the trie is not poisoned: rerunning the aborted prompt
+            # prefills from scratch and matches a clean engine's output
+            fresh = _make_engine(jax, budget=0, seed=0)
+            p = SamplingParams(max_tokens=4, temperature=0.0)
+            want = fresh.generate(LONG_PROMPT, p)
+            fresh.stop()
+            assert eng.generate(LONG_PROMPT, p) == want
+        finally:
+            eng.stop()
+
+    def test_abort_while_first_token_unharvested(self, jax):
+        """Abort landing between prefill dispatch and the deferred harvest:
+        the reap unwinds the slot and the harvest skips it by request
+        identity (like a recycled decode-block row)."""
+        from modal_examples_tpu.serving import SamplingParams
+
+        eng = _make_engine(jax, budget=0)
+        try:
+            req = eng.submit(
+                LONG_PROMPT, SamplingParams(max_tokens=4, temperature=0.0)
+            )
+            eng._expire_deadlines()
+            eng._admit()  # unbudgeted: all chunks + sample parked for harvest
+            slot = next(s for s in eng.slots if s.request is req)
+            assert slot.pending_first
+            assert len(eng._pending_harvest) == 1
+            eng.abort(req)
+            eng._decode_tick()  # reap unwinds, harvest skips the dead row
+            _, reason = _drain(req)
+            assert reason == "stop"
+            assert slot.free and not slot.pending_first
+            assert not eng._pending_harvest
+            occ = eng.cache.occupancy()
+            assert occ["pages_used"] == eng.prefix_cache.cached_pages
+        finally:
+            eng.stop()
+
+    def test_deadline_mid_prefill_counts_prefill_stage(self, jax):
+        from modal_examples_tpu.observability import catalog as C
+        from modal_examples_tpu.serving import SamplingParams
+        from modal_examples_tpu.utils.prometheus import default_registry
+
+        t = [0.0]
+        eng = _make_engine(jax, budget=1, clock=lambda: t[0])
+        try:
+            before = (
+                default_registry.value(
+                    C.DEADLINE_MISSES_TOTAL, {"stage": "prefill"}
+                )
+                or 0
+            )
+            req = eng.submit(
+                LONG_PROMPT,
+                SamplingParams(max_tokens=4, temperature=0.0, deadline_s=5.0),
+            )
+            eng.step()
+            slot = next(s for s in eng.slots if s.request is req)
+            assert slot.prefill is not None
+            t[0] = 10.0  # blow the deadline while chunks are pending
+            eng.step()
+            _, reason = _drain(req)
+            assert reason == "deadline"
+            assert slot.free
+            assert (
+                default_registry.value(
+                    C.DEADLINE_MISSES_TOTAL, {"stage": "prefill"}
+                )
+                == before + 1
+            )
+            occ = eng.cache.occupancy()
+            assert occ["pages_used"] == eng.prefix_cache.cached_pages
+        finally:
+            eng.stop()
+
+
+class TestDeferredHarvest:
+    def test_group_first_tokens_harvest_after_decode_dispatch(self, jax):
+        """A batch of short prompts admitted in one tick parks its first
+        tokens on the harvest queue and still lights every slot up within
+        that same tick (no token is lost to the deferral)."""
+        from modal_examples_tpu.serving import SamplingParams
+
+        eng = _make_engine(jax, budget=0)
+        try:
+            p = SamplingParams(max_tokens=3, temperature=0.0)
+            reqs = [eng.submit(f"group {i}", p) for i in range(3)]
+            eng.step()
+            assert not eng._pending_harvest  # harvested inside the tick
+            assert sum(1 for s in eng.slots if s.decodable) == 3
+            for _ in range(40):
+                eng.step()
+                if all(_drain(r)[1] or r.finish_reason for r in reqs):
+                    break
+            assert all(r.finish_reason in ("stop", "length") for r in reqs)
+        finally:
+            eng.stop()
+
+    def test_decode_stall_histogram_records(self, jax):
+        """The dispatch-gap histogram (the stall-free contract's
+        measurement) must record under concurrent traffic and ride the
+        registry exposition that /metrics serves."""
+        from modal_examples_tpu.observability import catalog as C
+        from modal_examples_tpu.serving import SamplingParams
+        from modal_examples_tpu.utils.prometheus import default_registry
+
+        eng = _make_engine(jax, budget=16)
+        try:
+            p = SamplingParams(max_tokens=8, temperature=1.0)
+            reqs = [eng.submit(LONG_PROMPT, p)] + [
+                eng.submit(f"r{i}", p) for i in range(3)
+            ]
+            for r in reqs:
+                "".join(eng.stream(r))
+        finally:
+            eng.stop()
+        q = default_registry.histogram_quantiles(C.DECODE_STALL_SECONDS)
+        assert q is not None and q["count"] >= 1
+
+
+class TestSlicedPrefillSpans:
+    def test_sliced_request_records_prefill_wait_span(self, jax):
+        from modal_examples_tpu.observability import reqtrace as rt
+        from modal_examples_tpu.serving import SamplingParams
+
+        eng = _make_engine(jax, budget=1)
+        try:
+            req = eng.submit(
+                LONG_PROMPT, SamplingParams(max_tokens=3, temperature=0.0)
+            )
+            "".join(eng.stream(req))
+            assert req.trace is not None
+            n_chunks = -(-len(req.prompt_tokens) // 32)
+            by = {}
+            for s in rt.read_trace(req.request_id):
+                by.setdefault(s["name"], []).append(s)
+            pf = by["prefill"][0]["attrs"]
+            assert pf["chunked"] is True
+            assert pf["chunks"] == n_chunks and pf["sliced"] is True
+            assert pf["budget"] == 1
+            wait = by["prefill_wait"][0]["attrs"]
+            assert wait["ticks"] == n_chunks and wait["chunks"] == n_chunks
+        finally:
+            eng.stop()
+
+    def test_unsliced_long_prefill_has_no_wait_span(self, jax):
+        from modal_examples_tpu.observability import reqtrace as rt
+        from modal_examples_tpu.serving import SamplingParams
+
+        eng = _make_engine(jax, budget=0)
+        try:
+            req = eng.submit(
+                LONG_PROMPT, SamplingParams(max_tokens=3, temperature=0.0)
+            )
+            "".join(eng.stream(req))
+            assert req.trace is not None
+            by = {}
+            for s in rt.read_trace(req.request_id):
+                by.setdefault(s["name"], []).append(s)
+            pf = by["prefill"][0]["attrs"]
+            assert pf["chunked"] is True and pf["sliced"] is False
+            assert "prefill_wait" not in by
+        finally:
+            eng.stop()
