@@ -165,6 +165,33 @@ CONFIGS = {
     "tiny-chaos": dict(
         slots=4, max_len=128, max_tokens=16, timeout=420, chaos=True
     ),
+    # CPU path-proof of the closed fleet loop (test_bench_contract,
+    # docs/fleet.md): after the measured run, the open-loop load generator
+    # drives a calibrated saturating sweep against an OpenAI server fronting
+    # the engine — pinned single replica first, then with the FleetAutoscaler
+    # scaling decode replicas out via snapshot-restored warm boots — and the
+    # json carries a `fleet` section (goodput, p99 TTFT/TPOT vs offered
+    # load, shed rate, scale events, A/B at the knee)
+    # max_len 384: the byte-level tokenizer makes the loadgen's
+    # shared-prefix prompts 100-300 TOKENS, and a clipped prompt would
+    # finish after one token and measure nothing but prefill
+    # fleet_max 2: scaled replicas share the host's cores with the primary
+    # on the CPU path-proof, and a third engine is pure contention there.
+    # ONE slot per replica: the pinned replica is then slot-bound while
+    # the host keeps CPU headroom, so scale-out adds real capacity — with
+    # 2+ slots a single tiny engine is CPU-bound and the A/B flatlines
+    "tiny-fleet": dict(
+        slots=1, max_len=384, max_tokens=8, timeout=420, fleet=True,
+        fleet_step_s=4.0, fleet_max=2,
+    ),
+    # the on-chip fleet sweep (revalidate_chip.sh stage 14): the headline
+    # int8 shape under production-shaped open-loop traffic. max 2 decode
+    # replicas — each warm boot restores a full int8 weight set (~7 GB), so
+    # v5e HBM holds two replicas plus caches and no more.
+    "llama2-7b-fleet-sweep": dict(
+        slots=16, max_len=384, max_tokens=64, timeout=1500, quant="int8",
+        kv_dtype="int8", fleet=True, fleet_step_s=10.0, fleet_max=2,
+    ),
 }
 
 
@@ -294,6 +321,135 @@ def _measure_interference(engine, spec: dict) -> dict:
     }
 
 
+def _fleet_n_pages(spec: dict) -> int:
+    """KV page pool for fleet-config engines: low-slot fleets keep
+    multi-slot slack so prefix warmth and queued claims don't fight over
+    one slot's pool — ONE formula for the primary and every scale-out
+    replica, or their A/B would silently diverge."""
+    pages_per_slot = (spec["max_len"] + 15) // 16
+    return 1 + max(4, spec["slots"]) * pages_per_slot
+
+
+def _measure_fleet(engine, spec: dict, make_engine) -> dict:
+    """Closed-loop fleet A/B (docs/fleet.md): front the warm engine with a
+    router + OpenAI server, calibrate single-replica capacity with an
+    overload burst, then run the same saturating open-loop sweep twice —
+    pinned to one replica, and with the FleetAutoscaler growing decode
+    replicas via snapshot-restored warm boots. The A/B at the pinned arm's
+    knee is where closing the loop must pay: higher goodput, lower
+    client-observed p99 TPOT, scale events journaled. Ends with an idle
+    tail so the scale-back-in path is exercised too."""
+    import time as _time
+
+    from modal_examples_tpu.fleet import FleetAutoscaler, SnapshotWarmFactory
+    from modal_examples_tpu.fleet.loadgen import (
+        LoadGenerator,
+        RequestClass,
+        ab_index,
+        fleet_section,
+    )
+    from modal_examples_tpu.scheduling import EngineReplica, PrefixAffinityRouter
+    from modal_examples_tpu.serving.openai_api import OpenAIServer
+
+    router = PrefixAffinityRouter(
+        [EngineReplica(engine, "decode-0", role="unified")]
+    )
+    server = OpenAIServer(router=router, host="127.0.0.1", port=0).start()
+    # the default class mix sized to this config's context budget (byte
+    # tokenizer: prompts are CHARACTERS; prompt + max_tokens must fit
+    # max_len or the engine clips the completion to nothing)
+    classes = (
+        RequestClass("interactive", "interactive", 0.5, (1, 2), 16, 2.0, 0.5),
+        RequestClass("streaming", "default", 0.3, (1, 3), 32, 4.0, 0.5),
+        RequestClass("batch", "batch", 0.2, (2, 4), 24, 30.0, 2.0,
+                     stream=False),
+    )
+
+    def build(name, role, params=None):
+        from modal_examples_tpu.serving import SamplingParams
+
+        eng = make_engine(params=params)
+        # compile-cache hits (the primary compiled the same shapes): the
+        # replica joins the fleet jitted, not paying first-request
+        # compiles. warmup() skips the chunk-offset jits long prompts hit,
+        # so serve one short and one chunking prompt before placement too.
+        eng.warmup()
+        eng.start()
+        for warm_prompt in ("warm " * 8, "boot warm long prompt " * 12):
+            eng.generate(warm_prompt, SamplingParams(max_tokens=4))
+        return EngineReplica(eng, name, role=role)
+
+    factory = SnapshotWarmFactory(
+        build, snapshot_key=f"fleet-bench-{os.getpid()}"
+    )
+    factory.prime(engine)  # scale-outs restore, never re-init
+    lg = LoadGenerator(
+        f"http://127.0.0.1:{server.port}", classes=classes, seed=0,
+        request_timeout_s=90.0,
+    )
+    step_s = float(spec.get("fleet_step_s", 3.0))
+    autoscaler = FleetAutoscaler(
+        router,
+        factory,
+        max_replicas={"decode": int(spec.get("fleet_max", 3))},
+        queue_high=2.0,
+        up_ticks=1,
+        down_ticks=4,
+        cooldown_s=1.0,
+        tick_s=0.2,
+        slos=(),  # the bench registry carries warmup-phase latencies
+    )
+    try:
+        lg.warm(n_per_class=1)
+        # first closed-loop probe is a THROWAWAY: concurrent traffic is
+        # what flushes the long tail of (bucket, chunk-offset) jit compiles
+        # warm() cannot enumerate; the second probe measures the fleet
+        lg.calibrate(duration_s=min(1.5, step_s))
+        capacity = lg.calibrate(duration_s=min(2.5, step_s))
+        rates = [0.6 * capacity, 1.25 * capacity, 2.5 * capacity]
+        pinned = lg.sweep(rates, step_s)
+        autoscaler.start()
+        autoscaled = lg.sweep(rates, step_s)
+        # the ascending ladder only scales out at its saturating step, so
+        # re-measure the knee-adjacent rate NOW, fleet still scaled out —
+        # the A/B the section headlines (see fleet_section)
+        scaled_step = None
+        if len(router.replicas) > 1:
+            scaled_step = lg.run_step(
+                rates[ab_index(pinned)], 1.5 * step_s, label="ab-scaled"
+            )
+        # idle tail: load is gone — the controller must scale back in
+        deadline = _time.monotonic() + 30.0
+        while len(router.replicas) > 1 and _time.monotonic() < deadline:
+            _time.sleep(0.2)
+        scaled_back_to = len(router.replicas)
+    finally:
+        autoscaler.stop()
+        # anything the controller left registered (scale-in not reached
+        # inside the tail window) is swept so the child exits clean
+        for r in list(router.replicas):
+            if r.name != "decode-0":
+                try:
+                    router.remove_replica(r.name)
+                    r.engine.stop()
+                except Exception:
+                    pass
+        factory.store.delete(factory.snapshot_key)  # bench key: no LRU churn
+        # NOT server.stop(): that would also stop every replica engine,
+        # including the primary the _child epilogue still reads/stops
+        server.httpd.shutdown()
+        server.httpd.server_close()
+    section = fleet_section(
+        pinned,
+        autoscaled,
+        scale_events=autoscaler.events,
+        capacity_rps=capacity,
+        scaled_step=scaled_step,
+    )
+    section["scaled_back_to"] = scaled_back_to
+    return section
+
+
 def _child(model: str) -> None:
     spec = CONFIGS[model]
     # measured runs keep the distributed request tracer sampled OUT
@@ -302,6 +458,12 @@ def _child(model: str) -> None:
     # bench-with-tracing deliberately; `tpurun benchdiff` then shows what
     # the instrumentation costs.
     os.environ.setdefault("MTPU_TRACE_SAMPLE", "0")
+    if spec.get("fleet"):
+        # production admission shape for the open-loop sweep: bounded
+        # queues turn sustained overload into honest 429s (the shed-rate
+        # axis of the fleet section) instead of minutes-deep queue waits.
+        # Must land before the engine builds its AdmissionController.
+        os.environ.setdefault("MTPU_SCHED_MAX_QUEUE", str(4 * spec["slots"]))
     if spec.get("tp", 1) > 1 and os.environ.get("BENCH_CPU"):
         # CPU TP path-proof needs virtual devices BEFORE jax imports
         flags = os.environ.get("XLA_FLAGS", "")
@@ -365,6 +527,9 @@ def _child(model: str) -> None:
         max_slots=spec["slots"],
         max_model_len=spec["max_len"],
         page_size=16,
+        # fleet configs may run 1 slot/replica (see tiny-fleet): keep
+        # multi-slot page slack so prefix warmth survives next to claims
+        n_pages=_fleet_n_pages(spec) if spec.get("fleet") else None,
         prefill_buckets=(64, 128, 256),
         # "int8" = quantized paged KV (half the decode KV HBM traffic and
         # residency, docs/kv_cache.md); default bf16
@@ -516,6 +681,31 @@ def _child(model: str) -> None:
     if spec.get("mixed"):
         interference = _measure_interference(engine, spec)
 
+    # closed-loop fleet A/B (fleet configs, docs/fleet.md): saturating
+    # open-loop sweep against an OpenAI front, pinned vs autoscaled —
+    # scale-out replicas are built by this factory with snapshot-restored
+    # params (quantization=None then: the restored tree is already
+    # quantized; re-quantizing it would corrupt the weights)
+    fleet_info = None
+    if spec.get("fleet"):
+        def _mk_fleet_engine(params=None):
+            return LLMEngine(
+                cfg,
+                params=params,
+                max_slots=spec["slots"],
+                max_model_len=spec["max_len"],
+                page_size=16,
+                n_pages=_fleet_n_pages(spec),
+                prefill_buckets=(64, 128, 256),
+                kv_dtype=spec.get("kv_dtype", jnp.bfloat16),
+                quantization=spec.get("quant") if params is None else None,
+                paged_impl="pallas",
+                mesh=mesh,
+                max_prefill_tokens_per_tick=spec.get("budget", 0),
+            )
+
+        fleet_info = _measure_fleet(engine, spec, _mk_fleet_engine)
+
     errors = engine.error_count
     engine.stop()
 
@@ -639,6 +829,7 @@ def _child(model: str) -> None:
                 **({"disagg": disagg_info} if disagg_info else {}),
                 **({"faults": faults_info} if faults_info else {}),
                 **({"interference": interference} if interference else {}),
+                **({"fleet": fleet_info} if fleet_info else {}),
             }
         )
     )
@@ -1068,6 +1259,7 @@ def main() -> int:
             "llama2-7b-tp2-int8-ctx1024",
             "llama2-7b-int8-spec-ngram",
             "llama2-7b-mixed-ctx1024",
+            "llama2-7b-fleet-sweep",
             "llama2-7b-disagg-2rep",
             "llama2-7b-int8-spec-draft1b",
             "llama2-7b-int8-s32",

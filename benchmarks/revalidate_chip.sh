@@ -76,3 +76,11 @@ if [ -n "$PREV" ]; then
 else
   echo "stage 13 SKIPPED: no BENCH_r*.json to diff against"
 fi
+# 14. closed-loop fleet sweep (docs/fleet.md), behind the regression gate:
+#     the int8 headline shape under production-shaped open-loop traffic —
+#     calibrated saturating sweep, pinned single replica vs FleetAutoscaler
+#     growing a second replica via snapshot-restored warm boot; the json's
+#     `fleet` section (goodput, p99 TTFT/TPOT vs offered load, shed rate,
+#     scale events, A/B at the knee) is what bench_diff's fleet.* metrics
+#     gate from the next round on
+timeout 1500 env BENCH_MODEL=llama2-7b-fleet-sweep BENCH_NO_SECONDARY=1 python bench.py || exit 21

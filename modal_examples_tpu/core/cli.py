@@ -23,6 +23,7 @@ Usage:
     tpurun top [--watch S]             # live serving summary + SLO burn rates
     tpurun disagg [--watch S]          # replica roles, migrations, KV tiers
     tpurun chaos [--last N]            # fault-injection episodes + invariants
+    tpurun fleet [--last N]            # fleet-autoscaler decisions + boots
 """
 
 from __future__ import annotations
@@ -959,6 +960,93 @@ def cmd_chaos(argv: list[str]) -> int:
     return 0
 
 
+def cmd_fleet(argv: list[str]) -> int:
+    """Fleet-autoscaler view: replica counts by role, scale decisions by
+    action/trigger, boot latency (warm snapshot-restore vs cold init), and
+    the newest decision-journal records (``<state_dir>/fleet.jsonl``) —
+    the replica-fleet companion of ``tpurun scaler`` (docs/fleet.md).
+
+    ``--last N`` shows the newest N journal records (default 20);
+    ``--dir PATH`` overrides the state dir root.
+    """
+    from pathlib import Path
+
+    from ..observability import catalog as C
+    from ..observability.export import pushed_jobs
+    from ..observability.journal import DecisionJournal
+    from ..utils.prometheus import merge_expositions, parse_exposition
+
+    usage = "usage: tpurun fleet [--last N] [--dir PATH]"
+    argv, root = _pop_dir_flag(argv, usage)
+    argv, last_s = _pop_flag(argv, "--last", usage)
+    last = int(last_s) if last_s is not None else 20
+
+    state_root = Path(root) if root else _config.state_dir()
+    journal = DecisionJournal(state_root / "fleet.jsonl")
+    records = journal.tail(last)
+
+    jobs = pushed_jobs(Path(root) / "metrics" if root else None)
+    merged = parse_exposition(merge_expositions(jobs)) if jobs else None
+
+    replicas: dict[str, float] = {}
+    decisions: dict[tuple[str, str], float] = {}
+    if merged is not None:
+        for lbls, v in merged.series(C.FLEET_REPLICAS):
+            replicas[lbls.get("role", "?")] = v
+        for lbls, v in merged.series(C.FLEET_DECISIONS_TOTAL):
+            decisions[(lbls.get("action", "?"), lbls.get("trigger", "?"))] = v
+    if not decisions:
+        # no pushed metrics: aggregate over the WHOLE journal (its own
+        # file bound), not the --last display window — the counts table
+        # prints as totals and must not be silently capped at N
+        for rec in journal.tail(1 << 20):
+            key = (rec.get("action", "?"), rec.get("trigger", "?"))
+            decisions[key] = decisions.get(key, 0) + 1
+
+    if not records and not decisions:
+        print(
+            "no fleet decisions recorded yet "
+            "(run the tiny-fleet bench config or a FleetAutoscaler first)"
+        )
+        return 0
+    if replicas:
+        print("replicas: " + "  ".join(
+            f"{role}={int(n)}" for role, n in sorted(replicas.items()) if n
+        ))
+    if decisions:
+        print(f"{'ACTION':<12} {'TRIGGER':<16} {'COUNT':>6}")
+        for (action, trigger), n in sorted(decisions.items()):
+            print(f"{action:<12} {trigger:<16} {int(n):>6}")
+    if merged is not None:
+        for boot in ("warm", "cold"):
+            q = merged.histogram_quantiles(
+                C.FLEET_BOOT_SECONDS, quantiles=(0.5, 0.95),
+                aggregate={"boot": boot},
+            )
+            if q:
+                print(
+                    f"{boot} boots: p50 {q['p50'] * 1000:.0f} ms   "
+                    f"p95 {q['p95'] * 1000:.0f} ms"
+                )
+    if records:
+        print()
+        print(
+            f"{'ACTION':<12} {'ROLE':<8} {'REPLICA':<14} {'TRIGGER':<16} "
+            f"{'BOOT':<6} {'N->N':>7}"
+        )
+        for rec in records:
+            boot = rec.get("boot") or "-"
+            before = rec.get("replicas_before")
+            after = rec.get("replicas_after")
+            sizes = f"{before}->{after}" if before is not None else "-"
+            print(
+                f"{rec.get('action', '?'):<12} {rec.get('role', '?'):<8} "
+                f"{rec.get('replica', '?'):<14} {rec.get('trigger', '?'):<16} "
+                f"{boot:<6} {sizes:>7}"
+            )
+    return 0
+
+
 def cmd_app(argv: list[str]) -> int:
     if argv and argv[0] == "list":
         reg = _config.state_dir() / "apps.json"
@@ -987,6 +1075,7 @@ COMMANDS = {
     "sched": cmd_sched,
     "disagg": cmd_disagg,
     "chaos": cmd_chaos,
+    "fleet": cmd_fleet,
     "top": cmd_top,
     "examples": cmd_examples,
     "docs": cmd_docs,

@@ -1,0 +1,32 @@
+"""Fleet layer: closed-loop replica autoscaling + the production load harness.
+
+Every organ for the "millions of users" story already exists — SLO burn
+rates (observability/slo.py), snapshot warm boots (snapshot/), the
+role-aware router with health re-admission (scheduling/router.py),
+KV-pressure shedding (scheduling/admission.py) — but until this layer
+nothing closed the loop at the *replica fleet* level: the executor
+autoscaler scales containers, not serving replicas. Two cooperating
+components (docs/fleet.md):
+
+- :mod:`.autoscaler` — :class:`FleetAutoscaler`, a closed-loop controller
+  that grows/shrinks prefill and decode replicas behind a
+  ``PrefixAffinityRouter`` from SLO burn rate, per-class queue depth, and
+  KV-page pressure, with hysteresis + cooldown and snapshot-restored warm
+  boots (:class:`SnapshotWarmFactory`). Every decision is journaled to
+  ``<state_dir>/fleet.jsonl`` and counted in the fleet catalog series
+  (``FLEET_REPLICAS`` / ``FLEET_DECISIONS_TOTAL`` / ``FLEET_BOOT_SECONDS``;
+  ``tpurun fleet``, gateway ``/fleet``).
+- :mod:`.loadgen` — an open-loop load generator (Poisson / heavy-tail
+  arrivals, mixed request classes with per-class SLOs, multi-tenant
+  shared-prefix populations) driving the OpenAI endpoint and emitting the
+  BENCH ``fleet`` section. It is a DRIVER like ``faults.chaos``:
+  production code never imports it (``tests/test_static.py`` enforces
+  the ban) — import it explicitly from tests, ``bench.py``, or operator
+  tooling.
+"""
+
+from __future__ import annotations
+
+from .autoscaler import FleetAutoscaler, SnapshotWarmFactory
+
+__all__ = ["FleetAutoscaler", "SnapshotWarmFactory"]

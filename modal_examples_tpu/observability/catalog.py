@@ -166,6 +166,20 @@ PREFIX_TIER_PAGES = "mtpu_prefix_tier_pages"
 #: gauge {tier}: serialized bytes resident per spill tier (host | volume)
 PREFIX_TIER_BYTES = "mtpu_prefix_tier_bytes"
 
+# -- fleet autoscaler (modal_examples_tpu/fleet, docs/fleet.md) -------------
+
+#: gauge {role}: replicas currently registered in the fleet, by serving
+#: role (prefill | decode | unified) — the closed-loop autoscaler's output
+FLEET_REPLICAS = "mtpu_fleet_replicas"
+#: counter {action, trigger}: fleet autoscaler decisions journaled to
+#: <state_dir>/fleet.jsonl; action = scale_up | scale_down, trigger =
+#: slo_burn | queue_pressure | kv_pressure | shed_pressure | idle |
+#: min_replicas (floor fill) | drain_timeout (forced reap)
+FLEET_DECISIONS_TOTAL = "mtpu_fleet_decisions_total"
+#: histogram {boot}: replica build+start seconds at scale-out;
+#: boot = warm (snapshot-restored params) | cold (full init)
+FLEET_BOOT_SECONDS = "mtpu_fleet_boot_seconds"
+
 # -- SLO engine (observability/slo.py) --------------------------------------
 
 #: gauge {slo}: observed/target burn rate per declared SLO (>1 = violating)
@@ -411,6 +425,22 @@ CATALOG: dict[str, dict] = {
     PREFIX_TIER_BYTES: {
         "type": "gauge", "labels": ["tier"],
         "help": "serialized bytes resident per spill tier",
+    },
+    FLEET_REPLICAS: {
+        "type": "gauge", "labels": ["role"],
+        "help": "replicas registered in the fleet, by serving role",
+    },
+    FLEET_DECISIONS_TOTAL: {
+        "type": "counter", "labels": ["action", "trigger"],
+        "help": "fleet autoscaler decisions journaled "
+                "(action=scale_up|scale_down, trigger=slo_burn|"
+                "queue_pressure|kv_pressure|shed_pressure|idle|"
+                "min_replicas|drain_timeout)",
+    },
+    FLEET_BOOT_SECONDS: {
+        "type": "histogram", "labels": ["boot"],
+        "help": "replica build+start seconds at scale-out "
+                "(boot=warm snapshot-restored | cold full init)",
     },
     SLO_BURN_RATE: {
         "type": "gauge", "labels": ["slo"],

@@ -536,3 +536,39 @@ def record_scaler_decision(
         labels={"function": function, "action": action},
         help=C.CATALOG[C.SCALER_DECISIONS_TOTAL]["help"],
     )
+
+
+# -- fleet autoscaler (modal_examples_tpu/fleet) ------------------------------
+
+
+def set_fleet_replicas(
+    role: str, n: int, *, registry: Registry | None = None
+) -> None:
+    _reg(registry).gauge_set(
+        C.FLEET_REPLICAS, float(n),
+        labels={"role": role},
+        help=C.CATALOG[C.FLEET_REPLICAS]["help"],
+    )
+
+
+def record_fleet_decision(
+    action: str, trigger: str, *, registry: Registry | None = None
+) -> None:
+    _reg(registry).counter_inc(
+        C.FLEET_DECISIONS_TOTAL, 1.0,
+        labels={"action": action, "trigger": trigger},
+        help=C.CATALOG[C.FLEET_DECISIONS_TOTAL]["help"],
+    )
+
+
+def record_fleet_boot(
+    seconds: float, boot: str, *, registry: Registry | None = None
+) -> None:
+    """One replica build+start at scale-out; ``boot`` says whether the
+    params came back from a memory snapshot (``warm``) or full init
+    (``cold``) — the near-instant-scale-out evidence."""
+    _reg(registry).histogram_observe(
+        C.FLEET_BOOT_SECONDS, seconds,
+        labels={"boot": boot},
+        help=C.CATALOG[C.FLEET_BOOT_SECONDS]["help"],
+    )

@@ -196,6 +196,50 @@ class PrefixAffinityRouter:
                 "replica to own requests"
             )
 
+    # -- fleet membership (modal_examples_tpu/fleet, docs/fleet.md) ----------
+
+    def add_replica(self, replica) -> None:
+        """Register a replica under live traffic. Rendezvous hashing means
+        only the keys the newcomer now wins remap to it — every other
+        prompt keeps its affinity replica, so a scale-out never stampedes
+        the prefix caches. Lists are rebuilt copy-on-write under the lock;
+        in-flight ``route()`` calls finish against the snapshot they read."""
+        if getattr(replica, "role", "unified") not in ROLES:
+            raise ValueError(f"unknown replica role {replica.role!r}")
+        with self._lock:
+            if any(r.name == replica.name for r in self.replicas):
+                raise ValueError(f"replica name {replica.name!r} already registered")
+            replicas = self.replicas + [replica]
+            self.replicas = replicas
+            self._serving = [
+                r for r in replicas
+                if getattr(r, "role", "unified") != "prefill"
+            ]
+
+    def remove_replica(self, name: str):
+        """Deregister a replica from placement; returns it. The replica
+        stops receiving NEW requests immediately, but requests it already
+        owns keep streaming (ownership rides on the request, not on the
+        router), so the caller drains ``outstanding()`` to zero before
+        stopping the engine — see ``FleetAutoscaler._scale_down``."""
+        with self._lock:
+            victim = next((r for r in self.replicas if r.name == name), None)
+            if victim is None:
+                raise KeyError(f"no replica named {name!r}")
+            replicas = [r for r in self.replicas if r.name != name]
+            serving = [
+                r for r in replicas
+                if getattr(r, "role", "unified") != "prefill"
+            ]
+            if getattr(victim, "role", "unified") != "prefill" and not serving:
+                raise ValueError(
+                    "cannot remove the last decode-capable replica"
+                )
+            self.replicas = replicas
+            self._serving = serving
+            self._down.pop(name, None)
+        return victim
+
     # -- placement -----------------------------------------------------------
 
     def _key(self, tokens: list[int]) -> bytes:

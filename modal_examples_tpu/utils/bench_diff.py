@@ -45,6 +45,12 @@ METRICS: list[tuple[str, bool, str]] = [
     # stall-free admission (docs/scheduling.md): the budgeted arm's
     # interactive-stream tail latency under long-prompt interference
     ("interference.budgeted.tpot_p95", True, "ratio"),
+    # closed fleet loop (docs/fleet.md): the autoscaled arm's goodput and
+    # client-observed p99 TPOT at the pinned fleet's saturation knee — a
+    # regression here means the autoscaler stopped absorbing the load the
+    # single replica cannot serve
+    ("fleet.goodput", False, "ratio"),
+    ("fleet.p99_tpot_at_knee", True, "ratio"),
 ]
 
 

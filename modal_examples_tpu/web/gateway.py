@@ -87,6 +87,40 @@ def _disagg_snapshot() -> dict:
     }
 
 
+def _fleet_snapshot(last: int = 20) -> dict:
+    """Fleet-autoscaler snapshot: replica counts by role and decision
+    counters from the process registry, boot-latency quantiles by kind
+    (warm snapshot-restore vs cold init), plus the newest records from the
+    fleet decision journal — the ``/fleet`` route's payload (``tpurun
+    fleet`` renders the same data from pushed metrics; docs/fleet.md)."""
+    from .._internal import config as _config
+    from ..observability import catalog as C
+    from ..observability.journal import DecisionJournal
+    from ..utils.prometheus import default_registry as reg
+
+    replicas = {
+        labels.get("role", "?"): v
+        for labels, v in reg.series(C.FLEET_REPLICAS)
+    }
+    decisions: dict = {}
+    for labels, v in reg.series(C.FLEET_DECISIONS_TOTAL):
+        action = labels.get("action", "?")
+        decisions.setdefault(action, {})[labels.get("trigger", "?")] = v
+    boots = {
+        boot: reg.histogram_quantiles(
+            C.FLEET_BOOT_SECONDS, aggregate={"boot": boot}
+        )
+        for boot in ("warm", "cold")
+    }
+    journal = DecisionJournal(_config.state_dir() / "fleet.jsonl").tail(last)
+    return {
+        "replicas": replicas,
+        "decisions": decisions,
+        "boot_seconds": {k: v for k, v in boots.items() if v},
+        "journal": journal,
+    }
+
+
 def _chaos_snapshot(last: int = 10) -> dict:
     """Chaos-harness snapshot: injected-fault counters per catalog point
     (live registry) plus the newest episode records from the chaos journal
@@ -246,18 +280,32 @@ class _Handler(BaseHTTPRequestHandler):
         ``/traces[/<call_id>]`` (call-lifecycle span JSON), ``/healthz``
         (SLO pass/fail + burn rates), ``/autoscaler[?function=tag]``
         (the autoscaler decision journal), ``/disagg`` (replica roles,
-        migration counters, prefix-tier occupancy — docs/disagg.md), and
+        migration counters, prefix-tier occupancy — docs/disagg.md),
         ``/chaos`` (injected-fault counters + episode journal —
-        docs/faults.md). User endpoints with the same label win — these
-        only answer when no route claimed the path."""
+        docs/faults.md), and ``/fleet`` (fleet-autoscaler replica counts,
+        decisions, boot latencies + journal — docs/fleet.md). User
+        endpoints with the same label win — these only answer when no
+        route claimed the path."""
         parts = parsed.path.strip("/").split("/")
         label = parts[0] if parts else ""
         if method != "GET" or label not in (
-            "metrics", "traces", "healthz", "autoscaler", "disagg", "chaos"
+            "metrics", "traces", "healthz", "autoscaler", "disagg", "chaos",
+            "fleet",
         ):
             return False
         if label == "disagg":
             self._respond_json(200, _disagg_snapshot())
+            return True
+        if label == "fleet":
+            q = {
+                k: v[-1]
+                for k, v in urllib.parse.parse_qs(parsed.query).items()
+            }
+            try:
+                n = int(q.get("n", 20))
+            except ValueError:
+                n = 20
+            self._respond_json(200, _fleet_snapshot(last=n))
             return True
         if label == "chaos":
             q = {

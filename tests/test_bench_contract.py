@@ -141,6 +141,65 @@ def test_bench_chaos_config_emits_faults_section():
 
 
 @pytest.mark.slow
+def test_bench_fleet_config_emits_fleet_section():
+    """The fleet config must ride the same schema plus a ``fleet``
+    section: the calibrated saturating open-loop sweep (pinned vs
+    autoscaled arms), the knee, the scaled-fleet A/B, shed rate, and the
+    scale events with their snapshot-restored warm boots (docs/fleet.md).
+    ``fleet.goodput`` / ``fleet.p99_tpot_at_knee`` are what benchdiff
+    gates round over round."""
+    out = subprocess.run(
+        [sys.executable, str(REPO / "bench.py")],
+        capture_output=True,
+        text=True,
+        timeout=500,
+        env={
+            **os.environ,
+            "BENCH_CPU": "1",
+            "BENCH_MODEL": "tiny-fleet",
+            "BENCH_NO_SECONDARY": "1",
+        },
+        cwd=str(REPO),
+    )
+    assert out.returncode == 0, out.stderr[-500:]
+    lines = [l for l in out.stdout.strip().splitlines() if l.strip()]
+    assert len(lines) == 1, lines
+    payload = json.loads(lines[0])
+    assert payload["value"] > 0 and payload["unit"] == "tok/s"
+    fleet = payload.get("fleet")
+    assert fleet, payload
+    assert {"arrival", "capacity_rps", "rates", "knee_rps", "goodput",
+            "p99_tpot_at_knee", "shed_rate", "ab", "sweep",
+            "scale_events"} <= set(fleet)
+    assert fleet["capacity_rps"] > 0
+    assert len(fleet["rates"]) == 3
+    assert fleet["goodput"] > 0
+    assert 0.0 <= fleet["shed_rate"] <= 1.0
+    # the sweep arms: every step terminal, nothing wedged
+    for arm in ("pinned", "autoscaled"):
+        steps = fleet["sweep"][arm]
+        assert len(steps) == 3
+        for s in steps:
+            assert s["wedged"] == 0, (arm, s)
+            assert s["offered"] >= s["completed"] + s["shed"] - 1
+    # the saturating step must actually saturate the pinned replica
+    assert fleet["sweep"]["pinned"][-1]["shed"] > 0
+    # scale-out happened, via snapshot-restored warm boots, and the
+    # idle tail scaled the fleet back to its floor
+    ev = fleet["scale_events"]
+    assert ev["up"] >= 1 and ev["warm_boots"] == ev["up"]
+    assert fleet["scaled_back_to"] == 1
+    ab = fleet["ab"]
+    assert ab["scaled_out"] is True
+    for side in ("pinned", "autoscaled"):
+        assert {"goodput_rps", "shed_rate", "ttft_p99", "tpot_p99",
+                "wedged"} <= set(ab[side])
+        assert ab[side]["wedged"] == 0
+    assert ab["improvement_goodput"] > 0
+    assert payload["engine_errors"] == 0
+
+
+@pytest.mark.slow
 def test_bench_mixed_config_emits_interference_section():
     """The mixed-traffic config must ride the same schema plus an
     ``interference`` section: the budget-on vs budget-off TPOT A/B for an

@@ -105,6 +105,102 @@ class TestChaosAcceptance:
         assert snap["wedged"] == 0
 
 
+class TestChaosUnderLoad:
+    """ISSUE 11: chaos driven CONCURRENTLY with the open-loop load
+    generator — self-healing measured, not just asserted. A two-replica
+    fleet serves a fixed offered load for a fault-free baseline window and
+    again with a seeded fault episode armed (health flap, decode stall,
+    page pressure); the PR-8 fleet invariants must hold afterwards AND the
+    goodput dip during the fault window must be bounded: recovery is a
+    throughput statement, not a liveness one (docs/fleet.md)."""
+
+    def test_goodput_dip_under_faults_is_bounded(
+        self, jax_cpu, state_dir, monkeypatch
+    ):
+        monkeypatch.setenv("MTPU_TRACE_SAMPLE", "0")
+        from modal_examples_tpu.faults.chaos import (
+            check_drained,
+            check_router_recovered,
+        )
+        from modal_examples_tpu.faults.inject import FaultPlan, active
+        from modal_examples_tpu.fleet.loadgen import LoadGenerator, RequestClass
+        from modal_examples_tpu.models import llama
+        from modal_examples_tpu.scheduling import (
+            EngineReplica,
+            PrefixAffinityRouter,
+        )
+        from modal_examples_tpu.serving import LLMEngine
+        from modal_examples_tpu.serving.openai_api import OpenAIServer
+
+        cfg = llama.LlamaConfig.tiny()
+        eng_a = LLMEngine(
+            cfg, seed=0, max_slots=2, max_model_len=384, page_size=16,
+            prefill_buckets=(64, 128),
+        )
+        # second replica shares the weight buffers: one init, two engines
+        eng_b = LLMEngine(
+            cfg, params=eng_a.params, max_slots=2, max_model_len=384,
+            page_size=16, prefill_buckets=(64, 128),
+        )
+        router = PrefixAffinityRouter(
+            [
+                EngineReplica(eng_a, "uni-a", role="unified"),
+                EngineReplica(eng_b, "uni-b", role="unified"),
+            ],
+            reprobe_s=0.2,
+        )
+        server = OpenAIServer(router=router, host="127.0.0.1", port=0)
+        server.start()
+        try:
+            classes = (
+                RequestClass(
+                    "interactive", "interactive", 0.7, (1, 2), 16, 5.0, 1.0
+                ),
+                RequestClass(
+                    "batch", "batch", 0.3, (2, 3), 16, 30.0, 2.0,
+                    stream=False,
+                ),
+            )
+            lg = LoadGenerator(
+                f"http://127.0.0.1:{server.port}", classes=classes, seed=3,
+                request_timeout_s=60.0,
+            )
+            lg.warm(n_per_class=1)
+            capacity = lg.calibrate(duration_s=1.5)
+            rate = 0.6 * capacity  # comfortable: the dip isolates the faults
+            baseline = lg.run_step(rate, 4.0, label="baseline")
+            plan = FaultPlan(
+                {
+                    "router.health_flap": {"on_hit": 2},
+                    "engine.slow_decode": {"on_hit": 3},
+                    "engine.out_of_pages": {"on_hit": 4},
+                },
+                seed=3,
+            )
+            with active(plan):
+                faulted = lg.run_step(rate, 4.0, label="faulted")
+            recovered = lg.run_step(rate, 2.0, label="recovered")
+
+            fired = plan.fired()
+            assert fired, "the episode never injected anything"
+            assert fired.get("router.health_flap"), fired
+            # liveness: nothing wedges or errors in ANY window
+            for step in (baseline, faulted, recovered):
+                assert step["wedged"] == 0, step
+                assert step["errors"] == 0, step
+            # fleet invariants (PR 8) after the fault window drained
+            assert check_drained({"uni-a": eng_a, "uni-b": eng_b}) == []
+            assert check_router_recovered(router) == []
+            # the measured self-healing clause: the fault window still
+            # delivered a bounded fraction of fault-free goodput
+            assert baseline["goodput_rps"] > 0
+            assert faulted["goodput_rps"] >= 0.25 * baseline["goodput_rps"], (
+                baseline, faulted,
+            )
+        finally:
+            server.stop()
+
+
 class TestTraceUnderChaos:
     def test_chaos_requests_carry_fault_events(self, chaos_report):
         """Acceptance: a chaos episode's injected faults appear as span

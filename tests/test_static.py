@@ -579,6 +579,71 @@ def test_production_code_never_imports_the_chaos_driver():
     )
 
 
+def test_production_code_never_imports_the_load_generator():
+    """Layering (the faults.chaos rule applied to the fleet layer):
+    production modules may import ``fleet.autoscaler`` (the closed-loop
+    controller) but NEVER ``fleet.loadgen`` (the driver that synthesizes
+    overload on purpose) — a production import would put traffic
+    synthesis on the serving path. Tests, bench.py, and operator tooling
+    import it explicitly."""
+    offenders = []
+    loadgen_path = PKG_ROOT / "fleet" / "loadgen.py"
+    for path in sorted(PKG_ROOT.rglob("*.py")):
+        if path == loadgen_path:
+            continue
+        tree = ast.parse(path.read_text())
+        for node in ast.walk(tree):
+            names = []
+            if isinstance(node, ast.Import):
+                names = [a.name for a in node.names]
+            elif isinstance(node, ast.ImportFrom):
+                mod = node.module or ""
+                if mod.endswith("loadgen"):
+                    names = [mod]
+                elif mod.endswith("fleet") or mod == "":
+                    names = [
+                        a.name for a in node.names if a.name == "loadgen"
+                    ]
+            if any("loadgen" in n for n in names):
+                offenders.append(
+                    f"{path.relative_to(REPO_ROOT)}:{node.lineno}"
+                )
+    assert not offenders, (
+        f"production modules importing fleet.loadgen: {offenders}"
+    )
+
+
+def test_fleet_series_declared_and_emitted():
+    """Closure for the ``mtpu_fleet_*`` series, both directions: the
+    package-wide name guard above already rejects an UNDECLARED fleet
+    series; this adds the reverse — every declared ``mtpu_fleet_*``
+    catalog constant must be referenced by a live emitter somewhere in
+    the package (a series the autoscaler stopped emitting would otherwise
+    rot in the catalog, the docs table, and the gateway payload)."""
+    from modal_examples_tpu.observability import catalog
+
+    fleet_consts = {
+        attr: val
+        for attr, val in vars(catalog).items()
+        if isinstance(val, str) and val.startswith("mtpu_fleet_")
+    }
+    assert len(fleet_consts) >= 3, fleet_consts
+    catalog_path = PKG_ROOT / "observability" / "catalog.py"
+    unused = []
+    for attr in fleet_consts:
+        referenced = any(
+            re.search(rf"\b{attr}\b", path.read_text())
+            for path in sorted(PKG_ROOT.rglob("*.py"))
+            if path != catalog_path
+        )
+        if not referenced:
+            unused.append(attr)
+    assert not unused, (
+        "mtpu_fleet_* series declared in the catalog but never referenced "
+        f"by an emitter/reader in the package: {unused}"
+    )
+
+
 def test_disabled_fault_gate_is_structurally_a_no_op():
     """The gate's zero-cost contract, pinned at the AST level: ``fire``'s
     FIRST statement must be the ``_active_plan is None -> return False``
