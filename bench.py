@@ -165,6 +165,23 @@ CONFIGS = {
     "tiny-chaos": dict(
         slots=4, max_len=128, max_tokens=16, timeout=420, chaos=True
     ),
+    # CPU path-proof of in-flight failover (test_bench_contract,
+    # docs/failover.md): after the measured run, streams are killed
+    # mid-decode by an injected scheduler crash and checkpoint-resumed on
+    # a second replica; the json carries a `failover` section
+    # {takeover_latency p50/p95, tokens_replayed, resumed_identical} —
+    # the takeover p95 is what bench_diff gates round over round
+    "tiny-failover": dict(
+        slots=4, max_len=192, max_tokens=32, timeout=420, failover=True
+    ),
+    # the on-chip failover A/B at the int8 headline shape
+    # (revalidate_chip.sh, behind the benchdiff gate): what a mid-stream
+    # replica death costs a real llama2-7b stream — takeover latency and
+    # replayed-prefill work with HBM-sized KV
+    "llama2-7b-failover": dict(
+        slots=16, max_len=384, max_tokens=64, timeout=1500, quant="int8",
+        kv_dtype="int8", failover=True,
+    ),
     # CPU path-proof of the closed fleet loop (test_bench_contract,
     # docs/fleet.md): after the measured run, the open-loop load generator
     # drives a calibrated saturating sweep against an OpenAI server fronting
@@ -328,6 +345,104 @@ def _fleet_n_pages(spec: dict) -> int:
     replica, or their A/B would silently diverge."""
     pages_per_slot = (spec["max_len"] + 15) // 16
     return 1 + max(4, spec["slots"]) * pages_per_slot
+
+
+def _measure_failover(engine, spec: dict, make_engine) -> dict:
+    """In-flight failover A/B (docs/failover.md): greedy reference streams
+    first, then the same streams killed mid-decode by an injected
+    scheduler crash on their replica and checkpoint-resumed on a second
+    one (weights shared — one set in HBM). Emits the `failover` section:
+    client-observed takeover latency p50/p95, generated-prefix tokens
+    replayed by the reactive re-prefill, and the exactness verdict
+    (resumed output == fault-free reference, byte for byte)."""
+    import queue as _queue
+    import threading as _threading
+    import time as _time
+
+    from modal_examples_tpu.faults.inject import FaultPlan, active
+    from modal_examples_tpu.observability import catalog as C
+    from modal_examples_tpu.scheduling import (
+        EngineReplica,
+        PrefixAffinityRouter,
+    )
+    from modal_examples_tpu.serving import SamplingParams
+    from modal_examples_tpu.utils.prometheus import default_registry
+
+    eng_a = make_engine(params=engine.params)
+    eng_b = make_engine(params=engine.params)
+    rep_a = EngineReplica(eng_a, "fo-a", role="unified")
+    rep_b = EngineReplica(eng_b, "fo-b", role="unified")
+    router = PrefixAffinityRouter([rep_a, rep_b], reprobe_s=0.2)
+    sp = SamplingParams(max_tokens=2 * spec["max_tokens"], temperature=0.0)
+    prompts = [
+        f"the quick brown fox jumps over the lazy dog variant {i}"
+        for i in range(min(4, spec["slots"]))
+    ]
+    replayed0 = default_registry.total(C.FAILOVER_TOKENS_REPLAYED_TOTAL)
+    failovers0 = default_registry.total(C.FAILOVER_TOTAL)
+    try:
+        eng_a.start()  # the victim; B boots lazily at takeover
+        reference = {p: eng_a.generate(p, sp) for p in prompts}
+        reqs, outs, threads = [], {}, []
+        for p in prompts:
+            req = rep_a.submit(p, sp)
+            req._router_replica = rep_a
+            reqs.append(req)
+            outs[req.request_id] = pieces = []
+            t = _threading.Thread(
+                target=lambda r=req, buf=pieces: buf.extend(router.stream(r))
+            )
+            t.start()
+            threads.append(t)
+        deadline = _time.monotonic() + 120
+        while _time.monotonic() < deadline and not all(
+            len(r.generated_tokens) >= 3 for r in reqs
+        ):
+            _time.sleep(0.002)
+        # freeze the victim's scheduler (a blocking control command) so
+        # the streams stay mid-decode, arm the crash, then release: the
+        # next tick dies with every stream live — the kill is
+        # deterministic, not a race against tiny-model decode speed
+        freeze = _threading.Event()
+        eng_a._ctrl.append((freeze.wait, _queue.Queue()))
+        plan = FaultPlan({"engine.scheduler_crash": {"on_hit": 1}})
+        with active(plan):
+            freeze.set()
+            deadline = _time.monotonic() + 60
+            while not plan.fired() and _time.monotonic() < deadline:
+                _time.sleep(0.002)
+        for t in threads:
+            t.join(timeout=300)
+        identical = all(
+            not t.is_alive() for t in threads
+        ) and all(
+            r.finish_reason in ("stop", "length")
+            and "".join(outs[r.request_id]) == reference[r.prompt]
+            for r in reqs
+        )
+        takeover = default_registry.histogram_quantiles(
+            C.FAILOVER_TAKEOVER_SECONDS
+        ) or {}
+        return {
+            "streams": len(reqs),
+            "failovers": int(
+                default_registry.total(C.FAILOVER_TOTAL) - failovers0
+            ),
+            "takeover_latency": {
+                k: round(takeover[k], 6) if isinstance(takeover[k], float)
+                else takeover[k]
+                for k in ("p50", "p95", "count")
+                if k in takeover
+            },
+            "tokens_replayed": int(
+                default_registry.total(C.FAILOVER_TOKENS_REPLAYED_TOTAL)
+                - replayed0
+            ),
+            "resumed_identical": bool(identical),
+        }
+    finally:
+        eng_a.stop()
+        eng_b.stop()
 
 
 def _measure_fleet(engine, spec: dict, make_engine) -> dict:
@@ -706,6 +821,34 @@ def _child(model: str) -> None:
 
         fleet_info = _measure_fleet(engine, spec, _mk_fleet_engine)
 
+    # in-flight failover A/B (failover configs, docs/failover.md): streams
+    # killed mid-decode on one replica, checkpoint-resumed on another —
+    # weights aliased (params=engine.params, already quantized) so HBM
+    # holds one weight set plus the two caches
+    failover_info = None
+    if spec.get("failover"):
+        # the measured engine's loop must be quiet first: the injected
+        # scheduler crash counts hits process-globally, and the victim
+        # replica's loop must be the ONLY one running for the kill to
+        # land deterministically (the measured traffic is already done)
+        engine.stop()
+
+        def _mk_failover_engine(params=None):
+            return LLMEngine(
+                cfg,
+                params=params,
+                max_slots=spec["slots"],
+                max_model_len=spec["max_len"],
+                page_size=16,
+                prefill_buckets=(64, 128, 256),
+                kv_dtype=spec.get("kv_dtype", jnp.bfloat16),
+                quantization=None if params is not None else spec.get("quant"),
+                paged_impl="pallas",
+                mesh=mesh,
+            )
+
+        failover_info = _measure_failover(engine, spec, _mk_failover_engine)
+
     errors = engine.error_count
     engine.stop()
 
@@ -830,6 +973,7 @@ def _child(model: str) -> None:
                 **({"faults": faults_info} if faults_info else {}),
                 **({"interference": interference} if interference else {}),
                 **({"fleet": fleet_info} if fleet_info else {}),
+                **({"failover": failover_info} if failover_info else {}),
             }
         )
     )

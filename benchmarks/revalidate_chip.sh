@@ -84,3 +84,10 @@ fi
 #     scale events, A/B at the knee) is what bench_diff's fleet.* metrics
 #     gate from the next round on
 timeout 1500 env BENCH_MODEL=llama2-7b-fleet-sweep BENCH_NO_SECONDARY=1 python bench.py || exit 21
+# 15. in-flight failover at the int8 headline shape (docs/failover.md),
+#     behind the regression gate: streams killed mid-decode and
+#     checkpoint-resumed on a second replica (weights aliased) — the
+#     json's `failover` section (takeover p50/p95, tokens_replayed,
+#     resumed_identical: true) is what bench_diff's
+#     failover.takeover_latency.p95 gates from the next round on
+timeout 1500 env BENCH_MODEL=llama2-7b-failover BENCH_NO_SECONDARY=1 python bench.py || exit 22

@@ -168,6 +168,13 @@ class FleetAutoscaler:
         cooldown_s: float = 5.0,  # no further action after any action
         tick_s: float = 0.5,
         drain_timeout_s: float = 60.0,
+        # drain-by-migration (serving/failover.py, docs/failover.md): a
+        # scale-in victim's live requests are checkpoint-migrated onto the
+        # remaining fleet instead of waited out — drain time is bounded by
+        # one migration per request, and the old forced reap (which killed
+        # live streams at drain_timeout) becomes migrate-then-reap. False
+        # restores the PR-11 idle-wait behavior.
+        migrate_on_drain: bool = True,
         journal_path=None,
         registry=None,
         slos=None,  # SLO tuple for the burn signal; () disables it
@@ -187,6 +194,16 @@ class FleetAutoscaler:
         self.cooldown_s = float(cooldown_s)
         self.tick_s = float(tick_s)
         self.drain_timeout_s = float(drain_timeout_s)
+        self.migrate_on_drain = bool(migrate_on_drain)
+        #: per-victim decode tokens carried off by drain migrations (what
+        #: fleet.jsonl records instead of requests killed)
+        self._drained_tokens: dict[str, int] = {}
+        #: per-victim (last_attempt_at, consecutive_failures): a victim
+        #: whose requests cannot move yet (targets shedding) is retried
+        #: with a growing backoff instead of every tick — without this a
+        #: stuck 60 s drain window would spam ~120 journal records,
+        #: fallback metrics, and failover spans per request
+        self._drain_attempts: dict[str, tuple[float, int]] = {}
         self.journal = DecisionJournal(
             journal_path or (_config.state_dir() / "fleet.jsonl")
         )
@@ -450,17 +467,35 @@ class FleetAutoscaler:
         return rec
 
     def _scale_down(self, group: str, sig: dict) -> dict | None:
-        # newest owned replica that is healthy and idle; the seed fleet is
-        # never reaped, and a replica on the router's down list is the
-        # health re-admission cycle's business, not ours (anti-flap)
+        # newest owned replica that is healthy — idle preferred, but with
+        # drain-by-migration a BUSY victim is eligible too: its live
+        # requests move to the remaining fleet in one migration each
+        # (docs/failover.md), so scale-in no longer waits for request
+        # completion. The seed fleet is never reaped, and a replica on the
+        # router's down list is the health re-admission cycle's business,
+        # not ours (anti-flap).
         victim = None
+        busy = None
         for name in reversed(self._owned[group]):
             r = next(
                 (x for x in self.router.replicas if x.name == name), None
             )
-            if r is not None and r.healthy() and r.outstanding() == 0:
+            if r is None or not r.healthy():
+                continue
+            if r.outstanding() == 0:
                 victim = r
                 break
+            if (
+                busy is None
+                and self.migrate_on_drain
+                # only engines with the live-migration surface: a busy
+                # victim that cannot migrate would fall straight into the
+                # drain_timeout forced reap (_reap_drained's duck-typing)
+                and hasattr(r.engine, "migrate_out")
+            ):
+                busy = r
+        if victim is None:
+            victim = busy
         if victim is None:
             return None
         self.router.remove_replica(victim.name)
@@ -484,15 +519,80 @@ class FleetAutoscaler:
 
     def _reap_drained(self, now: float) -> None:
         """Stop the engines of removed replicas once their last requests
-        finished. A replica that will not drain within ``drain_timeout_s``
-        is stopped anyway (its engine releases any caller loudly) — a leak
-        bounded in time beats a zombie engine held forever."""
+        are gone. With ``migrate_on_drain`` a victim's live requests are
+        checkpoint-migrated onto the remaining fleet RIGHT HERE
+        (serving/failover.py) — drain time is bounded by one migration per
+        request, not request completion, and ``fleet.jsonl`` records the
+        ``tokens_migrated`` carried off instead of requests killed. A
+        replica that still will not drain within ``drain_timeout_s`` is
+        stopped anyway (its engine releases any caller loudly; the
+        router-level stream failover then resumes them reactively) — a
+        leak bounded in time beats a zombie engine held forever."""
         still: list[tuple[object, float]] = []
         for replica, removed_at in self._draining:
             timed_out = now - removed_at > self.drain_timeout_s
+            last_at, fails = self._drain_attempts.get(replica.name, (0.0, 0))
+            if (
+                self.migrate_on_drain
+                and replica.outstanding() > 0
+                and getattr(replica, "serves_requests", True)
+                # duck-typed: only engines with the live-migration surface
+                # (a remote/fake replica without it keeps the idle-wait +
+                # timeout behavior)
+                and hasattr(replica.engine, "migrate_out")
+                # backoff: after N consecutive no-progress attempts, wait
+                # tick_s * 2^N (capped) before trying again
+                and now - last_at >= min(self.tick_s * (2 ** fails), 10.0)
+            ):
+                try:
+                    from ..serving import failover as _failover
+
+                    moved = _failover.drain_replica(replica, self.router)
+                except Exception:
+                    logger.exception(
+                        "fleet: drain migration failed for %s", replica.name
+                    )
+                    moved = None
+                progressed = bool(
+                    moved and (moved["migrated"] or moved["resumed"])
+                )
+                self._drain_attempts[replica.name] = (
+                    now, 0 if progressed else fails + 1
+                )
+                # journal progress always; pure-failure attempts only once
+                # per stuck victim (the retry spam the backoff bounds)
+                if moved and (
+                    progressed or (moved["failed"] and fails == 0)
+                ):
+                    self._drained_tokens[replica.name] = (
+                        self._drained_tokens.get(replica.name, 0)
+                        + moved["tokens_migrated"]
+                    )
+                    rec = {
+                        "at": time.time(),
+                        "action": "drain_migrate",
+                        "role": _role_group(replica),
+                        "replica": replica.name,
+                        **moved,
+                    }
+                    self.journal.record(rec)
+                    self.events.append(rec)
+                    logger.info(
+                        "fleet drain_migrate %s: %s", replica.name, moved
+                    )
             if replica.outstanding() == 0 or timed_out:
                 try:
-                    replica.engine.stop()
+                    if timed_out and replica.outstanding() > 0:
+                        # forced reap with live streams: release them as
+                        # ERRORS so the router-level reactive failover
+                        # resumes them — a "stop" release would end them
+                        # as silently truncated successes
+                        try:
+                            replica.engine.stop(reason="error")
+                        except TypeError:  # engine without the kwarg
+                            replica.engine.stop()
+                    else:
+                        replica.engine.stop()
                 except Exception:
                     logger.warning(
                         "fleet: engine stop failed for %s", replica.name
@@ -508,7 +608,12 @@ class FleetAutoscaler:
                         "trigger": "drain_timeout",
                         "role": _role_group(replica),
                         "replica": replica.name,
+                        "tokens_migrated": self._drained_tokens.get(
+                            replica.name, 0
+                        ),
                     })
+                self._drained_tokens.pop(replica.name, None)
+                self._drain_attempts.pop(replica.name, None)
             else:
                 still.append((replica, removed_at))
         self._draining = still

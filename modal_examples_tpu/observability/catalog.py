@@ -155,6 +155,35 @@ DISAGG_CHUNK_RETRIES_TOTAL = "mtpu_disagg_chunk_retries_total"
 #: role (prefill | decode | unified)
 REPLICA_ROLE = "mtpu_replica_role"
 
+# -- in-flight request failover (serving/failover.py, docs/failover.md) -----
+
+#: counter {mode, result}: in-flight request takeovers; mode = reactive
+#: (replica died — re-prefill prompt+generated-prefix from the decode
+#: checkpoint) | migrate (proactive live KV migration on drain/rebalance);
+#: result = ok | failed (no healthy target / resubmission shed)
+FAILOVER_TOTAL = "mtpu_failover_total"
+#: counter: generated-prefix tokens replayed (teacher-forced through the
+#: decode program) on reactive failover — the work redone because the dead
+#: replica's KV was lost; the prompt half re-prefills from the (often
+#: warm) prefix cache — docs/failover.md
+FAILOVER_TOKENS_REPLAYED_TOTAL = "mtpu_failover_tokens_replayed_total"
+#: histogram: client-observed takeover latency — stream error detected (or
+#: migration started) to the resumed request accepted on the new replica
+FAILOVER_TAKEOVER_SECONDS = "mtpu_failover_takeover_seconds"
+#: counter {result}: proactive live migrations of MID-DECODE requests
+#: (result = ok | fallback (reactive resume carried it after a wire/adopt
+#: failure) | aborted (client abort / deadline during the migration) |
+#: failed (reservation shed, victim unresponsive, or the fallback resume
+#: itself refused — the request was NOT moved))
+MIGRATION_LIVE_TOTAL = "mtpu_migration_live_total"
+#: counter: decode tokens carried across live migrations (each migrated
+#: request contributes its generated-so-far count — the work scale-in no
+#: longer throws away; ``fleet.jsonl``'s ``tokens_migrated`` source)
+MIGRATION_LIVE_TOKENS_TOTAL = "mtpu_migration_live_tokens_total"
+#: histogram: live-migration latency, checkpoint extraction -> adopted on
+#: the target (the bound on drain time per request)
+MIGRATION_LIVE_SECONDS = "mtpu_migration_live_seconds"
+
 # -- tiered prefix cache (serving/disagg/tiered_cache.py) -------------------
 
 #: counter {tier}: prefix PAGES served per tier (page units on every tier,
@@ -414,6 +443,35 @@ CATALOG: dict[str, dict] = {
         "help": "replica serving role, info metric "
                 "(role=prefill|decode|unified, value 1)",
     },
+    FAILOVER_TOTAL: {
+        "type": "counter", "labels": ["mode", "result"],
+        "help": "in-flight request takeovers "
+                "(mode=reactive|migrate, result=ok|failed)",
+    },
+    FAILOVER_TOKENS_REPLAYED_TOTAL: {
+        "type": "counter", "labels": [],
+        "help": "generated-prefix tokens replayed through the decode "
+                "program on reactive failover",
+    },
+    FAILOVER_TAKEOVER_SECONDS: {
+        "type": "histogram", "labels": [],
+        "help": "takeover latency: failure detected to resumed request "
+                "accepted on the new replica",
+    },
+    MIGRATION_LIVE_TOTAL: {
+        "type": "counter", "labels": ["result"],
+        "help": "proactive live migrations of mid-decode requests "
+                "(result=ok|fallback|aborted|failed)",
+    },
+    MIGRATION_LIVE_TOKENS_TOTAL: {
+        "type": "counter", "labels": [],
+        "help": "decode tokens carried across live migrations",
+    },
+    MIGRATION_LIVE_SECONDS: {
+        "type": "histogram", "labels": [],
+        "help": "live-migration latency: checkpoint extraction to adopted "
+                "on the target",
+    },
     PREFIX_TIER_HITS_TOTAL: {
         "type": "counter", "labels": ["tier"],
         "help": "prefix pages served per tier (tier=hbm|host|volume)",
@@ -563,6 +621,15 @@ SPAN_CATALOG: dict[str, dict] = {
         "attrs": ["replica", "pages"],
         "help": "migrated block scattered into the decode replica's cache "
                 "(on its scheduler thread)",
+    },
+    "failover": {
+        "attrs": ["replica", "source", "target", "mode", "position",
+                  "tokens_replayed", "result"],
+        "help": "an in-flight request's takeover by another replica "
+                "(mode=reactive re-prefill | migrate live KV move); "
+                "extends the SAME trace id past the failed replica's root "
+                "close, so `tpurun explain` shows death and resumption on "
+                "one timeline",
     },
     "spec_verify": {
         "attrs": ["replica", "proposed", "accepted"],

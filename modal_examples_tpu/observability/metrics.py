@@ -395,6 +395,66 @@ def set_replica_role(
     )
 
 
+# -- in-flight request failover (serving/failover.py) -------------------------
+
+
+def record_failover(
+    mode: str, result: str, *, tokens_replayed: int = 0,
+    registry: Registry | None = None,
+) -> None:
+    """One in-flight takeover attempt (mode=reactive|migrate); a reactive
+    resume also counts the generated-prefix tokens it re-prefilled."""
+    reg = _reg(registry)
+    reg.counter_inc(
+        C.FAILOVER_TOTAL, 1.0,
+        labels={"mode": mode, "result": result},
+        help=C.CATALOG[C.FAILOVER_TOTAL]["help"],
+    )
+    if tokens_replayed:
+        reg.counter_inc(
+            C.FAILOVER_TOKENS_REPLAYED_TOTAL, float(tokens_replayed),
+            help=C.CATALOG[C.FAILOVER_TOKENS_REPLAYED_TOTAL]["help"],
+        )
+
+
+def record_failover_takeover(
+    seconds: float, *, registry: Registry | None = None
+) -> None:
+    _reg(registry).histogram_observe(
+        C.FAILOVER_TAKEOVER_SECONDS, seconds,
+        buckets=C.TOKEN_TIME_BUCKETS,
+        help=C.CATALOG[C.FAILOVER_TAKEOVER_SECONDS]["help"],
+    )
+
+
+def record_live_migration(
+    result: str, *, tokens: int = 0, registry: Registry | None = None
+) -> None:
+    """One proactive live migration of a mid-decode request; a successful
+    one counts the decode tokens it carried (fleet.jsonl's
+    ``tokens_migrated`` source)."""
+    reg = _reg(registry)
+    reg.counter_inc(
+        C.MIGRATION_LIVE_TOTAL, 1.0,
+        labels={"result": result},
+        help=C.CATALOG[C.MIGRATION_LIVE_TOTAL]["help"],
+    )
+    if tokens:
+        reg.counter_inc(
+            C.MIGRATION_LIVE_TOKENS_TOTAL, float(tokens),
+            help=C.CATALOG[C.MIGRATION_LIVE_TOKENS_TOTAL]["help"],
+        )
+
+
+def record_live_migration_seconds(
+    seconds: float, *, registry: Registry | None = None
+) -> None:
+    _reg(registry).histogram_observe(
+        C.MIGRATION_LIVE_SECONDS, seconds,
+        help=C.CATALOG[C.MIGRATION_LIVE_SECONDS]["help"],
+    )
+
+
 def record_tier_hit(
     tier: str, *, n: int = 1, registry: Registry | None = None
 ) -> None:

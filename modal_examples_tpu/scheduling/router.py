@@ -420,8 +420,28 @@ class PrefixAffinityRouter:
             raise KeyError(f"request {req.request_id} not routed here")
         return replica
 
+    def failover_target(self, exclude: str | None = None):
+        """A healthy decode-capable replica to resume a failed request on
+        (serving/failover.py, docs/failover.md): least-outstanding among
+        healthy serving replicas, preferring any replica other than
+        ``exclude`` — but allowing ``exclude`` itself when it is the only
+        healthy one left (an injected transient crash leaves the engine
+        alive and able to take its own requests back). None = no healthy
+        replica; the caller surfaces the error honestly."""
+        healthy = self._candidates(self._serving)
+        pool = [r for r in healthy if r.name != exclude] or healthy
+        if not pool:
+            return None
+        return min(pool, key=lambda r: (r.outstanding(), r.name))
+
     def stream(self, req):
-        yield from self.replica_for(req).stream(req)
+        """Stream ``req``'s pieces with in-flight failover: a replica
+        dying mid-stream (terminal ``error``) is checkpoint-resumed on a
+        healthy peer and the stream continues token-identically — the
+        consumer never sees the seam (serving/failover.py)."""
+        from ..serving import failover as _failover
+
+        yield from _failover.stream_with_failover(self, req)
 
     def abort(self, req) -> None:
         self.replica_for(req).abort(req)

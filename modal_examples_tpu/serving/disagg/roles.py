@@ -380,8 +380,46 @@ class DisaggCoordinator:
             raise KeyError(f"request {req.request_id} not routed here")
         return replica
 
+    def failover_target(self, exclude: str | None = None):
+        """Resume target for a failed in-flight request: delegate to the
+        role-aware router (healthy decode-capable replicas only)."""
+        return self.router.failover_target(exclude=exclude)
+
     def stream(self, req):
-        yield from self.replica_for(req).stream(req)
+        """Stream with in-flight failover (serving/failover.py): a decode
+        replica dying mid-stream is checkpoint-resumed on a healthy peer
+        — the client stream continues token-identically, no visible
+        error (docs/failover.md)."""
+        from ..failover import stream_with_failover
+
+        yield from stream_with_failover(self, req)
+
+    def migrate_live(self, req, target_name: str | None = None) -> str:
+        """Coordinator-planned rebalancing: proactively move one in-flight
+        request off its current replica — KV pages and decode state ride
+        the same chunked MTKV1 wire the prefill migration uses, and the
+        target adopts mid-decode. Returns the
+        :func:`~..failover.migrate_request` result string."""
+        from ..failover import migrate_request
+
+        source = self.replica_for(req)
+        if target_name is not None:
+            target = next(
+                (r for r in self.replicas if r.name == target_name), None
+            )
+            if target is None or not target.serves_requests:
+                raise KeyError(
+                    f"no decode-capable replica named {target_name!r}"
+                )
+        else:
+            target = self.failover_target(exclude=source.name)
+            if target is None or target.name == source.name:
+                return "gone"  # nowhere better to move it
+        return migrate_request(
+            source, target, req,
+            chunk_bytes=self.chunk_bytes, max_rounds=self.max_rounds,
+            channel_factory=self._channel_factory,
+        )
 
     def abort(self, req) -> None:
         """Abort a request wherever it is: still migrating (the transfer

@@ -28,6 +28,15 @@ first token, seed). This module turns that into bytes and back:
   of the destination cache — the same ``.at[:, ids].set`` scatter shape the
   prefill page writes use, applied leaf-by-leaf through the pytree.
 
+**Decode-state leg (docs/failover.md).** A live-migrated MID-DECODE request
+ships through the same envelope with ``meta["resume"] = {"generated":
+[...], "emitted_len": n}`` next to the first-token sampler state — the
+accepted-token history and emitted-text cursor the target needs to adopt a
+running stream. The extension is purely additive meta: the byte layout,
+magic, and leaf framing are unchanged, so a plain PR-6 first-token block
+still decodes and adopts everywhere (tests/test_static.py pins the compat
+both ways), and a receiver that predates the leg simply ignores it.
+
 See docs/disagg.md for the byte layout and the failure matrix.
 """
 

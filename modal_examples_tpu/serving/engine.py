@@ -78,6 +78,15 @@ class Request:
     first_token_at: float | None = None
     last_token_at: float | None = None
     n_generated: int = 0
+    # failover state (serving/failover.py, docs/failover.md): the request
+    # carries its OWN accepted-token history — the slot's ``generated``
+    # list is this very object — so a decode checkpoint can be built from
+    # the request alone after its replica died (the slot is recycled; the
+    # request survives). ``emitted_len`` mirrors the slot's emitted-text
+    # cursor for the same reason: a resumed stream continues emission from
+    # exactly here, so the client never sees a duplicated or missing char.
+    generated_tokens: list = dataclasses.field(default_factory=list)
+    emitted_len: int = 0
     # engine-assigned when params.seed is None: sampling is derived from
     # (auto_seed, position) so outputs never depend on scheduler timing —
     # how many blocks/keys the engine happened to burn before this request.
@@ -126,6 +135,11 @@ class _Slot:
     #: prefill dispatched, first sampled token not yet harvested (it sits on
     #: the engine's pending-harvest queue as a device array)
     pending_first: bool = False
+    #: monotonically increasing per-install id: in-flight block/harvest
+    #: snapshots pin (request, tenancy), not request identity alone — a
+    #: failover-resumed request is the SAME object re-admitted, and a stale
+    #: block from its previous tenancy must not feed the new one
+    tenancy: int = 0
 
     @property
     def free(self) -> bool:
@@ -575,6 +589,9 @@ class LLMEngine:
         self._prefill_mm_jits: dict[object, object] = {}
 
         self.slots = [_Slot() for _ in range(max_slots)]
+        # per-install tenancy ids (see _Slot.tenancy); bumped only on the
+        # scheduler thread, where every install happens
+        self._tenancy_seq = 0
         # scheduling: the waiting set is a pluggable SchedulerPolicy (PR 4;
         # replaces the single unbounded FIFO queue) — priority classes +
         # tenant fair share by default — gated by cost-aware admission
@@ -650,6 +667,12 @@ class LLMEngine:
         # dispatched (entries: (tokens, rows, meta); rows pin request
         # identity like _inflight's snapshots)
         self._pending_harvest = collections.deque()
+        # scheduler-thread control queue (serving/failover.py): operations
+        # that must run next to the decode jits — live-migration checkpoint
+        # extraction releases slot pages the in-flight blocks still
+        # reference — enqueue (fn, result_queue) here and step() services
+        # them at the top of each tick (_run_on_scheduler)
+        self._ctrl = collections.deque()
         # last decode-block dispatch (monotonic); None while no decodable
         # slot exists — feeds mtpu_decode_stall_seconds
         self._last_dispatch_at: float | None = None
@@ -1615,6 +1638,11 @@ class LLMEngine:
             "block": block,
             "position": int(block.meta["position"]),
             "first_token": int(block.meta["first_token"]),
+            # decode-state leg (docs/failover.md): present on live-migrated
+            # mid-decode blocks, absent on plain PR-6 first-token blocks —
+            # the envelope extension is purely additive meta, so either
+            # side of the wire may predate the other
+            "resume": block.meta.get("resume"),
         }
         req._sched_entry = entry
         req._queue_span = _rt.begin(
@@ -1623,6 +1651,173 @@ class LLMEngine:
         )
         self.policy.submit(entry)
         return req
+
+    # -- in-flight request failover (serving/failover.py, docs/failover.md) --
+
+    def submit_resumed(
+        self, req: Request, *, prompt_tokens, generated, emitted_len: int = 0
+    ) -> Request:
+        """Enqueue a request resumed from a decode checkpoint: ``req``'s
+        stream continues on THIS engine, token-identical to the
+        uninterrupted run.
+
+        ``prompt_tokens`` is the ORIGINAL prompt's token ids, ``generated``
+        the tokens accepted before the failure. The engine re-prefills the
+        ORIGINAL prompt (the same bucket/path — bitwise the original
+        prompt KV, and cheap when the prefix cache still holds the
+        blocks), teacher-forces ``generated[:-1]`` through THE decode
+        block program (``_replay_decode_prefix`` — the same compiled body
+        the dead replica ran, so the rebuilt KV is bit-identical; a
+        prefill recompute of those positions drifts by a bf16 rounding
+        asymmetry and flips greedy argmaxes), then feeds ``generated[-1]``
+        — the last token the client already has — at its original
+        position through the fresh-slot override lane. Sampling is keyed
+        ``(seed, position)`` (the resumed request keeps its original
+        seed/auto_seed), so every token from there on reproduces the
+        uninterrupted stream exactly; the emitted-text cursor resumes at
+        ``emitted_len`` so no char is duplicated or lost. Empty
+        ``generated`` degrades to a plain resubmission. The same ``req``
+        object (same id, same out_queue, same trace id) rides through, so
+        a blocked ``stream()`` consumer continues without reconnecting."""
+        if self.spec_gamma:
+            raise ValueError(
+                "resuming into a speculative engine is unsupported: spec "
+                "sampling is not keyed (seed, position)"
+            )
+        if req.image is not None:
+            raise ValueError(
+                "multimodal requests do not take the failover resume path"
+            )
+        if req.aborted:
+            # a client abort landed during the failover window: honor it —
+            # resurrecting an abandoned request would decode to max_tokens
+            # for nobody (the abort flag is never reset here)
+            self._finish_stream(
+                req,
+                _Finish("deadline" if req.deadline_expired else "stop"),
+            )
+            return req
+        req.finish_reason = None
+        base = [int(t) for t in prompt_tokens]
+        gen = [int(t) for t in generated]
+        # pin the ORIGINAL prompt for any later checkpoint: resumption
+        # must never compound (prompt_tokens is reset to the base here,
+        # but the explicit record keeps that invariant checkable)
+        req._orig_prompt_tokens = base
+        req.generated_tokens = gen
+        req.emitted_len = int(emitted_len)
+        req.n_generated = max(req.n_generated, len(gen))
+        req.cache_key_tokens = None
+        req.created = time.monotonic()
+        if gen and (
+            len(gen) >= req.params.max_tokens
+            or len(base) + len(gen) >= self.max_model_len
+        ):
+            # nothing left to decode (the failure landed on the final
+            # token): deliver the terminal marker without taking a slot
+            self._finish_stream(req, _Finish("length"))
+            return req
+        req.prompt_tokens = list(base)
+        # the generated prefix is REPLAYED through the decode program at
+        # harvest, not re-prefilled: same compiled body, same inputs, same
+        # bits (the prompt claim is therefore identical to the original
+        # request's — same pages, same trie sharing)
+        req._resume_state = {"replay": gen} if gen else None
+        return self.submit_request(req)
+
+    def migrate_out(self, req: Request, *, timeout: float = 30.0):
+        """Detach ``req`` from this engine for a proactive live migration
+        (fleet drain / coordinator rebalancing — docs/failover.md). Runs on
+        the scheduler thread (the only one that may read cache arrays next
+        to the decode jits). Returns one of:
+
+        - ``("block", PageBlock)`` — the request was mid-decode: its KV
+          pages ([0, position)) are extracted with the decode-state leg in
+          the MTKV1 meta, the slot is released (trie pages stay cached),
+          and the caller adopts the block on the target via
+          :meth:`submit_adopted`;
+        - ``("requeue", None)`` — still queued, or mid-prefill with no
+          token accepted yet: nothing to ship, the caller resubmits the
+          prompt fresh on the target (token-identical — the stream never
+          emitted);
+        - ``("gone", None)`` — already finished or aborted; nothing to do.
+
+        Raises when the scheduler loop is stopped or unresponsive — the
+        caller falls back to the reactive (checkpoint-only) resume."""
+        if self.spec_gamma:
+            raise ValueError(
+                "live migration out of a speculative engine is unsupported: "
+                "the draft cache's KV is not on the wire"
+            )
+        return self._run_on_scheduler(
+            lambda: self._migrate_out_on_sched(req), timeout
+        )
+
+    def _migrate_out_on_sched(self, req: Request):
+        from .disagg.transport import chain_hashes, extract_pages
+
+        entry = getattr(req, "_sched_entry", None)
+        if entry is not None and self.policy.remove(entry):
+            # still queued: reservation back, caller resubmits elsewhere
+            self.admission.release(entry)
+            _obs.set_sched_queue_depths(self.policy.depths())
+            self._close_queue_span(req)
+            return ("requeue", None)
+        for i, s in enumerate(self.slots):
+            if s.request is not req:
+                continue
+            if req.aborted:
+                return ("gone", None)
+            if s.prefill is not None or s.pending_first:
+                # mid-prefill: partial KV must not ship or stay cached —
+                # unwind; nothing was emitted, so a fresh resubmission on
+                # the target is token-identical
+                self._unwind_slot(s)
+                s.request = None
+                self._active[i] = False
+                return ("requeue", None)
+            # mid-decode: KV for [0, position) is complete (every accepted
+            # token's predecessor was fed through a finished block); later
+            # positions an in-flight block may have written are masked by
+            # position-bounded attention and overwritten on resume
+            n_kv = self.cache.pages_for(s.position)
+            # the ORIGINAL prompt (explicit on resumed requests); the
+            # pages hold KV for base + generated[:-1], which keys their
+            # chained hashes
+            base = getattr(req, "_orig_prompt_tokens", None)
+            if base is None:
+                base = req.prompt_tokens
+            covered = list(base) + [int(t) for t in req.generated_tokens[:-1]]
+            block = extract_pages(
+                self.cache,
+                s.pages[:n_kv],
+                block_hashes=chain_hashes(covered, self.cache.page_size),
+                meta={
+                    "request_id": req.request_id,
+                    "prompt_tokens": [int(t) for t in base],
+                    "position": int(s.position),
+                    "first_token": int(s.last_token),
+                    "auto_seed": req.auto_seed,
+                    # the decode-state leg: everything past first-token
+                    # adoption that a mid-decode takeover needs
+                    "resume": {
+                        "generated": [int(t) for t in req.generated_tokens],
+                        "emitted_len": int(req.emitted_len),
+                    },
+                    "trace": _rt.wire(req.trace),
+                },
+            )
+            sp = getattr(req, "_decode_span", None)
+            if sp is not None:
+                req._decode_span = None
+                _rt.finish(req.trace, sp, store=self._trace_store)
+            # valid KV: trie pages stay cached (warm for a later reactive
+            # re-prefill), private pages free — the normal-finish release
+            self._release_slot_pages(s)
+            s.request = None
+            self._active[i] = False
+            return ("block", block)
+        return ("gone", None)
 
     def start(self) -> "LLMEngine":
         with self._lock:
@@ -1650,14 +1845,18 @@ class LLMEngine:
             self._stopped_on_error = False
         return self
 
-    def stop(self) -> None:
+    def stop(self, *, reason: str = "stop") -> None:
         """Stop the scheduler and release every caller: in-flight and queued
-        requests get their terminal _FINISH so stream()/generate() return
-        (partial output for in-flight ones) instead of blocking forever."""
+        requests get their terminal marker so stream()/generate() return
+        (partial output for in-flight ones) instead of blocking forever.
+        ``reason="error"`` marks the release as a failure — the fleet's
+        forced reap uses it so still-live streams take the router-level
+        reactive failover instead of ending as a silently truncated
+        "stop" (docs/failover.md)."""
         self._running = False
         if self._thread and self._thread is not threading.current_thread():
             self._thread.join(timeout=10)
-        self._release_all(_FINISH)
+        self._release_all(_FINISH if reason == "stop" else _Finish(reason))
         self._flush_token_counters()
 
     # -- scheduler loop ------------------------------------------------------
@@ -1732,7 +1931,42 @@ class LLMEngine:
                 self._stopped_on_error = True
                 self._release_all(_Finish("error"))
 
+    def _drain_ctrl(self) -> None:
+        """Service scheduler-thread control commands (live-migration
+        checkpoint extraction — serving/failover.py). Each command's
+        result/exception goes back to the waiting caller thread."""
+        while self._ctrl:
+            fn, out_q = self._ctrl.popleft()
+            try:
+                out_q.put(("ok", fn()))
+            except Exception as e:  # the caller re-raises; the loop lives
+                out_q.put(("err", e))
+
+    def _run_on_scheduler(self, fn, timeout: float = 30.0):
+        """Run ``fn`` on the scheduler thread (the only thread that may
+        touch cache arrays next to the decode jits) and return its result.
+        Raises RuntimeError when the loop is not running and TimeoutError
+        when it stops servicing commands — callers fall back to the
+        reactive (checkpoint-only) path either way."""
+        if not self._running:
+            raise RuntimeError("engine scheduler is not running")
+        out_q: queue.Queue = queue.Queue()
+        self._ctrl.append((fn, out_q))
+        try:
+            status, val = out_q.get(timeout=timeout)
+        except queue.Empty:
+            raise TimeoutError(
+                f"scheduler did not service the control command in {timeout}s"
+            ) from None
+        if status == "err":
+            raise val
+        return val
+
     def _release_all(self, marker: "_Finish") -> None:
+        while self._ctrl:
+            # a stopping/crashed engine must not wedge a migration caller
+            fn, out_q = self._ctrl.popleft()
+            out_q.put(("err", RuntimeError("engine released all requests")))
         self._inflight.clear()
         self._pending_harvest.clear()
         self._device_tokens = None
@@ -1758,6 +1992,7 @@ class LLMEngine:
         # fault point (docs/faults.md): a scheduler-thread crash. _loop
         # catches the FaultError, fails every caller loudly, and survives.
         _inject.check("engine.scheduler_crash")
+        self._drain_ctrl()
         self._expire_deadlines()
         admitted = self._admit()
         decoded = self._decode_tick()
@@ -2051,13 +2286,26 @@ class LLMEngine:
         )
         slot = self.slots[slot_idx]
         slot.request = req
+        self._tenancy_seq += 1
+        slot.tenancy = self._tenancy_seq
         # adopted pages are all privately owned: this replica's prefix trie
         # never saw them (tier/trie integration is the PREFILL side's job)
         slot.pages = list(pages)
         slot.trie_pages = []
         slot.private_pages = list(pages)
-        slot.generated = []
-        slot.emitted_text_len = 0
+        # mid-decode adoption (the decode-state leg of the MTKV1 envelope,
+        # docs/failover.md): a live-migrated request arrives with its
+        # accepted-token history and emitted-text cursor — seed both so
+        # detokenization, stop handling, and max_tokens continue exactly
+        # where the source replica left off. Absent (a plain first-token
+        # block) everything below degrades to the PR-6 behavior.
+        resume = state.get("resume")
+        if resume:
+            req.generated_tokens = [int(t) for t in resume["generated"]]
+            req.emitted_len = int(resume.get("emitted_len", 0))
+            req.n_generated = max(req.n_generated, len(req.generated_tokens))
+        slot.generated = req.generated_tokens
+        slot.emitted_text_len = req.emitted_len
         slot.prefill = None
         slot.pending_first = False
         table = np.zeros((self.pages_per_slot,), np.int32)
@@ -2074,7 +2322,14 @@ class LLMEngine:
             req.trace, "decode", replica=self.trace_name,
             spec_mode=self.spec_mode or "-",
         )
-        self._accept_token(slot_idx, state["first_token"])
+        if resume:
+            # the migrated token was accepted (and its text emitted) on the
+            # source replica before the checkpoint: feed it through the
+            # override lane without re-accepting — decode continues with
+            # the NEXT sampled token, token-identical to no migration
+            pass
+        else:
+            self._accept_token(slot_idx, state["first_token"])
         return "ok"
 
     def _fail_claims(self, chunk: list) -> None:
@@ -2310,11 +2565,16 @@ class LLMEngine:
         pages = claim["pages"]
         slot = self.slots[slot_idx]
         slot.request = req
+        self._tenancy_seq += 1
+        slot.tenancy = self._tenancy_seq
         slot.pages = pages
         slot.trie_pages = claim["trie_pages"]
         slot.private_pages = claim["private_pages"]
-        slot.generated = []
-        slot.emitted_text_len = 0
+        # the slot's generated list IS the request's own history (failover
+        # checkpoints are built from the request after the slot is gone);
+        # a resumed request arrives with both pre-seeded (docs/failover.md)
+        slot.generated = req.generated_tokens
+        slot.emitted_text_len = req.emitted_len
         slot.pending_first = False
         if self.spec_mode == "ngram":
             slot.ngram = _NgramIndex(
@@ -2395,7 +2655,7 @@ class LLMEngine:
         slot.pending_first = True
         self._pending_harvest.append((
             first,
-            [(slot_idx, req, 0, n_prompt)],
+            [(slot_idx, req, 0, n_prompt, slot.tenancy)],
             {
                 "phase": "prefill_chunked",
                 "t_start": pp.t_start,
@@ -2424,16 +2684,17 @@ class LLMEngine:
                 import traceback
 
                 traceback.print_exc()
-                for slot_idx, req, _row, _n in rows:
-                    if self.slots[slot_idx].request is req:
+                for slot_idx, req, _row, _n, tenancy in rows:
+                    s = self.slots[slot_idx]
+                    if s.request is req and s.tenancy == tenancy:
                         self._fail_slot(slot_idx, req)
                 continue
             _obs.record_engine_phase(
                 meta["phase"], time.monotonic() - meta["t_start"]
             )
-            for slot_idx, req, row, n_prompt in rows:
+            for slot_idx, req, row, n_prompt, tenancy in rows:
                 s = self.slots[slot_idx]
-                if s.request is not req or req.aborted:
+                if s.request is not req or s.tenancy != tenancy or req.aborted:
                     # recycled or aborted while the prefill was in flight:
                     # the reap (this tick or the next) owns the unwind —
                     # same identity rule as _process_block's snapshots
@@ -2441,7 +2702,22 @@ class LLMEngine:
                 s.pending_first = False
                 self.stats.prompt_tokens += n_prompt
                 s.position = n_prompt
-                s.last_token = int(next_np[row])
+                # failover resume (docs/failover.md): replay the accepted
+                # generated prefix through the decode block program
+                # (bit-identical KV), then feed the LAST accepted token —
+                # which the client already has — through the override lane
+                # instead of the prefill's sampled token, so the next
+                # sampled token carries the same (seed, position) key as
+                # the uninterrupted run and the stream continues
+                # identically
+                rs = getattr(req, "_resume_state", None)
+                if rs is not None:
+                    replay = rs["replay"]
+                    self._replay_decode_prefix(slot_idx, replay)
+                    s.position = n_prompt + len(replay) - 1
+                    s.last_token = int(replay[-1])
+                else:
+                    s.last_token = int(next_np[row])
                 s.fresh = True
                 worked = True
                 if meta["phase"] == "prefill_chunked":
@@ -2469,8 +2745,69 @@ class LLMEngine:
                     req.trace, "decode", replica=self.trace_name,
                     spec_mode=self.spec_mode or "-",
                 )
-                self._accept_token(slot_idx, s.last_token)
+                if rs is not None:
+                    # resumed: the fed token was already accepted and its
+                    # text emitted before the failure (slot.generated /
+                    # emitted_text_len carry the history from the install)
+                    req._resume_state = None
+                else:
+                    self._accept_token(slot_idx, s.last_token)
         return worked
+
+    def _replay_decode_prefix(self, slot_idx: int, replay: list) -> None:
+        """Teacher-forced KV rebuild for a failover-resumed request
+        (docs/failover.md): feed each already-accepted token except the
+        last through THE decode block program — the override lane, only
+        this slot active — one token per dispatch. Because it is the same
+        compiled body the original run executed, with the same carry
+        inputs (attention is position-bounded, so the block's trailing
+        sampled-garbage writes at positions not yet fed are invisible and
+        overwritten when those positions ARE fed), the rebuilt KV is
+        BIT-IDENTICAL to what the dead replica's decode wrote — a prefill
+        recompute of the same positions drifts by a bf16 rounding
+        asymmetry (prefill attends over unrounded in-graph k/v; decode
+        reads the rounded cache) and deterministically flips greedy
+        argmaxes at unlucky margins. All dispatches are async: the replay
+        queues device work without blocking the scheduler thread."""
+        if len(replay) <= 1:
+            return  # generated[-1] rides the override lane of live decode
+        base_pos = self.slots[slot_idx].position
+        B = self.max_slots
+        active = np.zeros((B,), bool)
+        active[slot_idx] = True
+        mask = np.zeros((B,), bool)
+        mask[slot_idx] = True
+        override = np.zeros((B,), np.int32)
+        positions = np.zeros((B,), np.int32)
+        prev = jnp.zeros((B,), jnp.int32)
+        # sampling args are irrelevant to the KV writes (the scatter uses
+        # the FED token; sampled outputs are discarded) — defaults keep
+        # sample() off its expensive filter path
+        ones = jnp.ones((B,), jnp.float32)
+        zeros_i = jnp.zeros((B,), jnp.int32)
+        no_seed = jnp.full((B,), -1, jnp.int32)
+        tables = jnp.asarray(self._page_tables.copy())
+        for i, tok in enumerate(replay[:-1]):
+            override[slot_idx] = int(tok)
+            positions[slot_idx] = base_pos + i
+            _toks, _last, self.cache.k_pages, self.cache.v_pages = (
+                self._block_jit(
+                    self.params,
+                    self.cache.k_pages,
+                    self.cache.v_pages,
+                    prev,
+                    jnp.asarray(override.copy()),
+                    jnp.asarray(mask.copy()),
+                    jnp.asarray(positions.copy()),
+                    tables,
+                    jnp.asarray(active.copy()),
+                    self._next_key(),
+                    ones,
+                    ones,
+                    zeros_i,
+                    no_seed,
+                )
+            )
 
     def _fail_slot(self, slot_idx: int, req: Request) -> None:
         """Release one mid-prefill slot whose work failed AFTER dispatch
@@ -2521,11 +2858,13 @@ class LLMEngine:
             pages, n_prompt = claim["pages"], claim["n_prompt"]
             slot = self.slots[slot_idx]
             slot.request = req
+            self._tenancy_seq += 1
+            slot.tenancy = self._tenancy_seq
             slot.pages = pages
             slot.trie_pages = claim["trie_pages"]
             slot.private_pages = claim["private_pages"]
-            slot.generated = []
-            slot.emitted_text_len = 0
+            slot.generated = req.generated_tokens  # request-owned history
+            slot.emitted_text_len = req.emitted_len
             slot.prefill = None
             if self.spec_mode == "ngram":
                 slot.ngram = _NgramIndex(
@@ -2598,7 +2937,10 @@ class LLMEngine:
         rows = []
         for i, (slot_idx, req, claim) in enumerate(group):
             self.slots[slot_idx].pending_first = True
-            rows.append((slot_idx, req, i, claim["n_prompt"]))
+            rows.append((
+                slot_idx, req, i, claim["n_prompt"],
+                self.slots[slot_idx].tenancy,
+            ))
         self._pending_harvest.append((
             next_tok,
             rows,
@@ -2743,7 +3085,16 @@ class LLMEngine:
             jnp.asarray(self._seeds.copy()),
         )
         self._device_tokens = last
-        self._inflight.append((toks, [(i, self.slots[i].request) for i in live]))
+        # snapshot pins (slot, request, tenancy): request identity alone is
+        # not enough — a failover-resumed request is the same object back
+        # in a NEW tenancy, and this block belongs to its old one
+        self._inflight.append((
+            toks,
+            [
+                (i, self.slots[i].request, self.slots[i].tenancy)
+                for i in live
+            ],
+        ))
         for i in live:
             self._opt_positions[i] += self.decode_block
 
@@ -2754,12 +3105,12 @@ class LLMEngine:
         _obs.record_engine_phase("decode_wait", time.monotonic() - t_wait)
         self.stats.steps += self.decode_block
         worked = False
-        for i, req in snapshot:
+        for i, req, tenancy in snapshot:
             s = self.slots[i]
-            if s.request is not req or req.aborted:
+            if s.request is not req or s.tenancy != tenancy or req.aborted:
                 continue  # slot finished/recycled while the block was in flight
             for k in range(self.decode_block):
-                if s.request is not req:
+                if s.request is not req or s.tenancy != tenancy:
                     break  # finished mid-block
                 s.position += 1
                 s.last_token = int(toks_np[k, i])
@@ -2867,6 +3218,9 @@ class LLMEngine:
         if new and (finished or not _unstable_tail(new)):
             req.out_queue.put(new)
             slot.emitted_text_len = slot.emitted_text_len + len(new)
+            # mirror onto the request: a failover checkpoint taken after
+            # this replica dies resumes emission from exactly this cursor
+            req.emitted_len = slot.emitted_text_len
         if finished:
             self._finish_stream(req, _Finish(reason))
             self._release_slot_pages(slot)

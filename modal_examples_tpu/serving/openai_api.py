@@ -307,13 +307,13 @@ class _Handler(BaseHTTPRequestHandler):
                 # partial fan-out shed: cancel the admitted siblings (their
                 # slots go back to the pool) and reject the whole call
                 for r, eng in pairs:
-                    eng.abort(r)
-                    for _ in eng.stream(r):
+                    srv.abort_request(r, eng)
+                    for _ in srv.stream_request(r, eng):
                         pass
                 self._shed_response(e)
                 return
             reqs = [r for r, _eng in pairs]
-            texts = ["".join(eng.stream(r)) for r, eng in pairs]
+            texts = ["".join(srv.stream_request(r, eng)) for r, eng in pairs]
             if any(r.finish_reason == "error" for r in reqs):
                 self._json(500, {"error": {
                     "message": "engine error while processing the request",
@@ -389,7 +389,7 @@ class _Handler(BaseHTTPRequestHandler):
                 })
 
             try:
-                for piece in eng.stream(req):
+                for piece in srv.stream_request(req, eng):
                     delta = (
                         {"delta": {"content": piece}} if chat else {"text": piece}
                     )
@@ -430,12 +430,12 @@ class _Handler(BaseHTTPRequestHandler):
                 # chunk/[DONE] writes arrives after the terminal marker was
                 # already consumed, and draining then would block forever.
                 if req.finish_reason is None:
-                    eng.abort(req)
-                    for _ in eng.stream(req):  # drain until _FINISH
+                    srv.abort_request(req, eng)
+                    for _ in srv.stream_request(req, eng):  # drain to _FINISH
                         pass
             return
 
-        text = "".join(eng.stream(req))
+        text = "".join(srv.stream_request(req, eng))
         if req.finish_reason == "error":
             # engine-side prefill/decode failure: a 5xx, not a fake success
             # with a non-OpenAI finish_reason
@@ -524,6 +524,25 @@ class OpenAIServer:
                                **sched),
             self.engine,
         )
+
+    def stream_request(self, req, eng):
+        """Stream one submitted request's text pieces. With a router
+        front this rides the failover path (serving/failover.py): a
+        replica dying mid-stream is checkpoint-resumed on a healthy peer
+        and the SSE stream continues token-identically — already-emitted
+        text is deduped at the seam, so the client sees zero errors and
+        zero duplicated chars (docs/failover.md)."""
+        if self.router is not None:
+            return self.router.stream(req)
+        return eng.stream(req)
+
+    def abort_request(self, req, eng) -> None:
+        """Abort wherever the request now lives — after a failover the
+        owning replica may not be the one that first accepted it."""
+        if self.router is not None:
+            self.router.abort(req)
+        else:
+            eng.abort(req)
 
     def _engines(self):
         """Engines whose scheduler loop this server owns. A role-aware

@@ -51,6 +51,10 @@ METRICS: list[tuple[str, bool, str]] = [
     # single replica cannot serve
     ("fleet.goodput", False, "ratio"),
     ("fleet.p99_tpot_at_knee", True, "ratio"),
+    # in-flight failover (docs/failover.md): the client-observed takeover
+    # tail — how long a stream stalls when its replica dies before a
+    # healthy peer resumes it token-identically
+    ("failover.takeover_latency.p95", True, "ratio"),
 ]
 
 

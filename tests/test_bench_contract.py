@@ -200,6 +200,49 @@ def test_bench_fleet_config_emits_fleet_section():
 
 
 @pytest.mark.slow
+def test_bench_failover_config_emits_failover_section():
+    """The failover config must ride the same schema plus a ``failover``
+    section: streams killed mid-decode on one replica and
+    checkpoint-resumed on another — client-observed takeover latency
+    p50/p95, generated-prefix tokens replayed, and the exactness verdict
+    (docs/failover.md). ``failover.takeover_latency.p95`` is what
+    benchdiff gates round over round."""
+    out = subprocess.run(
+        [sys.executable, str(REPO / "bench.py")],
+        capture_output=True,
+        text=True,
+        timeout=500,
+        env={
+            **os.environ,
+            "BENCH_CPU": "1",
+            "BENCH_MODEL": "tiny-failover",
+            "BENCH_NO_SECONDARY": "1",
+        },
+        cwd=str(REPO),
+    )
+    assert out.returncode == 0, out.stderr[-500:]
+    lines = [l for l in out.stdout.strip().splitlines() if l.strip()]
+    assert len(lines) == 1, lines
+    payload = json.loads(lines[0])
+    assert payload["value"] > 0 and payload["unit"] == "tok/s"
+    fo = payload.get("failover")
+    assert fo, payload
+    assert {"streams", "failovers", "takeover_latency", "tokens_replayed",
+            "resumed_identical"} <= set(fo)
+    assert fo["streams"] >= 1
+    assert fo["failovers"] >= 1
+    lat = fo["takeover_latency"]
+    assert {"p50", "p95", "count"} <= set(lat)
+    assert 0 < lat["p50"] <= lat["p95"] and lat["count"] >= 1
+    assert fo["tokens_replayed"] >= 1
+    # the exactness contract IS the section's verdict: every resumed
+    # stream byte-identical to its fault-free reference
+    assert fo["resumed_identical"] is True
+    # the measured headline number stays fault-free
+    assert payload["engine_errors"] == 0
+
+
+@pytest.mark.slow
 def test_bench_mixed_config_emits_interference_section():
     """The mixed-traffic config must ride the same schema plus an
     ``interference`` section: the budget-on vs budget-off TPOT A/B for an

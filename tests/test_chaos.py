@@ -8,6 +8,7 @@ violation, so the fixture IS the acceptance; the tests below pin each
 contract clause to a named assertion."""
 
 import json
+import time
 
 import pytest
 
@@ -197,6 +198,167 @@ class TestChaosUnderLoad:
             assert faulted["goodput_rps"] >= 0.25 * baseline["goodput_rps"], (
                 baseline, faulted,
             )
+        finally:
+            server.stop()
+
+
+class TestDecodeReplicaDeathMidStream:
+    """ISSUE 12: kill a decode replica mid-stream — idle fleet AND under
+    the PR-11 loadgen — and assert the PR-8 invariants plus the new one:
+    every affected stream finishes with its fault-free token sequence,
+    zero client-visible errors, zero wedges (docs/failover.md)."""
+
+    def test_idle_fleet_streams_survive_death_token_identical(self, jax_cpu):
+        import threading
+
+        from modal_examples_tpu.faults.chaos import (
+            check_drained,
+            check_router_recovered,
+        )
+        from modal_examples_tpu.faults.inject import FaultPlan, active
+        from modal_examples_tpu.models import llama
+        from modal_examples_tpu.scheduling import (
+            EngineReplica,
+            PrefixAffinityRouter,
+        )
+        from modal_examples_tpu.serving import LLMEngine, SamplingParams
+
+        cfg = llama.LlamaConfig.tiny()
+
+        def engine(**kw):
+            return LLMEngine(
+                cfg, seed=0, max_slots=4, max_model_len=128, page_size=8,
+                prefill_buckets=(16, 32), **kw,
+            )
+
+        sp = SamplingParams(max_tokens=48, temperature=0.0)
+        prompts = [
+            "the quick brown fox jumps over the lazy dog",
+            "the quick brown fox naps in the warm sun",
+            "a completely different prompt about thundering herds",
+        ]
+        ref_engine = engine()
+        try:
+            reference = {
+                p: ref_engine.generate(p, sp) for p in prompts
+            }
+        finally:
+            ref_engine.stop()
+
+        eng_a = engine()
+        eng_b = engine(params=eng_a.params)
+        rep_a = EngineReplica(eng_a, "death-a", role="unified")
+        rep_b = EngineReplica(eng_b, "death-b", role="unified")
+        router = PrefixAffinityRouter([rep_a, rep_b], reprobe_s=0.2)
+        try:
+            eng_a.start()  # the victim; B boots lazily at takeover
+            reqs, outs, threads = [], {}, []
+            for p in prompts:
+                req = rep_a.submit(p, sp)  # all streams on the victim
+                req._router_replica = rep_a
+                reqs.append(req)
+                outs[req.request_id] = pieces = []
+
+                t = threading.Thread(
+                    target=lambda r=req, buf=pieces: buf.extend(
+                        router.stream(r)
+                    )
+                )
+                t.start()
+                threads.append(t)
+            deadline = time.monotonic() + 60
+            while time.monotonic() < deadline and not all(
+                len(r.generated_tokens) >= 3 for r in reqs
+            ):
+                time.sleep(0.005)
+            # ONLY the victim's loop is running: the injected crash lands
+            # on it deterministically, releasing every stream with "error"
+            plan = FaultPlan({"engine.scheduler_crash": {"on_hit": 1}})
+            with active(plan):
+                deadline = time.monotonic() + 30
+                while not plan.fired() and time.monotonic() < deadline:
+                    time.sleep(0.005)
+            assert plan.fired().get("engine.scheduler_crash") == 1
+            for t in threads:
+                t.join(timeout=120)
+                assert not t.is_alive(), "stream wedged after replica death"
+            for req in reqs:
+                # zero client-visible errors + the fault-free sequence
+                assert req.finish_reason in ("stop", "length"), req.request_id
+                assert "".join(outs[req.request_id]) == reference[req.prompt]
+            # PR-8 fleet invariants after the episode
+            assert check_drained({"death-a": eng_a, "death-b": eng_b}) == []
+            assert check_router_recovered(router) == []
+        finally:
+            eng_a.stop()
+            eng_b.stop()
+
+    def test_streams_survive_death_under_load(
+        self, jax_cpu, state_dir, monkeypatch
+    ):
+        """The same death under the PR-11 open-loop load generator: the
+        SSE clients observe zero errors and zero wedges through the crash
+        window — failover is measured under production-shaped traffic,
+        not just asserted on a quiet fleet."""
+        monkeypatch.setenv("MTPU_TRACE_SAMPLE", "0")
+        from modal_examples_tpu.faults.chaos import (
+            check_drained,
+            check_router_recovered,
+        )
+        from modal_examples_tpu.faults.inject import FaultPlan, active
+        from modal_examples_tpu.fleet.loadgen import LoadGenerator, RequestClass
+        from modal_examples_tpu.models import llama
+        from modal_examples_tpu.scheduling import (
+            EngineReplica,
+            PrefixAffinityRouter,
+        )
+        from modal_examples_tpu.serving import LLMEngine
+        from modal_examples_tpu.serving.openai_api import OpenAIServer
+
+        cfg = llama.LlamaConfig.tiny()
+        eng_a = LLMEngine(
+            cfg, seed=0, max_slots=2, max_model_len=384, page_size=16,
+            prefill_buckets=(64, 128),
+        )
+        eng_b = LLMEngine(
+            cfg, params=eng_a.params, max_slots=2, max_model_len=384,
+            page_size=16, prefill_buckets=(64, 128),
+        )
+        router = PrefixAffinityRouter(
+            [
+                EngineReplica(eng_a, "dload-a", role="unified"),
+                EngineReplica(eng_b, "dload-b", role="unified"),
+            ],
+            reprobe_s=0.2,
+        )
+        server = OpenAIServer(router=router, host="127.0.0.1", port=0)
+        server.start()
+        try:
+            classes = (
+                RequestClass(
+                    "interactive", "interactive", 1.0, (1, 2), 16, 5.0, 1.0
+                ),
+            )
+            lg = LoadGenerator(
+                f"http://127.0.0.1:{server.port}", classes=classes, seed=5,
+                request_timeout_s=60.0,
+            )
+            lg.warm(n_per_class=1)
+            capacity = lg.calibrate(duration_s=1.5)
+            rate = 0.5 * capacity
+            # a decode replica dies mid-window: several in-flight SSE
+            # streams fail over to the surviving one
+            plan = FaultPlan({"engine.scheduler_crash": {"on_hit": 20}})
+            with active(plan):
+                faulted = lg.run_step(rate, 5.0, label="death")
+            assert plan.fired().get("engine.scheduler_crash"), plan.hits()
+            # the new invariant: the crash is CLIENT-INVISIBLE — no SSE
+            # error events, no wedged streams, and the fleet drained
+            assert faulted["wedged"] == 0, faulted
+            assert faulted["errors"] == 0, faulted
+            assert faulted["goodput_rps"] > 0
+            assert check_drained({"dload-a": eng_a, "dload-b": eng_b}) == []
+            assert check_router_recovered(router) == []
         finally:
             server.stop()
 

@@ -644,6 +644,100 @@ def test_fleet_series_declared_and_emitted():
     )
 
 
+def test_failover_series_declared_and_emitted():
+    """Closure for the ``mtpu_failover_*`` / ``mtpu_migration_live_*``
+    series, both directions (the fleet-series guard's pattern): the
+    package-wide name guard already rejects an UNDECLARED series; this
+    adds the reverse — every declared failover catalog constant must be
+    referenced by a live emitter/reader, AND every failover recorder in
+    observability/metrics.py must have a call site outside metrics.py
+    (a recorder nothing calls means a series that silently stopped
+    flowing to dashboards, docs, and the bench `failover` section)."""
+    from modal_examples_tpu.observability import catalog
+
+    consts = {
+        attr: val
+        for attr, val in vars(catalog).items()
+        if isinstance(val, str)
+        and val.startswith(("mtpu_failover_", "mtpu_migration_live_"))
+    }
+    assert len(consts) >= 4, consts
+    catalog_path = PKG_ROOT / "observability" / "catalog.py"
+    package_src = {
+        path: path.read_text()
+        for path in sorted(PKG_ROOT.rglob("*.py"))
+        if path != catalog_path
+    }
+    unused = [
+        attr for attr in consts
+        if not any(
+            re.search(rf"\b{attr}\b", src) for src in package_src.values()
+        )
+    ]
+    assert not unused, (
+        "failover series declared in the catalog but never referenced by "
+        f"an emitter/reader in the package: {unused}"
+    )
+    metrics_path = PKG_ROOT / "observability" / "metrics.py"
+    recorders = (
+        "record_failover", "record_failover_takeover",
+        "record_live_migration", "record_live_migration_seconds",
+    )
+    orphans = [
+        fn for fn in recorders
+        if not any(
+            re.search(rf"\b{fn}\(", src)
+            for path, src in package_src.items()
+            if path != metrics_path
+        )
+    ]
+    assert not orphans, (
+        f"failover recorders with no call site outside metrics.py: {orphans}"
+    )
+
+
+def test_wire_envelope_decode_state_leg_is_additive():
+    """MTKV1 compat guard (docs/failover.md): the live-migration
+    decode-state leg must be PURELY ADDITIVE meta — magic/layout
+    unchanged, a plain PR-6 first-token block still decodes, and an
+    extended block's PR-6 fields read identically with the leg present.
+    A byte-layout change here would strand every cross-version migration
+    mid-fleet-upgrade."""
+    import numpy as np
+
+    from modal_examples_tpu.serving.disagg import transport as T
+
+    assert T._MAGIC == b"MTKV1\n", (
+        "wire magic changed: bump breaks rolling-upgrade migrations — "
+        "the decode-state leg was designed to avoid exactly this"
+    )
+    leaves = {"k_pages": np.zeros((1, 2, 2, 1, 4), np.float32)}
+    plain = T.PageBlock(
+        leaves=dict(leaves), page_size=2, kv_dtype="float32",
+        meta={"position": 4, "first_token": 9},
+    )
+    out_plain = T.deserialize_block(T.serialize_block(plain))
+    assert "resume" not in out_plain.meta
+    assert out_plain.meta["position"] == 4
+    extended = T.PageBlock(
+        leaves=dict(leaves), page_size=2, kv_dtype="float32",
+        meta={
+            "position": 4,
+            "first_token": 9,
+            "resume": {"generated": [9, 9], "emitted_len": 1},
+        },
+    )
+    out_ext = T.deserialize_block(T.serialize_block(extended))
+    # the PR-6 fields a leg-unaware receiver reads are byte-identical
+    assert out_ext.meta["position"] == out_plain.meta["position"]
+    assert out_ext.meta["first_token"] == out_plain.meta["first_token"]
+    assert out_ext.meta["resume"] == {"generated": [9, 9], "emitted_len": 1}
+    # and the leg never touches the binary framing: same leaf payloads
+    assert np.array_equal(
+        out_ext.leaves["k_pages"], out_plain.leaves["k_pages"]
+    )
+
+
 def test_disabled_fault_gate_is_structurally_a_no_op():
     """The gate's zero-cost contract, pinned at the AST level: ``fire``'s
     FIRST statement must be the ``_active_plan is None -> return False``
