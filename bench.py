@@ -174,6 +174,26 @@ CONFIGS = {
     "tiny-failover": dict(
         slots=4, max_len=192, max_tokens=32, timeout=420, failover=True
     ),
+    # CPU path-proof of gray-failure recovery (test_bench_contract,
+    # docs/health.md): after the measured run, a replica's scheduler is
+    # SILENTLY frozen (no crash, no error) with streams mid-decode; the
+    # progress watchdog must detect the wedge from stale watermarks,
+    # error-stop the replica, and the PR-12 failover must resume every
+    # stream token-identically. The json carries a `recovery` section
+    # {time_to_detect p50/p95, time_to_mitigate p50/p95, goodput_dip,
+    # wedged: 0} — the mitigation p95 is what bench_diff gates round over
+    # round
+    "tiny-recovery": dict(
+        slots=4, max_len=192, max_tokens=32, timeout=420, recovery=True
+    ),
+    # the on-chip gray-failure recovery A/B at the int8 headline shape
+    # (revalidate_chip.sh, behind the benchdiff gate): what a silently
+    # wedged llama2-7b replica costs real streams — detection + mitigation
+    # latency with HBM-sized KV and real replay work
+    "llama2-7b-recovery": dict(
+        slots=16, max_len=384, max_tokens=64, timeout=1500, quant="int8",
+        kv_dtype="int8", recovery=True,
+    ),
     # the on-chip failover A/B at the int8 headline shape
     # (revalidate_chip.sh, behind the benchdiff gate): what a mid-stream
     # replica death costs a real llama2-7b stream — takeover latency and
@@ -441,6 +461,232 @@ def _measure_failover(engine, spec: dict, make_engine) -> dict:
             "resumed_identical": bool(identical),
         }
     finally:
+        eng_a.stop()
+        eng_b.stop()
+
+
+def _pct(values: list, q: float) -> float:
+    """Nearest-rank percentile over a small sample (no numpy on purpose:
+    the section must be emittable even when the episode count is tiny)."""
+    vals = sorted(values)
+    if not vals:
+        return 0.0
+    idx = min(len(vals) - 1, max(0, int(round(q * (len(vals) - 1)))))
+    return vals[idx]
+
+
+def _measure_recovery(engine, spec: dict, make_engine) -> dict:
+    """Gray-failure recovery A/B (docs/health.md): greedy reference streams
+    first, then the same streams with their replica's scheduler SILENTLY
+    frozen mid-decode — no crash, no error, ``healthy()`` still true. The
+    progress watchdog must classify the wedge from stale watermarks,
+    error-stop the replica, and the reactive failover must resume every
+    stream token-identically on the standby. Emits the `recovery` section:
+    time_to_detect (freeze fired -> watchdog stop ladder action) and
+    time_to_mitigate (freeze fired -> every stream resumed on the peer)
+    p50/p95 over the episodes, the goodput dip the episode cost, and the
+    exactness verdict."""
+    import threading as _threading
+    import time as _time
+
+    from modal_examples_tpu.faults.inject import FaultPlan, active
+    from modal_examples_tpu.observability import catalog as C
+    from modal_examples_tpu.scheduling import (
+        EngineReplica,
+        PrefixAffinityRouter,
+    )
+    from modal_examples_tpu.serving import SamplingParams
+    from modal_examples_tpu.serving.health import (
+        FleetWatchdog,
+        WatchdogPolicy,
+    )
+    from modal_examples_tpu.utils.prometheus import default_registry
+
+    eng_a = make_engine(params=engine.params)
+    eng_b = make_engine(params=engine.params)
+    rep_a = EngineReplica(eng_a, "rec-a", role="unified")
+    rep_b = EngineReplica(eng_b, "rec-b", role="unified")
+    router = PrefixAffinityRouter([rep_a, rep_b], reprobe_s=0.2)
+    sp = SamplingParams(max_tokens=2 * spec["max_tokens"], temperature=0.0)
+    prompts = [
+        f"the quick brown fox jumps over the lazy dog variant {i}"
+        for i in range(min(4, spec["slots"]))
+    ]
+    episodes = int(spec.get("recovery_episodes", 3))
+    detect_s: list[float] = []
+    mitigate_s: list[float] = []
+    episode_walls: list[float] = []
+    wedged = 0
+    identical = True
+    watchdog = None
+    try:
+        eng_a.start()
+        reference = {p: eng_a.generate(p, sp) for p in prompts}
+
+        def _stream_episode(replica) -> float:
+            """Run the episode's streams concurrently (the same shape the
+            fault episodes use) and return the wall time — the fault-free
+            arm of the goodput dip must batch exactly like the faulted
+            arm, or the dip compares sequential against concurrent."""
+            t0 = _time.monotonic()
+            ths = []
+            for p in prompts:
+                r = replica.submit(p, sp)
+                r._router_replica = replica
+                th = _threading.Thread(
+                    target=lambda rr=r: list(router.stream(rr))
+                )
+                th.start()
+                ths.append(th)
+            for th in ths:
+                th.join(timeout=300)
+            return _time.monotonic() - t0
+
+        wall_ref = _stream_episode(rep_a)
+        # warm the STANDBY too: it compiles its own jits (separate engine,
+        # separate caches), and its first-ever trace happens at TAKEOVER —
+        # under the watchdog, that compile stall reads as a wedge of the
+        # engine the failover is recovering onto, and the error-stop
+        # poisons it (the watchdog-vs-compile rule, docs/health.md,
+        # applied to both replicas)
+        eng_b.generate(prompts[0], sp)
+        eng_b.stop()
+        # the watchdog starts AFTER the warm reference runs: first-compile
+        # stalls must never read as a wedge
+        watchdog = FleetWatchdog(
+            router,
+            policy=WatchdogPolicy(
+                degraded_after_s=0.75, wedged_after_s=1.5,
+                quarantine_after=10_000,  # the bench measures stop/revive
+            ),
+            poll_s=0.05,
+        ).start()
+        victim, standby = rep_a, rep_b
+        for _ep in range(episodes):
+            t_ep = _time.monotonic()
+            reqs, outs, threads = [], {}, []
+            for p in prompts:
+                req = victim.submit(p, sp)
+                req._router_replica = victim
+                reqs.append(req)
+                outs[req.request_id] = pieces = []
+                t = _threading.Thread(
+                    target=lambda r=req, buf=pieces: buf.extend(
+                        router.stream(r)
+                    )
+                )
+                t.start()
+                threads.append(t)
+            deadline = _time.monotonic() + 120
+            while _time.monotonic() < deadline and not all(
+                len(r.generated_tokens) >= 3 for r in reqs
+            ):
+                _time.sleep(0.002)
+            # the standby's loop must be quiet while the freeze arms (the
+            # fault plan counts hits process-globally); the resumed
+            # streams lazily restart it at takeover
+            if standby.engine._running:
+                standby.engine.stop()
+            stops0 = len([
+                e for e in watchdog.events if e["action"] == "stop_revive"
+            ])
+            failovers0 = default_registry.value(
+                C.FAILOVER_TOTAL, labels={"mode": "reactive", "result": "ok"}
+            ) or 0.0
+            plan = FaultPlan(
+                {"engine.scheduler_freeze": {"p": 1.0, "max_fires": 1}}
+            )
+            t_detect = t_mitigate = None
+            with active(plan):
+                arm_deadline = _time.monotonic() + 30
+                while not plan.fired() and _time.monotonic() < arm_deadline:
+                    _time.sleep(0.002)
+                if not plan.fired():
+                    # the victim's loop never hit the point within the
+                    # bound (not running?): fall through WITHOUT waiting
+                    # forever — the join + per-request identity check
+                    # below stay honest, and the episode contributes no
+                    # detect/mitigate sample (zero samples fail the
+                    # contract loudly)
+                    print(
+                        f"recovery episode {_ep}: freeze never fired; "
+                        f"victim={victim.name} "
+                        f"running={victim.engine._running} "
+                        f"poisoned={victim.engine._stopped_on_error} "
+                        f"tokens={[len(r.generated_tokens) for r in reqs]}",
+                        file=sys.stderr,
+                    )
+                else:
+                    t_fire = _time.monotonic()
+                    deadline = t_fire + 60
+                    while _time.monotonic() < deadline:
+                        if t_detect is None and len([
+                            e for e in watchdog.events
+                            if e["action"] == "stop_revive"
+                        ]) > stops0:
+                            t_detect = _time.monotonic() - t_fire
+                        resumed = (
+                            default_registry.value(
+                                C.FAILOVER_TOTAL,
+                                labels={"mode": "reactive", "result": "ok"},
+                            ) or 0.0
+                        ) - failovers0
+                        if t_detect is not None and resumed >= len(reqs):
+                            t_mitigate = _time.monotonic() - t_fire
+                            break
+                        _time.sleep(0.002)
+            for t in threads:
+                t.join(timeout=300)
+            wedged += sum(1 for t in threads if t.is_alive())
+            for r in reqs:
+                got = "".join(outs[r.request_id])
+                ok = (
+                    r.finish_reason in ("stop", "length")
+                    and got == reference[r.prompt]
+                )
+                identical = identical and ok
+                if not ok:
+                    # forensics on stderr (stdout stays the ONE json line)
+                    print(
+                        f"recovery episode {_ep}: {r.request_id} "
+                        f"finish={r.finish_reason} "
+                        f"out={got[-60:]!r} ref={reference[r.prompt][-60:]!r}",
+                        file=sys.stderr,
+                    )
+            if t_detect is not None:
+                detect_s.append(t_detect)
+            if t_mitigate is not None:
+                mitigate_s.append(t_mitigate)
+            episode_walls.append(_time.monotonic() - t_ep)
+            # revive the frozen victim for the next episode (the router's
+            # probe path, driven directly) and swap roles: the streams now
+            # live on the standby
+            victim.probe()
+            victim, standby = standby, victim
+        wall_fault = sum(episode_walls) / max(1, len(episode_walls))
+        return {
+            "episodes": episodes,
+            "streams": len(prompts),
+            "time_to_detect": {
+                "p50": round(_pct(detect_s, 0.5), 6),
+                "p95": round(_pct(detect_s, 0.95), 6),
+            },
+            "time_to_mitigate": {
+                "p50": round(_pct(mitigate_s, 0.5), 6),
+                "p95": round(_pct(mitigate_s, 0.95), 6),
+            },
+            # fraction of fault-free throughput the episode cost: the same
+            # streams took wall_fault instead of wall_ref
+            "goodput_dip": round(
+                max(0.0, 1.0 - wall_ref / wall_fault) if wall_fault else 0.0,
+                6,
+            ),
+            "wedged": int(wedged),
+            "resumed_identical": bool(identical),
+        }
+    finally:
+        if watchdog is not None:
+            watchdog.stop()
         eng_a.stop()
         eng_b.stop()
 
@@ -849,6 +1095,32 @@ def _child(model: str) -> None:
 
         failover_info = _measure_failover(engine, spec, _mk_failover_engine)
 
+    # gray-failure recovery A/B (recovery configs, docs/health.md): a
+    # replica's scheduler silently frozen with streams mid-decode — the
+    # watchdog detects from stale watermarks, the failover resumes; same
+    # weight-aliasing rules as the failover A/B
+    recovery_info = None
+    if spec.get("recovery"):
+        # quiet loop first, same reason as the failover A/B: the injected
+        # freeze counts hits process-globally and must land on the victim
+        engine.stop()
+
+        def _mk_recovery_engine(params=None):
+            return LLMEngine(
+                cfg,
+                params=params,
+                max_slots=spec["slots"],
+                max_model_len=spec["max_len"],
+                page_size=16,
+                prefill_buckets=(64, 128, 256),
+                kv_dtype=spec.get("kv_dtype", jnp.bfloat16),
+                quantization=None if params is not None else spec.get("quant"),
+                paged_impl="pallas",
+                mesh=mesh,
+            )
+
+        recovery_info = _measure_recovery(engine, spec, _mk_recovery_engine)
+
     errors = engine.error_count
     engine.stop()
 
@@ -974,6 +1246,7 @@ def _child(model: str) -> None:
                 **({"interference": interference} if interference else {}),
                 **({"fleet": fleet_info} if fleet_info else {}),
                 **({"failover": failover_info} if failover_info else {}),
+                **({"recovery": recovery_info} if recovery_info else {}),
             }
         )
     )
@@ -1353,6 +1626,11 @@ def _run_config(model: str, env: dict, timeout: float) -> tuple[dict | None, str
     result = _extract_json(proc.stdout)
     if result is None:
         return None, f"{model}: exit={proc.returncode} stderr={proc.stderr[-400:]}"
+    if proc.stderr:
+        # forward the child's diagnostics (the stdout one-json-line
+        # contract holds; stderr is where section forensics like the
+        # recovery mismatch reports land — don't swallow them)
+        sys.stderr.write(proc.stderr[-4000:])
     return result, ""
 
 

@@ -91,3 +91,12 @@ timeout 1500 env BENCH_MODEL=llama2-7b-fleet-sweep BENCH_NO_SECONDARY=1 python b
 #     resumed_identical: true) is what bench_diff's
 #     failover.takeover_latency.p95 gates from the next round on
 timeout 1500 env BENCH_MODEL=llama2-7b-failover BENCH_NO_SECONDARY=1 python bench.py || exit 22
+# 16. gray-failure recovery at the int8 headline shape (docs/health.md),
+#     behind the regression gate: a replica's scheduler SILENTLY frozen
+#     with streams mid-decode — the progress watchdog detects the wedge
+#     from stale watermarks, error-stops the replica, and the failover
+#     resumes every stream token-identically; the json's `recovery`
+#     section (time_to_detect / time_to_mitigate p50/p95, goodput_dip,
+#     wedged: 0) is what bench_diff's recovery.time_to_mitigate.p95 gates
+#     from the next round on
+timeout 1500 env BENCH_MODEL=llama2-7b-recovery BENCH_NO_SECONDARY=1 python bench.py || exit 24

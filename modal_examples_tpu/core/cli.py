@@ -595,6 +595,7 @@ def cmd_top(argv: list[str]) -> int:
     from ..observability.export import pushed_jobs
     from ..observability.journal import DecisionJournal
     from ..observability.slo import evaluate
+    from ..serving.health import decode_watchdog_series
     from ..utils.prometheus import merge_expositions, parse_exposition
 
     usage = "usage: tpurun top [--watch S] [--dir PATH]"
@@ -645,6 +646,23 @@ def cmd_top(argv: list[str]) -> int:
                 f"scatter={labels.get('scatter', '?')} "
                 f"kv_dtype={labels.get('kv_dtype', '?')} "
                 f"tp={labels.get('tp', '1')}"
+            )
+        # gray-failure watchdog (docs/health.md): per-replica progress
+        # classification + last-progress age, when a watchdog has pushed
+        wd = decode_watchdog_series(merged)
+        wd_states = wd["states"]
+        if wd_states:
+            wd_ages = wd["ages"]
+            print(
+                "replica health: "
+                + "  ".join(
+                    f"{name}={state}"
+                    + (
+                        f"({wd_ages[name]:.1f}s)"
+                        if wd_ages.get(name) else ""
+                    )
+                    for name, state in sorted(wd_states.items())
+                )
             )
         print()
         print(f"{'SLO':<22} {'TARGET':>10} {'OBSERVED':>10} {'BURN':>6}  OK")
@@ -960,6 +978,94 @@ def cmd_chaos(argv: list[str]) -> int:
     return 0
 
 
+def cmd_health(argv: list[str]) -> int:
+    """Gray-failure watchdog view: per-replica progress classification,
+    watermark ages, ladder counters, and the last N watchdog decisions from
+    the journal (``<state_dir>/watchdog.jsonl``) plus the pushed watchdog
+    metric series (docs/health.md).
+
+    ``--last N`` shows the newest N journal records (default 20);
+    ``--dir PATH`` overrides the state dir root.
+    """
+    from pathlib import Path
+
+    from ..observability.export import pushed_jobs
+    from ..observability.journal import DecisionJournal
+    from ..serving.health import decode_watchdog_series
+    from ..utils.prometheus import merge_expositions, parse_exposition
+
+    usage = "usage: tpurun health [--last N] [--dir PATH]"
+    argv, root = _pop_dir_flag(argv, usage)
+    argv, last_s = _pop_flag(argv, "--last", usage)
+    last = int(last_s) if last_s is not None else 20
+
+    state_root = Path(root) if root else _config.state_dir()
+    records = DecisionJournal(state_root / "watchdog.jsonl").tail(last)
+
+    jobs = pushed_jobs(Path(root) / "metrics" if root else None)
+    merged = parse_exposition(merge_expositions(jobs)) if jobs else None
+
+    wd = (
+        decode_watchdog_series(merged)
+        if merged is not None
+        else {"states": {}, "ages": {}, "transitions": {}, "recoveries": {}}
+    )
+    states, ages = wd["states"], wd["ages"]
+    transitions, recoveries = wd["transitions"], wd["recoveries"]
+
+    if not records and not states:
+        print(
+            "no watchdog activity recorded yet "
+            "(run a FleetWatchdog — tests/test_chaos.py or the "
+            "tiny-recovery bench config exercise it)"
+        )
+        return 0
+    if states:
+        print(f"{'REPLICA':<16} {'STATE':<12} {'PROGRESS AGE':>12}")
+        for name in sorted(states):
+            age = ages.get(name)
+            print(
+                f"{name:<16} {states[name]:<12} "
+                f"{('%.2fs' % age) if age is not None else '-':>12}"
+            )
+    if transitions:
+        print(
+            "transitions: "
+            + "  ".join(
+                f"{k}={int(v)}" for k, v in sorted(transitions.items())
+            )
+        )
+    if recoveries:
+        print(
+            "ladder actions: "
+            + "  ".join(
+                f"{k}={int(v)}" for k, v in sorted(recoveries.items())
+            )
+        )
+    if records:
+        print()
+        print(f"{'ACTION':<16} {'REPLICA':<16} DETAIL")
+        for rec in records:
+            action = rec.get("action", "?")
+            who = rec.get("replica") or rec.get("transfer_id") or "?"
+            if action == "transition":
+                detail = (
+                    f"-> {rec.get('state')} (raw={rec.get('raw')}, "
+                    f"age={rec.get('progress_age_s')}s, "
+                    f"outstanding={rec.get('outstanding')})"
+                )
+            elif action == "down_weight":
+                detail = f"weight={rec.get('weight')}"
+            elif action == "quarantine":
+                detail = f"for {rec.get('quarantine_s')}s"
+            elif action == "abort_transfer":
+                detail = f"stalled > {rec.get('stall_s')}s"
+            else:
+                detail = ""
+            print(f"{action:<16} {who:<16} {detail}")
+    return 0
+
+
 def cmd_fleet(argv: list[str]) -> int:
     """Fleet-autoscaler view: replica counts by role, scale decisions by
     action/trigger, boot latency (warm snapshot-restore vs cold init), and
@@ -1076,6 +1182,7 @@ COMMANDS = {
     "disagg": cmd_disagg,
     "chaos": cmd_chaos,
     "fleet": cmd_fleet,
+    "health": cmd_health,
     "top": cmd_top,
     "examples": cmd_examples,
     "docs": cmd_docs,

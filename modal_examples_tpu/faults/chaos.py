@@ -192,6 +192,7 @@ class _Fleet:
         from ..scheduling import EngineReplica
         from ..serving import LLMEngine, SamplingParams
         from ..serving.disagg import DisaggCoordinator
+        from ..serving.health import FleetWatchdog, WatchdogPolicy
         from ..storage.volume import Volume
 
         self.seed = seed
@@ -238,8 +239,28 @@ class _Fleet:
         # replica's engine must never start — docs/disagg.md)
         for eng in self.coord.serving_engines():
             eng.start()
+        # the gray-failure watchdog supervises the whole run
+        # (docs/health.md): the silent-freeze and transfer-stall episodes
+        # are only recoverable because it turns stale watermarks into the
+        # error-stop / transfer-abort ladder. Thresholds are generous
+        # enough that a slow CI tick never false-positives (compiles are
+        # disk-cache-warm after the reference run), small enough that
+        # detection + recovery fit well inside DRAIN_TIMEOUT_S; quarantine
+        # is effectively off — one freeze episode must take the
+        # stop -> revive -> re-probe leg, not the bench.
+        self.watchdog = FleetWatchdog(
+            self.coord.router,
+            policy=WatchdogPolicy(
+                degraded_after_s=2.0,
+                wedged_after_s=5.0,
+                transfer_stall_s=1.5,
+                quarantine_after=99,
+            ),
+            poll_s=0.1,
+        ).start()
 
     def close(self) -> None:
+        self.watchdog.stop()
         self.dec.stop()
         self.uni.stop()
         self.volume_cm.__exit__(None, None, None)
@@ -308,6 +329,24 @@ EPISODES: list[tuple[str, dict, dict]] = [
     # hit first; its callers finish LOUDLY with "error", the loop survives
     ("scheduler-crash", {"engine.scheduler_crash": {"on_hit": 30}},
      {"n": 4}),
+    # SILENT scheduler freeze (docs/health.md): p=1.0 x max_fires=1 freezes
+    # whichever decode-capable loop hits step() first — no exception,
+    # healthy() stays true, the gray failure only progress watermarks can
+    # see. Requests that land on the frozen replica queue against a dead
+    # scheduler; the fleet watchdog classifies it wedged once it holds
+    # outstanding work, error-stops it (streams finish loudly, zero
+    # wedges), and the router's re-probe cycle revives it. Freezing BOTH
+    # loops would honestly leave no healthy replica to place on — a
+    # different (shed-everything) contract than the recovery this episode
+    # pins down.
+    ("silent-freeze",
+     {"engine.scheduler_freeze": {"p": 1.0, "max_fires": 1}},
+     {"n": 3}),
+    # mid-transfer chunk stall without an error (docs/health.md): the
+    # sender goes quiet; the watchdog's stalled-seq-watermark abort turns
+    # it into a TransportError and the coordinator's PR-6 unified fallback
+    # completes the request token-identically on the decode side
+    ("transfer-stall", {"disagg.transfer_stall": {"on_hit": 1}}, {"n": 2}),
 ]
 
 
@@ -327,10 +366,13 @@ def _run_episode(fleet: _Fleet, name: str, spec: dict, seed: int,
                     tiered._host.pop(h, None)
                     tiered._host_used -= len(data)
         results, shed, attempted = _traffic(fleet, **traffic_kw)
-        if name == "router-flap":
+        if name in ("router-flap", "silent-freeze"):
             # let the down timer lapse, then place again: the re-probe
-            # re-admission path (mtpu_router_readmissions_total)
-            time.sleep(fleet.coord.router.reprobe_s + 0.05)
+            # re-admission path (mtpu_router_readmissions_total). For the
+            # freeze episode this is the ladder's last leg — the watchdog
+            # error-stopped the wedged engines, and these placements
+            # probe, revive, and restart them (docs/health.md)
+            time.sleep(fleet.coord.router.reprobe_s + 0.3)
             more, more_shed, more_attempted = _traffic(fleet, n=2)
             results += more
             shed += more_shed

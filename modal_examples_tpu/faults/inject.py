@@ -65,6 +65,13 @@ POINTS: dict[str, dict] = {
         "effect": "ConnectionError mid-transfer (peer died)",
         "recovery": "coordinator unified fallback: re-prefill on decode",
     },
+    "disagg.transfer_stall": {
+        "component": "serving/disagg/transport.py",
+        "effect": "the sender goes quiet between chunks — no error, the "
+                  "peer just never sees the next seq",
+        "recovery": "watchdog aborts the stalled transfer (stale seq "
+                    "watermark) -> TransportError -> unified fallback",
+    },
     "disagg.adopt_corrupt": {
         "component": "serving/disagg/roles.py",
         "effect": "the reassembled block corrupts before adoption",
@@ -85,6 +92,13 @@ POINTS: dict[str, dict] = {
         "effect": "the scheduler thread's step() raises",
         "recovery": "inflight/queued requests fail LOUDLY with "
                     "finish_reason='error'; the loop survives",
+    },
+    "engine.scheduler_freeze": {
+        "component": "serving/engine.py",
+        "effect": "the scheduler thread silently stops making progress "
+                  "(no exception, healthy() stays true) until stop()",
+        "recovery": "watchdog classifies wedged from stale watermarks -> "
+                    "stop(reason='error') -> streams failover (health.py)",
     },
     "engine.slow_decode": {
         "component": "serving/engine.py",

@@ -219,6 +219,13 @@ class FleetAutoscaler:
         self._up_streak = {"decode": 0, "prefill": 0}
         self._down_streak = {"decode": 0, "prefill": 0}
         self._cooldown_until = {"decode": 0.0, "prefill": 0.0}
+        #: quarantined replicas a "quarantine" scale-up already replaced —
+        #: the trigger is per-BENCHING (edge), not per-tick (level): one
+        #: replacement per quarantined replica, re-armed when its
+        #: quarantine lifts (pruned against the live quarantined set)
+        self._quarantine_handled: dict[str, set[str]] = {
+            "decode": set(), "prefill": set(),
+        }
         #: names this controller created (only these are scale-in victims:
         #: the operator's seed replicas are never reaped)
         self._owned: dict[str, list[str]] = {"decode": [], "prefill": []}
@@ -261,7 +268,18 @@ class FleetAutoscaler:
             self._last_sheds = sheds
         out: dict = {"sheds_delta": shed_delta, "burn_rate": self._burn_rate()}
         for group in ("decode", "prefill"):
-            replicas = self._replicas(group)
+            everyone = self._replicas(group)
+            # a watchdog-quarantined replica (serving/health.py,
+            # docs/health.md) is benched capacity: it serves nothing, so
+            # counting it would mask the exact pressure its absence
+            # creates. The flag read is cheap and side-effect-free
+            # (healthy() would consume fault-plan hits).
+            quarantined = [
+                r for r in everyone if getattr(r, "quarantined", False)
+            ]
+            replicas = [
+                r for r in everyone if not getattr(r, "quarantined", False)
+            ]
             if not replicas:
                 out[group] = None
                 continue
@@ -271,6 +289,8 @@ class FleetAutoscaler:
             kv = max(self._kv_pressure(r.engine) for r in replicas)
             out[group] = {
                 "replicas": len(replicas),
+                "quarantined": len(quarantined),
+                "quarantined_names": sorted(r.name for r in quarantined),
                 "queued": queued,
                 "queued_per_replica": queued / len(replicas),
                 "outstanding": outstanding,
@@ -301,6 +321,16 @@ class FleetAutoscaler:
     def _pressure_trigger(self, group: str, sig: dict, fleet: dict) -> str | None:
         """The scale-up trigger for this group, or None. Prefill replicas
         have no decode latency to defend: only their own backlog counts."""
+        q_names = set(sig.get("quarantined_names", ()))
+        handled = self._quarantine_handled[group]
+        handled &= q_names  # quarantine lifted: re-arm for a later re-bench
+        if q_names - handled:
+            # the watchdog benched a replica for repeated wedges: replace
+            # its capacity via a snapshot warm boot NOW rather than waiting
+            # for the queues the hole will back up (docs/health.md). Edge-
+            # triggered per benched replica — the scale-up marks it handled,
+            # so a 30s quarantine does not buy a build every cooldown
+            return "quarantine"
         if sig["queued_per_replica"] > self.queue_high or (
             group == "prefill"
             and sig["outstanding"] / max(1, sig["replicas"]) > self.queue_high
@@ -370,6 +400,16 @@ class FleetAutoscaler:
                     deferred.append((group, trigger, sig))
                     self._up_streak[group] = 0
                     self._cooldown_until[group] = self._clock() + self.cooldown_s
+                    if trigger == "quarantine":
+                        # one replacement per benched replica: mark exactly
+                        # one unhandled name; any further quarantined
+                        # replicas keep the trigger armed for the next tick
+                        new = (
+                            set(sig["quarantined_names"])
+                            - self._quarantine_handled[group]
+                        )
+                        if new:
+                            self._quarantine_handled[group].add(min(new))
                 continue
             self._up_streak[group] = 0
             n = sig["replicas"]

@@ -203,11 +203,28 @@ FLEET_REPLICAS = "mtpu_fleet_replicas"
 #: counter {action, trigger}: fleet autoscaler decisions journaled to
 #: <state_dir>/fleet.jsonl; action = scale_up | scale_down, trigger =
 #: slo_burn | queue_pressure | kv_pressure | shed_pressure | idle |
-#: min_replicas (floor fill) | drain_timeout (forced reap)
+#: min_replicas (floor fill) | drain_timeout (forced reap) | quarantine
+#: (the watchdog benched a replica — replace its capacity, docs/health.md)
 FLEET_DECISIONS_TOTAL = "mtpu_fleet_decisions_total"
 #: histogram {boot}: replica build+start seconds at scale-out;
 #: boot = warm (snapshot-restored params) | cold (full init)
 FLEET_BOOT_SECONDS = "mtpu_fleet_boot_seconds"
+
+# -- gray-failure watchdog (serving/health.py, docs/health.md) ---------------
+
+#: gauge {replica, state}: one-hot replica classification by the progress
+#: watchdog (state = healthy | degraded | wedged | quarantined; exactly one
+#: state reads 1 per replica)
+WATCHDOG_REPLICA_STATE = "mtpu_watchdog_replica_state"
+#: gauge {replica}: worst stale age (seconds) among the replica's mandatory
+#: progress watermarks — 0 while idle (staleness only counts against
+#: outstanding work)
+WATCHDOG_PROGRESS_AGE_SECONDS = "mtpu_watchdog_progress_age_seconds"
+#: counter {state}: classification transitions (entering the labeled state)
+WATCHDOG_TRANSITIONS_TOTAL = "mtpu_watchdog_transitions_total"
+#: counter {action}: recovery-ladder actions taken; action = down_weight |
+#: restore_weight | abort_transfer | stop_revive | quarantine | unquarantine
+WATCHDOG_RECOVERIES_TOTAL = "mtpu_watchdog_recoveries_total"
 
 # -- SLO engine (observability/slo.py) --------------------------------------
 
@@ -493,12 +510,32 @@ CATALOG: dict[str, dict] = {
         "help": "fleet autoscaler decisions journaled "
                 "(action=scale_up|scale_down, trigger=slo_burn|"
                 "queue_pressure|kv_pressure|shed_pressure|idle|"
-                "min_replicas|drain_timeout)",
+                "min_replicas|drain_timeout|quarantine)",
     },
     FLEET_BOOT_SECONDS: {
         "type": "histogram", "labels": ["boot"],
         "help": "replica build+start seconds at scale-out "
                 "(boot=warm snapshot-restored | cold full init)",
+    },
+    WATCHDOG_REPLICA_STATE: {
+        "type": "gauge", "labels": ["replica", "state"],
+        "help": "one-hot watchdog classification per replica "
+                "(state=healthy|degraded|wedged|quarantined)",
+    },
+    WATCHDOG_PROGRESS_AGE_SECONDS: {
+        "type": "gauge", "labels": ["replica"],
+        "help": "worst stale age among a replica's mandatory progress "
+                "watermarks (0 while idle)",
+    },
+    WATCHDOG_TRANSITIONS_TOTAL: {
+        "type": "counter", "labels": ["state"],
+        "help": "watchdog classification transitions (entering the state)",
+    },
+    WATCHDOG_RECOVERIES_TOTAL: {
+        "type": "counter", "labels": ["action"],
+        "help": "watchdog recovery-ladder actions (action=down_weight|"
+                "restore_weight|abort_transfer|stop_revive|quarantine|"
+                "unquarantine)",
     },
     SLO_BURN_RATE: {
         "type": "gauge", "labels": ["slo"],
@@ -640,6 +677,13 @@ SPAN_CATALOG: dict[str, dict] = {
         "attrs": ["replica", "point"],
         "help": "an injected fault (faults/inject.py POINTS) fired on this "
                 "request's path (event)",
+    },
+    "watchdog": {
+        "attrs": ["replica", "state", "action"],
+        "help": "the gray-failure watchdog intervened on this request's "
+                "replica (serving/health.py ladder: state=wedged, "
+                "action=stop_revive|quarantine) — shows between the hang "
+                "and the failover seam on the stitched timeline (event)",
     },
     "retry_wait": {
         "attrs": ["replica", "round", "pending", "delay_s"],

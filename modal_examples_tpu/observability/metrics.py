@@ -485,6 +485,53 @@ def set_tier_occupancy(
     )
 
 
+# -- gray-failure watchdog (serving/health.py) --------------------------------
+
+
+def set_watchdog_state(
+    replica: str, state: str, active: bool, *,
+    registry: Registry | None = None,
+) -> None:
+    """One cell of the one-hot per-replica classification gauge — callers
+    sweep every state so exactly one reads 1 (stale states read 0, never
+    linger at their old value)."""
+    _reg(registry).gauge_set(
+        C.WATCHDOG_REPLICA_STATE, 1.0 if active else 0.0,
+        labels={"replica": replica, "state": state},
+        help=C.CATALOG[C.WATCHDOG_REPLICA_STATE]["help"],
+    )
+
+
+def set_watchdog_progress_age(
+    replica: str, seconds: float, *, registry: Registry | None = None
+) -> None:
+    _reg(registry).gauge_set(
+        C.WATCHDOG_PROGRESS_AGE_SECONDS, float(seconds),
+        labels={"replica": replica},
+        help=C.CATALOG[C.WATCHDOG_PROGRESS_AGE_SECONDS]["help"],
+    )
+
+
+def record_watchdog_transition(
+    state: str, *, registry: Registry | None = None
+) -> None:
+    _reg(registry).counter_inc(
+        C.WATCHDOG_TRANSITIONS_TOTAL, 1.0,
+        labels={"state": state},
+        help=C.CATALOG[C.WATCHDOG_TRANSITIONS_TOTAL]["help"],
+    )
+
+
+def record_watchdog_recovery(
+    action: str, *, registry: Registry | None = None
+) -> None:
+    _reg(registry).counter_inc(
+        C.WATCHDOG_RECOVERIES_TOTAL, 1.0,
+        labels={"action": action},
+        help=C.CATALOG[C.WATCHDOG_RECOVERIES_TOTAL]["help"],
+    )
+
+
 # -- resource occupancy ------------------------------------------------------
 
 

@@ -109,6 +109,15 @@ class SchedulerPolicy(abc.ABC):
     def total_depth(self) -> int:
         return sum(self.depths().values())
 
+    def oldest_enqueued_at(self) -> float | None:
+        """``enqueued_at`` of the oldest queued entry, or None when empty —
+        the queue-age-head progress watermark (serving/health.py): a head
+        that only ever gets older while the scheduler keeps ticking is a
+        gray failure the depth gauges cannot see. Concrete subclasses
+        override with an O(depth) scan; the default None opts a custom
+        policy out of the signal rather than breaking it."""
+        return None
+
     def drain(self) -> list[ScheduledRequest]:
         """Pop everything (engine stop/release path)."""
         out: list[ScheduledRequest] = []
@@ -171,6 +180,13 @@ class FIFOPolicy(SchedulerPolicy):
             for e in self._queue:
                 d[e.priority] = d.get(e.priority, 0) + 1
             return d
+
+    def oldest_enqueued_at(self) -> float | None:
+        with self._lock:
+            return min(
+                (e.enqueued_at for e in self._queue if e.enqueued_at),
+                default=None,
+            )
 
 
 class FairSharePolicy(SchedulerPolicy):
@@ -292,3 +308,15 @@ class FairSharePolicy(SchedulerPolicy):
                 c: sum(len(q) for q in tenants.values())
                 for c, tenants in self._queues.items()
             }
+
+    def oldest_enqueued_at(self) -> float | None:
+        with self._lock:
+            oldest = None
+            for tenants in self._queues.values():
+                for q in tenants.values():
+                    for e in q:
+                        if e.enqueued_at and (
+                            oldest is None or e.enqueued_at < oldest
+                        ):
+                            oldest = e.enqueued_at
+            return oldest

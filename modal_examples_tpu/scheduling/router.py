@@ -73,6 +73,13 @@ class EngineReplica:
         self.engine = engine
         self.name = name
         self.role = role
+        # gray-failure watchdog surface (serving/health.py, docs/health.md):
+        # the watchdog writes the graded classification here and benches a
+        # repeatedly-wedging replica via the quarantine flag — healthy()
+        # and probe() both honor it, so neither placement nor the router's
+        # revival probe can resurrect a quarantined replica early
+        self.health_state = "healthy"
+        self.quarantined = False
         if role == "prefill" and hasattr(engine, "prefill_budget"):
             # prefill replicas have no decode to protect: the per-tick
             # prefill token budget (docs/scheduling.md, stall-free
@@ -115,6 +122,8 @@ class EngineReplica:
         return self.engine.max_slots
 
     def healthy(self) -> bool:
+        if self.quarantined:
+            return False
         # fault point (docs/faults.md): one flapped health observation —
         # the router evicts, re-probes, and re-admits this replica
         if _inject.fire("router.health_flap"):
@@ -127,7 +136,12 @@ class EngineReplica:
         — every caller it owed was already released with
         finish_reason="error", so it comes back empty. Prefill-role
         replicas never start a scheduler loop, so they only re-check
-        health. Returns post-probe health."""
+        health. A QUARANTINED replica refuses the probe outright: the
+        watchdog benched it for repeated wedges and owns lifting the flag
+        (docs/health.md) — reviving it early would put a known-bad replica
+        back in placement. Returns post-probe health."""
+        if self.quarantined:
+            return False
         eng = self.engine
         if eng._stopped_on_error and self.serves_requests:
             try:
@@ -140,6 +154,22 @@ class EngineReplica:
         return self.outstanding() >= self.saturation_factor * max(
             1, self.capacity()
         )
+
+    def stats(self) -> dict:
+        """Per-replica snapshot for router/gateway/CLI surfaces, including
+        the last-progress watermark ages (read through the health API —
+        docs/health.md; ``tpurun top`` and ``/health`` render these)."""
+        from ..serving.health import replica_snapshot
+
+        return {
+            "role": self.role,
+            "outstanding": self.outstanding(),
+            "healthy": self.healthy(),
+            "saturated": self.saturated(),
+            "state": self.health_state,
+            "quarantined": self.quarantined,
+            "progress": replica_snapshot(self),
+        }
 
 
 class PrefixAffinityRouter:
@@ -180,6 +210,13 @@ class PrefixAffinityRouter:
         #: replica name -> next re-probe time (monotonic): the down list.
         #: Present = excluded from candidates until probed healthy again.
         self._down: dict[str, float] = {}
+        #: replica name -> placement weight in (0, 1]: the GRADED health
+        #: signal next to the binary healthy()/down cycle. The gray-failure
+        #: watchdog down-weights a degraded replica (docs/health.md); a
+        #: weight below 1.0 loses affinity preference and costs
+        #: proportionally more in every least-loaded comparison, so new
+        #: work drains away without cutting the replica off entirely.
+        self._weights: dict[str, float] = {}
         self.affinity_hits = 0
         self.fallbacks = 0
         self.readmissions = 0
@@ -297,6 +334,36 @@ class PrefixAffinityRouter:
                     self._down[r.name] = now + self.reprobe_s
         return out
 
+    # -- graded health (serving/health.py watchdog, docs/health.md) ----------
+
+    def set_health_weight(self, name: str, weight: float) -> None:
+        """Down-weight (or restore) one replica's placement. ``weight`` in
+        (0, 1]; 1.0 clears the entry. In-flight requests are untouched —
+        this only shapes where NEW work lands."""
+        w = float(weight)
+        if not (0.0 < w <= 1.0):
+            raise ValueError(f"health weight must be in (0, 1], got {w}")
+        with self._lock:
+            if w >= 1.0:
+                self._weights.pop(name, None)
+            else:
+                self._weights[name] = w
+
+    def health_weight(self, name: str) -> float:
+        with self._lock:
+            return self._weights.get(name, 1.0)
+
+    def _effective_load(self, replica) -> float:
+        """Outstanding work scaled by the inverse health weight: a
+        degraded replica at weight 0.25 competes as if 4x busier, plus a
+        constant bias so an idle degraded replica still loses to an idle
+        healthy one."""
+        w = self.health_weight(replica.name)
+        load = replica.outstanding() / w
+        if w < 1.0:
+            load += 1.0 / w
+        return load
+
     def _readmit(self, name: str) -> None:
         with self._lock:
             self._down.pop(name, None)
@@ -324,10 +391,19 @@ class PrefixAffinityRouter:
         healthy = self._candidates(self._serving)
         if not healthy:
             raise RuntimeError("no healthy replicas")
-        if preferred in healthy and not preferred.saturated():
+        if (
+            preferred in healthy
+            and not preferred.saturated()
+            # a down-weighted (degraded) replica loses affinity preference:
+            # prefix warmth is not worth placing onto a replica the
+            # watchdog says is limping (docs/health.md)
+            and self.health_weight(preferred.name) >= 1.0
+        ):
             chosen, route = preferred, "affinity"
         else:
-            chosen = min(healthy, key=lambda r: (r.outstanding(), r.name))
+            chosen = min(
+                healthy, key=lambda r: (self._effective_load(r), r.name)
+            )
             route = "fallback"
         with self._lock:
             hit = route == "affinity" and self._seen.get(key) == chosen.name
@@ -367,7 +443,9 @@ class PrefixAffinityRouter:
             if not r.saturated()
         ]
         if not prefillers:
-            chosen = min(decoders, key=lambda r: (r.outstanding(), r.name))
+            chosen = min(
+                decoders, key=lambda r: (self._effective_load(r), r.name)
+            )
             with self._lock:
                 self.fallbacks += 1
             _obs.record_router_route("fallback")
@@ -376,8 +454,10 @@ class PrefixAffinityRouter:
         pair = self._preferred(
             hashlib.sha1(pre.name.encode()).digest(), decoders
         )
-        if pair.saturated():
-            pair = min(decoders, key=lambda r: (r.outstanding(), r.name))
+        if pair.saturated() or self.health_weight(pair.name) < 1.0:
+            pair = min(
+                decoders, key=lambda r: (self._effective_load(r), r.name)
+            )
         with self._lock:
             hit = self._seen.get(key) == pre.name
             self._seen[key] = pre.name
@@ -432,7 +512,7 @@ class PrefixAffinityRouter:
         pool = [r for r in healthy if r.name != exclude] or healthy
         if not pool:
             return None
-        return min(pool, key=lambda r: (r.outstanding(), r.name))
+        return min(pool, key=lambda r: (self._effective_load(r), r.name))
 
     def stream(self, req):
         """Stream ``req``'s pieces with in-flight failover: a replica
@@ -453,17 +533,28 @@ class PrefixAffinityRouter:
                 self.readmissions,
             )
             down = dict(self._down)
-        return {
-            "replicas": {
-                r.name: {
+            weights = dict(self._weights)
+
+        def one(r) -> dict:
+            # EngineReplica grows a stats() with watermark last-progress
+            # fields (docs/health.md); bare duck-typed replicas keep the
+            # legacy shape
+            base = (
+                r.stats()
+                if hasattr(r, "stats")
+                else {
                     "role": getattr(r, "role", "unified"),
                     "outstanding": r.outstanding(),
                     "healthy": r.healthy(),
                     "saturated": r.saturated(),
-                    "down": r.name in down,
                 }
-                for r in self.replicas
-            },
+            )
+            base["down"] = r.name in down
+            base["weight"] = weights.get(r.name, 1.0)
+            return base
+
+        return {
+            "replicas": {r.name: one(r) for r in self.replicas},
             "affinity_hits": hits,
             "fallbacks": fallbacks,
             "readmissions": readmissions,

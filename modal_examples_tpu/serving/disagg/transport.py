@@ -56,6 +56,7 @@ from ...core.retries import Retries
 from ...faults import inject as _inject
 from ...observability import metrics as _obs
 from ...observability import reqtrace as _rt
+from ..health import transfers as _transfer_watermarks
 
 #: envelope magic + version (bump on any layout change)
 _MAGIC = b"MTKV1\n"
@@ -437,58 +438,96 @@ def transfer(
 
     Fault points (docs/faults.md): ``disagg.replica_death`` kills the
     stream mid-transfer, ``disagg.chunk_drop`` swallows one chunk,
-    ``disagg.chunk_corrupt`` flips payload bytes under a stale crc.
+    ``disagg.chunk_corrupt`` flips payload bytes under a stale crc, and
+    ``disagg.transfer_stall`` holds the sender between chunks WITHOUT an
+    error — the gray failure only the progress watchdog can see.
+
+    Progress watermarks (serving/health.py, docs/health.md): the transfer
+    registers in the process-wide :data:`~..health.transfers` registry and
+    advances its sequence watermark per chunk sent; the fleet watchdog
+    aborts a transfer whose watermark goes stale, which surfaces HERE as a
+    :class:`TransportError` between chunks — the coordinator's unified
+    fallback then re-prefills on the decode side, so a silently stalled
+    wire never hangs a request to its deadline.
     """
     chunks = iter_chunks(payload, transfer_id, chunk_bytes)
     asm = ChunkAssembler(transfer_id)
     pending = list(range(len(chunks)))
-    for round_i in range(max(1, int(max_rounds))):
-        if round_i and pending:
-            _obs.record_disagg_chunk_retries(len(pending))
-            if backoff is not None:
-                delay = backoff.delay_for_attempt(round_i, key=transfer_id)
-                # retry backoff as a span event on the ambient request
-                # (the coordinator scopes the migration's trace frame
-                # around this call — docs/observability.md)
-                _rt.ambient_event(
-                    "retry_wait", round=round_i, pending=len(pending),
-                    delay_s=round(delay, 6),
+    _transfer_watermarks.begin(transfer_id)
+    try:
+        for round_i in range(max(1, int(max_rounds))):
+            if round_i and pending:
+                _obs.record_disagg_chunk_retries(len(pending))
+                if backoff is not None:
+                    delay = backoff.delay_for_attempt(round_i, key=transfer_id)
+                    # retry backoff as a span event on the ambient request
+                    # (the coordinator scopes the migration's trace frame
+                    # around this call — docs/observability.md)
+                    _rt.ambient_event(
+                        "retry_wait", round=round_i, pending=len(pending),
+                        delay_s=round(delay, 6),
+                    )
+                    time.sleep(delay)
+            for seq in pending:
+                if should_abort is not None and should_abort():
+                    raise TransferAborted(f"transfer {transfer_id} aborted")
+                if _transfer_watermarks.abort_requested(transfer_id):
+                    raise TransportError(
+                        f"transfer {transfer_id}: aborted by the progress "
+                        "watchdog (stalled between chunks)"
+                    )
+                _inject.check(
+                    "disagg.replica_death",
+                    ConnectionError,
+                    f"injected: peer died mid-transfer {transfer_id}",
                 )
-                time.sleep(delay)
-        for seq in pending:
-            if should_abort is not None and should_abort():
-                raise TransferAborted(f"transfer {transfer_id} aborted")
-            _inject.check(
-                "disagg.replica_death",
-                ConnectionError,
-                f"injected: peer died mid-transfer {transfer_id}",
-            )
-            if _inject.fire("disagg.chunk_drop"):
-                continue  # the chunk vanishes; the next round re-sends it
-            chunk = chunks[seq]
-            if _inject.fire("disagg.chunk_corrupt"):
-                chunk = _mangle(chunk)
-            # per-chunk span (child of the ambient transfer span): a dead
-            # channel mid-send still closes it with status=error
-            sp = _rt.begin_ambient(
-                "chunk", seq=seq, nbytes=len(chunk[5]), round=round_i
-            )
-            try:
-                channel.send(chunk)
-            except BaseException:
-                _rt.finish_ambient(sp, status="error")
-                raise
-            _rt.finish_ambient(sp)
-        while True:
-            try:
-                received = channel.recv(block=False)
-            except queue.Empty:
-                break
-            asm.add(received)
-        if asm.complete:
-            return asm.payload()
-        pending = asm.missing()
-    raise TransportError(
-        f"transfer {transfer_id}: {len(asm.missing())} chunks still missing "
-        f"after {max_rounds} rounds ({asm.corrupt} corrupt)"
-    )
+                if _inject.fire("disagg.transfer_stall"):
+                    # gray failure: the sender goes quiet between chunks —
+                    # no exception, no closed channel, the peer just never
+                    # sees the next seq. Only an abort (the watchdog's
+                    # stalled-watermark ladder, or the caller's own
+                    # abort/deadline) lifts the stall.
+                    while not _transfer_watermarks.abort_requested(
+                        transfer_id
+                    ) and not (should_abort is not None and should_abort()):
+                        time.sleep(0.005)
+                    if should_abort is not None and should_abort():
+                        raise TransferAborted(
+                            f"transfer {transfer_id} aborted"
+                        )
+                    raise TransportError(
+                        f"transfer {transfer_id}: aborted by the progress "
+                        "watchdog (stalled between chunks)"
+                    )
+                if _inject.fire("disagg.chunk_drop"):
+                    continue  # the chunk vanishes; the next round re-sends it
+                chunk = chunks[seq]
+                if _inject.fire("disagg.chunk_corrupt"):
+                    chunk = _mangle(chunk)
+                # per-chunk span (child of the ambient transfer span): a dead
+                # channel mid-send still closes it with status=error
+                sp = _rt.begin_ambient(
+                    "chunk", seq=seq, nbytes=len(chunk[5]), round=round_i
+                )
+                try:
+                    channel.send(chunk)
+                except BaseException:
+                    _rt.finish_ambient(sp, status="error")
+                    raise
+                _rt.finish_ambient(sp)
+                _transfer_watermarks.progress(transfer_id, seq)
+            while True:
+                try:
+                    received = channel.recv(block=False)
+                except queue.Empty:
+                    break
+                asm.add(received)
+            if asm.complete:
+                return asm.payload()
+            pending = asm.missing()
+        raise TransportError(
+            f"transfer {transfer_id}: {len(asm.missing())} chunks still "
+            f"missing after {max_rounds} rounds ({asm.corrupt} corrupt)"
+        )
+    finally:
+        _transfer_watermarks.end(transfer_id)

@@ -53,6 +53,7 @@ from ..scheduling.policy import (
 from ..faults import inject as _inject
 from ..faults.inject import FaultError as _FaultError
 from ..utils.log import get_logger
+from .health import EngineWatermarks
 from .kv_cache import OutOfPages, PagedKVCache
 from .sampling import SamplingParams, sample
 from ..utils.tokenizer import load_tokenizer
@@ -598,6 +599,11 @@ class LLMEngine:
         # control (bounded per-class queues, KV-pressure shedding,
         # deadlines). A plain FIFO is one `policy=FIFOPolicy()` away.
         self._clock = clock or time.monotonic
+        # progress watermarks (serving/health.py, docs/health.md): the
+        # scheduler thread notes ticks/dispatches/accepts for free; the
+        # fleet watchdog classifies gray failures from their ages. Shares
+        # the engine's injectable clock so fake-clock tests see real ages.
+        self.watermarks = EngineWatermarks(clock=self._clock)
         self.policy: SchedulerPolicy = policy or FairSharePolicy(
             clock=self._clock
         )
@@ -1828,6 +1834,10 @@ class LLMEngine:
                 )
             if self._running:
                 return self
+            # starting IS progress: a revived engine must not present its
+            # previous life's stale watermark ages to the watchdog in the
+            # window before its first tick (serving/health.py)
+            self.watermarks.note_start()
             self._running = True
             self._thread = threading.Thread(target=self._loop, daemon=True)
             self._thread.start()
@@ -1850,9 +1860,15 @@ class LLMEngine:
         requests get their terminal marker so stream()/generate() return
         (partial output for in-flight ones) instead of blocking forever.
         ``reason="error"`` marks the release as a failure — the fleet's
-        forced reap uses it so still-live streams take the router-level
-        reactive failover instead of ending as a silently truncated
-        "stop" (docs/failover.md)."""
+        forced reap and the gray-failure watchdog use it so still-live
+        streams take the router-level reactive failover instead of ending
+        as a silently truncated "stop" (docs/failover.md). An error-stop
+        also POISONS the engine like a strict-mode scheduler crash: the
+        router must not place new work on it until ``probe()`` revives and
+        restarts it (the watchdog's stop -> revive -> re-probe ladder leg,
+        docs/health.md)."""
+        if reason == "error":
+            self._stopped_on_error = True
         self._running = False
         if self._thread and self._thread is not threading.current_thread():
             self._thread.join(timeout=10)
@@ -1992,6 +2008,25 @@ class LLMEngine:
         # fault point (docs/faults.md): a scheduler-thread crash. _loop
         # catches the FaultError, fails every caller loudly, and survives.
         _inject.check("engine.scheduler_crash")
+        # fault point (docs/health.md): a SILENT scheduler freeze — the
+        # thread stays alive, healthy() stays true, but no tick, dispatch,
+        # or accept ever lands again. Nothing inside the engine ends it;
+        # only stop() (the watchdog's wedged-scheduler recovery, or an
+        # operator) lifts the hold — exactly the gray failure the
+        # progress-watermark watchdog exists to detect.
+        if _inject.fire("engine.scheduler_freeze"):
+            _log.warning("injected scheduler freeze: holding the loop")
+            for s in self.slots:
+                if s.request is not None:
+                    _rt.event(
+                        s.request.trace, "fault", store=self._trace_store,
+                        replica=self.trace_name,
+                        point="engine.scheduler_freeze",
+                    )
+            while self._running:
+                time.sleep(0.005)
+            return False
+        self.watermarks.note_tick()
         self._drain_ctrl()
         self._expire_deadlines()
         admitted = self._admit()
@@ -3038,6 +3073,7 @@ class LLMEngine:
             # whole time: the stall the prefill budget bounds to ~one chunk
             _obs.record_decode_stall(now - self._last_dispatch_at)
         self._last_dispatch_at = now
+        self.watermarks.note_dispatch()
         _obs.record_engine_batch(len(live))
         self._active[:] = False
         self._override_mask[:] = False
@@ -3170,6 +3206,7 @@ class LLMEngine:
         slot = self.slots[slot_idx]
         req = slot.request
         self.stats.generated_tokens += 1
+        self.watermarks.note_accept()
         # token-level latency: TTFT on the request's first token, the
         # inter-token gap (TPOT) on every later one. Honest wall-clock from
         # the client's seat: pipelined blocks emit in bursts, and the

@@ -55,6 +55,11 @@ METRICS: list[tuple[str, bool, str]] = [
     # tail — how long a stream stalls when its replica dies before a
     # healthy peer resumes it token-identically
     ("failover.takeover_latency.p95", True, "ratio"),
+    # gray-failure recovery (docs/health.md): the end-to-end tail from a
+    # SILENT wedge (no crash, no error) to every affected stream resumed
+    # on a healthy peer — detection by progress watermarks plus the
+    # failover takeover; a regression means hangs live longer
+    ("recovery.time_to_mitigate.p95", True, "ratio"),
 ]
 
 

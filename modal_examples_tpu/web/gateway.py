@@ -121,6 +121,35 @@ def _fleet_snapshot(last: int = 20) -> dict:
     }
 
 
+def _health_snapshot(last: int = 20) -> dict:
+    """Gray-failure watchdog snapshot: per-replica classification +
+    progress-age watermarks (one-hot ``mtpu_watchdog_replica_state`` +
+    ``mtpu_watchdog_progress_age_seconds`` from the live registry), ladder
+    transition/recovery counters, and the newest watchdog ladder decisions
+    from ``<state_dir>/watchdog.jsonl`` — the ``/health`` route's payload
+    (``tpurun health`` renders the same data from pushed metrics;
+    docs/health.md). Distinct from ``/healthz``: that is the SLO pass/fail
+    gate; this is the per-replica progress detail view."""
+    from .._internal import config as _config
+    from ..observability.journal import DecisionJournal
+    from ..serving.health import decode_watchdog_series
+    from ..utils.prometheus import default_registry as reg
+
+    wd = decode_watchdog_series(reg)
+    journal = DecisionJournal(
+        _config.state_dir() / "watchdog.jsonl"
+    ).tail(last)
+    return {
+        "replicas": {
+            name: {"state": state, "progress_age_s": wd["ages"].get(name)}
+            for name, state in wd["states"].items()
+        },
+        "transitions": wd["transitions"],
+        "recoveries": wd["recoveries"],
+        "journal": journal,
+    }
+
+
 def _chaos_snapshot(last: int = 10) -> dict:
     """Chaos-harness snapshot: injected-fault counters per catalog point
     (live registry) plus the newest episode records from the chaos journal
@@ -282,19 +311,32 @@ class _Handler(BaseHTTPRequestHandler):
         (the autoscaler decision journal), ``/disagg`` (replica roles,
         migration counters, prefix-tier occupancy — docs/disagg.md),
         ``/chaos`` (injected-fault counters + episode journal —
-        docs/faults.md), and ``/fleet`` (fleet-autoscaler replica counts,
-        decisions, boot latencies + journal — docs/fleet.md). User
-        endpoints with the same label win — these only answer when no
-        route claimed the path."""
+        docs/faults.md), ``/fleet`` (fleet-autoscaler replica counts,
+        decisions, boot latencies + journal — docs/fleet.md), and
+        ``/health`` (gray-failure watchdog: per-replica progress
+        classification, watermark ages, ladder decisions —
+        docs/health.md). User endpoints with the same label win — these
+        only answer when no route claimed the path."""
         parts = parsed.path.strip("/").split("/")
         label = parts[0] if parts else ""
         if method != "GET" or label not in (
             "metrics", "traces", "healthz", "autoscaler", "disagg", "chaos",
-            "fleet",
+            "fleet", "health",
         ):
             return False
         if label == "disagg":
             self._respond_json(200, _disagg_snapshot())
+            return True
+        if label == "health":
+            q = {
+                k: v[-1]
+                for k, v in urllib.parse.parse_qs(parsed.query).items()
+            }
+            try:
+                n = int(q.get("n", 20))
+            except ValueError:
+                n = 20
+            self._respond_json(200, _health_snapshot(last=n))
             return True
         if label == "fleet":
             q = {

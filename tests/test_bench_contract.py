@@ -243,6 +243,53 @@ def test_bench_failover_config_emits_failover_section():
 
 
 @pytest.mark.slow
+def test_bench_recovery_config_emits_recovery_section():
+    """The recovery config must ride the same schema plus a ``recovery``
+    section: a replica's scheduler SILENTLY frozen (no crash, no error)
+    with streams mid-decode — the progress watchdog detects the wedge from
+    stale watermarks, error-stops the replica, and the failover resumes
+    every stream token-identically (docs/health.md).
+    ``recovery.time_to_mitigate.p95`` is what benchdiff gates round over
+    round."""
+    out = subprocess.run(
+        [sys.executable, str(REPO / "bench.py")],
+        capture_output=True,
+        text=True,
+        timeout=500,
+        env={
+            **os.environ,
+            "BENCH_CPU": "1",
+            "BENCH_MODEL": "tiny-recovery",
+            "BENCH_NO_SECONDARY": "1",
+        },
+        cwd=str(REPO),
+    )
+    assert out.returncode == 0, out.stderr[-500:]
+    lines = [l for l in out.stdout.strip().splitlines() if l.strip()]
+    assert len(lines) == 1, lines
+    payload = json.loads(lines[0])
+    assert payload["value"] > 0 and payload["unit"] == "tok/s"
+    rec = payload.get("recovery")
+    assert rec, payload
+    assert {"episodes", "streams", "time_to_detect", "time_to_mitigate",
+            "goodput_dip", "wedged", "resumed_identical"} <= set(rec)
+    assert rec["episodes"] >= 1 and rec["streams"] >= 1
+    for key in ("time_to_detect", "time_to_mitigate"):
+        assert {"p50", "p95"} <= set(rec[key]), rec
+        assert 0 < rec[key]["p50"] <= rec[key]["p95"], rec
+    # detection precedes mitigation on the same clock
+    assert rec["time_to_detect"]["p50"] <= rec["time_to_mitigate"]["p50"]
+    assert 0.0 <= rec["goodput_dip"] <= 1.0
+    # the contract headline: a silent hang wedges NOTHING, and every
+    # resumed stream is byte-identical to its fault-free reference
+    # (on mismatch the bench prints per-request forensics to stderr)
+    assert rec["wedged"] == 0, out.stderr[-1200:]
+    assert rec["resumed_identical"] is True, out.stderr[-1200:]
+    # the measured headline number stays fault-free
+    assert payload["engine_errors"] == 0
+
+
+@pytest.mark.slow
 def test_bench_mixed_config_emits_interference_section():
     """The mixed-traffic config must ride the same schema plus an
     ``interference`` section: the budget-on vs budget-off TPOT A/B for an

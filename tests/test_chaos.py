@@ -363,6 +363,110 @@ class TestDecodeReplicaDeathMidStream:
             server.stop()
 
 
+class TestSilentHangUnderLoad:
+    """ISSUE 13 (docs/health.md): a SILENT scheduler freeze — no crash, no
+    error, ``healthy()`` stays true — under the PR-11 open-loop load
+    generator. The progress watchdog must detect the wedge from stale
+    watermarks, error-stop the replica so every live SSE stream takes the
+    PR-12 reactive failover, and the fleet must drain with zero wedges and
+    zero client-visible errors. (The idle-fleet token-identity half lives
+    in tests/test_health.py; detection-latency numbers live in the
+    fake-clock unit matrix — no wall-clock direction asserts here.)"""
+
+    def test_freeze_under_load_recovers(self, jax_cpu, state_dir, monkeypatch):
+        monkeypatch.setenv("MTPU_TRACE_SAMPLE", "0")
+        from modal_examples_tpu.faults.chaos import (
+            check_drained,
+            check_router_recovered,
+        )
+        from modal_examples_tpu.faults.inject import FaultPlan, active
+        from modal_examples_tpu.fleet.loadgen import LoadGenerator, RequestClass
+        from modal_examples_tpu.models import llama
+        from modal_examples_tpu.scheduling import (
+            EngineReplica,
+            PrefixAffinityRouter,
+        )
+        from modal_examples_tpu.serving import LLMEngine
+        from modal_examples_tpu.serving.health import (
+            FleetWatchdog,
+            WatchdogPolicy,
+        )
+        from modal_examples_tpu.serving.openai_api import OpenAIServer
+
+        cfg = llama.LlamaConfig.tiny()
+        eng_a = LLMEngine(
+            cfg, seed=0, max_slots=2, max_model_len=384, page_size=16,
+            prefill_buckets=(64, 128),
+        )
+        eng_b = LLMEngine(
+            cfg, params=eng_a.params, max_slots=2, max_model_len=384,
+            page_size=16, prefill_buckets=(64, 128),
+        )
+        router = PrefixAffinityRouter(
+            [
+                EngineReplica(eng_a, "hang-a", role="unified"),
+                EngineReplica(eng_b, "hang-b", role="unified"),
+            ],
+            reprobe_s=0.2,
+        )
+        server = OpenAIServer(router=router, host="127.0.0.1", port=0)
+        server.start()
+        watchdog = None
+        try:
+            classes = (
+                RequestClass(
+                    "interactive", "interactive", 1.0, (1, 2), 16, 5.0, 1.0
+                ),
+            )
+            lg = LoadGenerator(
+                f"http://127.0.0.1:{server.port}", classes=classes, seed=7,
+                request_timeout_s=60.0,
+            )
+            lg.warm(n_per_class=1)
+            capacity = lg.calibrate(duration_s=1.5)
+            rate = 0.5 * capacity
+            # the watchdog starts AFTER warm/calibrate — and after BOTH
+            # engines compiled their own jits (a takeover onto a cold
+            # standby would otherwise stall in its first trace and read
+            # as a wedge — the watchdog-vs-compile rule, docs/health.md)
+            from modal_examples_tpu.serving import SamplingParams
+
+            for eng in (eng_a, eng_b):
+                eng.generate(
+                    "watchdog warm probe", SamplingParams(max_tokens=4)
+                )
+            watchdog = FleetWatchdog(
+                router,
+                policy=WatchdogPolicy(
+                    degraded_after_s=1.0, wedged_after_s=2.0,
+                    quarantine_after=99,
+                ),
+                poll_s=0.1,
+            ).start()
+            # one loop silently freezes mid-window; its in-flight SSE
+            # streams must fail over with the crash invisible to clients
+            plan = FaultPlan(
+                {"engine.scheduler_freeze": {"p": 1.0, "max_fires": 1}}
+            )
+            with active(plan):
+                faulted = lg.run_step(rate, 6.0, label="freeze")
+            assert plan.fired().get("engine.scheduler_freeze") == 1
+            recovered = lg.run_step(rate, 2.0, label="recovered")
+            for step in (faulted, recovered):
+                assert step["wedged"] == 0, step
+                assert step["errors"] == 0, step
+            assert faulted["goodput_rps"] > 0
+            # the ladder actually ran: a wedge transition + an error-stop
+            acted = {e["action"] for e in watchdog.events}
+            assert "stop_revive" in acted, watchdog.events
+            assert check_drained({"hang-a": eng_a, "hang-b": eng_b}) == []
+            assert check_router_recovered(router) == []
+        finally:
+            if watchdog is not None:
+                watchdog.stop()
+            server.stop()
+
+
 class TestTraceUnderChaos:
     def test_chaos_requests_carry_fault_events(self, chaos_report):
         """Acceptance: a chaos episode's injected faults appear as span

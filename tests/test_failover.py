@@ -359,7 +359,11 @@ class TestLiveMigration:
             LoopbackChannel,
         )
 
-        sp = SamplingParams(max_tokens=32, temperature=0.0)
+        # generous max_tokens: after the gate releases, the reactive
+        # fallback races the victim's resumed decode — if the request
+        # FINISHES first, migrate_out honestly reports "gone". The long
+        # tail keeps the request mid-decode through that window.
+        sp = SamplingParams(max_tokens=128, temperature=0.0)
         eng_a, eng_b, rep_a, rep_b = self._fleet()
         try:
             ref = eng_b.submit(PROMPT, sp)
@@ -372,16 +376,41 @@ class TestLiveMigration:
             )
             t.start()
             assert _wait_tokens(req, 4)
+            # park the victim's scheduler on a blocking control command
+            # (the bench _measure_failover trick): without it, decode
+            # races the migration to max_tokens under CI load and
+            # migrate_out honestly reports "gone" — the gate guarantees
+            # the migration lands mid-decode, deterministically
+            import queue as _queue
+
+            gate = threading.Event()
+            eng_a._ctrl.append((gate.wait, _queue.Queue()))
 
             class BlackholeChannel(LoopbackChannel):
                 def send(self, chunk):
                     pass  # every chunk vanishes; rounds exhaust
 
-            result = fo.migrate_request(
-                rep_a, rep_b, req, chunk_bytes=512, max_rounds=2,
-                channel_factory=BlackholeChannel,
-            )
-            assert result == "resumed"
+            box: dict = {}
+
+            def migrate():
+                box["result"] = fo.migrate_request(
+                    rep_a, rep_b, req, chunk_bytes=512, max_rounds=2,
+                    channel_factory=BlackholeChannel,
+                )
+
+            mt = threading.Thread(target=migrate)
+            mt.start()
+            # release the gate only once the migration's own control
+            # command is queued behind it
+            deadline = time.monotonic() + 30
+            while time.monotonic() < deadline:
+                if eng_a._ctrl and eng_a._ctrl[-1][0] is not gate.wait:
+                    break
+                time.sleep(0.002)
+            gate.set()
+            mt.join(timeout=120)
+            assert not mt.is_alive()
+            assert box.get("result") == "resumed"
             t.join(timeout=120)
             assert not t.is_alive()
             assert req.finish_reason == ref.finish_reason

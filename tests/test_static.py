@@ -696,6 +696,117 @@ def test_failover_series_declared_and_emitted():
     )
 
 
+def test_watchdog_series_declared_and_emitted():
+    """Closure for the ``mtpu_watchdog_*`` series, both directions (the
+    fleet/failover-series guard pattern): the package-wide name guard
+    already rejects an UNDECLARED watchdog series; this adds the reverse —
+    every declared watchdog catalog constant must be referenced by a live
+    emitter/reader, AND every watchdog recorder in observability/metrics.py
+    must have a call site outside metrics.py (a recorder nothing calls
+    means a series that silently stopped flowing to `tpurun health`, the
+    gateway `/health` view, and the bench `recovery` section)."""
+    from modal_examples_tpu.observability import catalog
+
+    consts = {
+        attr: val
+        for attr, val in vars(catalog).items()
+        if isinstance(val, str) and val.startswith("mtpu_watchdog_")
+    }
+    assert len(consts) >= 4, consts
+    catalog_path = PKG_ROOT / "observability" / "catalog.py"
+    package_src = {
+        path: path.read_text()
+        for path in sorted(PKG_ROOT.rglob("*.py"))
+        if path != catalog_path
+    }
+    unused = [
+        attr for attr in consts
+        if not any(
+            re.search(rf"\b{attr}\b", src) for src in package_src.values()
+        )
+    ]
+    assert not unused, (
+        "watchdog series declared in the catalog but never referenced by "
+        f"an emitter/reader in the package: {unused}"
+    )
+    metrics_path = PKG_ROOT / "observability" / "metrics.py"
+    recorders = (
+        "set_watchdog_state", "set_watchdog_progress_age",
+        "record_watchdog_transition", "record_watchdog_recovery",
+    )
+    orphans = [
+        fn for fn in recorders
+        if not any(
+            re.search(rf"\b{fn}\(", src)
+            for path, src in package_src.items()
+            if path != metrics_path
+        )
+    ]
+    assert not orphans, (
+        f"watchdog recorders with no call site outside metrics.py: {orphans}"
+    )
+
+
+#: the ONLY attributes production code may touch on a watermarks object
+#: (serving/health.py): the note_* writers the owning threads call, and
+#: nothing else — reads go through health.replica_snapshot/classify. A raw
+#: timestamp poke (`eng.watermarks.last_tick_at`) would couple consumers to
+#: the watermark representation and rot the moment the model evolves.
+_WATERMARK_ALLOWED_ATTRS = {
+    "note_start", "note_tick", "note_dispatch", "note_accept",
+}
+
+
+def test_production_reads_watermarks_only_through_health_api():
+    """Both halves of the health-API boundary (docs/health.md):
+    (a) outside serving/health.py, the only attribute access on a
+    ``.watermarks`` object is a ``note_*`` write hook (the engine
+    publishing progress) — never a raw field read, never ``snapshot``
+    bypassing :func:`~modal_examples_tpu.serving.health.replica_snapshot`;
+    (b) the transfer registry's internals (``transfers._active``) are
+    touched nowhere outside health.py — producers and the watchdog go
+    through begin/progress/end/request_abort/abort_requested/stalled/
+    snapshot."""
+    health_path = PKG_ROOT / "serving" / "health.py"
+    violations = []
+    for path in sorted(PKG_ROOT.rglob("*.py")):
+        if path == health_path:
+            continue
+        tree = ast.parse(path.read_text())
+        for node in ast.walk(tree):
+            if not isinstance(node, ast.Attribute):
+                continue
+            # X.watermarks.<attr>: <attr> must be an allowed note_* hook
+            val = node.value
+            if (
+                isinstance(val, ast.Attribute)
+                and val.attr == "watermarks"
+                and node.attr not in _WATERMARK_ALLOWED_ATTRS
+            ):
+                violations.append(
+                    f"{path.relative_to(REPO_ROOT)}:{node.lineno}: "
+                    f".watermarks.{node.attr} (use serving.health."
+                    "replica_snapshot)"
+                )
+            # <transfers object>._active / other privates
+            if (
+                node.attr.startswith("_")
+                and isinstance(val, (ast.Name, ast.Attribute))
+                and (
+                    getattr(val, "id", None) or getattr(val, "attr", None)
+                )
+                in ("transfers", "_transfer_watermarks", "_twm")
+            ):
+                violations.append(
+                    f"{path.relative_to(REPO_ROOT)}:{node.lineno}: "
+                    f"transfer-registry private {node.attr}"
+                )
+    assert not violations, (
+        "production code pokes watermark internals instead of the health "
+        f"API: {violations}"
+    )
+
+
 def test_wire_envelope_decode_state_leg_is_additive():
     """MTKV1 compat guard (docs/failover.md): the live-migration
     decode-state leg must be PURELY ADDITIVE meta — magic/layout
