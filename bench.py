@@ -54,6 +54,19 @@ CONFIGS = {
         slots=36, max_len=256, max_tokens=128, timeout=1200, quant="int8",
         kv_dtype="int8",
     ),
+    "llama2-7b-int8-s44": dict(
+        # the >=40-slot compile-helper ceiling repro (ROADMAP #1): the
+        # round-4 sweep crashed the remote-compile helper somewhere past
+        # ~40 slots, wedging the chip. NOT in the supervisor's default
+        # order — run only by revalidate_chip.sh's compile-ledger stage
+        # with MTPU_PROFILE=1 and a local MTPU_STATE_DIR: the profiler
+        # writes a `begin` ledger event BEFORE each program build, so even
+        # when this run dies mid-compile the ledger's begin-without-end
+        # row names exactly which program/shape hit the ceiling —
+        # diagnosable offline from compiles.jsonl alone.
+        slots=44, max_len=256, max_tokens=32, timeout=1500, quant="int8",
+        kv_dtype="int8",
+    ),
     "llama2-7b-int8-kv8-ctx1024": dict(
         # long-context decode: at ctx 1024 KV reads are ~34 GB/step and
         # DOMINATE the step (NOTES r5) — the config where int8 KV is the
@@ -466,13 +479,11 @@ def _measure_failover(engine, spec: dict, make_engine) -> dict:
 
 
 def _pct(values: list, q: float) -> float:
-    """Nearest-rank percentile over a small sample (no numpy on purpose:
-    the section must be emittable even when the episode count is tiny)."""
-    vals = sorted(values)
-    if not vals:
-        return 0.0
-    idx = min(len(vals) - 1, max(0, int(round(q * (len(vals) - 1)))))
-    return vals[idx]
+    """The repo-wide nearest-rank percentile (utils/stats.py) — one rank
+    convention across every BENCH section benchdiff compares."""
+    from modal_examples_tpu.utils.stats import percentile_nearest_rank
+
+    return percentile_nearest_rank(values, q)
 
 
 def _measure_recovery(engine, spec: dict, make_engine) -> dict:
@@ -819,6 +830,13 @@ def _child(model: str) -> None:
     # bench-with-tracing deliberately; `tpurun benchdiff` then shows what
     # the instrumentation costs.
     os.environ.setdefault("MTPU_TRACE_SAMPLE", "0")
+    # bench configs OPT IN to the hot-path profiler (the one explicit env,
+    # resolved once in LLMEngine.__init__ — docs/observability.md): every
+    # BENCH json carries an `overhead` section (host fraction, per-phase
+    # tick p50/p95, compile totals), and the compile ledger captures every
+    # program build. MTPU_PROFILE=0 in the environment still wins, so the
+    # instrumentation cost itself stays A/B-able via `tpurun benchdiff`.
+    os.environ.setdefault("MTPU_PROFILE", "1")
     if spec.get("fleet"):
         # production admission shape for the open-loop sweep: bounded
         # queues turn sustained overload into honest 429s (the shed-rate
@@ -1035,6 +1053,19 @@ def _child(model: str) -> None:
         "admitted_total": int(admitted),
     }
 
+    # hot-path overhead attribution (docs/observability.md#hot-path-
+    # profiling): host-vs-device fraction, per-phase tick p50/p95, detok
+    # share, and compile totals from the engine's profiler ring —
+    # snapshotted HERE, with the other latency sections and before the
+    # interference/fleet/failover A/Bs, so the headline attribution
+    # reflects the measured traffic rather than the deliberately-degraded
+    # A/B arms. Children run MTPU_PROFILE=1 by default, so every config's
+    # json carries the section; benchdiff gates overhead.host_fraction and
+    # overhead.tick_p95 round over round.
+    overhead = None
+    if engine.profiler is not None:
+        overhead = engine.profiler.overhead_summary()
+
     # stall-free admission interference A/B (mixed configs): measured on
     # the same warm engine BEFORE it stops — budget on vs off TPOT for an
     # interactive stream under long-prompt arrivals (docs/scheduling.md)
@@ -1239,6 +1270,7 @@ def _child(model: str) -> None:
                 "token_latency": token_latency,
                 "scheduling": scheduling,
                 "kv_cache": kv_cache_info,
+                **({"overhead": overhead} if overhead else {}),
                 "tokens_per_second": round(tok_s, 2),
                 **({"spec": spec_info} if spec_info else {}),
                 **({"disagg": disagg_info} if disagg_info else {}),

@@ -100,3 +100,47 @@ timeout 1500 env BENCH_MODEL=llama2-7b-failover BENCH_NO_SECONDARY=1 python benc
 #     wedged: 0) is what bench_diff's recovery.time_to_mitigate.p95 gates
 #     from the next round on
 timeout 1500 env BENCH_MODEL=llama2-7b-recovery BENCH_NO_SECONDARY=1 python bench.py || exit 24
+# 17. hot-path overhead attribution at the int8 headline shape (ROADMAP #3,
+#     docs/observability.md#hot-path-profiling), behind the regression
+#     gate: bench children profile by default (MTPU_PROFILE=1), so stage
+#     12's full run ALREADY measured the headline config's `overhead`
+#     section (host_fraction, per-phase tick p50/p95, detok_share, compile
+#     totals) on real hardware, and stage 13's benchdiff gates
+#     overhead.host_fraction / overhead.tick_p95 from the next round on —
+#     the host-vs-device split is the BASELINE the multi-step decode PR
+#     must shrink. This stage validates + extracts that artifact instead
+#     of paying a duplicate ~25-minute headline run.
+timeout 120 python - <<'PYEOF' || exit 25
+import json
+from modal_examples_tpu.utils.bench_diff import load_bench
+ov = load_bench("benchmarks/BENCH_revalidate.json")["overhead"]
+assert ov["ticks"] > 0 and ov["host_fraction"] is not None, ov
+assert ov["tick_p95"] is not None and ov["phases"], ov
+json.dump(ov, open("benchmarks/BENCH_overhead.json", "w"), indent=1)
+print(f"stage 17: overhead section OK — host_fraction={ov['host_fraction']}"
+      f" tick_p95={ov['tick_p95']} compiles={ov['compiles_n']}")
+PYEOF
+# 18. compile ledger for the >=40-slot compile-helper ceiling (ROADMAP #1,
+#     docs/observability.md#hot-path-profiling): run the s44 config with
+#     the hot-path profiler ON and a LOCAL state dir. The profiler writes
+#     a `begin` ledger event before every program build, so when the
+#     remote-compile helper crashes/hangs past ~40 slots the ledger's
+#     begin-without-end row names the exact program/shape — the repro
+#     ships offline-diagnosable (`tpurun profile --dir benchmarks/profile_state`).
+#     LAST on purpose: this config wedged the chip in round 4, and every
+#     earlier stage assumes a healthy device — running it here means a
+#     wedge poisons nothing and the round's other results stand. The s44
+#     program shapes are unique to this config (no other config runs >=40
+#     slots), so nothing earlier warms its compiles. Non-fatal: failure at
+#     the ceiling is the expected outcome; the ledger is the artifact.
+mkdir -p benchmarks/profile_state
+# fresh ledger each round: revalidate appends otherwise, and a stale
+# round's begin/end rows would inflate compile totals in the artifact
+rm -f benchmarks/profile_state/compiles.jsonl
+if MTPU_STATE_DIR=benchmarks/profile_state timeout 1500 \
+    env BENCH_MODEL=llama2-7b-int8-s44 BENCH_NO_SECONDARY=1 python bench.py; then
+  echo "stage 18: s44 ran clean — the compile ceiling may have moved; ledger captured anyway"
+else
+  echo "stage 18: s44 failed at the compile ceiling (expected) — see benchmarks/profile_state/compiles.jsonl"
+fi
+cp benchmarks/profile_state/compiles.jsonl benchmarks/compiles_s44.jsonl 2>/dev/null || true

@@ -356,10 +356,15 @@ def cmd_trace(argv: list[str]) -> int:
     and a unique id PREFIX resolves too.
 
     trace ID           — the spans of one call/request
-    trace ID --perfetto [-o FILE]
+    trace ID --perfetto [-o FILE] [--profile SNAP.json]
                        — emit the trace as Chrome-trace/Perfetto JSON
                          (loads in chrome://tracing and ui.perfetto.dev;
-                         request traces get one track per replica)
+                         request traces get one track per replica).
+                         ``--profile`` merges a saved hot-path profiler
+                         snapshot (the gateway's ``/profile`` payload, or
+                         a bare {replica: {ticks, compiles}} map) as
+                         tick-phase counter tracks + compile slices on
+                         the owning replica tracks
     trace list [--limit N]
                        — most recently active traces, newest first
     ``--dir PATH`` overrides the trace root (default ``<state_dir>/traces``;
@@ -408,10 +413,26 @@ def cmd_trace(argv: list[str]) -> int:
     if "--perfetto" in argv:
         from ..observability.export import spans_to_chrome_trace
 
-        argv, out_file = _pop_flag(
-            argv, "-o", "usage: tpurun trace ID --perfetto [-o FILE]"
+        usage_p = (
+            "usage: tpurun trace ID --perfetto [-o FILE] "
+            "[--profile SNAP.json]"
         )
-        doc = spans_to_chrome_trace(spans, trace_id)
+        argv, out_file = _pop_flag(argv, "-o", usage_p)
+        argv, prof_file = _pop_flag(argv, "--profile", usage_p)
+        profile = None
+        if prof_file:
+            from pathlib import Path as _Path
+
+            doc_in = json.loads(_Path(prof_file).read_text())
+            # accept the gateway's /profile payload or a bare
+            # {replica: {ticks, compiles}} map
+            nodes = doc_in.get("replicas", doc_in)
+            profile = {
+                name: node.get("perfetto", node)
+                for name, node in nodes.items()
+                if isinstance(node, dict)
+            }
+        doc = spans_to_chrome_trace(spans, trace_id, profile=profile)
         if out_file:
             from pathlib import Path as _Path
 
@@ -503,6 +524,120 @@ def cmd_benchdiff(argv: list[str]) -> int:
     from ..utils.bench_diff import run_diff
 
     return run_diff(argv)
+
+
+def cmd_profile(argv: list[str]) -> int:
+    """Hot-path time attribution (docs/observability.md#hot-path-profiling):
+    the scheduler-tick phase table (p50/p95 per catalog.TICK_PHASES entry),
+    the host-vs-device overhead fraction, and the compile ledger's biggest
+    builds — from the pushed metrics files plus
+    ``<state_dir>/compiles.jsonl``. Engines emit these series only under
+    ``MTPU_PROFILE`` (bench configs opt in), so an empty table means no
+    profiled engine has pushed yet. jax-free by construction.
+
+    profile [N]        — phase table + top N ledger compiles (default 10)
+    profile --json     — the machine-readable payload
+    ``--dir PATH`` overrides the state-dir root (``metrics/`` +
+    ``compiles.jsonl`` live under it).
+    """
+    from pathlib import Path
+
+    from ..observability import catalog as C
+    from ..observability import profiler as _prof
+    from ..observability.export import pushed_jobs
+    from ..utils.prometheus import merge_expositions, parse_exposition
+
+    argv, root = _pop_dir_flag(argv, "usage: tpurun profile [N] [--json]")
+    as_json = "--json" in argv
+    argv = [a for a in argv if a != "--json"]
+    top_n = int(argv[0]) if argv else 10
+
+    jobs = pushed_jobs(Path(root) / "metrics" if root else None)
+    merged = parse_exposition(merge_expositions(jobs)) if jobs else None
+    ledger = _prof.read_ledger(
+        path=Path(root) / "compiles.jsonl" if root else None, n=2000
+    )
+    builds = [r for r in ledger if r.get("event") == "end"]
+    unfinished = _prof.unfinished_builds(ledger)
+
+    phases: dict = {}
+    ratio = None
+    lookups: dict = {}
+    if merged is not None:
+        for phase in C.TICK_PHASES + (C.TICK_TOTAL_PHASE,):
+            q = merged.histogram_quantiles(
+                C.TICK_PHASE_SECONDS, quantiles=(0.5, 0.95),
+                aggregate={"phase": phase},
+            )
+            if q:
+                phases[phase] = {
+                    "p50": q["p50"], "p95": q["p95"], "count": q["count"],
+                }
+        # a 0..1 fraction must never sum across jobs: show the worst
+        ratio = merged.peak(C.HOST_OVERHEAD_RATIO) or None
+        for labels, v in merged.series(C.COMPILES_TOTAL):
+            entry = lookups.setdefault(
+                labels.get("program", "?"), {"hit": 0, "miss": 0}
+            )
+            entry[labels.get("cache", "miss")] = int(v)
+
+    top = sorted(
+        builds, key=lambda r: r.get("seconds") or 0.0, reverse=True
+    )[:top_n]
+    if as_json:
+        print(json.dumps({
+            "host_overhead_ratio": ratio,
+            "phases": phases,
+            "compile_lookups": lookups,
+            "compile_total_s": round(
+                sum(r.get("seconds") or 0.0 for r in builds), 3
+            ),
+            "compiles_n": len(builds),
+            "top_compiles": top,
+            "unfinished_builds": unfinished,
+        }))
+        return 0
+
+    if ratio is not None:
+        print(f"host overhead ratio: {ratio:.3f} (1 - device-blocked/total)")
+    if phases:
+        print(f"{'PHASE':<18} {'P50 ms':>9} {'P95 ms':>9} {'TICKS':>7}")
+        for phase in list(C.TICK_PHASES) + [C.TICK_TOTAL_PHASE]:
+            q = phases.get(phase)
+            if q:
+                print(
+                    f"{phase:<18} {q['p50'] * 1000:>9.3f} "
+                    f"{q['p95'] * 1000:>9.3f} {q['count']:>7}"
+                )
+    else:
+        print(
+            "no tick-phase series in pushed metrics "
+            "(run a bench or an engine with MTPU_PROFILE=1 first)"
+        )
+    if lookups:
+        print("\ncompile-cache lookups per program (miss=fresh build):")
+        for program, entry in sorted(lookups.items()):
+            print(
+                f"  {program:<16} miss={entry['miss']:<5} hit={entry['hit']}"
+            )
+    if top:
+        print(f"\ntop compiles ({len(builds)} ledgered builds):")
+        for r in top:
+            print(
+                f"  {r.get('seconds', 0.0):>8.3f}s  "
+                f"{r.get('program', '?'):<16} {r.get('shape_key', '?'):<14} "
+                f"({r.get('replica', '?')})"
+            )
+    if unfinished:
+        # the ≥40-slot ceiling diagnosis: a begin event with no end means
+        # the build crashed or hung — name it loudly
+        print("\nUNFINISHED builds (began, never completed — crash/hang?):")
+        for r in unfinished:
+            print(
+                f"  {r.get('program', '?')} {r.get('shape_key', '?')} "
+                f"on {r.get('replica', '?')}"
+            )
+    return 0
 
 
 def cmd_metrics(argv: list[str]) -> int:
@@ -1177,6 +1312,7 @@ COMMANDS = {
     "explain": cmd_explain,
     "benchdiff": cmd_benchdiff,
     "metrics": cmd_metrics,
+    "profile": cmd_profile,
     "scaler": cmd_scaler,
     "sched": cmd_sched,
     "disagg": cmd_disagg,

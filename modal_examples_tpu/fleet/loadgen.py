@@ -95,10 +95,12 @@ ARRIVAL_PROCESSES = ("poisson", "heavy_tail")
 
 
 def _percentile(values: list[float], p: float) -> float:
-    if not values:
-        return 0.0
-    ordered = sorted(values)
-    return ordered[min(len(ordered) - 1, int(p * len(ordered)))]
+    """The repo-wide nearest-rank percentile (utils/stats.py) — the same
+    rank convention as bench.py's latency sections and the profiler's
+    ``overhead`` section, so benchdiff never compares drifted quantiles."""
+    from ..utils.stats import percentile_nearest_rank
+
+    return percentile_nearest_rank(values, p)
 
 
 class LoadGenerator:

@@ -65,6 +65,8 @@ from .metrics import (
     set_prefix_cache_pages,
     set_snapshot_store_size,
 )
+from . import profiler
+from .profiler import HotPathProfiler
 from . import reqtrace
 from .reqtrace import explain_lines, finish_request, start_request_trace
 from .slo import DEFAULT_SLOS, SLO, evaluate as evaluate_slos, healthz
@@ -83,6 +85,7 @@ from .trace import (
 __all__ = [
     "DEFAULT_SLOS",
     "DecisionJournal",
+    "HotPathProfiler",
     "SLO",
     "Span",
     "TraceContext",
@@ -115,6 +118,7 @@ __all__ = [
     "record_token_totals",
     "record_tpot",
     "record_ttft",
+    "profiler",
     "reqtrace",
     "sample_host_rss",
     "set_context",

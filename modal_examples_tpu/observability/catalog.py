@@ -226,6 +226,50 @@ WATCHDOG_TRANSITIONS_TOTAL = "mtpu_watchdog_transitions_total"
 #: restore_weight | abort_transfer | stop_revive | quarantine | unquarantine
 WATCHDOG_RECOVERIES_TOTAL = "mtpu_watchdog_recoveries_total"
 
+# -- hot-path profiler (observability/profiler.py, docs/observability.md) ---
+
+#: the scheduler-tick phase taxonomy the hot-path profiler attributes —
+#: THE phase vocabulary: ``serving/engine.py`` marks phases only through
+#: these names and ``tests/test_static.py`` enforces the closure in both
+#: directions, so a phase the scheduler stops marking (or marks under a
+#: new ad-hoc spelling) fails the suite instead of rotting in dashboards.
+#: Rendering order is anatomical: control -> admission -> prefill ->
+#: decode -> harvest -> emit.
+TICK_PHASES = (
+    "ctrl",              # scheduler control commands (migration extraction)
+    "policy",            # deadline expiry, abort reaps, gauge refresh
+    "admit",             # policy pops, page claims, slot installs
+    "prefill_resume",    # budgeted sliced-prefill chunk advance
+    "prefill_dispatch",  # batched/chunked prefill program dispatch
+    "decode_dispatch",   # decode-block program dispatch (async)
+    "harvest",           # blocking device reads (tokens ready on host)
+    "detokenize",        # incremental tokenizer.decode per accepted token
+    "accept",            # token bookkeeping, stop handling, stream emit
+)
+#: extra ``{phase}`` label value carrying the WHOLE-tick duration, so
+#: ``overhead.tick_p95`` is one histogram read (not declared in
+#: TICK_PHASES: it is the denominator, not an attribution)
+TICK_TOTAL_PHASE = "total"
+
+#: histogram {phase}: per-tick host time attributed to one scheduler phase
+#: (phase = TICK_PHASES, plus "total" for the whole-tick duration).
+#: Emitted ONLY under MTPU_PROFILE — the disabled hot path takes zero new
+#: timestamps (the faults-gate zero-cost contract)
+TICK_PHASE_SECONDS = "mtpu_tick_phase_seconds"
+#: gauge: host share of busy-tick time over the profiler ring —
+#: 1 - (device-blocked seconds / total tick seconds); the per-token host
+#: overhead ROADMAP #3's multi-step decode loop exists to amortize
+HOST_OVERHEAD_RATIO = "mtpu_host_overhead_ratio"
+#: histogram {program}: seconds spent building one jitted program at its
+#: first dispatch of a (program, shape_key); program = block | prefill |
+#: prefill_mm | prefill_chunk | draft_prefill | spec_verify | ngram_verify
+#: | sample (the ops-level first-token helper)
+COMPILE_SECONDS = "mtpu_compile_seconds"
+#: counter {program, cache}: program-cache lookups at the engine's jit
+#: dispatch sites; cache = miss (a fresh build — timed and appended to the
+#: <state_dir>/compiles.jsonl ledger) | hit (served already-compiled)
+COMPILES_TOTAL = "mtpu_compiles_total"
+
 # -- SLO engine (observability/slo.py) --------------------------------------
 
 #: gauge {slo}: observed/target burn rate per declared SLO (>1 = violating)
@@ -537,6 +581,30 @@ CATALOG: dict[str, dict] = {
                 "restore_weight|abort_transfer|stop_revive|quarantine|"
                 "unquarantine)",
     },
+    TICK_PHASE_SECONDS: {
+        "type": "histogram", "labels": ["phase"],
+        "help": "scheduler-tick host time per phase (phase=ctrl|policy|"
+                "admit|prefill_resume|prefill_dispatch|decode_dispatch|"
+                "harvest|detokenize|accept, plus total); emitted only "
+                "under MTPU_PROFILE",
+    },
+    HOST_OVERHEAD_RATIO: {
+        "type": "gauge", "labels": [],
+        "help": "host share of busy-tick time over the profiler ring "
+                "(1 - device-blocked/total) — ROADMAP #3's amortization "
+                "target",
+    },
+    COMPILE_SECONDS: {
+        "type": "histogram", "labels": ["program"],
+        "help": "jitted-program build seconds at first dispatch "
+                "(program=block|prefill|prefill_mm|prefill_chunk|"
+                "draft_prefill|spec_verify|ngram_verify|sample)",
+    },
+    COMPILES_TOTAL: {
+        "type": "counter", "labels": ["program", "cache"],
+        "help": "program-cache lookups at jit dispatch sites "
+                "(cache=miss fresh build, ledgered | hit served compiled)",
+    },
     SLO_BURN_RATE: {
         "type": "gauge", "labels": ["slo"],
         "help": "observed/target burn rate per declared SLO (>1 violating)",
@@ -720,4 +788,15 @@ COUNT_BUCKETS = (1, 2, 4, 8, 16, 32, 64, 128)
 TOKEN_TIME_BUCKETS = (
     0.0005, 0.001, 0.0025, 0.005, 0.01, 0.025, 0.05, 0.1, 0.25, 0.5,
     1.0, 2.5, 5.0, 10.0, 30.0,
+)
+
+#: buckets for mtpu_tick_phase_seconds: most scheduler-tick phases are
+#: tens of MICROseconds (ctrl/policy/harvest bookkeeping) while dispatch
+#: phases reach tens of milliseconds — TOKEN_TIME_BUCKETS' 0.5 ms floor
+#: would collapse every cheap phase into its first bucket and the
+#: `tpurun profile` p50/p95 table (the ROADMAP #3 ranking instrument)
+#: could not tell a 5 us phase from a 400 us one
+TICK_PHASE_BUCKETS = (
+    0.000005, 0.00001, 0.000025, 0.00005, 0.0001, 0.00025, 0.0005,
+    0.001, 0.0025, 0.005, 0.01, 0.025, 0.05, 0.1, 0.25, 1.0,
 )

@@ -119,7 +119,9 @@ def _gc(d: Path) -> None:
 _CONTAINER_SPAN_NAMES = ("execute", "serialize")
 
 
-def spans_to_chrome_trace(spans: list[dict], trace_id: str = "") -> dict:
+def spans_to_chrome_trace(
+    spans: list[dict], trace_id: str = "", profile: dict | None = None
+) -> dict:
     """Convert one trace's JSONL spans to Chrome-trace / Perfetto JSON.
 
     Output is the Trace Event Format object (``{"traceEvents": [...]}``)
@@ -139,10 +141,38 @@ def spans_to_chrome_trace(spans: list[dict], trace_id: str = "") -> dict:
     (execute/serialize + user spans) on tid 2. Migrations additionally get
     span LINKS: a flow arrow from the transfer (or prefill) span on the
     source replica's track to the adopt span on the destination's.
+
+    ``profile`` (hot-path profiler ride-along, docs/observability.md):
+    ``{replica: {"ticks": [...], "compiles": [...]}}`` snapshots from
+    :meth:`~.profiler.HotPathProfiler.perfetto_snapshot` — tick-phase
+    COUNTER tracks ("C" events, one series per phase in milliseconds) and
+    compile SLICES ("X" events named ``compile:<program>``) render on the
+    owning replica's track; replicas appearing only in the profile get
+    their own track after the span replicas, in the same deterministic
+    sorted order.
     """
     import zlib as _zlib
 
-    if not spans:
+    # tolerate hand-saved --profile files: a record without a numeric
+    # wall-clock "at" cannot be placed on the timeline, so it is dropped
+    # here instead of KeyError-ing the whole export (every other field is
+    # already optional via .get)
+    profile = {
+        name: {
+            "ticks": [
+                t for t in (snap or {}).get("ticks", ())
+                if isinstance(t, dict)
+                and isinstance(t.get("at"), (int, float))
+            ],
+            "compiles": [
+                c for c in (snap or {}).get("compiles", ())
+                if isinstance(c, dict)
+                and isinstance(c.get("at"), (int, float))
+            ],
+        }
+        for name, snap in (profile or {}).items()
+    }
+    if not spans and not profile:
         return {"traceEvents": [], "displayTimeUnit": "ms"}
     by_id = {s.get("span_id"): s for s in spans}
 
@@ -156,13 +186,23 @@ def spans_to_chrome_trace(spans: list[dict], trace_id: str = "") -> dict:
             cur = by_id.get(cur.get("parent_id"))
         return False
 
-    t0 = min(s.get("start") or 0.0 for s in spans)
+    starts = [s.get("start") or 0.0 for s in spans]
+    for snap in profile.values():
+        starts += [
+            t["at"] - (t.get("total") or 0.0) for t in snap.get("ticks", [])
+        ]
+        starts += [
+            c["at"] - (c.get("seconds") or 0.0)
+            for c in snap.get("compiles", [])
+        ]
+    t0 = min(starts) if starts else 0.0
     replicas = sorted(
         {
             (s.get("attrs") or {}).get("replica")
             for s in spans
             if (s.get("attrs") or {}).get("replica")
         }
+        | set(profile)
     )
     events: list[dict] = [
         {"ph": "M", "pid": 1, "tid": 0, "name": "process_name",
@@ -257,6 +297,40 @@ def spans_to_chrome_trace(spans: list[dict], trace_id: str = "") -> dict:
                  "ts": round(((adopt.get("start") or t0) - t0) * 1e6, 3),
                  "name": "migration", "cat": "mtpu"}
             )
+
+    # hot-path profiler ride-along: tick-phase counter tracks + compile
+    # slices on each owning replica's track, deterministic ordering (sorted
+    # replicas; ticks/compiles sorted by wall timestamp)
+    for replica in sorted(profile):
+        snap = profile[replica] or {}
+        tid = tid_of_replica.get(replica, other_tid)
+        for t in sorted(
+            snap.get("ticks", ()), key=lambda t: t.get("at") or 0.0
+        ):
+            total = t.get("total") or 0.0
+            args = {
+                phase: round(seconds * 1e3, 6)
+                for phase, seconds in sorted(
+                    (t.get("phases") or {}).items()
+                )
+            }
+            events.append({
+                "ph": "C", "pid": 1, "tid": tid, "cat": "mtpu",
+                "name": "tick_phase_ms",
+                "ts": round((t["at"] - total - t0) * 1e6, 3),
+                "args": args,
+            })
+        for c in sorted(
+            snap.get("compiles", ()), key=lambda c: c.get("at") or 0.0
+        ):
+            seconds = c.get("seconds") or 0.0
+            events.append({
+                "ph": "X", "pid": 1, "tid": tid, "cat": "mtpu",
+                "name": f"compile:{c.get('program', '?')}",
+                "ts": round((c["at"] - seconds - t0) * 1e6, 3),
+                "dur": round(seconds * 1e6, 3),
+                "args": {"shape_key": c.get("shape_key")},
+            })
     return {
         "traceEvents": events,
         "displayTimeUnit": "ms",

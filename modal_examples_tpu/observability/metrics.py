@@ -485,6 +485,55 @@ def set_tier_occupancy(
     )
 
 
+# -- hot-path profiler (observability/profiler.py) ----------------------------
+
+
+def record_tick_phase(
+    phase: str, seconds: float, *, registry: Registry | None = None
+) -> None:
+    """One scheduler tick's host time attributed to ``phase`` (a
+    ``catalog.TICK_PHASES`` member, or ``"total"`` for the whole tick).
+    Called only by the hot-path profiler — with MTPU_PROFILE unset nothing
+    reaches here (the zero-cost gate)."""
+    _reg(registry).histogram_observe(
+        C.TICK_PHASE_SECONDS,
+        seconds,
+        labels={"phase": phase},
+        buckets=C.TICK_PHASE_BUCKETS,
+        help=C.CATALOG[C.TICK_PHASE_SECONDS]["help"],
+    )
+
+
+def set_host_overhead_ratio(
+    ratio: float, *, registry: Registry | None = None
+) -> None:
+    _reg(registry).gauge_set(
+        C.HOST_OVERHEAD_RATIO, float(ratio),
+        help=C.CATALOG[C.HOST_OVERHEAD_RATIO]["help"],
+    )
+
+
+def record_compile(
+    program: str, seconds: float, cache_hit: bool, *,
+    registry: Registry | None = None,
+) -> None:
+    """One program-cache lookup at a jit dispatch site: every lookup
+    counts under its outcome label; only misses (fresh builds) carry a
+    build-seconds observation."""
+    reg = _reg(registry)
+    reg.counter_inc(
+        C.COMPILES_TOTAL, 1.0,
+        labels={"program": program, "cache": "hit" if cache_hit else "miss"},
+        help=C.CATALOG[C.COMPILES_TOTAL]["help"],
+    )
+    if not cache_hit:
+        reg.histogram_observe(
+            C.COMPILE_SECONDS, seconds,
+            labels={"program": program},
+            help=C.CATALOG[C.COMPILE_SECONDS]["help"],
+        )
+
+
 # -- gray-failure watchdog (serving/health.py) --------------------------------
 
 

@@ -1,0 +1,390 @@
+"""Hot-path time attribution: per-tick host/device phase accounting and
+compile telemetry for the serving engine (docs/observability.md).
+
+ROADMAP #3 claims the biggest remaining throughput lever is amortizing the
+per-token host overhead — one Python tick of dispatch/harvest/detokenize
+per generated token — and ROADMAP #1 needs the ≥40-slot compile-helper
+ceiling diagnosable offline. Neither was measurable: request traces show
+WHERE a request went, progress watermarks show THAT the scheduler moves,
+but nothing attributed where a scheduler tick's time actually goes or
+recorded when/what XLA compiles. This module is that instrument — the
+measurement foundation every subsequent perf PR (multi-step decode, spec
+adaptivity) is judged against.
+
+Three legs:
+
+- **Tick anatomy** — the scheduler thread accounts each ``step()`` into
+  named phases (:data:`~.catalog.TICK_PHASES`) via monotonic deltas on the
+  engine's injectable clock: :meth:`HotPathProfiler.begin_tick` hands the
+  tick a :class:`TickProfile`, the engine's ``_tm(tick, "phase")`` helper
+  closes intervals into phases, and :meth:`~HotPathProfiler.end_tick`
+  aggregates busy ticks into a ring buffer plus the
+  ``mtpu_tick_phase_seconds{phase}`` histograms. Blocking device reads
+  mark with ``device=True``, so the ring carries a host-vs-device split
+  and the ``mtpu_host_overhead_ratio`` gauge falls out: 1 - device-blocked
+  over total — the number the multi-step decode loop must shrink.
+- **Compile telemetry** — every jitted-program build site reports through
+  ONE chokepoint, :meth:`~HotPathProfiler.note_compile`: first dispatch of
+  a (program, shape_key) is timed (``mtpu_compile_seconds{program}``,
+  ``mtpu_compiles_total{program,cache="miss"}``) and appended to the
+  ``<state_dir>/compiles.jsonl`` ledger (the journal pattern); later
+  dispatches count as cache hits. The ledger writes a ``begin`` event
+  BEFORE the build and an ``end`` event after — so a compile helper that
+  crashes or hangs mid-build (the ≥40-slot ceiling) leaves a
+  begin-without-end row naming exactly which program/shape killed it,
+  diagnosable offline from the ledger alone.
+- **Surfaces** — ``tpurun profile`` (phase table, host fraction, top
+  compiles), the gateway's ``/profile`` route, Perfetto counter tracks +
+  compile slices merged into the replica-aware trace export, and the
+  BENCH ``overhead`` section via :meth:`~HotPathProfiler.overhead_summary`.
+
+**Zero-cost when disabled** (the ``faults/inject.py`` gate pattern):
+``LLMEngine.__init__`` resolves ``MTPU_PROFILE`` ONCE (explicit arg beats
+env beats off) and keeps ``self.profiler = None`` when off — every hot-path
+touch point is then a ``tick is None`` branch with no timestamp, no
+allocation, no dict write. ``tests/test_profiler.py`` pins the no-op shape
+at the AST level like the faults gate.
+
+jax-free and import-light: ``observability/`` is imported by the jax-free
+``core/`` layer, and ``tpurun profile`` must not attach a chip to render a
+ledger.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+import weakref
+from collections import deque
+
+from .._internal import config as _config
+from ..utils.stats import percentile_nearest_rank as _pct
+from . import catalog as C
+from . import metrics as _obs
+from .journal import DecisionJournal
+
+#: the one env switch (resolved once in ``LLMEngine.__init__``, the
+#: MTPU_KV_DTYPE rule): unset/0 = off — bench configs opt in explicitly
+PROFILE_ENV = "MTPU_PROFILE"
+
+#: busy ticks retained in the in-memory ring (per profiler)
+RING_TICKS = 512
+#: completed compile records retained in memory for the Perfetto export
+#: (the JSONL ledger is the unbounded-ish superset)
+COMPILE_LOG_KEEP = 256
+#: refresh the host-overhead gauge every N busy ticks (a gauge write per
+#: tick would be pure lock traffic for a value that moves slowly)
+_GAUGE_EVERY = 32
+
+#: the ledger file name under ``<state_dir>`` (the journal pattern —
+#: ``watchdog.jsonl`` / ``fleet.jsonl`` / ``chaos.jsonl``'s sibling)
+LEDGER_NAME = "compiles.jsonl"
+
+
+def profiling_enabled(explicit=None) -> bool:
+    """Resolve the profile switch ONCE: explicit arg beats
+    :data:`PROFILE_ENV` beats off (the MTPU_KV_DTYPE rule — the env is
+    never re-read on the hot path)."""
+    import os
+
+    if explicit is not None:
+        return bool(explicit)
+    return os.environ.get(PROFILE_ENV, "") not in ("", "0")
+
+
+class TickProfile:
+    """One scheduler tick's phase accumulator.
+
+    Interval semantics: :meth:`mark` closes the time since the PREVIOUS
+    mark (or the tick's start) into the named phase — the scheduler runs
+    one thread, so sequential marks partition the tick exactly and the
+    per-phase sums can never exceed the tick total. ``device=True``
+    additionally counts the interval as device-blocked time (the host
+    waiting on a device array), feeding the host-vs-device split.
+    """
+
+    __slots__ = ("_clock", "t0", "_last", "phases", "device_s")
+
+    def __init__(self, clock):
+        self._clock = clock
+        self.t0 = self._last = clock()
+        self.phases: dict[str, float] = {}
+        self.device_s = 0.0
+
+    def mark(self, phase: str, device: bool = False) -> None:
+        now = self._clock()
+        dt = now - self._last
+        self._last = now
+        if dt > 0:
+            self.phases[phase] = self.phases.get(phase, 0.0) + dt
+            if device:
+                self.device_s += dt
+
+
+class HotPathProfiler:
+    """Per-engine hot-path profiler: tick ring + compile telemetry.
+
+    ``clock`` is the engine's injectable monotonic clock (fake-clock tests
+    see real phase deltas); ``name`` is the replica name, or a zero-arg
+    callable resolving it lazily (the engine's ``trace_name`` is assigned
+    by the fleet AFTER construction). All methods are safe from the
+    scheduler thread plus concurrent ``prefill_sync`` server threads.
+    """
+
+    def __init__(
+        self,
+        *,
+        clock=None,
+        name="engine",
+        registry=None,
+        ledger_path=None,
+        ring: int = RING_TICKS,
+    ):
+        self._clock = clock or time.monotonic
+        self._name = name
+        self._registry = registry
+        self._lock = threading.Lock()
+        self._ring: deque[dict] = deque(maxlen=ring)
+        self._busy_ticks = 0
+        #: (program, shape_key str) pairs already built in this process
+        self._seen: set[tuple[str, str]] = set()
+        self._compiles = 0
+        self._compile_s = 0.0
+        self._compile_log: deque[dict] = deque(maxlen=COMPILE_LOG_KEEP)
+        self._ledger_path = ledger_path
+        self._ledger: DecisionJournal | None = None
+        register(self)
+
+    @property
+    def replica(self) -> str:
+        return str(self._name() if callable(self._name) else self._name)
+
+    # -- tick anatomy --------------------------------------------------------
+
+    def begin_tick(self) -> TickProfile:
+        return TickProfile(self._clock)
+
+    def end_tick(self, tick: TickProfile, worked: bool) -> None:
+        """Close one tick. Idle ticks (``worked=False``, or nothing marked)
+        record NOTHING — the ring and histograms carry only ticks that did
+        work, so an idle engine's profile stays empty instead of drowning
+        the signal in sub-millisecond no-op loops."""
+        if not worked or not tick.phases:
+            return
+        total = max(0.0, self._clock() - tick.t0)
+        entry = {
+            "at": time.time(),  # wall clock: aligns with trace span starts
+            "total": total,
+            "device": tick.device_s,
+            "phases": dict(tick.phases),
+        }
+        with self._lock:
+            self._ring.append(entry)
+            self._busy_ticks += 1
+            refresh = self._busy_ticks % _GAUGE_EVERY == 0
+        for phase, seconds in tick.phases.items():
+            _obs.record_tick_phase(phase, seconds, registry=self._registry)
+        _obs.record_tick_phase(
+            C.TICK_TOTAL_PHASE, total, registry=self._registry
+        )
+        if refresh:
+            self._refresh_ratio()
+
+    def flush(self) -> None:
+        """Force the host-overhead gauge current (engine stop / push time:
+        a short run may never cross the every-N-ticks refresh)."""
+        self._refresh_ratio()
+
+    def _refresh_ratio(self) -> None:
+        with self._lock:
+            total = sum(e["total"] for e in self._ring)
+            device = sum(e["device"] for e in self._ring)
+        if total > 0:
+            _obs.set_host_overhead_ratio(
+                max(0.0, min(1.0, 1.0 - device / total)),
+                registry=self._registry,
+            )
+
+    # -- compile telemetry ---------------------------------------------------
+
+    def compile_begin(self, program: str, shape_key) -> float | None:
+        """First half of the build-site chokepoint: None when this
+        (program, shape_key) was already built in this process (the caller
+        records a cache hit via :meth:`compile_end`); otherwise the start
+        timestamp — and a ``begin`` ledger event, written BEFORE the build
+        so a crash/hang mid-compile still names its program/shape."""
+        key = (program, str(shape_key))
+        with self._lock:
+            if key in self._seen:
+                return None
+            self._seen.add(key)
+        self._ledger_record({
+            "at": time.time(),
+            "event": "begin",
+            "replica": self.replica,
+            "program": program,
+            "shape_key": str(shape_key),
+        })
+        return self._clock()
+
+    def compile_abort(self, program: str, shape_key) -> None:
+        """A build that raised: forget the (program, shape_key) so the
+        next dispatch is timed as a fresh miss again — without this, the
+        successful retry would be misreported as a cache hit and its
+        ``begin`` row would read as a crash forever. The open ``begin``
+        stays in the ledger; the retry's own begin/end pair supersedes it
+        in :func:`unfinished_builds`, and a never-retried failure keeps
+        reading as unfinished — which it is."""
+        with self._lock:
+            self._seen.discard((program, str(shape_key)))
+
+    def compile_end(self, program: str, shape_key, t0: float | None) -> None:
+        if t0 is None:
+            self.note_compile(program, shape_key, 0.0, cache_hit=True)
+        else:
+            self.note_compile(
+                program, shape_key, self._clock() - t0, cache_hit=False
+            )
+
+    def note_compile(
+        self, program: str, shape_key, seconds: float, cache_hit: bool
+    ) -> None:
+        """THE chokepoint every build site reports through: counts the
+        lookup (``mtpu_compiles_total{program,cache}``); a miss (fresh
+        build) also observes ``mtpu_compile_seconds{program}`` and appends
+        the ``end`` event to the ledger."""
+        _obs.record_compile(
+            program, seconds, cache_hit, registry=self._registry
+        )
+        if cache_hit:
+            return
+        rec = {
+            "at": time.time(),
+            "event": "end",
+            "replica": self.replica,
+            "program": program,
+            "shape_key": str(shape_key),
+            "seconds": round(float(seconds), 6),
+            "cache": "miss",
+        }
+        with self._lock:
+            self._compiles += 1
+            self._compile_s += float(seconds)
+            self._compile_log.append(rec)
+        self._ledger_record(rec)
+
+    def _ledger_record(self, rec: dict) -> None:
+        if self._ledger is None:
+            self._ledger = DecisionJournal(
+                self._ledger_path or (_config.state_dir() / LEDGER_NAME)
+            )
+        self._ledger.record(rec)
+
+    # -- read surfaces -------------------------------------------------------
+
+    def overhead_summary(self) -> dict:
+        """The BENCH ``overhead`` section / ``/profile`` payload: per-phase
+        tick p50/p95 over the ring, the host fraction, the detokenize
+        share, attribution coverage (attributed/total — structurally ≤ 1),
+        and compile totals."""
+        with self._lock:
+            ring = list(self._ring)
+            compiles_n, compile_s = self._compiles, self._compile_s
+        if not ring:
+            return {
+                "ticks": 0,
+                "host_fraction": None,
+                "tick_p50": None,
+                "tick_p95": None,
+                "detok_share": None,
+                "attribution_cover": None,
+                "phases": {},
+                "compile_total_s": round(compile_s, 3),
+                "compiles_n": compiles_n,
+            }
+        totals = sorted(e["total"] for e in ring)
+        sum_total = sum(totals)
+        sum_device = sum(e["device"] for e in ring)
+        sum_detok = sum(e["phases"].get("detokenize", 0.0) for e in ring)
+        sum_attr = sum(sum(e["phases"].values()) for e in ring)
+        phases: dict[str, dict] = {}
+        for phase in C.TICK_PHASES:
+            vals = sorted(
+                e["phases"][phase] for e in ring if phase in e["phases"]
+            )
+            if vals:
+                phases[phase] = {
+                    "p50": round(_pct(vals, 0.50), 6),
+                    "p95": round(_pct(vals, 0.95), 6),
+                    "count": len(vals),
+                }
+        return {
+            "ticks": len(ring),
+            "host_fraction": round(
+                max(0.0, min(1.0, 1.0 - sum_device / sum_total)), 6
+            ) if sum_total > 0 else None,
+            "tick_p50": round(_pct(totals, 0.50), 6),
+            "tick_p95": round(_pct(totals, 0.95), 6),
+            "detok_share": round(sum_detok / sum_total, 6)
+            if sum_total > 0 else None,
+            "attribution_cover": round(sum_attr / sum_total, 6)
+            if sum_total > 0 else None,
+            "phases": phases,
+            "compile_total_s": round(compile_s, 3),
+            "compiles_n": compiles_n,
+        }
+
+    def perfetto_snapshot(self) -> dict:
+        """Ring + in-memory compile log in the shape the Perfetto export's
+        ``profile=`` parameter takes (wall-clock ``at`` fields align with
+        request-span timestamps)."""
+        with self._lock:
+            return {
+                "ticks": [dict(e) for e in self._ring],
+                "compiles": [dict(r) for r in self._compile_log],
+            }
+
+
+# -- process registry (the gateway's /profile source) ------------------------
+
+_registry_lock = threading.Lock()
+#: weak refs so the registry never pins a dead engine's profiler (the
+#: profiler's lazy-name callable holds the engine)
+_profilers: list = []
+
+
+def register(profiler: HotPathProfiler) -> None:
+    with _registry_lock:
+        _profilers.append(weakref.ref(profiler))
+        # drop dead refs opportunistically; cap the list
+        _profilers[:] = [r for r in _profilers if r() is not None][-64:]
+
+
+def active_profilers() -> list[HotPathProfiler]:
+    with _registry_lock:
+        return [p for p in (r() for r in _profilers) if p is not None]
+
+
+def read_ledger(path=None, n: int = 200) -> list[dict]:
+    """Newest-last slice of the compile ledger (jax-free — `tpurun
+    profile` and the gateway read it without touching an engine)."""
+    return DecisionJournal(
+        path or (_config.state_dir() / LEDGER_NAME)
+    ).tail(n)
+
+
+def unfinished_builds(records: list[dict]) -> list[dict]:
+    """``begin`` events with no matching LATER ``end`` — the offline
+    diagnosis for a compile helper that crashed or hung mid-build (the
+    ≥40-slot ceiling's smoking gun). Pairing is strictly ordered: an
+    ``end`` closes only begins that precede it, so a ledger spanning
+    several runs (revalidate rounds append) still reports a later run's
+    mid-build crash of a program/shape that built fine earlier."""
+    open_begins: dict[tuple, dict] = {}
+    for rec in records:
+        key = (rec.get("replica"), rec.get("program"), rec.get("shape_key"))
+        if rec.get("event") == "begin":
+            open_begins[key] = rec
+        elif rec.get("event") == "end":
+            open_begins.pop(key, None)
+    return list(open_begins.values())

@@ -41,6 +41,7 @@ import numpy as np
 
 from ..models import llama
 from ..observability import metrics as _obs
+from ..observability import profiler as _profiler
 from ..observability import reqtrace as _rt
 from ..scheduling.admission import AdmissionController, ShedError
 from ..scheduling.policy import (
@@ -59,6 +60,25 @@ from .sampling import SamplingParams, sample
 from ..utils.tokenizer import load_tokenizer
 
 _log = get_logger("engine")
+
+
+def _tm(tick, phase: str) -> None:
+    """Close the interval since the tick's last mark into ``phase`` — THE
+    way scheduler code feeds the hot-path profiler (docs/observability.md).
+    ``tick`` is None whenever profiling is off, so the disabled hot path is
+    one branch: no timestamp, no allocation (the faults-gate zero-cost
+    contract; tests/test_profiler.py pins this shape at the AST level, and
+    tests/test_static.py pins the phase names to catalog.TICK_PHASES)."""
+    if tick is not None:
+        tick.mark(phase)
+
+
+def _tm_device(tick, phase: str) -> None:
+    """`_tm`, additionally counting the interval as DEVICE-blocked time (a
+    blocking read of a device array) — the device half of the profiler's
+    host-vs-device split behind ``mtpu_host_overhead_ratio``."""
+    if tick is not None:
+        tick.mark(phase, device=True)
 
 
 @dataclasses.dataclass
@@ -392,6 +412,12 @@ class LLMEngine:
         policy: SchedulerPolicy | None = None,  # waiting-set ordering
         admission: AdmissionController | None = None,  # shed/deadline gate
         clock=None,  # injectable monotonic clock (fake-clock scheduling tests)
+        # hot-path profiler (observability/profiler.py): None resolves
+        # MTPU_PROFILE once (the MTPU_KV_DTYPE rule); True/False override.
+        # Off = self.profiler stays None and the scheduler tick takes ZERO
+        # new timestamps, so chaos/loadgen runs can't silently pay
+        # profiling cost; bench configs opt in explicitly.
+        profile=None,
         # tiered prefix cache (docs/disagg.md): True for env-default sizing,
         # or a dict of TieredPrefixCache kwargs (host_bytes=, volume=);
         # evicted prefix pages spill HBM -> host RAM -> Volume and promote
@@ -604,6 +630,17 @@ class LLMEngine:
         # fleet watchdog classifies gray failures from their ages. Shares
         # the engine's injectable clock so fake-clock tests see real ages.
         self.watermarks = EngineWatermarks(clock=self._clock)
+        # hot-path profiler (docs/observability.md#hot-path-profiling):
+        # resolved ONCE — explicit arg beats MTPU_PROFILE beats off. The
+        # lazy name callable picks up the fleet's trace_name assignment.
+        self.profiler = (
+            _profiler.HotPathProfiler(
+                clock=self._clock, name=lambda: self.trace_name
+            )
+            if _profiler.profiling_enabled(profile)
+            else None
+        )
+        self._tick = None  # the in-flight TickProfile (None = off/idle)
         self.policy: SchedulerPolicy = policy or FairSharePolicy(
             clock=self._clock
         )
@@ -787,6 +824,35 @@ class LLMEngine:
         )
 
     # -- jitted programs ----------------------------------------------------
+
+    def _profiled(self, program: str, shape_key, fn):
+        """THE compile-telemetry chokepoint (docs/observability.md): every
+        jitted-program dispatch site wraps its callable here. Profiling
+        off: returns ``fn`` untouched — no wrapper, no allocation (the
+        zero-cost gate, AST-pinned in tests/test_profiler.py). On: the
+        first dispatch of each (program, shape_key) is timed into
+        ``mtpu_compile_seconds{program}`` and the compiles.jsonl ledger
+        (begin event BEFORE the build, so a mid-compile crash/hang still
+        names its program — the ≥40-slot ceiling diagnosis); later
+        dispatches count as ``mtpu_compiles_total{cache="hit"}``."""
+        prof = self.profiler
+        if prof is None:
+            return fn
+
+        def run(*args, **kwargs):
+            t0 = prof.compile_begin(program, shape_key)
+            try:
+                out = fn(*args, **kwargs)
+            except BaseException:
+                if t0 is not None:
+                    # the build raised: forget the key so a retry is timed
+                    # as a fresh miss, not misreported as a cache hit
+                    prof.compile_abort(program, shape_key)
+                raise
+            prof.compile_end(program, shape_key, t0)
+            return out
+
+        return run
 
     def _decode_block_fn(
         self, params, k_pages, v_pages, prev_tokens, override, override_mask,
@@ -1078,10 +1144,14 @@ class LLMEngine:
         return props, n_prop
 
     def _ngram_tick(self, active_idx: list[int]) -> bool:
+        tick = self._tick
         props, n_prop = self._ngram_proposals()
         (
             out_tokens, n_emit, self.cache.k_pages, self.cache.v_pages,
-        ) = self._ngram_jit(
+        ) = self._profiled(
+            "ngram_verify", f"s{self.max_slots}g{self.spec_gamma}",
+            self._ngram_jit,
+        )(
             self.params,
             self.cache.k_pages,
             self.cache.v_pages,
@@ -1094,8 +1164,10 @@ class LLMEngine:
             self._next_key(),
             jnp.asarray(self._temps.copy()),
         )
+        _tm(tick, "decode_dispatch")
         out_np = np.asarray(out_tokens)
         n_np = np.asarray(n_emit)
+        _tm_device(tick, "harvest")
         self.stats.steps += 1
         for i in active_idx:
             s = self.slots[i]
@@ -1114,6 +1186,7 @@ class LLMEngine:
                 s.position += 1
                 s.last_token = int(out_np[i, t])
                 self._accept_token(i, s.last_token)
+        _tm(tick, "accept")
         return True
 
     def _bucket_for(self, n: int) -> int:
@@ -1336,8 +1409,11 @@ class LLMEngine:
         t0 = time.monotonic()
         for bucket in buckets or self.prefill_buckets:
             B = self.prefill_batch
-            _tok, self.cache.k_pages, self.cache.v_pages = self._prefill_jit(
-                (bucket, B)
+            # warmup shares the dispatch sites' (program, shape_key) space:
+            # boot-time builds land in the compile ledger once, and the
+            # live path then records cache hits instead of re-timing
+            _tok, self.cache.k_pages, self.cache.v_pages = self._profiled(
+                "prefill", f"b{bucket}x{B}", self._prefill_jit((bucket, B))
             )(
                 self.params,
                 self.cache.k_pages,
@@ -1357,8 +1433,9 @@ class LLMEngine:
             S = self.vision_cfg.vision.image_size
             B = self.prefill_batch
             mm_bucket = self._bucket_for(self.vision_cfg.n_image_tokens + 1)
-            _tok, self.cache.k_pages, self.cache.v_pages = self._prefill_mm_jit(
-                (mm_bucket, B)
+            _tok, self.cache.k_pages, self.cache.v_pages = self._profiled(
+                "prefill_mm", f"b{mm_bucket}x{B}",
+                self._prefill_mm_jit((mm_bucket, B)),
             )(
                 self.params,
                 self.vision_params,
@@ -1378,7 +1455,10 @@ class LLMEngine:
         if not self.spec_gamma:
             # spec mode never runs the block program — compiling the 8-step
             # scan there would be pure cold-start cost for a dead path
-            _toks, _last, self.cache.k_pages, self.cache.v_pages = self._block_jit(
+            _toks, _last, self.cache.k_pages, self.cache.v_pages = self._profiled(
+                "block", f"s{self.max_slots}k{self.decode_block}",
+                self._block_jit,
+            )(
                 self.params,
                 self.cache.k_pages,
                 self.cache.v_pages,
@@ -1398,7 +1478,10 @@ class LLMEngine:
             B = self.max_slots
             (
                 _, _, self.cache.k_pages, self.cache.v_pages,
-            ) = self._ngram_jit(
+            ) = self._profiled(
+                "ngram_verify", f"s{self.max_slots}g{self.spec_gamma}",
+                self._ngram_jit,
+            )(
                 self.params,
                 self.cache.k_pages,
                 self.cache.v_pages,
@@ -1415,7 +1498,10 @@ class LLMEngine:
             for bucket in buckets or self.prefill_buckets:
                 B = self.prefill_batch
                 _, self.draft_cache.k_pages, self.draft_cache.v_pages = (
-                    self._draft_prefill_jit((bucket, B))(
+                    self._profiled(
+                        "draft_prefill", f"b{bucket}x{B}",
+                        self._draft_prefill_jit((bucket, B)),
+                    )(
                         self.draft_params,
                         self.draft_cache.k_pages,
                         self.draft_cache.v_pages,
@@ -1431,7 +1517,10 @@ class LLMEngine:
                 self.cache.v_pages,
                 self.draft_cache.k_pages,
                 self.draft_cache.v_pages,
-            ) = self._spec_jit(
+            ) = self._profiled(
+                "spec_verify", f"s{self.max_slots}g{self.spec_gamma}",
+                self._spec_jit,
+            )(
                 self.params,
                 self.draft_params,
                 self.cache.k_pages,
@@ -1874,6 +1963,8 @@ class LLMEngine:
             self._thread.join(timeout=10)
         self._release_all(_FINISH if reason == "stop" else _Finish(reason))
         self._flush_token_counters()
+        if self.profiler is not None:
+            self.profiler.flush()
 
     # -- scheduler loop ------------------------------------------------------
 
@@ -2004,10 +2095,18 @@ class LLMEngine:
 
     def step(self) -> bool:
         """One scheduler tick: expire deadlines -> admit -> decode -> emit.
-        Returns True if any work happened."""
+        Returns True if any work happened.
+
+        Tick anatomy (docs/observability.md#hot-path-profiling): with the
+        profiler on, the tick's host time is partitioned into the
+        catalog.TICK_PHASES via sequential ``_tm`` marks here and in the
+        helpers this calls; idle ticks record nothing."""
         # fault point (docs/faults.md): a scheduler-thread crash. _loop
         # catches the FaultError, fails every caller loudly, and survives.
         _inject.check("engine.scheduler_crash")
+        prof = self.profiler
+        tick = None if prof is None else prof.begin_tick()
+        self._tick = tick
         # fault point (docs/health.md): a SILENT scheduler freeze — the
         # thread stays alive, healthy() stays true, but no tick, dispatch,
         # or accept ever lands again. Nothing inside the engine ends it;
@@ -2028,10 +2127,16 @@ class LLMEngine:
             return False
         self.watermarks.note_tick()
         self._drain_ctrl()
+        _tm(tick, "ctrl")
         self._expire_deadlines()
+        _tm(tick, "policy")
         admitted = self._admit()
         decoded = self._decode_tick()
         self._refresh_gauges()
+        _tm(tick, "policy")
+        if tick is not None:
+            self._tick = None
+            prof.end_tick(tick, worked=admitted or decoded)
         return admitted or decoded
 
     def _expire_deadlines(self) -> None:
@@ -2139,8 +2244,10 @@ class LLMEngine:
         the sampled first tokens park on the pending-harvest queue and are
         read only after ``_decode_tick`` has dispatched the next decode
         block, so in-flight streams never wait on a prefill round trip."""
+        tick = self._tick
         budget = self.prefill_budget or None  # None/0 = unlimited
         spent = self._advance_pending_prefills(budget, 0)
+        _tm(tick, "prefill_resume")
         assignments: list[tuple[int, "Request", dict]] = []  # (slot, req, claim)
         free_slots = [i for i, s in enumerate(self.slots) if s.free]
         entries = (
@@ -2219,6 +2326,7 @@ class LLMEngine:
                 # as their state machine advances below
                 spent += claim["n_prompt"]
 
+        _tm(tick, "admit")
         long_ones: list[tuple] = []
         grouped: list[tuple] = []
         for a in assignments:
@@ -2261,11 +2369,13 @@ class LLMEngine:
 
                 traceback.print_exc()
                 self._fail_claims([a])
+        _tm(tick, "prefill_dispatch")
         if long_ones:
             # newly admitted long prompts advance with what remains of this
             # tick's budget (at least one chunk fires when nothing else
             # did: the progress guarantee)
             spent = self._advance_pending_prefills(budget, spent)
+            _tm(tick, "prefill_resume")
         return bool(assignments) or adopted_any or spent > 0
 
     def _admit_adopted(
@@ -2494,7 +2604,9 @@ class LLMEngine:
                 donate_argnums=(2, 3),
             )
             self._chunk_jits[offset] = fn
-        logits, self.cache.k_pages, self.cache.v_pages = fn(
+        logits, self.cache.k_pages, self.cache.v_pages = self._profiled(
+            "prefill_chunk", f"off{offset}", fn
+        )(
             self.params,
             jnp.asarray(toks),
             self.cache.k_pages,
@@ -2507,7 +2619,9 @@ class LLMEngine:
             # the same cached jit serves the draft: cfg is a static call
             # argument, so target and draft get separate compile-cache
             # entries under one callable
-            _, self.draft_cache.k_pages, self.draft_cache.v_pages = fn(
+            _, self.draft_cache.k_pages, self.draft_cache.v_pages = self._profiled(
+                "draft_prefill", f"chunk-off{offset}", fn
+            )(
                 self.draft_params,
                 jnp.asarray(toks),
                 self.draft_cache.k_pages,
@@ -2542,7 +2656,10 @@ class LLMEngine:
         p = req.params
         if n_prompt > self.prefill_buckets[-1]:
             logits = self._run_prefill_chunks(req.prompt_tokens, table)
-            first = sample(
+            # the ops-level first-token helper: eager sample() builds its
+            # own small compiled programs — report them through the same
+            # chokepoint as the big jits
+            first = self._profiled("sample", "first_token", sample)(
                 logits,
                 self._next_key(),
                 jnp.asarray([p.temperature], np.float32),
@@ -2567,8 +2684,8 @@ class LLMEngine:
         seeds = np.full((B,), -1, np.int32)
         temps[0], top_ps[0], top_ks[0] = p.temperature, p.top_p, p.top_k
         seeds[0] = _req_seed(req)
-        next_tok, self.cache.k_pages, self.cache.v_pages = self._prefill_jit(
-            (bucket, B)
+        next_tok, self.cache.k_pages, self.cache.v_pages = self._profiled(
+            "prefill", f"b{bucket}x{B}", self._prefill_jit((bucket, B))
         )(
             self.params,
             self.cache.k_pages,
@@ -2677,7 +2794,7 @@ class LLMEngine:
         req = pp.req
         p = req.params
         n_prompt = len(req.prompt_tokens)
-        first = sample(
+        first = self._profiled("sample", "first_token", sample)(
             pp.logits,
             self._next_key(),
             jnp.asarray([p.temperature], np.float32),
@@ -2707,11 +2824,13 @@ class LLMEngine:
         override lane. Slots recycled while the prefill was in flight
         (abort/deadline unwound them) are skipped by request identity,
         like ``_process_block``'s snapshots."""
+        tick = self._tick
         worked = False
         while self._pending_harvest:
             next_tok, rows, meta = self._pending_harvest.popleft()
             try:
                 next_np = np.asarray(next_tok)
+                _tm_device(tick, "harvest")
             except Exception:
                 # a prefill that failed ON DEVICE (materialization error):
                 # unwind every still-owned slot and release the callers —
@@ -2787,6 +2906,7 @@ class LLMEngine:
                     req._resume_state = None
                 else:
                     self._accept_token(slot_idx, s.last_token)
+            _tm(tick, "accept")
         return worked
 
     def _replay_decode_prefix(self, slot_idx: int, replay: list) -> None:
@@ -2826,7 +2946,10 @@ class LLMEngine:
             override[slot_idx] = int(tok)
             positions[slot_idx] = base_pos + i
             _toks, _last, self.cache.k_pages, self.cache.v_pages = (
-                self._block_jit(
+                self._profiled(
+                    "block", f"s{self.max_slots}k{self.decode_block}",
+                    self._block_jit,
+                )(
                     self.params,
                     self.cache.k_pages,
                     self.cache.v_pages,
@@ -2919,7 +3042,10 @@ class LLMEngine:
 
         if is_mm:
             next_tok, self.cache.k_pages, self.cache.v_pages = (
-                self._prefill_mm_jit((bucket, B))(
+                self._profiled(
+                    "prefill_mm", f"b{bucket}x{B}",
+                    self._prefill_mm_jit((bucket, B)),
+                )(
                     self.params,
                     self.vision_params,
                     self.cache.k_pages,
@@ -2936,8 +3062,8 @@ class LLMEngine:
                 )
             )
         else:
-            next_tok, self.cache.k_pages, self.cache.v_pages = self._prefill_jit(
-                (bucket, B)
+            next_tok, self.cache.k_pages, self.cache.v_pages = self._profiled(
+                "prefill", f"b{bucket}x{B}", self._prefill_jit((bucket, B))
             )(
                 self.params,
                 self.cache.k_pages,
@@ -2955,7 +3081,10 @@ class LLMEngine:
             # fill the draft model's cache over the same pages (same tables:
             # page ids are shared between the two caches)
             _, self.draft_cache.k_pages, self.draft_cache.v_pages = (
-                self._draft_prefill_jit((bucket, B))(
+                self._profiled(
+                    "draft_prefill", f"b{bucket}x{B}",
+                    self._draft_prefill_jit((bucket, B)),
+                )(
                     self.draft_params,
                     self.draft_cache.k_pages,
                     self.draft_cache.v_pages,
@@ -2988,6 +3117,7 @@ class LLMEngine:
         ))
 
     def _decode_tick(self) -> bool:
+        tick = self._tick
         # fault point (docs/faults.md): one stalled decode tick — a slow
         # collective, a preempted host thread. Latency only; the tick then
         # proceeds normally and requests still terminate.
@@ -3018,6 +3148,7 @@ class LLMEngine:
                     self._release_slot_pages(s)
                 s.request = None
                 self._active[i] = False
+        _tm(tick, "policy")
         live = [i for i, s in enumerate(self.slots) if s.decodable]
 
         if self.spec_gamma:
@@ -3036,6 +3167,7 @@ class LLMEngine:
                 p = s.request.params
                 self._temps[i] = p.temperature
                 self._seeds[i] = _req_seed(s.request)
+            _tm(tick, "admit")  # spec batch staging: slot-state bookkeeping
             return self._spec_tick(live) or worked
 
         # pipelined path: keep one decode block in flight ahead of the one
@@ -3067,6 +3199,7 @@ class LLMEngine:
         per-block snapshot pins request identity so the host drops output
         rows whose slot was recycled.
         """
+        tick = self._tick
         now = time.monotonic()
         if self._last_dispatch_at is not None:
             # dispatch-to-dispatch gap while decodable slots existed the
@@ -3104,7 +3237,9 @@ class LLMEngine:
         prev = self._device_tokens
         if prev is None:
             prev = jnp.zeros((self.max_slots,), jnp.int32)
-        toks, last, self.cache.k_pages, self.cache.v_pages = self._block_jit(
+        toks, last, self.cache.k_pages, self.cache.v_pages = self._profiled(
+            "block", f"s{self.max_slots}k{self.decode_block}", self._block_jit
+        )(
             self.params,
             self.cache.k_pages,
             self.cache.v_pages,
@@ -3133,12 +3268,15 @@ class LLMEngine:
         ))
         for i in live:
             self._opt_positions[i] += self.decode_block
+        _tm(tick, "decode_dispatch")
 
     def _process_block(self) -> bool:
+        tick = self._tick
         toks, snapshot = self._inflight.popleft()
         t_wait = time.monotonic()
         toks_np = np.asarray(toks)  # [K, B] — the ONE blocking read per block
         _obs.record_engine_phase("decode_wait", time.monotonic() - t_wait)
+        _tm_device(tick, "harvest")
         self.stats.steps += self.decode_block
         worked = False
         for i, req, tenancy in snapshot:
@@ -3152,12 +3290,14 @@ class LLMEngine:
                 s.last_token = int(toks_np[k, i])
                 self._accept_token(i, s.last_token)
                 worked = True
+        _tm(tick, "accept")
         return worked
 
     def _spec_tick(self, active_idx: list[int]) -> bool:
         """Speculative decode tick: up to gamma+1 tokens per slot per step."""
         if self.spec_mode == "ngram":
             return self._ngram_tick(active_idx)
+        tick = self._tick
         (
             out_tokens,
             n_emit,
@@ -3165,7 +3305,10 @@ class LLMEngine:
             self.cache.v_pages,
             self.draft_cache.k_pages,
             self.draft_cache.v_pages,
-        ) = self._spec_jit(
+        ) = self._profiled(
+            "spec_verify", f"s{self.max_slots}g{self.spec_gamma}",
+            self._spec_jit,
+        )(
             self.params,
             self.draft_params,
             self.cache.k_pages,
@@ -3180,8 +3323,10 @@ class LLMEngine:
             jnp.asarray(self._temps.copy()),
             jnp.asarray(self._seeds.copy()),
         )
+        _tm(tick, "decode_dispatch")
         out_np = np.asarray(out_tokens)
         n_np = np.asarray(n_emit)
+        _tm_device(tick, "harvest")
         self.stats.steps += 1
         for i in active_idx:
             s = self.slots[i]
@@ -3200,6 +3345,7 @@ class LLMEngine:
                 s.position += 1
                 s.last_token = int(out_np[i, t])
                 self._accept_token(i, s.last_token)
+        _tm(tick, "accept")
         return True
 
     def _accept_token(self, slot_idx: int, token: int) -> None:
@@ -3234,8 +3380,15 @@ class LLMEngine:
             elif slot.position + 1 >= self.max_model_len:
                 finished, reason = True, "length"
 
-        # incremental detokenization: emit the stable new suffix
+        # incremental detokenization: emit the stable new suffix. Profiled
+        # as its own phase (the ROADMAP #3 "move detokenization off the
+        # scheduler thread" candidate needs its cost attributed first):
+        # everything since the last mark is accept bookkeeping, the decode
+        # call itself is detokenize.
+        tick = self._tick
+        _tm(tick, "accept")
         text = self.tokenizer.decode(slot.generated)
+        _tm(tick, "detokenize")
         if req.params.stop:
             for stop_s in req.params.stop:
                 idx = text.find(stop_s)

@@ -60,6 +60,14 @@ METRICS: list[tuple[str, bool, str]] = [
     # on a healthy peer — detection by progress watermarks plus the
     # failover takeover; a regression means hangs live longer
     ("recovery.time_to_mitigate.p95", True, "ratio"),
+    # hot-path overhead (docs/observability.md#hot-path-profiling): the
+    # host share of serving time and the scheduler-tick tail from the
+    # profiler's `overhead` section. host_fraction is a 0..1 rate (abs
+    # comparison, like shed_rate); a regression in either means the engine
+    # got chattier per token — the exact lever ROADMAP #3's multi-step
+    # decode loop exists to shrink, so it must fail the gate loudly.
+    ("overhead.host_fraction", True, "abs"),
+    ("overhead.tick_p95", True, "ratio"),
 ]
 
 

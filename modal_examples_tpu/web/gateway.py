@@ -174,6 +174,35 @@ def _chaos_snapshot(last: int = 10) -> dict:
     }
 
 
+def _profile_snapshot(last: int = 20) -> dict:
+    """Hot-path profiler snapshot: per-replica overhead summaries + raw
+    Perfetto-ready ring/compile snapshots from every live profiler in the
+    process, plus the newest compile-ledger records from
+    ``<state_dir>/compiles.jsonl`` — the ``/profile`` route's payload
+    (``tpurun profile`` renders the same data from pushed metrics + the
+    ledger; docs/observability.md#hot-path-profiling). Empty ``replicas``
+    means no engine in this process runs with MTPU_PROFILE on."""
+    from ..observability import profiler as _prof
+
+    replicas = {}
+    for p in _prof.active_profilers():
+        replicas[p.replica] = {
+            "summary": p.overhead_summary(),
+            "perfetto": p.perfetto_snapshot(),
+        }
+    # the unfinished scan reads a DEEP tail regardless of the display size
+    # `last`: 20+ later begin/end pairs (one multi-bucket warmup) would
+    # otherwise push the crash-diagnosing begin-without-end row out of the
+    # window and the gateway would report no unfinished builds while the
+    # ledger still holds the smoking gun
+    deep = _prof.read_ledger(n=2000)
+    return {
+        "replicas": replicas,
+        "ledger": deep[-last:] if last else [],
+        "unfinished_builds": _prof.unfinished_builds(deep),
+    }
+
+
 class _Handler(BaseHTTPRequestHandler):
     gateway: "Gateway"
 
@@ -315,17 +344,31 @@ class _Handler(BaseHTTPRequestHandler):
         decisions, boot latencies + journal — docs/fleet.md), and
         ``/health`` (gray-failure watchdog: per-replica progress
         classification, watermark ages, ladder decisions —
-        docs/health.md). User endpoints with the same label win — these
-        only answer when no route claimed the path."""
+        docs/health.md), and ``/profile`` (hot-path profiler: per-replica
+        tick-phase summaries, host fraction, compile ledger —
+        docs/observability.md#hot-path-profiling). User endpoints with the
+        same label win — these only answer when no route claimed the
+        path."""
         parts = parsed.path.strip("/").split("/")
         label = parts[0] if parts else ""
         if method != "GET" or label not in (
             "metrics", "traces", "healthz", "autoscaler", "disagg", "chaos",
-            "fleet", "health",
+            "fleet", "health", "profile",
         ):
             return False
         if label == "disagg":
             self._respond_json(200, _disagg_snapshot())
+            return True
+        if label == "profile":
+            q = {
+                k: v[-1]
+                for k, v in urllib.parse.parse_qs(parsed.query).items()
+            }
+            try:
+                n = int(q.get("n", 20))
+            except ValueError:
+                n = 20
+            self._respond_json(200, _profile_snapshot(last=n))
             return True
         if label == "health":
             q = {

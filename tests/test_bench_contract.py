@@ -63,6 +63,30 @@ def test_bench_emits_schema_json():
     assert kv["bytes"] > 0 and kv["bytes_per_slot"] > 0
     assert kv["max_slots_at_hbm"] > 0  # tiny model: plenty of HBM headroom
     assert payload["tokens_per_second"] == payload["value"]
+    # hot-path overhead attribution (docs/observability.md#hot-path-
+    # profiling): EVERY bench config's json carries the `overhead` section
+    # — bench children run MTPU_PROFILE=1 — with per-phase attribution
+    # summing to ~the tick duration (cover ≤ 1 structurally: sequential
+    # marks partition the tick) and a nonzero compile ledger. Structure
+    # only — wall-clock DIRECTION lives behind the on-chip benchdiff gate.
+    ov = payload.get("overhead")
+    assert ov, payload
+    assert {"ticks", "host_fraction", "tick_p50", "tick_p95", "detok_share",
+            "attribution_cover", "phases", "compile_total_s",
+            "compiles_n"} <= set(ov), ov
+    assert ov["ticks"] >= 1
+    assert 0.0 <= ov["host_fraction"] <= 1.0
+    assert 0 < ov["tick_p50"] <= ov["tick_p95"]
+    assert 0.0 <= ov["detok_share"] <= 1.0
+    assert 0.8 <= ov["attribution_cover"] <= 1.0 + 1e-6
+    # the full non-spec tick anatomy shows up under real traffic
+    for phase in ("admit", "prefill_dispatch", "decode_dispatch", "harvest",
+                  "detokenize", "accept"):
+        assert phase in ov["phases"], (phase, ov["phases"])
+        assert ov["phases"][phase]["p50"] <= ov["phases"][phase]["p95"]
+    # nonzero compile ledger: at least the block + one prefill bucket built
+    assert ov["compiles_n"] >= 2
+    assert ov["compile_total_s"] > 0
 
 
 @pytest.mark.slow

@@ -357,6 +357,82 @@ class TestExplainAndExport:
         assert s_ev["tid"] == tracks["pre-0"]
         assert f_ev["tid"] == tracks["dec-0"]
 
+    def test_perfetto_profile_ride_along_on_replica_tracks(self):
+        """Hot-path profiler ride-along (docs/observability.md): tick-phase
+        counter tracks and compile slices land on the OWNING replica's
+        track — including a replica only the profile knows — with the
+        deterministic ordering of the PR-9 layout preserved (same doc for
+        reversed span input and reordered profile dicts)."""
+        from modal_examples_tpu.observability.export import (
+            spans_to_chrome_trace,
+        )
+
+        spans = [
+            {"trace_id": "req-y", "span_id": "sp-1", "parent_id": None,
+             "name": "request", "start": 10.0, "end": 12.0, "status": "ok",
+             "attrs": {"replica": "dec-0"}},
+            {"trace_id": "req-y", "span_id": "sp-2", "parent_id": "sp-1",
+             "name": "prefill", "start": 10.1, "end": 10.4, "status": "ok",
+             "attrs": {"replica": "pre-0"}},
+        ]
+        profile = {
+            "dec-0": {
+                "ticks": [
+                    {"at": 11.0, "total": 0.004, "device": 0.001,
+                     "phases": {"decode_dispatch": 0.003,
+                                "harvest": 0.001}},
+                ],
+                "compiles": [
+                    {"at": 10.9, "seconds": 0.5, "program": "block",
+                     "shape_key": "s4k8", "event": "end", "cache": "miss"},
+                ],
+            },
+            # a replica with NO spans in this trace still gets its own
+            # deterministic track
+            "pre-1": {"ticks": [
+                {"at": 10.5, "total": 0.002, "device": 0.0,
+                 "phases": {"prefill_dispatch": 0.002}},
+            ], "compiles": []},
+        }
+        doc1 = spans_to_chrome_trace(spans, "req-y", profile=profile)
+        doc2 = spans_to_chrome_trace(
+            list(reversed(spans)),
+            "req-y",
+            profile=dict(reversed(list(profile.items()))),
+        )
+        assert doc1 == doc2, "profile ride-along must stay deterministic"
+        tracks = {
+            ev["args"]["name"]: ev["tid"]
+            for ev in doc1["traceEvents"]
+            if ev["ph"] == "M" and ev["name"] == "thread_name"
+        }
+        assert {"dec-0", "pre-0", "pre-1"} <= set(tracks)
+        counters = [e for e in doc1["traceEvents"] if e["ph"] == "C"]
+        assert len(counters) == 2
+        dec_counter = next(
+            e for e in counters if e["tid"] == tracks["dec-0"]
+        )
+        assert dec_counter["name"] == "tick_phase_ms"
+        assert dec_counter["args"]["decode_dispatch"] == pytest.approx(3.0)
+        pre1_counter = next(
+            e for e in counters if e["tid"] == tracks["pre-1"]
+        )
+        assert pre1_counter["args"]["prefill_dispatch"] == pytest.approx(2.0)
+        compile_slices = [
+            e for e in doc1["traceEvents"]
+            if e["ph"] == "X" and e["name"].startswith("compile:")
+        ]
+        assert len(compile_slices) == 1
+        sl = compile_slices[0]
+        assert sl["name"] == "compile:block"
+        assert sl["tid"] == tracks["dec-0"]
+        assert sl["dur"] == pytest.approx(0.5 * 1e6)
+        assert sl["args"]["shape_key"] == "s4k8"
+        # plain span export (no profile kwarg) is bit-for-bit unchanged
+        assert spans_to_chrome_trace(spans, "req-y") == spans_to_chrome_trace(
+            spans, "req-y", profile=None
+        )
+
     def test_call_traces_keep_the_legacy_two_track_layout(self):
         from modal_examples_tpu.observability.export import (
             spans_to_chrome_trace,

@@ -747,6 +747,199 @@ def test_watchdog_series_declared_and_emitted():
     )
 
 
+def test_profiler_series_declared_and_emitted():
+    """Closure for the hot-path profiler series (``mtpu_tick_phase_*``,
+    ``mtpu_host_overhead_*``, ``mtpu_compile*``), both directions (the
+    fleet/failover/watchdog-series guard pattern): every declared profiler
+    catalog constant must be referenced by a live emitter/reader, AND every
+    profiler recorder in observability/metrics.py must have a call site
+    outside metrics.py (a recorder nothing calls means `tpurun profile`,
+    the gateway ``/profile`` view, and the bench `overhead` section went
+    quietly blind)."""
+    from modal_examples_tpu.observability import catalog
+
+    consts = {
+        attr: val
+        for attr, val in vars(catalog).items()
+        if isinstance(val, str)
+        and val.startswith(
+            ("mtpu_tick_phase", "mtpu_host_overhead", "mtpu_compile")
+        )
+    }
+    assert len(consts) >= 4, consts
+    catalog_path = PKG_ROOT / "observability" / "catalog.py"
+    package_src = {
+        path: path.read_text()
+        for path in sorted(PKG_ROOT.rglob("*.py"))
+        if path != catalog_path
+    }
+    unused = [
+        attr for attr in consts
+        if not any(
+            re.search(rf"\b{attr}\b", src) for src in package_src.values()
+        )
+    ]
+    assert not unused, (
+        "profiler series declared in the catalog but never referenced by "
+        f"an emitter/reader in the package: {unused}"
+    )
+    metrics_path = PKG_ROOT / "observability" / "metrics.py"
+    recorders = (
+        "record_tick_phase", "set_host_overhead_ratio", "record_compile",
+    )
+    orphans = [
+        fn for fn in recorders
+        if not any(
+            re.search(rf"\b{fn}\(", src)
+            for path, src in package_src.items()
+            if path != metrics_path
+        )
+    ]
+    assert not orphans, (
+        f"profiler recorders with no call site outside metrics.py: {orphans}"
+    )
+
+
+#: the engine's profiler mark helpers — THE call-site convention for tick
+#: phase attribution (serving/engine.py `_tm`/`_tm_device`): a string-
+#: literal phase name from catalog.TICK_PHASES at positional index 1
+_TICK_MARK_FUNCS = {"_tm", "_tm_device"}
+
+
+def test_tick_phase_names_declared_and_wired():
+    """Both directions of the tick-phase taxonomy closure (the metric/
+    fault/span-catalog discipline applied to profiler phases): (a) every
+    ``_tm(tick, "...")`` / ``_tm_device(tick, "...")`` call in serving/
+    names a ``catalog.TICK_PHASES`` member with a literal (no stringly
+    drift — two spellings of one phase would silently split a series),
+    (b) every declared phase has at least one live mark site (a phase the
+    scheduler stopped marking fails here instead of rotting in dashboards
+    and the BENCH overhead schema), and (c) serving code never calls a raw
+    ``tick.mark(...)`` outside the two helpers — the PR-13 watermark-guard
+    lesson applied to timing."""
+    from modal_examples_tpu.observability.catalog import TICK_PHASES
+
+    sites: dict[str, list[str]] = {}
+    violations: list[str] = []
+    for path in sorted((PKG_ROOT / "serving").rglob("*.py")):
+        tree = ast.parse(path.read_text())
+        # line ranges of the _tm/_tm_device helper bodies (their internal
+        # tick.mark(phase) is the gate itself, not a bypass)
+        helper_ranges = [
+            (n.lineno, n.end_lineno)
+            for n in ast.walk(tree)
+            if isinstance(n, ast.FunctionDef) and n.name in _TICK_MARK_FUNCS
+        ]
+        for node in ast.walk(tree):
+            if not isinstance(node, ast.Call):
+                continue
+            fn = node.func
+            where = f"{path.relative_to(REPO_ROOT)}:{node.lineno}"
+            if isinstance(fn, ast.Name) and fn.id in _TICK_MARK_FUNCS:
+                phase = (
+                    _const_str(node.args[1]) if len(node.args) > 1 else None
+                )
+                if phase is None:
+                    violations.append(f"{where}: non-literal phase name")
+                else:
+                    sites.setdefault(phase, []).append(where)
+            elif isinstance(fn, ast.Attribute) and fn.attr == "mark":
+                inside_helper = any(
+                    lo <= node.lineno <= hi for lo, hi in helper_ranges
+                )
+                if not inside_helper:
+                    violations.append(
+                        f"{where}: raw .mark() outside the _tm gate"
+                    )
+    assert not violations, violations
+    undeclared = sorted(set(sites) - set(TICK_PHASES))
+    assert not undeclared, (
+        "tick phases marked but not declared in catalog.TICK_PHASES: "
+        f"{undeclared}"
+    )
+    unwired = sorted(set(TICK_PHASES) - set(sites))
+    assert not unwired, (
+        "tick phases declared in catalog.TICK_PHASES but never marked in "
+        f"serving/: {unwired}"
+    )
+    # the guard must actually be guarding the full taxonomy
+    assert len(sites) >= 9, sites
+
+
+#: (file, qualified function) pairs in serving/ that may call the raw
+#: ``time.monotonic()`` — each justified. PHASE timing goes through the
+#: profiler (`_tm` + catalog.TICK_PHASES, engine's injectable clock); the
+#: survivors are wall-clock token telemetry (TTFT/TPOT are CLIENT-seat
+#: numbers, not tick anatomy), gauge throttles, LRU stamps, and one-shot
+#: boot/migration timers. Adding ad-hoc timing to serving code means
+#: either routing it through the profiler or consciously editing this
+#: list — the PR-13 watermark-guard lesson applied to timing.
+_SERVING_MONOTONIC_ALLOWLIST = frozenset({
+    ("serving/disagg/roles.py", "DisaggCoordinator._submit_disagg"),
+    ("serving/disagg/roles.py", "Migration.__init__"),
+    ("serving/engine.py", "EngineStats.tokens_per_second"),
+    ("serving/engine.py", "LLMEngine._accept_token"),
+    ("serving/engine.py", "LLMEngine._dispatch_block"),
+    ("serving/engine.py", "LLMEngine._harvest_prefills"),
+    ("serving/engine.py", "LLMEngine._prefill_group"),
+    ("serving/engine.py", "LLMEngine._prefill_long"),
+    ("serving/engine.py", "LLMEngine._prefill_sync_locked"),
+    ("serving/engine.py", "LLMEngine._process_block"),
+    ("serving/engine.py", "LLMEngine._refresh_gauges"),
+    ("serving/engine.py", "LLMEngine.submit_resumed"),
+    ("serving/engine.py", "LLMEngine.warmup"),
+    ("serving/failover.py", "migrate_request"),
+    ("serving/failover.py", "resume_request"),
+    ("serving/failover.py", "stream_with_failover"),
+    ("serving/prefix_cache.py", "PrefixCache.acquire"),
+    ("serving/prefix_cache.py", "PrefixCache.insert"),
+    ("serving/prefix_cache.py", "_Node.__init__"),
+})
+
+
+def test_serving_monotonic_timing_is_allowlisted():
+    """No ad-hoc ``time.monotonic()`` phase timing in serving/ outside the
+    profiler API: every raw-clock call site must be on the frozen
+    allowlist above (exact match both ways, so a REMOVED site prunes its
+    entry too). New timing belongs in the profiler — `_tm` marks against
+    the engine's injectable clock — where it lands in a cataloged series
+    instead of a local variable someone printf-debugs once and deletes."""
+    found = set()
+    for path in sorted((PKG_ROOT / "serving").rglob("*.py")):
+        tree = ast.parse(path.read_text())
+        rel = str(path.relative_to(PKG_ROOT.parent / "modal_examples_tpu"))
+
+        def walk(node, stack):
+            for child in ast.iter_child_nodes(node):
+                nstack = stack
+                if isinstance(
+                    child,
+                    (ast.FunctionDef, ast.AsyncFunctionDef, ast.ClassDef),
+                ):
+                    nstack = stack + [child.name]
+                if (
+                    isinstance(child, ast.Call)
+                    and isinstance(child.func, ast.Attribute)
+                    and child.func.attr == "monotonic"
+                    and isinstance(child.func.value, ast.Name)
+                    and child.func.value.id == "time"
+                ):
+                    found.add((rel, ".".join(stack) or "<module>"))
+                walk(child, nstack)
+
+        walk(tree, [])
+    new_sites = found - _SERVING_MONOTONIC_ALLOWLIST
+    assert not new_sites, (
+        "new time.monotonic() call sites in serving/ — route phase timing "
+        "through the profiler (_tm + catalog.TICK_PHASES) or consciously "
+        f"extend the allowlist: {sorted(new_sites)}"
+    )
+    stale = _SERVING_MONOTONIC_ALLOWLIST - found
+    assert not stale, (
+        f"stale allowlist entries (site removed — prune them): {sorted(stale)}"
+    )
+
+
 #: the ONLY attributes production code may touch on a watermarks object
 #: (serving/health.py): the note_* writers the owning threads call, and
 #: nothing else — reads go through health.replica_snapshot/classify. A raw
