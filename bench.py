@@ -837,6 +837,14 @@ def _child(model: str) -> None:
     # program build. MTPU_PROFILE=0 in the environment still wins, so the
     # instrumentation cost itself stays A/B-able via `tpurun benchdiff`.
     os.environ.setdefault("MTPU_PROFILE", "1")
+    # ... and to the flight recorder (docs/observability.md#metrics-history):
+    # the engine starts the tsdb sampler once, so every bench run leaves a
+    # replayable metrics history under <state_dir>/tsdb/ and the `overhead`
+    # section gains the sampler's own cost — benchdiff's existing
+    # overhead.host_fraction / overhead.tick_p95 gates are the proof the
+    # recorder costs nothing measurable on the hot path. MTPU_TSDB=0 in the
+    # environment still wins (the sampler-off A/B arm).
+    os.environ.setdefault("MTPU_TSDB", "1")
     if spec.get("fleet"):
         # production admission shape for the open-loop sweep: bounded
         # queues turn sustained overload into honest 429s (the shed-rate
@@ -1065,6 +1073,26 @@ def _child(model: str) -> None:
     overhead = None
     if engine.profiler is not None:
         overhead = engine.profiler.overhead_summary()
+        # flight-recorder ride-along (docs/observability.md#metrics-history):
+        # the tsdb sampler's own telemetry lands NEXT TO the host-overhead
+        # numbers it must not move — samples taken, scrape-cost p95, and
+        # the series count, read from the same registry it scraped
+        from modal_examples_tpu.observability import catalog as _cat
+        from modal_examples_tpu.observability import timeseries as _tsm
+        from modal_examples_tpu.utils.prometheus import (
+            default_registry as _dreg,
+        )
+
+        if _tsm.global_sampler() is not None:
+            scrape_q = _dreg.histogram_quantiles(
+                _cat.TSDB_SCRAPE_SECONDS, quantiles=(0.5, 0.95), aggregate={}
+            )
+            overhead["tsdb"] = {
+                "samples": int(_dreg.value(_cat.TSDB_SAMPLES_TOTAL)),
+                "series": int(_dreg.value(_cat.TSDB_SERIES)),
+                "scrape_p50": scrape_q["p50"] if scrape_q else None,
+                "scrape_p95": scrape_q["p95"] if scrape_q else None,
+            }
 
     # stall-free admission interference A/B (mixed configs): measured on
     # the same warm engine BEFORE it stops — budget on vs off TPOT for an

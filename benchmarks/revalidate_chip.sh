@@ -10,35 +10,49 @@ cd "$(dirname "$0")/.."
 # every run leaves an attributable record (which stage ran/hung/failed)
 LOG="benchmarks/revalidate_$(date -u +%Y%m%d_%H%M).log"
 exec > >(tee "$LOG") 2>&1
+# flight recorder (docs/observability.md): every stage's engines run under
+# the tsdb sampler, so the minutes before a wedge survive on disk ...
+export MTPU_TSDB=1
+# ... and any stage failure ships an incident bundle (tsdb window, journal
+# tails, compile ledger, env fingerprint) instead of a shrug: `fail CODE
+# "STAGE"` captures, prints the bundle path in the stage summary, exits.
+fail() {
+  local code="$1"
+  BUNDLE=$(timeout 120 python -m modal_examples_tpu incident capture \
+    --trigger stage_failure \
+    --reason "revalidate_chip stage failed (exit code ${code})" 2>/dev/null | tail -1)
+  echo "revalidate_chip FAILED (exit code ${code}) — incident bundle: ${BUNDLE:-capture failed}"
+  exit "${code}"
+}
 # 0. health
-timeout 120 python -c "import jax, jax.numpy as jnp; print(jax.devices()); print(float(jnp.ones(3).sum()))" || exit 1
+timeout 120 python -c "import jax, jax.numpy as jnp; print(jax.devices()); print(float(jnp.ones(3).sum()))" || fail 1
 # 1. every kernel, tiny shapes, one killable subprocess each; registry
 #    order puts the round-4 wedge suspect (scatter_kv) LAST
-python -m modal_examples_tpu.utils.kernel_probe --all --timeout 600 || exit 2
+python -m modal_examples_tpu.utils.kernel_probe --all --timeout 600 || fail 2
 # 2. pure-XLA decode path on the token-major layout
-timeout 900 python benchmarks/decode_micro.py --quant int8 --slots 8 --impl xla || exit 3
+timeout 900 python benchmarks/decode_micro.py --quant int8 --slots 8 --impl xla || fail 3
 # 3. ragged attention kernel (v3) at real shapes
-timeout 900 python benchmarks/decode_micro.py --quant int8 --slots 8,36 --impl pallas || exit 4
+timeout 900 python benchmarks/decode_micro.py --quant int8 --slots 8,36 --impl pallas || fail 4
 # 4. the pallas scatter at real shapes
-MTPU_SCATTER_IMPL=pallas timeout 900 python benchmarks/decode_micro.py --quant int8 --slots 8 --impl pallas || exit 5
+MTPU_SCATTER_IMPL=pallas timeout 900 python benchmarks/decode_micro.py --quant int8 --slots 8 --impl pallas || fail 5
 # 5. int4 weights
-timeout 900 python benchmarks/decode_micro.py --quant int4 --slots 8,36 --impl pallas || exit 6
+timeout 900 python benchmarks/decode_micro.py --quant int4 --slots 8,36 --impl pallas || fail 6
 # 6. GQA on the grouped ragged kernel (llama-3.1 head geometry) + the
 #    flat-vs-grouped A/B at the 7B MHA shape
-timeout 1500 python benchmarks/decode_micro.py --model llama3.1-8b --quant int8 --slots 8,32 --impl pallas || exit 7
-timeout 900 python benchmarks/decode_micro.py --quant int8 --slots 32 --impl pallas --variant grouped || exit 8
+timeout 1500 python benchmarks/decode_micro.py --model llama3.1-8b --quant int8 --slots 8,32 --impl pallas || fail 7
+timeout 900 python benchmarks/decode_micro.py --quant int8 --slots 32 --impl pallas --variant grouped || fail 8
 # 7. int8 KV cache (new Mosaic paths: int8 page + scale-row DMAs, in-VMEM
 #    dequant — probed first via --probe) — the bf16-vs-int8 KV A/B at the
 #    headline shape, then the long-context config where KV reads dominate
-timeout 900 python benchmarks/decode_micro.py --probe --quant int8 --slots 32 --impl pallas --kv-dtype int8 || exit 9
-timeout 900 python benchmarks/decode_micro.py --quant int8 --slots 8,16 --max-len 1024 --impl pallas --kv-dtype bf16 || exit 10
-timeout 900 python benchmarks/decode_micro.py --quant int8 --slots 8,16 --max-len 1024 --impl pallas --kv-dtype int8 || exit 11
+timeout 900 python benchmarks/decode_micro.py --probe --quant int8 --slots 32 --impl pallas --kv-dtype int8 || fail 9
+timeout 900 python benchmarks/decode_micro.py --quant int8 --slots 8,16 --max-len 1024 --impl pallas --kv-dtype bf16 || fail 10
+timeout 900 python benchmarks/decode_micro.py --quant int8 --slots 8,16 --max-len 1024 --impl pallas --kv-dtype int8 || fail 11
 # 8. two-replica disagg smoke: the ctx-1024 int8-KV config unified, then the
 #    same shape disaggregated (prefill replica shipping int8 pages + scale
 #    rows to the decode replica, weights shared) — the A/B that prices page
 #    migration on real hardware (docs/disagg.md)
-timeout 1500 env BENCH_MODEL=llama2-7b-int8-kv8-ctx1024 BENCH_NO_SECONDARY=1 python bench.py || exit 12
-timeout 1500 env BENCH_MODEL=llama2-7b-disagg-2rep BENCH_NO_SECONDARY=1 python bench.py || exit 13
+timeout 1500 env BENCH_MODEL=llama2-7b-int8-kv8-ctx1024 BENCH_NO_SECONDARY=1 python bench.py || fail 12
+timeout 1500 env BENCH_MODEL=llama2-7b-disagg-2rep BENCH_NO_SECONDARY=1 python bench.py || fail 13
 # 9. tensor parallelism (TP=2) on the sharded pallas fast path (round 7,
 #    ops.sharded): pallas-vs-xla A/B at bf16 and int8 KV — per-shard Hkv=16
 #    compiles ride the probe harness (stage 1 covers
@@ -47,32 +61,32 @@ timeout 1500 env BENCH_MODEL=llama2-7b-disagg-2rep BENCH_NO_SECONDARY=1 python b
 #    Gated on device count: a 1-chip host SKIPS these stages (the later
 #    single-chip stages must still run) instead of aborting the script.
 if timeout 120 python -c "import jax; raise SystemExit(0 if len(jax.devices()) >= 2 else 1)"; then
-  timeout 900 python benchmarks/decode_micro.py --quant int8 --slots 8 --tp 2 --impl xla,pallas --kv-dtype bf16 || exit 14
-  timeout 900 python benchmarks/decode_micro.py --probe --quant int8 --slots 8 --tp 2 --impl xla,pallas --kv-dtype int8 || exit 15
-  timeout 1500 env BENCH_MODEL=llama2-7b-tp2-int8-ctx1024 BENCH_NO_SECONDARY=1 python bench.py || exit 16
+  timeout 900 python benchmarks/decode_micro.py --quant int8 --slots 8 --tp 2 --impl xla,pallas --kv-dtype bf16 || fail 14
+  timeout 900 python benchmarks/decode_micro.py --probe --quant int8 --slots 8 --tp 2 --impl xla,pallas --kv-dtype int8 || fail 15
+  timeout 1500 env BENCH_MODEL=llama2-7b-tp2-int8-ctx1024 BENCH_NO_SECONDARY=1 python bench.py || fail 16
 else
   echo "stage 9 SKIPPED: fewer than 2 devices (TP stages need a multi-chip host)"
 fi
 # 10. speculative decoding as a measured lever (ROADMAP open item #4): the
 #     ngram config (acceptance-driven win) vs its no-spec A/B partner
 #     llama2-7b-int8-kv8-s36 from the full bench below
-timeout 1500 env BENCH_MODEL=llama2-7b-int8-spec-ngram BENCH_NO_SECONDARY=1 python bench.py || exit 17
+timeout 1500 env BENCH_MODEL=llama2-7b-int8-spec-ngram BENCH_NO_SECONDARY=1 python bench.py || fail 17
 # 11. stall-free admission under mixed traffic (round 10, docs/scheduling.md):
 #     the ctx-1024 int8 shape with an interactive stream decoding while
 #     ~1k-token prompts chunk-prefill — budgeted (256 tok/tick = one chunk)
 #     vs unbudgeted TPOT in the json's `interference` section, plus the
 #     mtpu_decode_stall_seconds dispatch-gap quantiles
-timeout 1500 env BENCH_MODEL=llama2-7b-mixed-ctx1024 BENCH_NO_SECONDARY=1 python bench.py || exit 18
+timeout 1500 env BENCH_MODEL=llama2-7b-mixed-ctx1024 BENCH_NO_SECONDARY=1 python bench.py || fail 18
 # 12. full bench (kv_cache + disagg + spec + tp + interference sections),
 #     captured to a file for the regression gate below
-timeout 1500 python bench.py | tee benchmarks/BENCH_revalidate.json || exit 19
+timeout 1500 python bench.py | tee benchmarks/BENCH_revalidate.json || fail 19
 # 13. round-over-round regression gate (ROADMAP #1): diff the fresh json
 #     against the newest committed BENCH_r*.json — tok/s, ttft/tpot p95,
 #     shed rate, migration p95, interference p95 — and FAIL loudly past
 #     15% instead of relying on eyeballs
 PREV=$(ls BENCH_r*.json 2>/dev/null | sort | tail -1)
 if [ -n "$PREV" ]; then
-  python -m modal_examples_tpu benchdiff "$PREV" benchmarks/BENCH_revalidate.json --threshold 15 || exit 20
+  python -m modal_examples_tpu benchdiff "$PREV" benchmarks/BENCH_revalidate.json --threshold 15 || fail 20
 else
   echo "stage 13 SKIPPED: no BENCH_r*.json to diff against"
 fi
@@ -83,14 +97,14 @@ fi
 #     `fleet` section (goodput, p99 TTFT/TPOT vs offered load, shed rate,
 #     scale events, A/B at the knee) is what bench_diff's fleet.* metrics
 #     gate from the next round on
-timeout 1500 env BENCH_MODEL=llama2-7b-fleet-sweep BENCH_NO_SECONDARY=1 python bench.py || exit 21
+timeout 1500 env BENCH_MODEL=llama2-7b-fleet-sweep BENCH_NO_SECONDARY=1 python bench.py || fail 21
 # 15. in-flight failover at the int8 headline shape (docs/failover.md),
 #     behind the regression gate: streams killed mid-decode and
 #     checkpoint-resumed on a second replica (weights aliased) — the
 #     json's `failover` section (takeover p50/p95, tokens_replayed,
 #     resumed_identical: true) is what bench_diff's
 #     failover.takeover_latency.p95 gates from the next round on
-timeout 1500 env BENCH_MODEL=llama2-7b-failover BENCH_NO_SECONDARY=1 python bench.py || exit 22
+timeout 1500 env BENCH_MODEL=llama2-7b-failover BENCH_NO_SECONDARY=1 python bench.py || fail 22
 # 16. gray-failure recovery at the int8 headline shape (docs/health.md),
 #     behind the regression gate: a replica's scheduler SILENTLY frozen
 #     with streams mid-decode — the progress watchdog detects the wedge
@@ -99,7 +113,7 @@ timeout 1500 env BENCH_MODEL=llama2-7b-failover BENCH_NO_SECONDARY=1 python benc
 #     section (time_to_detect / time_to_mitigate p50/p95, goodput_dip,
 #     wedged: 0) is what bench_diff's recovery.time_to_mitigate.p95 gates
 #     from the next round on
-timeout 1500 env BENCH_MODEL=llama2-7b-recovery BENCH_NO_SECONDARY=1 python bench.py || exit 24
+timeout 1500 env BENCH_MODEL=llama2-7b-recovery BENCH_NO_SECONDARY=1 python bench.py || fail 24
 # 17. hot-path overhead attribution at the int8 headline shape (ROADMAP #3,
 #     docs/observability.md#hot-path-profiling), behind the regression
 #     gate: bench children profile by default (MTPU_PROFILE=1), so stage
@@ -110,7 +124,7 @@ timeout 1500 env BENCH_MODEL=llama2-7b-recovery BENCH_NO_SECONDARY=1 python benc
 #     the host-vs-device split is the BASELINE the multi-step decode PR
 #     must shrink. This stage validates + extracts that artifact instead
 #     of paying a duplicate ~25-minute headline run.
-timeout 120 python - <<'PYEOF' || exit 25
+timeout 120 python - <<'PYEOF' || fail 25
 import json
 from modal_examples_tpu.utils.bench_diff import load_bench
 ov = load_bench("benchmarks/BENCH_revalidate.json")["overhead"]

@@ -18,6 +18,10 @@ Usage:
     tpurun explain REQUEST_ID          # request lifecycle narrative (either id kind)
     tpurun benchdiff OLD NEW [--threshold PCT]  # BENCH json regression diff
     tpurun metrics [--json]            # merged pushed prometheus expositions
+    tpurun metrics --watch S [--rate]  # live tsdb deltas (flight recorder)
+    tpurun tsdb [--series NAME]        # on-disk metrics history (MTPU_TSDB=1)
+    tpurun alerts [--last N]           # alert rules + fire/clear history
+    tpurun incidents [list|show|capture]  # incident bundles
     tpurun scaler [N] [--function TAG] # autoscaler decision journal
     tpurun sched [--watch S]           # live class queues, shed rates, router
     tpurun top [--watch S]             # live serving summary + SLO burn rates
@@ -32,6 +36,7 @@ import argparse
 import inspect
 import json
 import os
+import re
 import sys
 
 from .._internal import config as _config
@@ -57,17 +62,24 @@ def _build_entrypoint_parser(fn, prog: str) -> argparse.ArgumentParser:
     return p
 
 
+_NEGATIVE_NUMBER = re.compile(r"^-\d+(\.\d+)?$")
+
+
 def _pop_flag(
     argv: list[str], flag: str, usage: str
 ) -> tuple[list[str], str | None]:
     """Extract ``<flag> VALUE`` from argv; returns (rest, value_or_None).
-    A flag present without its value exits with ``usage``."""
+    A flag present without its value — or followed by another flag-shaped
+    token (``--x``/``-o``; negative numbers pass) — exits with ``usage``."""
     if flag not in argv:
         return argv, None
     i = argv.index(flag)
     if i + 1 >= len(argv):
         raise SystemExit(usage)
-    return argv[:i] + argv[i + 2 :], argv[i + 1]
+    value = argv[i + 1]
+    if value.startswith("-") and not _NEGATIVE_NUMBER.match(value):
+        raise SystemExit(usage)
+    return argv[:i] + argv[i + 2 :], value
 
 
 def _pop_dir_flag(argv: list[str], usage: str) -> tuple[list[str], str | None]:
@@ -356,7 +368,7 @@ def cmd_trace(argv: list[str]) -> int:
     and a unique id PREFIX resolves too.
 
     trace ID           — the spans of one call/request
-    trace ID --perfetto [-o FILE] [--profile SNAP.json]
+    trace ID --perfetto [-o FILE] [--profile SNAP.json] [--tsdb]
                        — emit the trace as Chrome-trace/Perfetto JSON
                          (loads in chrome://tracing and ui.perfetto.dev;
                          request traces get one track per replica).
@@ -364,7 +376,9 @@ def cmd_trace(argv: list[str]) -> int:
                          snapshot (the gateway's ``/profile`` payload, or
                          a bare {replica: {ticks, compiles}} map) as
                          tick-phase counter tracks + compile slices on
-                         the owning replica tracks
+                         the owning replica tracks; ``--tsdb`` rides the
+                         on-disk flight-recorder window overlapping the
+                         spans along as counter tracks
     trace list [--limit N]
                        — most recently active traces, newest first
     ``--dir PATH`` overrides the trace root (default ``<state_dir>/traces``;
@@ -415,10 +429,24 @@ def cmd_trace(argv: list[str]) -> int:
 
         usage_p = (
             "usage: tpurun trace ID --perfetto [-o FILE] "
-            "[--profile SNAP.json]"
+            "[--profile SNAP.json] [--tsdb]"
         )
         argv, out_file = _pop_flag(argv, "-o", usage_p)
         argv, prof_file = _pop_flag(argv, "--profile", usage_p)
+        with_tsdb = "--tsdb" in argv
+        argv = [a for a in argv if a != "--tsdb"]
+        tsdb = None
+        if with_tsdb:
+            # the on-disk flight-recorder window overlapping the spans
+            # (±30 s) rides along as counter tracks next to the tick-phase
+            # tracks (docs/observability.md#metrics-history)
+            from ..observability import timeseries as _tsm
+
+            at = [s.get("start") or 0.0 for s in spans]
+            at += [s.get("end") or 0.0 for s in spans]
+            at = [t for t in at if t]
+            if at:
+                tsdb = _tsm.read_window(min(at) - 30.0, max(at) + 30.0)
         profile = None
         if prof_file:
             from pathlib import Path as _Path
@@ -432,7 +460,9 @@ def cmd_trace(argv: list[str]) -> int:
                 for name, node in nodes.items()
                 if isinstance(node, dict)
             }
-        doc = spans_to_chrome_trace(spans, trace_id, profile=profile)
+        doc = spans_to_chrome_trace(
+            spans, trace_id, profile=profile, tsdb=tsdb
+        )
         if out_file:
             from pathlib import Path as _Path
 
@@ -644,12 +674,23 @@ def cmd_metrics(argv: list[str]) -> int:
     """Print the merged prometheus exposition of every pushed job file
     (``<state_dir>/metrics/*.prom`` — the local pushgateway) — the same text
     a scraper sees on the gateway's ``/metrics``. ``--json`` prints
-    {job: path} of the sources instead."""
+    {job: path} of the sources instead.
+
+    ``--watch S [--rate]`` switches to the flight recorder: live DELTAS
+    from the on-disk tsdb (``<state_dir>/tsdb/``, written by any process
+    running ``MTPU_TSDB=1``) refreshed every S seconds — each series'
+    current value plus its change over the refresh window (``--rate``
+    renders per-second rates instead), which a one-shot exposition dump
+    structurally cannot show (docs/observability.md#metrics-history)."""
     from ..observability.export import _metrics_dir, read_pushed_metrics
 
-    argv, root = _pop_dir_flag(
-        argv, "usage: tpurun metrics [--json] [--dir PATH]"
-    )
+    usage = "usage: tpurun metrics [--json] [--watch S [--rate]] [--dir PATH]"
+    argv, root = _pop_dir_flag(argv, usage)
+    argv, watch_s = _pop_flag(argv, "--watch", usage)
+    as_rate = "--rate" in argv
+    argv = [a for a in argv if a != "--rate"]
+    if watch_s is not None:
+        return _metrics_watch(float(watch_s), root, as_rate)
     if "--json" in argv:
         d = _metrics_dir(root)
         print(json.dumps({p.stem: str(p) for p in sorted(d.glob("*.prom"))}))
@@ -662,6 +703,346 @@ def cmd_metrics(argv: list[str]) -> int:
     return 0
 
 
+def _series_key(entry) -> str:
+    name, labels = entry[0], entry[1]
+    if not labels:
+        return name
+    inner = ",".join(f"{k}={v}" for k, v in sorted(labels.items()))
+    return f"{name}{{{inner}}}"
+
+
+def _metrics_watch(watch: float, root, as_rate: bool) -> int:
+    """The `tpurun metrics --watch` loop: render the newest tsdb sample
+    and the per-series delta (or rate) against the previous refresh."""
+    import time as _time
+
+    from ..observability import timeseries as _ts
+
+    prev: dict | None = None
+    try:
+        while True:
+            cur = _ts.read_latest(root=root)
+            print("\033[2J\033[H", end="")
+            if cur is None:
+                print(
+                    f"no tsdb samples under {_ts.tsdb_dir(root)} "
+                    "(start an engine/bench with MTPU_TSDB=1)"
+                )
+                _time.sleep(watch)
+                continue
+            rows: list[tuple[str, float, float | None]] = []
+            prev_vals = (
+                {
+                    _series_key(e): (e[3], e[4])
+                    for e in prev.get("series", ())
+                }
+                if prev is not None
+                else {}
+            )
+            dt = cur["at"] - prev["at"] if prev is not None else None
+            for e in cur.get("series", ()):
+                key = _series_key(e)
+                value = e[3]
+                delta = None
+                if key in prev_vals and dt and dt > 0:
+                    d = value - prev_vals[key][0]
+                    delta = (d / dt) if as_rate else d
+                rows.append((key, value, delta))
+            moved = [r for r in rows if r[2]]
+            still = [r for r in rows if not r[2]]
+            when = _time.strftime(
+                "%H:%M:%S", _time.localtime(cur["at"])
+            )
+            unit = "/s" if as_rate else f"/{dt:.1f}s" if dt else ""
+            print(
+                f"tsdb {_ts.tsdb_dir(root)}  sample {when}  "
+                f"{len(rows)} series  (delta{unit or ': first sample'})"
+            )
+            print(f"{'SERIES':<56} {'VALUE':>12} {'DELTA':>12}")
+            shown = 0
+            for key, value, delta in (
+                sorted(moved, key=lambda r: -abs(r[2])) + sorted(still)
+            ):
+                if shown >= 40:
+                    hidden_moved = max(0, len(moved) - shown)
+                    note = (
+                        f"{hidden_moved} still changing"
+                        if hidden_moved
+                        else "unchanged"
+                    )
+                    print(f"… {len(rows) - shown} more ({note})")
+                    break
+                d = f"{delta:+.3f}" if delta is not None else "-"
+                print(f"{key:<56} {value:>12.3f} {d:>12}")
+                shown += 1
+            prev = cur
+            _time.sleep(watch)
+    except KeyboardInterrupt:
+        pass
+    return 0
+
+
+def cmd_tsdb(argv: list[str]) -> int:
+    """Metrics-history view of the on-disk tsdb segment ring
+    (``<state_dir>/tsdb/``, docs/observability.md#metrics-history).
+
+    tsdb                      — summary: segments, window covered, series
+    tsdb --series NAME [--label k=v] [--window S] [--sum]
+                              — (time, value) points for one series,
+                                newest last; ``--sum`` reads a histogram's
+                                cumulative seconds instead of its count
+    tsdb --rate ...           — the per-second increase over the window
+                                (counter-reset aware), instead of points
+    tsdb --perfetto FILE [--window S]
+                              — export the window's counter tracks as
+                                Chrome-trace JSON (ui.perfetto.dev)
+    tsdb --json               — machine-readable payload
+    ``--dir PATH`` overrides the state-dir root.
+    """
+    from pathlib import Path
+
+    from ..observability import timeseries as _ts
+
+    usage = (
+        "usage: tpurun tsdb [--series NAME [--label k=v] [--sum] [--rate]]"
+        " [--window S] [--perfetto FILE] [--json] [--dir PATH]"
+    )
+    argv, root = _pop_dir_flag(argv, usage)
+    argv, series = _pop_flag(argv, "--series", usage)
+    argv, label_s = _pop_flag(argv, "--label", usage)
+    argv, window_s = _pop_flag(argv, "--window", usage)
+    argv, perfetto = _pop_flag(argv, "--perfetto", usage)
+    as_json = "--json" in argv
+    as_rate = "--rate" in argv
+    as_sum = "--sum" in argv
+
+    labels = None
+    if label_s:
+        k, _, v = label_s.partition("=")
+        labels = {k: v}
+
+    records = _ts.read_window(root=root)
+    if window_s is not None and records:
+        lo = records[-1]["at"] - float(window_s)
+        records = [r for r in records if r["at"] >= lo]
+    if not records:
+        print(
+            f"no tsdb samples under {_ts.tsdb_dir(root)} "
+            "(start an engine/bench with MTPU_TSDB=1)"
+        )
+        return 0
+
+    if perfetto:
+        from ..observability.export import spans_to_chrome_trace
+
+        doc = spans_to_chrome_trace([], "tsdb-window", tsdb=records)
+        Path(perfetto).write_text(json.dumps(doc, indent=1))
+        print(
+            f"wrote {perfetto} ({len(records)} samples as counter tracks "
+            "— open in chrome://tracing or ui.perfetto.dev)"
+        )
+        return 0
+
+    span = records[-1]["at"] - records[0]["at"]
+    if series:
+        pts = _ts.series_points(
+            series, records, labels=labels,
+            field="sum" if as_sum else "value",
+        )
+        if as_rate:
+            r = _ts.rate(pts)
+            if as_json:
+                print(json.dumps({"series": series, "rate_per_s": r}))
+            elif r is None:
+                print(f"not enough points for a rate ({len(pts)} in window)")
+            else:
+                print(f"{series}: {r:.6f}/s over {span:.1f}s")
+            return 0
+        if as_json:
+            print(json.dumps({"series": series, "points": pts}))
+            return 0
+        if not pts:
+            print(f"no points for {series} in the window")
+            return 0
+        import time as _time
+
+        for at, v in pts:
+            when = _time.strftime("%H:%M:%S", _time.localtime(at))
+            print(f"{when}  {v:.6f}")
+        return 0
+
+    names = _ts.series_names(records)
+    if as_json:
+        print(json.dumps({
+            "dir": str(_ts.tsdb_dir(root)),
+            "samples": len(records),
+            "window_s": round(span, 3),
+            "first_at": records[0]["at"],
+            "last_at": records[-1]["at"],
+            "series": names,
+        }))
+        return 0
+    segs = sorted(_ts.tsdb_dir(root).glob("seg-*.jsonl"))
+    print(
+        f"{_ts.tsdb_dir(root)}: {len(segs)} segments, "
+        f"{len(records)} samples covering {span:.1f}s, "
+        f"{len(names)} series"
+    )
+    for name in names:
+        print(f"  {name}")
+    return 0
+
+
+def cmd_alerts(argv: list[str]) -> int:
+    """Alert rules + fire/clear history
+    (docs/observability.md#alert-rules): the declarative rule set, each
+    rule's condition evaluated one-shot over the on-disk tsdb window, and
+    the newest transitions from the ``alerts`` journal.
+
+    alerts [--last N]   — rule table + last N journal records (default 20)
+    alerts --json       — machine-readable payload
+    ``--dir PATH`` overrides the state-dir root.
+    """
+    from ..observability import alerts as _alerts
+    from ..observability import timeseries as _ts
+
+    usage = "usage: tpurun alerts [--last N] [--json] [--dir PATH]"
+    argv, root = _pop_dir_flag(argv, usage)
+    argv, last_s = _pop_flag(argv, "--last", usage)
+    last = int(last_s) if last_s is not None else 20
+    as_json = "--json" in argv
+
+    records = _ts.read_window(root=root)
+    rows = _alerts.evaluate_offline(records)
+    history = _alerts.read_alert_journal(last, root)
+    if as_json:
+        print(json.dumps({
+            "rules": rows,
+            "history": history,
+            "tsdb_samples": len(records),
+        }))
+        return 0
+    if not records:
+        print(
+            "no tsdb window to evaluate "
+            "(start an engine/bench with MTPU_TSDB=1); rule set:"
+        )
+    print(
+        f"{'RULE':<20} {'KIND':<10} {'SERIES':<32} {'THRESH':>7} "
+        f"{'NOW':<5} DESCRIPTION"
+    )
+    for r in rows:
+        now_s = "FIRE" if r["firing"] else "ok"
+        print(
+            f"{r['rule']:<20} {r['kind']:<10} {r['series']:<32} "
+            f"{r['threshold']:>7} {now_s:<5} {r['description']}"
+        )
+    if history:
+        import time as _time
+
+        print()
+        print(f"{'WHEN':<20} {'EVENT':<6} {'RULE':<20} VALUE")
+        for rec in history:
+            when = _time.strftime(
+                "%Y-%m-%d %H:%M:%S", _time.localtime(rec.get("at", 0))
+            )
+            print(
+                f"{when:<20} {rec.get('event', '?'):<6} "
+                f"{rec.get('rule', '?'):<20} {rec.get('value')}"
+            )
+    return 0
+
+
+def cmd_incidents(argv: list[str]) -> int:
+    """Incident bundles (docs/observability.md#incident-bundles).
+
+    incidents [list] [--json]    — bundle index, newest first
+    incidents show ID [--file NAME]
+                                 — one bundle's manifest (or one bundled
+                                   file raw); a unique id prefix resolves
+    incidents capture [--reason TEXT] [--trigger T]
+                                 — capture a bundle right now (trigger
+                                   ``manual``; ``revalidate_chip.sh``'s
+                                   stage wrapper passes ``stage_failure``)
+    ``--dir PATH`` overrides the state-dir root.
+    """
+    from ..observability import incident as _incident
+
+    usage = (
+        "usage: tpurun incidents [list [--json] | show ID [--file NAME] "
+        "| capture [--reason TEXT] [--trigger T]] [--dir PATH]"
+    )
+    argv, root = _pop_dir_flag(argv, usage)
+    as_json = "--json" in argv
+    argv = [a for a in argv if a != "--json"]
+    sub = argv[0] if argv else "list"
+
+    if sub == "capture":
+        argv, reason = _pop_flag(argv[1:], "--reason", usage)
+        argv, trigger = _pop_flag(argv, "--trigger", usage)
+        if trigger is not None and trigger not in _incident.TRIGGERS:
+            raise SystemExit(
+                f"unknown trigger {trigger!r}; one of {_incident.TRIGGERS}"
+            )
+        bundle = _incident.capture(
+            trigger or "manual",
+            reason=reason or "tpurun incidents capture",
+            root=root, force=True,
+        )
+        if bundle is None:
+            print("capture failed (read-only state dir?)")
+            return 1
+        print(bundle)
+        return 0
+
+    if sub == "show":
+        argv, file_name = _pop_flag(argv, "--file", usage)
+        if len(argv) < 2:
+            raise SystemExit(usage)
+        manifest = _incident.read_manifest(argv[1], root=root)
+        if manifest is None:
+            raise SystemExit(f"no incident bundle {argv[1]!r}")
+        if file_name:
+            body = _incident.read_bundle_file(
+                manifest["id"], file_name, root=root
+            )
+            if body is None:
+                raise SystemExit(
+                    f"no file {file_name!r} in {manifest['id']} "
+                    f"(files: {sorted(manifest.get('files', {}))})"
+                )
+            print(body, end="")
+            return 0
+        print(json.dumps(manifest, indent=1))
+        return 0
+
+    if sub != "list":
+        raise SystemExit(usage)
+    manifests = _incident.list_incidents(root=root)
+    if as_json:
+        print(json.dumps(manifests))
+        return 0
+    if not manifests:
+        print(f"no incident bundles under {_incident.incidents_dir(root)}")
+        return 0
+    import time as _time
+
+    print(
+        f"{'ID':<34} {'TRIGGER':<20} {'WHEN':<20} {'TSDB':>5} "
+        f"{'TRACES':>6}  REASON"
+    )
+    for m in manifests:
+        when = _time.strftime(
+            "%Y-%m-%d %H:%M:%S", _time.localtime(m.get("at", 0))
+        )
+        print(
+            f"{m.get('id', '?'):<34} {m.get('trigger', '?'):<20} "
+            f"{when:<20} {m.get('tsdb_records', 0):>5} "
+            f"{len(m.get('open_traces', ())):>6}  {m.get('reason', '')}"
+        )
+    return 0
+
+
 def cmd_scaler(argv: list[str]) -> int:
     """Print the autoscaler decision journal, newest last.
 
@@ -670,7 +1051,7 @@ def cmd_scaler(argv: list[str]) -> int:
     scaler --json         — raw JSONL records
     ``--dir PATH`` overrides the journal directory (default: state dir).
     """
-    from ..observability.journal import DecisionJournal
+    from ..observability.journal import named_journal
 
     argv, root = _pop_dir_flag(argv, "usage: tpurun scaler ... --dir PATH")
     as_json = "--json" in argv
@@ -679,11 +1060,8 @@ def cmd_scaler(argv: list[str]) -> int:
         argv, "--function", "usage: tpurun scaler [N] [--function TAG]"
     )
     n = int(argv[0]) if argv else 20
-    from pathlib import Path
 
-    journal = DecisionJournal(
-        path=Path(root) / "scaler.jsonl" if root else None
-    )
+    journal = named_journal("scaler", root)
     recs = journal.tail(n, function=function)
     if not recs:
         print(f"no autoscaler decisions in {journal.path}")
@@ -728,7 +1106,7 @@ def cmd_top(argv: list[str]) -> int:
     """
     from ..observability import catalog as C
     from ..observability.export import pushed_jobs
-    from ..observability.journal import DecisionJournal
+    from ..observability.journal import named_journal
     from ..observability.slo import evaluate
     from ..serving.health import decode_watchdog_series
     from ..utils.prometheus import merge_expositions, parse_exposition
@@ -741,9 +1119,7 @@ def cmd_top(argv: list[str]) -> int:
     from pathlib import Path
 
     metrics_root = Path(root) / "metrics" if root else None
-    journal = DecisionJournal(
-        path=Path(root) / "scaler.jsonl" if root else None
-    )
+    journal = named_journal("scaler", root)
 
     def render() -> None:
         jobs = pushed_jobs(metrics_root)
@@ -1049,7 +1425,7 @@ def cmd_chaos(argv: list[str]) -> int:
 
     from ..observability import catalog as C
     from ..observability.export import pushed_jobs
-    from ..observability.journal import DecisionJournal
+    from ..observability.journal import named_journal
     from ..utils.prometheus import merge_expositions, parse_exposition
 
     usage = "usage: tpurun chaos [--last N] [--dir PATH]"
@@ -1057,8 +1433,7 @@ def cmd_chaos(argv: list[str]) -> int:
     argv, last_s = _pop_flag(argv, "--last", usage)
     last = int(last_s) if last_s is not None else 10
 
-    state_root = Path(root) if root else _config.state_dir()
-    episodes = DecisionJournal(state_root / "chaos.jsonl").tail(last)
+    episodes = named_journal("chaos", root).tail(last)
 
     # per-point injected totals: pushed metrics when available (the chaos
     # runner pushes job "chaos"), else aggregated from the journal records
@@ -1125,7 +1500,7 @@ def cmd_health(argv: list[str]) -> int:
     from pathlib import Path
 
     from ..observability.export import pushed_jobs
-    from ..observability.journal import DecisionJournal
+    from ..observability.journal import named_journal
     from ..serving.health import decode_watchdog_series
     from ..utils.prometheus import merge_expositions, parse_exposition
 
@@ -1134,8 +1509,7 @@ def cmd_health(argv: list[str]) -> int:
     argv, last_s = _pop_flag(argv, "--last", usage)
     last = int(last_s) if last_s is not None else 20
 
-    state_root = Path(root) if root else _config.state_dir()
-    records = DecisionJournal(state_root / "watchdog.jsonl").tail(last)
+    records = named_journal("watchdog", root).tail(last)
 
     jobs = pushed_jobs(Path(root) / "metrics" if root else None)
     merged = parse_exposition(merge_expositions(jobs)) if jobs else None
@@ -1214,7 +1588,7 @@ def cmd_fleet(argv: list[str]) -> int:
 
     from ..observability import catalog as C
     from ..observability.export import pushed_jobs
-    from ..observability.journal import DecisionJournal
+    from ..observability.journal import named_journal
     from ..utils.prometheus import merge_expositions, parse_exposition
 
     usage = "usage: tpurun fleet [--last N] [--dir PATH]"
@@ -1222,8 +1596,7 @@ def cmd_fleet(argv: list[str]) -> int:
     argv, last_s = _pop_flag(argv, "--last", usage)
     last = int(last_s) if last_s is not None else 20
 
-    state_root = Path(root) if root else _config.state_dir()
-    journal = DecisionJournal(state_root / "fleet.jsonl")
+    journal = named_journal("fleet", root)
     records = journal.tail(last)
 
     jobs = pushed_jobs(Path(root) / "metrics" if root else None)
@@ -1313,6 +1686,10 @@ COMMANDS = {
     "benchdiff": cmd_benchdiff,
     "metrics": cmd_metrics,
     "profile": cmd_profile,
+    "tsdb": cmd_tsdb,
+    "alerts": cmd_alerts,
+    "incidents": cmd_incidents,
+    "incident": cmd_incidents,  # `tpurun incident capture` reads naturally
     "scaler": cmd_scaler,
     "sched": cmd_sched,
     "disagg": cmd_disagg,

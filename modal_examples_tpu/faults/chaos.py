@@ -490,12 +490,20 @@ def run_chaos(
     to ``<state_dir>/chaos.jsonl`` and the registry pushes as job
     ``chaos`` so ``tpurun chaos`` / ``/chaos`` render the run afterwards.
     """
-    from ..observability.journal import DecisionJournal
-    from .._internal import config as _config
+    from ..observability import incident as _incident
+    from ..observability.journal import named_journal
 
-    journal = DecisionJournal(
-        journal_path or (_config.state_dir() / "chaos.jsonl")
-    )
+    journal = named_journal("chaos", path=journal_path)
+
+    def _note_violation(rec: dict) -> None:
+        # a failed fleet invariant IS the incident: capture the bundle
+        # before the strict raise tears the run down (strict=False records
+        # it too — the bench child's report and the bundle stay paired)
+        _incident.capture(
+            "chaos_invariant",
+            reason=f"episode {rec['episode']}: {rec['invariants']}",
+        )
+
     fleet = _Fleet(seed)
     episodes: list[dict] = []
     try:
@@ -503,18 +511,24 @@ def run_chaos(
             rec = _run_episode(fleet, name, spec, seed, traffic_kw)
             journal.record(rec)
             episodes.append(rec)
-            if strict and rec["invariants"] != "ok":
-                raise ChaosInvariantError(
-                    f"episode {name!r}: {rec['invariants']}"
-                )
+            if rec["invariants"] != "ok":
+                _note_violation(rec)
+                if strict:
+                    raise ChaosInvariantError(
+                        f"episode {name!r}: {rec['invariants']}"
+                    )
     finally:
         fleet.close()
     if include_executor:
         rec = _run_executor_episode(seed)
         journal.record(rec)
         episodes.append(rec)
-        if strict and rec["invariants"] != "ok":
-            raise ChaosInvariantError(f"episode executor-retry: {rec['invariants']}")
+        if rec["invariants"] != "ok":
+            _note_violation(rec)
+            if strict:
+                raise ChaosInvariantError(
+                    f"episode executor-retry: {rec['invariants']}"
+                )
 
     injected: dict[str, int] = {}
     for rec in episodes:

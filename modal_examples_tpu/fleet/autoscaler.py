@@ -46,11 +46,10 @@ from __future__ import annotations
 import threading
 import time
 
-from .._internal import config as _config
 from ..observability import catalog as C
 from ..observability import metrics as _obs
 from ..observability import slo as _slo
-from ..observability.journal import DecisionJournal
+from ..observability.journal import named_journal
 from ..utils.log import get_logger
 from ..utils.prometheus import default_registry
 
@@ -204,9 +203,7 @@ class FleetAutoscaler:
         #: stuck 60 s drain window would spam ~120 journal records,
         #: fallback metrics, and failover spans per request
         self._drain_attempts: dict[str, tuple[float, int]] = {}
-        self.journal = DecisionJournal(
-            journal_path or (_config.state_dir() / "fleet.jsonl")
-        )
+        self.journal = named_journal("fleet", path=journal_path)
         self._registry = registry if registry is not None else default_registry
         self._slos = (
             slos

@@ -42,7 +42,18 @@ from .export import (
     read_pushed_metrics,
     spans_to_chrome_trace,
 )
-from .journal import DecisionJournal, default_journal
+from . import alerts
+from . import incident
+from . import timeseries
+from .alerts import DEFAULT_RULES, AlertEvaluator, AlertRule
+from .incident import capture as capture_incident, list_incidents
+from .journal import (
+    JOURNALS,
+    DecisionJournal,
+    default_journal,
+    named_journal,
+)
+from .timeseries import TsdbSampler, ensure_sampler, read_window
 from .metrics import (
     record_container_kill,
     record_engine_batch,
@@ -83,9 +94,22 @@ from .trace import (
 )
 
 __all__ = [
+    "DEFAULT_RULES",
     "DEFAULT_SLOS",
+    "AlertEvaluator",
+    "AlertRule",
     "DecisionJournal",
     "HotPathProfiler",
+    "JOURNALS",
+    "TsdbSampler",
+    "alerts",
+    "capture_incident",
+    "ensure_sampler",
+    "incident",
+    "list_incidents",
+    "named_journal",
+    "read_window",
+    "timeseries",
     "SLO",
     "Span",
     "TraceContext",

@@ -270,6 +270,31 @@ COMPILE_SECONDS = "mtpu_compile_seconds"
 #: <state_dir>/compiles.jsonl ledger) | hit (served already-compiled)
 COMPILES_TOTAL = "mtpu_compiles_total"
 
+# -- flight recorder (observability/timeseries.py / alerts.py / incident.py,
+#    docs/observability.md#metrics-history) ----------------------------------
+
+#: counter: sampler scrape cycles completed into the on-disk tsdb
+#: (emitted only while MTPU_TSDB is on — the zero-cost-when-off gate)
+TSDB_SAMPLES_TOTAL = "mtpu_tsdb_samples_total"
+#: histogram: wall seconds one scrape cycle spent snapshotting the registry
+#: and appending its record (the sampler's own overhead, so "does the
+#: flight recorder cost anything?" is itself answerable from the recorder)
+TSDB_SCRAPE_SECONDS = "mtpu_tsdb_scrape_seconds"
+#: counter: tsdb segment rotations (a new JSONL segment opened; old
+#: segments LRU-pruned past the ring bound)
+TSDB_ROTATIONS_TOTAL = "mtpu_tsdb_rotations_total"
+#: gauge: distinct (series, label set) pairs captured by the last scrape
+TSDB_SERIES = "mtpu_tsdb_series"
+#: gauge {rule}: 1 while the named alert rule is firing, 0 otherwise
+ALERTS_ACTIVE = "mtpu_alerts_active"
+#: counter {rule}: fire transitions of the named alert rule (clears don't
+#: count — the journal carries the full fire/clear history)
+ALERTS_FIRED_TOTAL = "mtpu_alerts_fired_total"
+#: counter {trigger}: incident bundles captured; trigger = watchdog_wedge |
+#: watchdog_quarantine | scheduler_crash | chaos_invariant | alert |
+#: stage_failure | manual
+INCIDENTS_CAPTURED_TOTAL = "mtpu_incidents_captured_total"
+
 # -- SLO engine (observability/slo.py) --------------------------------------
 
 #: gauge {slo}: observed/target burn rate per declared SLO (>1 = violating)
@@ -604,6 +629,36 @@ CATALOG: dict[str, dict] = {
         "type": "counter", "labels": ["program", "cache"],
         "help": "program-cache lookups at jit dispatch sites "
                 "(cache=miss fresh build, ledgered | hit served compiled)",
+    },
+    TSDB_SAMPLES_TOTAL: {
+        "type": "counter", "labels": [],
+        "help": "sampler scrape cycles appended to the on-disk tsdb",
+    },
+    TSDB_SCRAPE_SECONDS: {
+        "type": "histogram", "labels": [],
+        "help": "wall seconds per tsdb scrape cycle (sampler overhead)",
+    },
+    TSDB_ROTATIONS_TOTAL: {
+        "type": "counter", "labels": [],
+        "help": "tsdb segment rotations (ring-bounded JSONL segments)",
+    },
+    TSDB_SERIES: {
+        "type": "gauge", "labels": [],
+        "help": "distinct series captured by the last tsdb scrape",
+    },
+    ALERTS_ACTIVE: {
+        "type": "gauge", "labels": ["rule"],
+        "help": "1 while the named alert rule is firing, 0 otherwise",
+    },
+    ALERTS_FIRED_TOTAL: {
+        "type": "counter", "labels": ["rule"],
+        "help": "fire transitions of the named alert rule",
+    },
+    INCIDENTS_CAPTURED_TOTAL: {
+        "type": "counter", "labels": ["trigger"],
+        "help": "incident bundles captured (trigger=watchdog_wedge|"
+                "watchdog_quarantine|scheduler_crash|chaos_invariant|"
+                "alert|stage_failure|manual)",
     },
     SLO_BURN_RATE: {
         "type": "gauge", "labels": ["slo"],

@@ -119,8 +119,70 @@ def _gc(d: Path) -> None:
 _CONTAINER_SPAN_NAMES = ("execute", "serialize")
 
 
+#: the counter tracks a tsdb ride-along renders by default — the serving
+#: trajectory an incident reader wants next to the spans (a full window
+#: export would be hundreds of tracks; pass ``names=`` for more)
+TSDB_COUNTER_SERIES = (
+    "mtpu_tokens_per_second",
+    "mtpu_active_slots",
+    "mtpu_waiting_requests",
+    "mtpu_kv_page_occupancy",
+    "mtpu_host_overhead_ratio",
+    "mtpu_generated_tokens_total",
+    "mtpu_decode_stall_seconds",
+    "mtpu_alerts_active",
+)
+
+
+def tsdb_counter_events(
+    records: list[dict],
+    names: tuple[str, ...] | None = None,
+    *,
+    t0: float = 0.0,
+    pid: int = 1,
+    tid: int = 0,
+) -> list[dict]:
+    """Chrome-trace counter ("C") events from a tsdb window
+    (:func:`~.timeseries.read_window` records): one counter track per
+    series name, values folded across label sets per the ``tpurun top``
+    rule (gauges take the max — a 0..1 fraction must never sum across
+    replicas; counters and histogram counts sum). Timestamps are
+    microseconds relative to ``t0`` (wall-clock seconds)."""
+    names = TSDB_COUNTER_SERIES if names is None else tuple(names)
+    events: list[dict] = []
+    for rec in records:
+        at = rec.get("at")
+        if not isinstance(at, (int, float)):
+            continue
+        folded: dict[str, float] = {}
+        kinds: dict[str, str] = {}
+        for entry in rec.get("series", ()):
+            try:
+                name, _labels, kind, value, _hsum = entry
+            except (ValueError, TypeError):
+                continue
+            if name not in names:
+                continue
+            kinds[name] = kind
+            if name in folded and kind == "gauge":
+                folded[name] = max(folded[name], float(value))
+            else:
+                folded[name] = folded.get(name, 0.0) + float(value)
+        for name, value in sorted(folded.items()):
+            events.append({
+                "ph": "C", "pid": pid, "tid": tid, "cat": "mtpu",
+                "name": name,
+                "ts": round((at - t0) * 1e6, 3),
+                "args": {kinds.get(name, "value"): round(value, 6)},
+            })
+    return events
+
+
 def spans_to_chrome_trace(
-    spans: list[dict], trace_id: str = "", profile: dict | None = None
+    spans: list[dict],
+    trace_id: str = "",
+    profile: dict | None = None,
+    tsdb: list[dict] | None = None,
 ) -> dict:
     """Convert one trace's JSONL spans to Chrome-trace / Perfetto JSON.
 
@@ -150,6 +212,13 @@ def spans_to_chrome_trace(
     owning replica's track; replicas appearing only in the profile get
     their own track after the span replicas, in the same deterministic
     sorted order.
+
+    ``tsdb`` (flight-recorder ride-along, docs/observability.md
+    #metrics-history): a :func:`~.timeseries.read_window` record list —
+    the window's :data:`TSDB_COUNTER_SERIES` render as counter tracks on
+    one dedicated "tsdb" track next to the tick-phase tracks, so the
+    serving trajectory (tokens/s, occupancy, overhead ratio) lines up
+    under the spans of the request that died inside it.
     """
     import zlib as _zlib
 
@@ -172,7 +241,11 @@ def spans_to_chrome_trace(
         }
         for name, snap in (profile or {}).items()
     }
-    if not spans and not profile:
+    tsdb = [
+        r for r in (tsdb or ())
+        if isinstance(r, dict) and isinstance(r.get("at"), (int, float))
+    ]
+    if not spans and not profile and not tsdb:
         return {"traceEvents": [], "displayTimeUnit": "ms"}
     by_id = {s.get("span_id"): s for s in spans}
 
@@ -195,6 +268,7 @@ def spans_to_chrome_trace(
             c["at"] - (c.get("seconds") or 0.0)
             for c in snap.get("compiles", [])
         ]
+    starts += [r["at"] for r in tsdb]
     t0 = min(starts) if starts else 0.0
     replicas = sorted(
         {
@@ -331,6 +405,15 @@ def spans_to_chrome_trace(
                 "dur": round(seconds * 1e6, 3),
                 "args": {"shape_key": c.get("shape_key")},
             })
+    if tsdb:
+        # the flight-recorder trajectory on its own dedicated track,
+        # after every replica track (legacy layout uses tids 1/2)
+        tsdb_tid = (len(replicas) + 2) if replicas else 3
+        events.append(
+            {"ph": "M", "pid": 1, "tid": tsdb_tid, "name": "thread_name",
+             "args": {"name": "tsdb"}}
+        )
+        events += tsdb_counter_events(tsdb, t0=t0, pid=1, tid=tsdb_tid)
     return {
         "traceEvents": events,
         "displayTimeUnit": "ms",

@@ -1,17 +1,20 @@
-"""Autoscaler decision journal: every scale-up / scale-down / kill the
-executor's ``_autoscale`` loop takes, with its rationale.
+"""THE bounded-JSONL decision journal every subsystem writes through.
 
-The autoscaler used to be a black box: a pool would boot three containers or
-reap a warm one and the only evidence was the container count moving. Every
-decision now appends a structured record — trigger, queue depth, inflight
-count, idle ages, pool size before/after — to a bounded in-memory ring
-buffer AND a JSONL file under ``<state_dir>/scaler.jsonl``, so both a live
-gateway (``GET /autoscaler``) and a later CLI process (``tpurun scaler``)
-can answer "why did the pool scale?".
+Grown from the autoscaler's decision journal (PR 3), this is now the one
+append-only record sink for every "why did the system do that?" surface:
+autoscaler decisions, fleet scale events, watchdog ladder actions, chaos
+episodes, compile-ledger events, and alert fire/clear transitions each get
+a named JSONL file under ``<state_dir>`` — the :data:`JOURNALS` table owns
+the name -> filename mapping, and :func:`named_journal` is the ONLY way a
+writer or reader resolves one (``tests/test_static.py`` bans direct
+:class:`DecisionJournal` construction outside this module, so the file
+names can't drift call-site by call-site the way metric names used to).
 
 Records are plain dicts (one JSON object per line, same greppable shape as
-trace files). The file is bounded: when it grows past ``_MAX_FILE_RECORDS``
-lines it is atomically rewritten keeping the newest half.
+trace files), buffered in a bounded in-memory ring AND appended to the
+file, so both a live gateway route and a later CLI process can read them.
+The file is bounded: when it grows past ``_MAX_FILE_RECORDS`` lines it is
+atomically rewritten keeping the newest half.
 """
 
 from __future__ import annotations
@@ -29,6 +32,43 @@ from .._internal import config as _config
 RING_CAPACITY = 512
 #: JSONL file bound: rewrite keeping the newest half past this many lines
 _MAX_FILE_RECORDS = 4096
+
+#: every journal the framework writes: name -> file under ``<state_dir>``.
+#: One table, like the metric catalog — writers AND readers (CLI, gateway,
+#: incident bundles) resolve through :func:`named_journal`, never a
+#: hand-built path.
+JOURNALS: dict[str, str] = {
+    "scaler": "scaler.jsonl",      # executor autoscaler (core/executor.py)
+    "fleet": "fleet.jsonl",        # fleet autoscaler (fleet/autoscaler.py)
+    "watchdog": "watchdog.jsonl",  # gray-failure ladder (serving/health.py)
+    "chaos": "chaos.jsonl",        # chaos episodes (faults/chaos.py)
+    "compiles": "compiles.jsonl",  # compile ledger (observability/profiler.py)
+    "alerts": "alerts.jsonl",      # alert fire/clear (observability/alerts.py)
+}
+
+
+def journal_path(name: str, root=None) -> Path:
+    """The JSONL path for a named journal — ``<root or state_dir>/<file>``.
+    ``name`` must be a :data:`JOURNALS` key (typos fail loudly, not as a
+    silently empty journal)."""
+    return Path(root or _config.state_dir()) / JOURNALS[name]
+
+
+def named_journal(name: str, root=None, *, path=None) -> "DecisionJournal":
+    """Resolve a named journal. ``path`` (an explicit file, e.g. a test's
+    tmp file or a bench run's local ledger) wins over ``root`` (an
+    alternate state dir, the CLI's ``--dir``); with neither, the state
+    dir resolves LAZILY at first use, so a module-level journal built at
+    import time still honors a later ``MTPU_STATE_DIR``."""
+    if name not in JOURNALS:
+        raise KeyError(
+            f"unknown journal {name!r}; one of {sorted(JOURNALS)}"
+        )
+    if path is not None:
+        return DecisionJournal(path)
+    if root is not None:
+        return DecisionJournal(journal_path(name, root))
+    return DecisionJournal(name=name)
 
 
 def make_record(
@@ -66,10 +106,15 @@ def make_record(
 
 
 class DecisionJournal:
-    """Ring buffer + JSONL sink for autoscaler decisions."""
+    """Ring buffer + JSONL sink for one named journal's records.
 
-    def __init__(self, path: str | Path | None = None):
+    Build instances through :func:`named_journal` — direct construction
+    outside this module is banned by ``tests/test_static.py`` (the file
+    names live in :data:`JOURNALS`, nowhere else)."""
+
+    def __init__(self, path: str | Path | None = None, *, name: str = "scaler"):
         self._path = Path(path) if path else None
+        self._name = name
         self._resolved: Path | None = None
         self._ring: deque[dict] = deque(maxlen=RING_CAPACITY)
         self._lock = threading.Lock()
@@ -78,7 +123,7 @@ class DecisionJournal:
     @property
     def path(self) -> Path:
         if self._resolved is None:
-            p = self._path or (_config.state_dir() / "scaler.jsonl")
+            p = self._path or journal_path(self._name)
             p.parent.mkdir(parents=True, exist_ok=True)
             self._resolved = p
         return self._resolved
@@ -148,5 +193,6 @@ class DecisionJournal:
         return out
 
 
-#: process-wide default journal (state-dir backed)
-default_journal = DecisionJournal()
+#: process-wide default journal (state-dir backed): the executor
+#: autoscaler's sink, read back by ``tpurun scaler`` / ``/autoscaler``
+default_journal = named_journal("scaler")

@@ -534,6 +534,71 @@ def record_compile(
         )
 
 
+# -- flight recorder (observability/timeseries.py / alerts.py / incident.py) --
+
+
+def record_tsdb_sample(
+    series: int, seconds: float, *, registry: Registry | None = None
+) -> None:
+    """One sampler scrape cycle: the series count it captured and the wall
+    time it cost. Called only by the tsdb sampler — with MTPU_TSDB unset
+    nothing reaches here (the zero-cost gate)."""
+    reg = _reg(registry)
+    reg.counter_inc(
+        C.TSDB_SAMPLES_TOTAL, 1.0,
+        help=C.CATALOG[C.TSDB_SAMPLES_TOTAL]["help"],
+    )
+    reg.gauge_set(
+        C.TSDB_SERIES, float(series),
+        help=C.CATALOG[C.TSDB_SERIES]["help"],
+    )
+    reg.histogram_observe(
+        C.TSDB_SCRAPE_SECONDS, seconds,
+        # µs-scale buckets (the tick-phase rationale): a scrape costs
+        # well under a millisecond — default buckets would collapse every
+        # observation into their first bound
+        buckets=C.TICK_PHASE_BUCKETS,
+        help=C.CATALOG[C.TSDB_SCRAPE_SECONDS]["help"],
+    )
+
+
+def record_tsdb_rotation(*, registry: Registry | None = None) -> None:
+    _reg(registry).counter_inc(
+        C.TSDB_ROTATIONS_TOTAL, 1.0,
+        help=C.CATALOG[C.TSDB_ROTATIONS_TOTAL]["help"],
+    )
+
+
+def set_alert_active(
+    rule: str, firing: bool, *, registry: Registry | None = None
+) -> None:
+    _reg(registry).gauge_set(
+        C.ALERTS_ACTIVE, 1.0 if firing else 0.0,
+        labels={"rule": rule},
+        help=C.CATALOG[C.ALERTS_ACTIVE]["help"],
+    )
+
+
+def record_alert_fired(
+    rule: str, *, registry: Registry | None = None
+) -> None:
+    _reg(registry).counter_inc(
+        C.ALERTS_FIRED_TOTAL, 1.0,
+        labels={"rule": rule},
+        help=C.CATALOG[C.ALERTS_FIRED_TOTAL]["help"],
+    )
+
+
+def record_incident_captured(
+    trigger: str, *, registry: Registry | None = None
+) -> None:
+    _reg(registry).counter_inc(
+        C.INCIDENTS_CAPTURED_TOTAL, 1.0,
+        labels={"trigger": trigger},
+        help=C.CATALOG[C.INCIDENTS_CAPTURED_TOTAL]["help"],
+    )
+
+
 # -- gray-failure watchdog (serving/health.py) --------------------------------
 
 

@@ -57,11 +57,10 @@ import time
 import weakref
 from collections import deque
 
-from .._internal import config as _config
 from ..utils.stats import percentile_nearest_rank as _pct
 from . import catalog as C
 from . import metrics as _obs
-from .journal import DecisionJournal
+from .journal import JOURNALS, DecisionJournal, named_journal
 
 #: the one env switch (resolved once in ``LLMEngine.__init__``, the
 #: MTPU_KV_DTYPE rule): unset/0 = off — bench configs opt in explicitly
@@ -76,9 +75,10 @@ COMPILE_LOG_KEEP = 256
 #: tick would be pure lock traffic for a value that moves slowly)
 _GAUGE_EVERY = 32
 
-#: the ledger file name under ``<state_dir>`` (the journal pattern —
-#: ``watchdog.jsonl`` / ``fleet.jsonl`` / ``chaos.jsonl``'s sibling)
-LEDGER_NAME = "compiles.jsonl"
+#: the ledger file name under ``<state_dir>`` — owned by the
+#: ``JOURNALS`` table (journal.py) and resolved through
+#: ``named_journal("compiles")``; re-exported here for readers
+LEDGER_NAME = JOURNALS["compiles"]
 
 
 def profiling_enabled(explicit=None) -> bool:
@@ -275,8 +275,8 @@ class HotPathProfiler:
 
     def _ledger_record(self, rec: dict) -> None:
         if self._ledger is None:
-            self._ledger = DecisionJournal(
-                self._ledger_path or (_config.state_dir() / LEDGER_NAME)
+            self._ledger = named_journal(
+                "compiles", path=self._ledger_path
             )
         self._ledger.record(rec)
 
@@ -368,9 +368,7 @@ def active_profilers() -> list[HotPathProfiler]:
 def read_ledger(path=None, n: int = 200) -> list[dict]:
     """Newest-last slice of the compile ledger (jax-free — `tpurun
     profile` and the gateway read it without touching an engine)."""
-    return DecisionJournal(
-        path or (_config.state_dir() / LEDGER_NAME)
-    ).tail(n)
+    return named_journal("compiles", path=path).tail(n)
 
 
 def unfinished_builds(records: list[dict]) -> list[dict]:

@@ -40,9 +40,11 @@ import jax.numpy as jnp
 import numpy as np
 
 from ..models import llama
+from ..observability import incident as _incident
 from ..observability import metrics as _obs
 from ..observability import profiler as _profiler
 from ..observability import reqtrace as _rt
+from ..observability import timeseries as _ts
 from ..scheduling.admission import AdmissionController, ShedError
 from ..scheduling.policy import (
     DEFAULT_CLASS,
@@ -641,6 +643,14 @@ class LLMEngine:
             else None
         )
         self._tick = None  # the in-flight TickProfile (None = off/idle)
+        # flight recorder (docs/observability.md#metrics-history): MTPU_TSDB=1
+        # starts the process-wide tsdb sampler ONCE (idempotent; its whole
+        # cost is one locked registry pass per interval off the hot path —
+        # the same zero-cost-when-off rule as the profiler above), and the
+        # incident collector learns about this engine so a capture can
+        # snapshot its watermarks / impl plan / open requests
+        _ts.ensure_sampler()
+        _incident.register_engine(self)
         self.policy: SchedulerPolicy = policy or FairSharePolicy(
             clock=self._clock
         )
@@ -2021,6 +2031,13 @@ class LLMEngine:
                         # then release callers
                         self._stopped_on_error = True
                         self._running = False
+                        # capture BEFORE the release sweep frees the slots:
+                        # the bundle's open-request traces are the victims
+                        _incident.capture(
+                            "scheduler_crash",
+                            reason=tb.strip().splitlines()[-1] if tb else "",
+                            replica=self.trace_name,
+                        )
                         self._release_all(_Finish("error"))
                         return
                     worked = False
@@ -2036,6 +2053,11 @@ class LLMEngine:
                 # until revive() (docs/faults.md: no request may wedge).
                 self._running = False
                 self._stopped_on_error = True
+                _incident.capture(
+                    "scheduler_crash",
+                    reason="scheduler thread died without stop()",
+                    replica=self.trace_name,
+                )
                 self._release_all(_Finish("error"))
 
     def _drain_ctrl(self) -> None:

@@ -67,10 +67,10 @@ import dataclasses
 import threading
 import time
 
-from .._internal import config as _config
+from ..observability import incident as _incident
 from ..observability import metrics as _obs
 from ..observability import reqtrace as _rt
-from ..observability.journal import DecisionJournal
+from ..observability.journal import named_journal
 from ..utils.log import get_logger
 
 _log = get_logger("health")
@@ -429,9 +429,7 @@ class FleetWatchdog:
         self.policy = policy or WatchdogPolicy()
         self.poll_s = float(poll_s)
         self._clock = clock or time.monotonic
-        self.journal = DecisionJournal(
-            journal_path or (_config.state_dir() / "watchdog.jsonl")
-        )
+        self.journal = named_journal("watchdog", path=journal_path)
         self._transfers = (
             transfer_watermarks if transfer_watermarks is not None else transfers
         )
@@ -660,6 +658,20 @@ class FleetWatchdog:
             "%s — live streams take the reactive failover",
             replica.name, progress_age(snap) or -1.0,
             snap.get("tick_seq"), action,
+        )
+        # incident bundle BEFORE the error-stop sweeps the victim's slots:
+        # the bundle's open-request traces (and the watchdog events just
+        # marked on them) are the evidence of what was mid-flight when the
+        # chip wedged (docs/observability.md#incident-bundles)
+        _incident.capture(
+            "watchdog_quarantine" if quarantine else "watchdog_wedge",
+            reason=(
+                f"progress age {progress_age(snap) or -1.0:.2f}s, "
+                f"tick_seq {snap.get('tick_seq')}, "
+                f"wedges_in_window {mon.wedges_in_window(now)}"
+            ),
+            replica=replica.name,
+            registry=self._registry,
         )
         try:
             # error-stop: every live stream gets a terminal error (the

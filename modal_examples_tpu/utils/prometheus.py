@@ -275,6 +275,25 @@ class Registry:
                 return float(self._histograms[key]["count"])
             return self._counters.get(key, 0.0)
 
+    def all_series(self) -> list[tuple]:
+        """Every series in the registry as ``(name, labels, kind, value,
+        hsum)`` tuples: counters/gauges carry their value (``hsum`` 0.0),
+        histograms their cumulative observation count with ``hsum`` = the
+        cumulative sum — one locked pass, no exposition round trip. The
+        time-series sampler's scrape surface (observability/timeseries.py)."""
+        out: list[tuple] = []
+        with self._lock:
+            for (name, lbls), v in self._counters.items():
+                out.append((name, dict(lbls), "counter", float(v), 0.0))
+            for (name, lbls), v in self._gauges.items():
+                out.append((name, dict(lbls), "gauge", float(v), 0.0))
+            for (name, lbls), h in self._histograms.items():
+                out.append(
+                    (name, dict(lbls), "histogram",
+                     float(h["count"]), float(h["sum"]))
+                )
+        return out
+
     def snapshot(self) -> dict:
         with self._lock:
             return {

@@ -93,9 +93,8 @@ def _fleet_snapshot(last: int = 20) -> dict:
     (warm snapshot-restore vs cold init), plus the newest records from the
     fleet decision journal — the ``/fleet`` route's payload (``tpurun
     fleet`` renders the same data from pushed metrics; docs/fleet.md)."""
-    from .._internal import config as _config
     from ..observability import catalog as C
-    from ..observability.journal import DecisionJournal
+    from ..observability.journal import named_journal
     from ..utils.prometheus import default_registry as reg
 
     replicas = {
@@ -112,7 +111,7 @@ def _fleet_snapshot(last: int = 20) -> dict:
         )
         for boot in ("warm", "cold")
     }
-    journal = DecisionJournal(_config.state_dir() / "fleet.jsonl").tail(last)
+    journal = named_journal("fleet").tail(last)
     return {
         "replicas": replicas,
         "decisions": decisions,
@@ -130,15 +129,12 @@ def _health_snapshot(last: int = 20) -> dict:
     (``tpurun health`` renders the same data from pushed metrics;
     docs/health.md). Distinct from ``/healthz``: that is the SLO pass/fail
     gate; this is the per-replica progress detail view."""
-    from .._internal import config as _config
-    from ..observability.journal import DecisionJournal
+    from ..observability.journal import named_journal
     from ..serving.health import decode_watchdog_series
     from ..utils.prometheus import default_registry as reg
 
     wd = decode_watchdog_series(reg)
-    journal = DecisionJournal(
-        _config.state_dir() / "watchdog.jsonl"
-    ).tail(last)
+    journal = named_journal("watchdog").tail(last)
     return {
         "replicas": {
             name: {"state": state, "progress_age_s": wd["ages"].get(name)}
@@ -155,16 +151,15 @@ def _chaos_snapshot(last: int = 10) -> dict:
     (live registry) plus the newest episode records from the chaos journal
     — the ``/chaos`` route's payload (``tpurun chaos`` renders the same
     data from pushed metrics + the journal; docs/faults.md)."""
-    from .._internal import config as _config
     from ..observability import catalog as C
-    from ..observability.journal import DecisionJournal
+    from ..observability.journal import named_journal
     from ..utils.prometheus import default_registry as reg
 
     injected = {
         labels.get("point", "?"): v
         for labels, v in reg.series(C.FAULTS_INJECTED_TOTAL)
     }
-    episodes = DecisionJournal(_config.state_dir() / "chaos.jsonl").tail(last)
+    episodes = named_journal("chaos").tail(last)
     return {
         "injected": injected,
         "injected_total": sum(injected.values()),
@@ -172,6 +167,43 @@ def _chaos_snapshot(last: int = 10) -> dict:
         "episodes": episodes,
         "wedged": sum(int(e.get("wedged", 0)) for e in episodes),
     }
+
+
+def _alerts_snapshot(last: int = 20) -> dict:
+    """Alert-rule snapshot: per-rule firing state — from the live
+    evaluator when this process runs the tsdb sampler, else a one-shot
+    evaluation over the on-disk window — plus the newest fire/clear
+    transitions from the ``alerts`` journal; the ``/alerts`` route's
+    payload (``tpurun alerts`` renders the same data;
+    docs/observability.md#alert-rules)."""
+    from ..observability import alerts as _alerts
+    from ..observability import timeseries as _ts
+
+    sampler = _ts.global_sampler()
+    ev = sampler.evaluator if sampler is not None else None
+    # a sampler built with evaluate_alerts=False has no evaluator: fall
+    # through to the one-shot offline evaluation below
+    if ev is not None:
+        rules = ev.snapshot()
+        active = ev.active()
+    else:
+        rules = _alerts.evaluate_offline(_ts.read_window())
+        active = [r["rule"] for r in rules if r["firing"]]
+    return {
+        "rules": rules,
+        "active": active,
+        "live_evaluator": ev is not None,
+        "history": _alerts.read_alert_journal(last),
+    }
+
+
+def _incidents_snapshot() -> dict:
+    """Bundle index — the ``/incidents`` route's payload (``tpurun
+    incidents`` renders the same data;
+    docs/observability.md#incident-bundles)."""
+    from ..observability import incident as _incident
+
+    return {"incidents": _incident.list_incidents()}
 
 
 def _profile_snapshot(last: int = 20) -> dict:
@@ -344,18 +376,70 @@ class _Handler(BaseHTTPRequestHandler):
         decisions, boot latencies + journal — docs/fleet.md), and
         ``/health`` (gray-failure watchdog: per-replica progress
         classification, watermark ages, ladder decisions —
-        docs/health.md), and ``/profile`` (hot-path profiler: per-replica
+        docs/health.md), ``/profile`` (hot-path profiler: per-replica
         tick-phase summaries, host fraction, compile ledger —
-        docs/observability.md#hot-path-profiling). User endpoints with the
-        same label win — these only answer when no route claimed the
-        path."""
+        docs/observability.md#hot-path-profiling), ``/alerts``
+        (alert-rule firing state + fire/clear history —
+        docs/observability.md#alert-rules), and
+        ``/incidents[/<id>[?file=NAME]]`` (incident-bundle index /
+        manifest / bundled file — docs/observability.md#incident-bundles).
+        User endpoints with the same label win — these only answer when no
+        route claimed the path."""
         parts = parsed.path.strip("/").split("/")
         label = parts[0] if parts else ""
         if method != "GET" or label not in (
             "metrics", "traces", "healthz", "autoscaler", "disagg", "chaos",
-            "fleet", "health", "profile",
+            "fleet", "health", "profile", "alerts", "incidents",
         ):
             return False
+        if label == "alerts":
+            q = {
+                k: v[-1]
+                for k, v in urllib.parse.parse_qs(parsed.query).items()
+            }
+            try:
+                n = int(q.get("n", 20))
+            except ValueError:
+                n = 20
+            self._respond_json(200, _alerts_snapshot(last=n))
+            return True
+        if label == "incidents":
+            from ..observability import incident as _incident
+
+            if len(parts) > 1 and parts[1]:
+                # by-id fetch: the manifest, or one bundled file via
+                # ?file=NAME (manifest-whitelisted — read_bundle_file
+                # refuses names capture() never wrote)
+                token = urllib.parse.unquote(parts[1])
+                manifest = _incident.read_manifest(token)
+                if manifest is None:
+                    self._respond_json(
+                        404, {"error": f"no incident {token!r}"}
+                    )
+                    return True
+                q = {
+                    k: v[-1]
+                    for k, v in urllib.parse.parse_qs(parsed.query).items()
+                }
+                name = q.get("file")
+                if name:
+                    body = _incident.read_bundle_file(manifest["id"], name)
+                    if body is None:
+                        self._respond_json(
+                            404,
+                            {"error": f"no file {name!r} in {manifest['id']}"},
+                        )
+                    else:
+                        self._respond_json(
+                            200,
+                            {"id": manifest["id"], "file": name,
+                             "content": body},
+                        )
+                else:
+                    self._respond_json(200, manifest)
+                return True
+            self._respond_json(200, _incidents_snapshot())
+            return True
         if label == "disagg":
             self._respond_json(200, _disagg_snapshot())
             return True

@@ -87,6 +87,17 @@ def test_bench_emits_schema_json():
     # nonzero compile ledger: at least the block + one prefill bucket built
     assert ov["compiles_n"] >= 2
     assert ov["compile_total_s"] > 0
+    # flight recorder ride-along (docs/observability.md#metrics-history):
+    # bench children default MTPU_TSDB=1, so the overhead section carries
+    # the sampler's own cost next to the host-overhead numbers the sampler
+    # must not move (benchdiff's existing overhead.* gates are the proof)
+    ts = ov.get("tsdb")
+    assert ts, ov
+    assert {"samples", "series", "scrape_p50", "scrape_p95"} <= set(ts), ts
+    assert ts["samples"] >= 1
+    assert ts["series"] >= 1
+    if ts["scrape_p95"] is not None:
+        assert 0.0 <= ts["scrape_p50"] <= ts["scrape_p95"]
 
 
 @pytest.mark.slow

@@ -1,0 +1,884 @@
+"""Flight recorder (ISSUE 15, docs/observability.md): the tsdb sampler's
+segment ring + windowed queries, the declarative alert-rule state machine,
+incident-bundle capture across every trigger, the CLI/gateway surfaces —
+and the acceptance E2E: a forced silent-freeze wedge ships a bundle whose
+MANIFEST references a non-empty tsdb window, the watchdog journal tail,
+and the victim's open request traces.
+"""
+
+import json
+import os
+import time
+
+import pytest
+
+from modal_examples_tpu.observability import alerts as al
+from modal_examples_tpu.observability import catalog as C
+from modal_examples_tpu.observability import incident as inc
+from modal_examples_tpu.observability import timeseries as ts
+from modal_examples_tpu.observability.journal import named_journal
+from modal_examples_tpu.utils.prometheus import Registry
+
+
+def rec(at: float, **series) -> dict:
+    """One hand-built scrape record: ``name=value`` for gauges,
+    ``name=(kind, value, hsum)`` for anything else."""
+    out = []
+    for name, v in series.items():
+        if isinstance(v, tuple):
+            kind, value, hsum = v
+        else:
+            kind, value, hsum = "gauge", v, 0.0
+        out.append([name, {}, kind, float(value), float(hsum)])
+    return {"at": at, "series": out}
+
+
+@pytest.fixture
+def no_cooldown(monkeypatch):
+    """Incident capture debounce is process-global state: isolate it."""
+    monkeypatch.setattr(inc, "_last_capture", {})
+
+
+# ---------------------------------------------------------------------------
+# sampler / segments / windowed queries
+# ---------------------------------------------------------------------------
+
+
+class TestSampler:
+    def test_sample_once_writes_ring_disk_and_telemetry(self, tmp_path):
+        reg = Registry()
+        reg.gauge_set("mtpu_active_slots", 3.0)
+        reg.counter_inc("mtpu_generated_tokens_total", 7.0)
+        reg.histogram_observe("mtpu_ttft_seconds", 0.5)
+        s = ts.TsdbSampler(registry=reg, root=tmp_path, evaluate_alerts=False)
+        for _ in range(3):
+            s.sample_once()
+        assert len(s.ring) == 3
+        records = ts.read_window(root=tmp_path)
+        assert len(records) == 3
+        names = ts.series_names(records)
+        assert "mtpu_active_slots" in names
+        assert "mtpu_generated_tokens_total" in names
+        # histograms carry (count, sum): rate() can recover seconds/s
+        pts = ts.series_points(
+            "mtpu_ttft_seconds", records, field="sum"
+        )
+        assert pts and pts[-1][1] == pytest.approx(0.5)
+        # the sampler's own cost is recorded into the registry it scrapes
+        assert reg.value(C.TSDB_SAMPLES_TOTAL) == 3.0
+        assert reg.value(C.TSDB_SERIES) >= 3.0
+
+    def test_segment_rotation_and_lru_prune(self, tmp_path):
+        reg = Registry()
+        reg.gauge_set("mtpu_active_slots", 1.0)
+        s = ts.TsdbSampler(
+            registry=reg, root=tmp_path, evaluate_alerts=False,
+            segment_records=2, max_segments=2,
+        )
+        for _ in range(7):
+            s.sample_once()
+        segs = sorted((tmp_path / "tsdb").glob("seg-*.jsonl"))
+        assert len(segs) <= 2  # LRU-pruned past the ring bound
+        assert reg.value(C.TSDB_ROTATIONS_TOTAL) >= 2.0
+        index = json.loads((tmp_path / "tsdb" / "index.json").read_text())
+        assert index["samples"] == 7
+        assert index["segments"] == [p.name for p in segs]
+        # the newest records survive the prune
+        records = ts.read_window(root=tmp_path)
+        assert 1 <= len(records) <= 4
+
+    def test_prune_spares_concurrent_writers_active_segment(self, tmp_path):
+        reg = Registry()
+        reg.gauge_set("mtpu_active_slots", 1.0)
+        d = tmp_path / "tsdb"
+        d.mkdir()
+        # a FOREIGN segment being actively written by another MTPU_TSDB=1
+        # process (fresh mtime) vs one from a long-dead run (old mtime)
+        fresh = d / "seg-0000000000001-0001.jsonl"
+        fresh.write_text(json.dumps(rec(1.0, x=1)) + "\n")
+        stale = d / "seg-0000000000000-0001.jsonl"
+        stale.write_text(json.dumps(rec(0.5, x=1)) + "\n")
+        old = time.time() - ts.SEGMENT_PRUNE_GRACE_S - 5.0
+        os.utime(stale, (old, old))
+        s = ts.TsdbSampler(
+            registry=reg, root=tmp_path, evaluate_alerts=False,
+            segment_records=1, max_segments=2,
+        )
+        for _ in range(4):  # rotations force pruning past the bound
+            s.sample_once()
+        assert fresh.exists()  # the live writer's segment survived
+        assert not stale.exists()  # the dead run's segment was pruned
+
+    def test_read_window_bounds_and_limit(self, tmp_path):
+        d = tmp_path / "tsdb"
+        d.mkdir()
+        lines = [json.dumps(rec(float(at), x=at)) for at in range(10)]
+        (d / "seg-0000000000001-0001.jsonl").write_text(
+            "\n".join(lines[:5]) + "\n"
+        )
+        (d / "seg-0000000000002-0002.jsonl").write_text(
+            "\n".join(lines[5:]) + "\ntorn-tail-line{{{\n"
+        )
+        assert len(ts.read_window(root=tmp_path)) == 10
+        win = ts.read_window(start=3.0, end=6.0, root=tmp_path)
+        assert [r["at"] for r in win] == [3.0, 4.0, 5.0, 6.0]
+        # limit keeps the NEWEST n
+        assert [r["at"] for r in ts.read_window(root=tmp_path, limit=2)] == [
+            8.0, 9.0,
+        ]
+
+    def test_series_points_folds_labels_by_agg(self):
+        records = [{
+            "at": 1.0,
+            "series": [
+                ["mtpu_kv_page_occupancy", {"r": "a"}, "gauge", 0.5, 0.0],
+                ["mtpu_kv_page_occupancy", {"r": "b"}, "gauge", 0.9, 0.0],
+            ],
+        }]
+        # a 0..1 fraction folds by max, never sum (the tpurun top rule)
+        assert ts.series_points(
+            "mtpu_kv_page_occupancy", records, agg="max"
+        ) == [(1.0, 0.9)]
+        assert ts.series_points(
+            "mtpu_kv_page_occupancy", records,
+            labels={"r": "a"}, agg="max",
+        ) == [(1.0, 0.5)]
+
+    def test_rate_is_counter_reset_aware(self):
+        # restart zeroes the counter mid-window: the new absolute value
+        # contributes, the prometheus rate() convention
+        pts = [(0.0, 10.0), (1.0, 12.0), (2.0, 3.0)]
+        assert ts.rate(pts) == pytest.approx((2.0 + 3.0) / 2.0)
+        assert ts.rate(pts[:1]) is None
+
+    def test_zero_cost_when_off(self, monkeypatch):
+        monkeypatch.delenv(ts.TSDB_ENV, raising=False)
+        assert ts.ensure_sampler() is None
+        monkeypatch.setenv(ts.TSDB_ENV, "0")
+        assert ts.ensure_sampler() is None
+        assert ts.global_sampler() is None
+
+
+# ---------------------------------------------------------------------------
+# alert rules
+# ---------------------------------------------------------------------------
+
+
+class _Src:
+    def __init__(self):
+        self.records: list[dict] = []
+
+    def recent(self, window_s=None):
+        return list(self.records)
+
+
+def _evaluator(rules, tmp_path, reg=None):
+    src = _Src()
+    ev = al.AlertEvaluator(
+        rules, source=src, registry=reg or Registry(),
+        journal_path=tmp_path / "alerts.jsonl",
+    )
+    return ev, src
+
+
+class TestAlertRules:
+    def test_threshold_fires_after_for_s_and_clears_after_clear_s(
+        self, tmp_path
+    ):
+        reg = Registry()
+        rule = al.AlertRule(
+            name="kv", series="mtpu_kv_page_occupancy",
+            threshold=0.9, for_s=2.0, clear_s=2.0,
+        )
+        ev, src = _evaluator((rule,), tmp_path, reg)
+        src.records.append(rec(10.0, mtpu_kv_page_occupancy=0.95))
+        assert ev.evaluate_once(now=10.0) == []  # held 0s < for_s
+        src.records.append(rec(12.0, mtpu_kv_page_occupancy=0.96))
+        out = ev.evaluate_once(now=12.0)
+        assert [t["event"] for t in out] == ["fire"]
+        assert ev.active() == ["kv"]
+        assert reg.value(C.ALERTS_ACTIVE, {"rule": "kv"}) == 1.0
+        assert reg.value(C.ALERTS_FIRED_TOTAL, {"rule": "kv"}) == 1.0
+        # condition goes false: hysteresis holds the alert until clear_s
+        src.records.append(rec(13.0, mtpu_kv_page_occupancy=0.1))
+        assert ev.evaluate_once(now=13.0) == []
+        assert ev.active() == ["kv"]
+        src.records.append(rec(15.5, mtpu_kv_page_occupancy=0.1))
+        out = ev.evaluate_once(now=15.5)
+        assert [t["event"] for t in out] == ["clear"]
+        assert ev.active() == []
+        assert reg.value(C.ALERTS_ACTIVE, {"rule": "kv"}) == 0.0
+        # clears don't count as fires
+        assert reg.value(C.ALERTS_FIRED_TOTAL, {"rule": "kv"}) == 1.0
+        # every transition journaled, replayable after the process dies
+        events = [
+            r["event"]
+            for r in named_journal(
+                "alerts", path=tmp_path / "alerts.jsonl"
+            ).tail(10)
+        ]
+        assert events == ["fire", "clear"]
+
+    def test_flap_inside_for_s_never_fires(self, tmp_path):
+        rule = al.AlertRule(
+            name="kv", series="mtpu_kv_page_occupancy",
+            threshold=0.9, for_s=5.0,
+        )
+        ev, src = _evaluator((rule,), tmp_path)
+        for i, v in enumerate((0.95, 0.2, 0.95, 0.2)):
+            src.records.append(rec(10.0 + i, mtpu_kv_page_occupancy=v))
+            assert ev.evaluate_once(now=10.0 + i) == []
+        assert ev.active() == []
+
+    def test_rate_rule_reads_histogram_burn(self, tmp_path):
+        rule = al.AlertRule(
+            name="stall", series="mtpu_decode_stall_seconds",
+            kind="rate", field="sum", agg="sum",
+            threshold=0.5, window_s=10.0,
+        )
+        ev, src = _evaluator((rule,), tmp_path)
+        # 3 stall-seconds over 4s of window: 0.75/s > 0.5
+        src.records.append(
+            rec(10.0, mtpu_decode_stall_seconds=("histogram", 5, 1.0))
+        )
+        src.records.append(
+            rec(14.0, mtpu_decode_stall_seconds=("histogram", 11, 4.0))
+        )
+        out = ev.evaluate_once(now=14.0)
+        assert [t["event"] for t in out] == ["fire"]
+
+    def test_absence_rule_guards_on_outstanding_work(self, tmp_path):
+        rule = al.AlertRule(
+            name="stuck", series="mtpu_generated_tokens_total",
+            kind="absence", agg="sum", window_s=5.0,
+            guard_series="mtpu_active_slots",
+        )
+        ev, src = _evaluator((rule,), tmp_path)
+        # idle engine (guard 0): silence is healthy
+        src.records.append(
+            rec(10.0, mtpu_generated_tokens_total=("counter", 5, 0),
+                mtpu_active_slots=0)
+        )
+        src.records.append(
+            rec(12.0, mtpu_generated_tokens_total=("counter", 5, 0),
+                mtpu_active_slots=0)
+        )
+        assert ev.evaluate_once(now=12.0) == []
+        # active slots + flat counter = stagnation: fire
+        src.records.append(
+            rec(13.0, mtpu_generated_tokens_total=("counter", 5, 0),
+                mtpu_active_slots=2)
+        )
+        src.records.append(
+            rec(14.0, mtpu_generated_tokens_total=("counter", 5, 0),
+                mtpu_active_slots=2)
+        )
+        out = ev.evaluate_once(now=14.0)
+        assert [t["event"] for t in out] == ["fire"]
+        # tokens move again: condition false (clear_s=0 clears at once)
+        src.records.append(
+            rec(15.0, mtpu_generated_tokens_total=("counter", 9, 0),
+                mtpu_active_slots=2)
+        )
+        out = ev.evaluate_once(now=15.0)
+        assert [t["event"] for t in out] == ["clear"]
+
+    def test_absence_rule_is_counter_reset_aware(self, tmp_path):
+        rule = al.AlertRule(
+            name="stuck", series="mtpu_generated_tokens_total",
+            kind="absence", agg="sum", window_s=30.0,
+            guard_series="mtpu_active_slots",
+        )
+        ev, src = _evaluator((rule,), tmp_path)
+        # a window spanning a process restart: 50000 pre-restart, counter
+        # zeroed, 800 post-restart — tokens ARE flowing (rate() convention)
+        src.records.append(
+            rec(10.0, mtpu_generated_tokens_total=("counter", 50000, 0),
+                mtpu_active_slots=2)
+        )
+        src.records.append(
+            rec(15.0, mtpu_generated_tokens_total=("counter", 800, 0),
+                mtpu_active_slots=2)
+        )
+        assert ev.evaluate_once(now=15.0) == []
+        # once the window slides past the reset and the counter stays
+        # flat, that IS genuine stagnation: fire
+        for at in (20.0, 30.0, 46.0):
+            src.records.append(
+                rec(at, mtpu_generated_tokens_total=("counter", 800, 0),
+                    mtpu_active_slots=2)
+            )
+        out = ev.evaluate_once(now=46.0)
+        assert [t["event"] for t in out] == ["fire"]
+
+    def test_capture_rule_ships_an_incident_bundle(
+        self, tmp_path, no_cooldown
+    ):
+        rule = al.AlertRule(
+            name="page_me", series="mtpu_kv_page_occupancy",
+            threshold=0.9, capture=True,
+        )
+        src = _Src()
+        ev = al.AlertEvaluator(
+            (rule,), source=src, registry=Registry(), root=tmp_path,
+        )
+        src.records.append(rec(10.0, mtpu_kv_page_occupancy=0.95))
+        out = ev.evaluate_once(now=10.0)
+        assert [t["event"] for t in out] == ["fire"]
+        manifests = inc.list_incidents(root=tmp_path)
+        assert len(manifests) == 1
+        assert manifests[0]["trigger"] == "alert"
+        assert "page_me" in manifests[0]["reason"]
+
+    def test_unknown_kind_and_op_fail_loudly(self):
+        with pytest.raises(ValueError):
+            al.AlertRule(name="x", series="s", kind="bogus")
+        with pytest.raises(ValueError):
+            al.AlertRule(name="x", series="s", op="!=")
+
+
+# ---------------------------------------------------------------------------
+# incident bundles
+# ---------------------------------------------------------------------------
+
+
+class _BundleFakeEngine:
+    """The duck-typed surface _engine_section reads."""
+
+    class _Slot:
+        def __init__(self, request):
+            self.request = request
+
+    class _Req:
+        def __init__(self, rid):
+            self.request_id = rid
+            self.trace = type("T", (), {"trace_id": rid})()
+
+    def __init__(self):
+        self.trace_name = "victim-0"
+        self._running = True
+        self._stopped_on_error = False
+        self.impl_plan = {"attention": "ragged", "tp": 1}
+        self.paged_impl = "pallas"
+        self.scatter_impl = "xla"
+        self.decode_block = 8
+        self.error_count = 0
+        self.error_log = []
+        self.slots = [
+            self._Slot(self._Req("req-bundle-1")),
+            self._Slot(None),
+        ]
+
+
+class TestIncidentBundles:
+    def _seed_state(self, tmp_path):
+        """A tsdb window + journal tails for the collector to find."""
+        reg = Registry()
+        reg.gauge_set("mtpu_active_slots", 2.0)
+        s = ts.TsdbSampler(registry=reg, root=tmp_path, evaluate_alerts=False)
+        s.sample_once()
+        s.sample_once()
+        named_journal("watchdog", tmp_path).record(
+            {"at": time.time(), "action": "transition", "state": "wedged"}
+        )
+        named_journal("chaos", tmp_path).record(
+            {"at": time.time(), "episode": "seeded"}
+        )
+
+    def test_manual_capture_manifest_completeness(
+        self, tmp_path, no_cooldown, monkeypatch
+    ):
+        import hashlib
+
+        self._seed_state(tmp_path)
+        monkeypatch.setattr(inc, "_engines", [])
+        fake = _BundleFakeEngine()  # keep a strong ref: the registry is weak
+        inc.register_engine(fake)
+        bundle = inc.capture(
+            "manual", reason="completeness", root=tmp_path, force=True
+        )
+        assert bundle is not None and bundle.is_dir()
+        manifest = json.loads((bundle / "MANIFEST.json").read_text())
+        assert manifest["trigger"] == "manual"
+        assert manifest["tsdb_records"] == 2
+        assert manifest["journals"]["watchdog"] == 1
+        assert manifest["journals"]["chaos"] == 1
+        assert manifest["engines"] == ["victim-0"]
+        assert manifest["open_traces"] == ["req-bundle-1"]
+        # every manifest file exists with a matching digest — the bundle
+        # is content-addressed, a tampered file no longer matches
+        for name, meta in manifest["files"].items():
+            body = (bundle / name).read_bytes()
+            assert len(body) == meta["bytes"]
+            assert hashlib.sha256(body).hexdigest() == meta["sha256"]
+        assert manifest["id"] == bundle.name
+        env = json.loads((bundle / "env.json").read_text())
+        assert "MTPU_STATE_DIR" in env["env"]
+        engines = json.loads((bundle / "engines.json").read_text())
+        assert engines[0]["impl_plan"]["attention"] == "ragged"
+        assert engines[0]["occupied_slots"] == [
+            {"slot": 0, "request_id": "req-bundle-1",
+             "trace_id": "req-bundle-1"},
+        ]
+
+    def test_capture_reads_through_the_surfaces(
+        self, tmp_path, no_cooldown
+    ):
+        self._seed_state(tmp_path)
+        bundle = inc.capture("manual", root=tmp_path, force=True)
+        m = inc.read_manifest(bundle.name, root=tmp_path)
+        assert m["id"] == bundle.name
+        # unique-prefix resolve, the TraceStore rule
+        assert inc.read_manifest(bundle.name[:10], root=tmp_path)["id"] == m["id"]
+        body = inc.read_bundle_file(bundle.name, "tsdb.jsonl", root=tmp_path)
+        assert body and len(body.splitlines()) == 2
+        # a name the manifest never wrote is refused (traversal guard)
+        assert inc.read_bundle_file(
+            bundle.name, "../../../etc/passwd", root=tmp_path
+        ) is None
+        assert inc.read_bundle_file(
+            bundle.name, "MANIFEST.json", root=tmp_path
+        ) is None
+
+    def test_debounce_and_force(self, tmp_path, no_cooldown):
+        assert inc.capture("manual", root=tmp_path) is not None
+        # same trigger inside the cooldown: debounced
+        assert inc.capture("manual", root=tmp_path) is None
+        # a different trigger has its own clock
+        assert inc.capture("chaos_invariant", root=tmp_path) is not None
+        # force skips the debounce (the CLI path)
+        named_journal("chaos", tmp_path).record({"at": 1.0, "x": 1})
+        assert inc.capture("manual", root=tmp_path, force=True) is not None
+
+    def test_debounce_is_per_replica(self, tmp_path, no_cooldown):
+        # a correlated wedge hitting two replicas inside the cooldown must
+        # bundle BOTH victims (the second error-stop sweeps its slots)
+        assert inc.capture(
+            "watchdog_wedge", replica="r0", root=tmp_path
+        ) is not None
+        assert inc.capture(
+            "watchdog_wedge", replica="r1", root=tmp_path
+        ) is not None
+        assert inc.capture(
+            "watchdog_wedge", replica="r0", root=tmp_path
+        ) is None  # the same victim IS debounced
+
+    def test_failed_capture_releases_debounce(
+        self, tmp_path, no_cooldown, monkeypatch
+    ):
+        calls = {"n": 0}
+        real = inc._capture_locked
+
+        def flaky(*a, **kw):
+            calls["n"] += 1
+            if calls["n"] == 1:
+                raise OSError("disk full")
+            return real(*a, **kw)
+
+        monkeypatch.setattr(inc, "_capture_locked", flaky)
+        assert inc.capture("manual", root=tmp_path) is None
+        # the failure must not consume the debounce slot: an immediate
+        # retry (the next poll re-firing the ladder) still ships a bundle
+        assert inc.capture("manual", root=tmp_path) is not None
+
+    def test_lru_prune(self, tmp_path, no_cooldown, monkeypatch):
+        monkeypatch.setattr(inc, "MAX_INCIDENTS", 2)
+        ids = []
+        for i in range(3):
+            # distinct evidence -> distinct content address
+            named_journal("chaos", tmp_path).record({"at": float(i), "i": i})
+            b = inc.capture("manual", root=tmp_path, force=True)
+            ids.append(b.name)
+            time.sleep(0.02)
+        left = {p.name for p in (tmp_path / "incidents").glob("inc-*")}
+        assert len(left) == 2
+        assert ids[0] not in left  # oldest pruned first
+
+    def test_unknown_trigger_fails_loudly(self, tmp_path):
+        with pytest.raises(ValueError):
+            inc.capture("bogus", root=tmp_path)
+
+    def test_scheduler_crash_poison_captures(
+        self, jax_cpu, tmp_path, no_cooldown, monkeypatch
+    ):
+        """The crash-poison trigger end to end: a strict-mode scheduler
+        exception poisons the engine AND ships a bundle naming it."""
+        from modal_examples_tpu.models import llama
+        from modal_examples_tpu.serving import LLMEngine
+
+        monkeypatch.setenv("MTPU_STATE_DIR", str(tmp_path))
+        eng = LLMEngine(
+            llama.LlamaConfig.tiny(), max_slots=2, max_model_len=64,
+            page_size=8, prefill_buckets=(16,),
+        )
+        monkeypatch.setattr(
+            eng, "step", lambda: (_ for _ in ()).throw(
+                RuntimeError("forced scheduler bug")
+            )
+        )
+        # the crash here is DELIBERATE: restore the session-wide sentinel
+        # (conftest asserts no engine recorded a scheduler error)
+        reports_before = list(LLMEngine._error_reports)
+        try:
+            eng.start()
+            # the capture runs ON the dying scheduler thread after the
+            # poison flag flips: wait for the bundle, not the flag
+            deadline = time.monotonic() + 30
+            while (
+                not inc.list_incidents(root=tmp_path)
+                and time.monotonic() < deadline
+            ):
+                time.sleep(0.01)
+            assert eng._stopped_on_error
+            manifests = inc.list_incidents(root=tmp_path)
+            assert [m["trigger"] for m in manifests] == ["scheduler_crash"]
+            assert "forced scheduler bug" in manifests[0]["reason"]
+            engines = json.loads(inc.read_bundle_file(
+                manifests[0]["id"], "engines.json", root=tmp_path
+            ))
+            assert any(e["stopped_on_error"] for e in engines)
+        finally:
+            eng.stop()
+            LLMEngine._error_reports[:] = reports_before
+
+    def test_chaos_invariant_violation_captures(
+        self, tmp_path, no_cooldown, monkeypatch
+    ):
+        """A failing fleet invariant ships a bundle (strict and lenient
+        both) — the harness stubbed down to one violating episode."""
+        from modal_examples_tpu.faults import chaos
+
+        monkeypatch.setenv("MTPU_STATE_DIR", str(tmp_path))
+
+        class _StubFleet:
+            def __init__(self, seed):
+                pass
+
+            def close(self):
+                pass
+
+        bad = {
+            "at": 1.0, "episode": "stub", "seed": 0, "injected": {},
+            "hits": {}, "finished": {}, "shed": 0, "wedged": 1,
+            "recovered": 0, "invariants": ["a stream wedged"],
+        }
+        monkeypatch.setattr(chaos, "_Fleet", _StubFleet)
+        monkeypatch.setattr(chaos, "EPISODES", [("stub", {}, {})])
+        monkeypatch.setattr(
+            chaos, "_run_episode",
+            lambda fleet, name, spec, seed, kw: dict(bad),
+        )
+        report = chaos.run_chaos(
+            include_executor=False, strict=False, push=False,
+            journal_path=tmp_path / "chaos.jsonl",
+        )
+        assert report["wedged"] == 1
+        manifests = inc.list_incidents(root=tmp_path)
+        assert [m["trigger"] for m in manifests] == ["chaos_invariant"]
+        assert "stub" in manifests[0]["reason"]
+
+
+# ---------------------------------------------------------------------------
+# CLI / gateway surfaces
+# ---------------------------------------------------------------------------
+
+
+class TestSurfaces:
+    def _seed(self, tmp_path):
+        reg = Registry()
+        reg.gauge_set("mtpu_active_slots", 2.0)
+        reg.counter_inc("mtpu_generated_tokens_total", 4.0)
+        s = ts.TsdbSampler(registry=reg, root=tmp_path, evaluate_alerts=False)
+        for _ in range(3):
+            reg.counter_inc("mtpu_generated_tokens_total", 2.0)
+            s.sample_once()
+
+    def test_cli_tsdb_summary_series_and_perfetto(self, tmp_path, capsys):
+        from modal_examples_tpu.core.cli import main
+
+        self._seed(tmp_path)
+        assert main(["tsdb", "--dir", str(tmp_path)]) == 0
+        out = capsys.readouterr().out
+        assert "3 samples" in out and "mtpu_active_slots" in out
+        assert main([
+            "tsdb", "--dir", str(tmp_path),
+            "--series", "mtpu_generated_tokens_total", "--rate",
+        ]) == 0
+        assert "/s over" in capsys.readouterr().out
+        out_file = tmp_path / "tsdb.perfetto.json"
+        assert main([
+            "tsdb", "--dir", str(tmp_path), "--perfetto", str(out_file),
+        ]) == 0
+        doc = json.loads(out_file.read_text())
+        counters = [
+            e for e in doc["traceEvents"] if e.get("ph") == "C"
+        ]
+        assert counters, doc
+        assert {"mtpu_active_slots", "mtpu_generated_tokens_total"} <= {
+            e["name"] for e in counters
+        }
+        # the dedicated tsdb track is named
+        assert any(
+            e.get("name") == "thread_name"
+            and e["args"]["name"] == "tsdb"
+            for e in doc["traceEvents"]
+        )
+
+    def test_cli_metrics_watch_requires_tsdb_hint(self, tmp_path, capsys):
+        """--watch with an empty tsdb prints the MTPU_TSDB hint (one
+        refresh, then interrupted)."""
+        from modal_examples_tpu.core import cli
+
+        calls = {"n": 0}
+
+        def fake_sleep(_s):
+            calls["n"] += 1
+            if calls["n"] >= 2:
+                raise KeyboardInterrupt
+
+        import time as _time
+
+        orig = _time.sleep
+        _time.sleep = fake_sleep
+        try:
+            assert cli.main(
+                ["metrics", "--watch", "0.01", "--dir", str(tmp_path)]
+            ) == 0
+        finally:
+            _time.sleep = orig
+        assert "MTPU_TSDB=1" in capsys.readouterr().out
+
+    def test_cli_metrics_watch_renders_deltas(self, tmp_path, capsys):
+        from modal_examples_tpu.core import cli
+
+        self._seed(tmp_path)
+        import time as _time
+
+        orig = _time.sleep
+        calls = {"n": 0}
+
+        def fake_sleep(_s):
+            calls["n"] += 1
+            if calls["n"] >= 2:
+                raise KeyboardInterrupt
+
+        _time.sleep = fake_sleep
+        try:
+            assert cli.main(
+                ["metrics", "--watch", "0.01", "--dir", str(tmp_path)]
+            ) == 0
+        finally:
+            _time.sleep = orig
+        out = capsys.readouterr().out
+        assert "mtpu_generated_tokens_total" in out
+        assert "SERIES" in out and "DELTA" in out
+
+    def test_cli_alerts_and_incidents(self, tmp_path, capsys, no_cooldown):
+        from modal_examples_tpu.core.cli import main
+
+        self._seed(tmp_path)
+        assert main(["alerts", "--json", "--dir", str(tmp_path)]) == 0
+        payload = json.loads(capsys.readouterr().out)
+        assert {r["rule"] for r in payload["rules"]} == {
+            r.name for r in al.DEFAULT_RULES
+        }
+        assert payload["tsdb_samples"] == 3
+        # capture -> list -> show round trip
+        assert main([
+            "incidents", "capture", "--reason", "cli-test",
+            "--dir", str(tmp_path),
+        ]) == 0
+        bundle_path = capsys.readouterr().out.strip()
+        assert bundle_path
+        assert main(["incidents", "--json", "--dir", str(tmp_path)]) == 0
+        listed = json.loads(capsys.readouterr().out)
+        assert len(listed) == 1 and listed[0]["reason"] == "cli-test"
+        assert listed[0]["tsdb_records"] == 3
+        assert main([
+            "incidents", "show", listed[0]["id"], "--dir", str(tmp_path),
+        ]) == 0
+        shown = json.loads(capsys.readouterr().out)
+        assert shown["id"] == listed[0]["id"]
+        assert main([
+            "incidents", "show", listed[0]["id"],
+            "--file", "tsdb.jsonl", "--dir", str(tmp_path),
+        ]) == 0
+        assert len(capsys.readouterr().out.splitlines()) == 3
+        # stage wrapper path: an explicit non-manual trigger
+        assert main([
+            "incident", "capture", "--trigger", "stage_failure",
+            "--reason", "stage 7", "--dir", str(tmp_path),
+        ]) == 0
+        capsys.readouterr()
+
+    def test_gateway_alerts_and_incidents_routes(
+        self, tmp_path, no_cooldown, monkeypatch
+    ):
+        import urllib.error
+        import urllib.request
+
+        from modal_examples_tpu.core.app import App
+        from modal_examples_tpu.web.gateway import Gateway
+
+        monkeypatch.setenv("MTPU_STATE_DIR", str(tmp_path))
+        self._seed(tmp_path)
+        bundle = inc.capture("manual", reason="gw", root=None, force=True)
+        assert bundle is not None
+        gw = Gateway(App("fr-gw")).start()
+        try:
+            base = gw.base_url
+
+            def get(path):
+                with urllib.request.urlopen(base + path, timeout=5) as r:
+                    return r.status, json.loads(r.read().decode())
+
+            status, alerts_payload = get("/alerts")
+            assert status == 200
+            assert {r["rule"] for r in alerts_payload["rules"]} == {
+                r.name for r in al.DEFAULT_RULES
+            }
+            assert alerts_payload["active"] == []
+            status, idx = get("/incidents")
+            assert status == 200
+            assert [m["id"] for m in idx["incidents"]] == [bundle.name]
+            status, manifest = get(f"/incidents/{bundle.name}")
+            assert status == 200 and manifest["trigger"] == "manual"
+            status, file_payload = get(
+                f"/incidents/{bundle.name}?file=env.json"
+            )
+            assert status == 200
+            assert json.loads(file_payload["content"])["pid"]
+            with pytest.raises(urllib.error.HTTPError) as exc:
+                urllib.request.urlopen(
+                    base + "/incidents/inc-nope", timeout=5
+                )
+            assert exc.value.code == 404
+        finally:
+            gw.stop()
+
+
+# ---------------------------------------------------------------------------
+# the acceptance E2E: silent freeze -> wedge -> bundle
+# ---------------------------------------------------------------------------
+
+
+class TestWedgeShipsABundle:
+    def test_silent_freeze_produces_bundle_with_evidence(
+        self, jax_cpu, tmp_path, no_cooldown, monkeypatch
+    ):
+        """ISSUE 15 acceptance: a forced wedge under the chaos harness's
+        silent-freeze fault produces an incident bundle whose MANIFEST
+        references a non-empty tsdb window, the watchdog journal tail,
+        and the victim's open request traces."""
+        from modal_examples_tpu.faults.inject import FaultPlan, active
+        from modal_examples_tpu.models import llama
+        from modal_examples_tpu.scheduling import (
+            EngineReplica,
+            PrefixAffinityRouter,
+        )
+        from modal_examples_tpu.serving import LLMEngine, SamplingParams
+        from modal_examples_tpu.serving.health import (
+            FleetWatchdog,
+            WatchdogPolicy,
+        )
+
+        monkeypatch.setenv("MTPU_STATE_DIR", str(tmp_path))
+        monkeypatch.setenv(ts.TSDB_ENV, "1")
+        monkeypatch.setenv(ts.INTERVAL_ENV, "0.05")
+        ts.stop_sampler()  # a fresh singleton under the patched env
+        try:
+            eng = LLMEngine(
+                llama.LlamaConfig.tiny(), seed=0, max_slots=4,
+                max_model_len=128, page_size=8, prefill_buckets=(16, 32),
+            )
+            assert ts.global_sampler() is not None  # MTPU_TSDB=1 took
+            rep = EngineReplica(eng, "victim-a", role="unified")
+            router = PrefixAffinityRouter([rep], reprobe_s=60.0)
+            watchdog = FleetWatchdog(
+                router,
+                policy=WatchdogPolicy(
+                    degraded_after_s=0.5, wedged_after_s=1.0,
+                    quarantine_after=99,
+                ),
+                poll_s=0.1,
+            )
+            sp = SamplingParams(max_tokens=64, temperature=0.0)
+            try:
+                eng.start()
+                reqs = [
+                    rep.submit("the quick brown fox jumps", sp),
+                    rep.submit("a different prompt entirely", sp),
+                ]
+                deadline = time.monotonic() + 60
+                while time.monotonic() < deadline and not all(
+                    len(r.generated_tokens) >= 3 for r in reqs
+                ):
+                    time.sleep(0.005)
+                assert all(len(r.generated_tokens) >= 3 for r in reqs)
+                # engines warm + mid-decode: NOW freeze silently and let
+                # the watchdog walk its ladder
+                watchdog.start()
+                plan = FaultPlan(
+                    {"engine.scheduler_freeze": {"p": 1.0, "max_fires": 1}}
+                )
+                with active(plan):
+                    deadline = time.monotonic() + 30
+                    while (
+                        not plan.fired() and time.monotonic() < deadline
+                    ):
+                        time.sleep(0.005)
+                    assert plan.fired().get("engine.scheduler_freeze") == 1
+                    deadline = time.monotonic() + 60
+                    while time.monotonic() < deadline and not any(
+                        m["trigger"] == "watchdog_wedge"
+                        for m in inc.list_incidents(root=tmp_path)
+                    ):
+                        time.sleep(0.05)
+            finally:
+                watchdog.stop()
+                eng.stop()
+            wedge = [
+                m for m in inc.list_incidents(root=tmp_path)
+                if m["trigger"] == "watchdog_wedge"
+            ]
+            assert wedge, inc.list_incidents(root=tmp_path)
+            m = wedge[0]
+            assert m["replica"] == "victim-a"
+            # (a) a non-empty tsdb window: the 0.05s sampler recorded the
+            # minutes (well, seconds) leading up to the wedge
+            assert m["tsdb_records"] > 0
+            tsdb_body = inc.read_bundle_file(
+                m["id"], "tsdb.jsonl", root=tmp_path
+            )
+            names = ts.series_names([
+                json.loads(line) for line in tsdb_body.splitlines()
+            ])
+            assert "mtpu_generated_tokens_total" in names
+            # (b) the watchdog journal tail, wedge transition included
+            assert m["journals"].get("watchdog", 0) > 0
+            wd_body = inc.read_bundle_file(
+                m["id"], "journal_watchdog.jsonl", root=tmp_path
+            )
+            wd_records = [
+                json.loads(line) for line in wd_body.splitlines()
+            ]
+            assert any(
+                r.get("state") == "wedged" for r in wd_records
+            ), wd_records
+            # (c) the victim's open request traces: both mid-flight
+            # requests, with the spans recorded so far
+            assert set(m["open_traces"]) == {
+                r.request_id for r in reqs
+            }
+            traces = json.loads(inc.read_bundle_file(
+                m["id"], "traces.json", root=tmp_path
+            ))
+            for r in reqs:
+                assert traces["open"].get(r.request_id), r.request_id
+            # the engine fingerprint names the victim
+            engines = json.loads(inc.read_bundle_file(
+                m["id"], "engines.json", root=tmp_path
+            ))
+            assert any(e["replica"] == "victim-a" for e in engines)
+        finally:
+            ts.stop_sampler()

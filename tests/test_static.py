@@ -1305,3 +1305,127 @@ def test_decorator_kwargs_exist_on_function_spec(decorator):
         f"{decorator.__qualname__} kwargs with no FunctionSpec field: "
         f"{sorted(unmapped)}"
     )
+
+
+def test_flight_recorder_series_declared_and_emitted():
+    """Closure for the flight-recorder series (``mtpu_tsdb_*``,
+    ``mtpu_alerts_*``, ``mtpu_incidents_*``), both directions (the
+    fleet/failover/watchdog/profiler-series guard pattern): every declared
+    flight-recorder catalog constant must be referenced by a live
+    emitter/reader, AND every flight-recorder recorder in
+    observability/metrics.py must have a call site outside metrics.py (a
+    recorder nothing calls means the tsdb/alerts/incident surfaces went
+    quietly blind)."""
+    from modal_examples_tpu.observability import catalog
+
+    consts = {
+        attr: val
+        for attr, val in vars(catalog).items()
+        if isinstance(val, str)
+        and val.startswith(("mtpu_tsdb_", "mtpu_alerts_", "mtpu_incidents_"))
+    }
+    assert len(consts) >= 7, consts
+    catalog_path = PKG_ROOT / "observability" / "catalog.py"
+    package_src = {
+        path: path.read_text()
+        for path in sorted(PKG_ROOT.rglob("*.py"))
+        if path != catalog_path
+    }
+    unused = [
+        attr for attr in consts
+        if not any(
+            re.search(rf"\b{attr}\b", src) for src in package_src.values()
+        )
+    ]
+    assert not unused, (
+        "flight-recorder series declared in the catalog but never "
+        f"referenced by an emitter/reader in the package: {unused}"
+    )
+    metrics_path = PKG_ROOT / "observability" / "metrics.py"
+    recorders = (
+        "record_tsdb_sample", "record_tsdb_rotation",
+        "set_alert_active", "record_alert_fired",
+        "record_incident_captured",
+    )
+    orphans = [
+        fn for fn in recorders
+        if not any(
+            re.search(rf"\b{fn}\(", src)
+            for path, src in package_src.items()
+            if path != metrics_path
+        )
+    ]
+    assert not orphans, (
+        "flight-recorder recorders with no call site outside metrics.py: "
+        f"{orphans}"
+    )
+
+
+def test_alert_rules_reference_only_cataloged_series():
+    """Every series an AlertRule reads — the rule's own series AND its
+    absence guard — must be declared in observability/catalog.py. A rule
+    watching a misspelled or refactored-away series would never fire and
+    never error; this guard turns that silence into a test failure."""
+    from modal_examples_tpu.observability import catalog
+    from modal_examples_tpu.observability.alerts import (
+        DEFAULT_RULES,
+        rule_series,
+    )
+
+    assert len(DEFAULT_RULES) >= 5
+    unknown = {
+        rule.name: [
+            s for s in rule_series(rule) if s not in catalog.CATALOG
+        ]
+        for rule in DEFAULT_RULES
+    }
+    unknown = {name: missing for name, missing in unknown.items() if missing}
+    assert not unknown, (
+        f"alert rules referencing series missing from the catalog: {unknown}"
+    )
+    # incident triggers are a catalog label set the same way: the capture
+    # chokepoint validates against TRIGGERS, so the catalog help text and
+    # the code can't drift
+    from modal_examples_tpu.observability.incident import TRIGGERS
+
+    help_text = catalog.CATALOG[catalog.INCIDENTS_CAPTURED_TOTAL]["help"]
+    for trigger in TRIGGERS:
+        assert trigger in help_text, (
+            f"incident trigger {trigger!r} missing from the "
+            "mtpu_incidents_captured_total catalog help"
+        )
+
+
+def test_journals_resolve_only_through_named_journal():
+    """One table owns every journal file name (observability/journal.py
+    JOURNALS): production code must resolve journals through
+    named_journal()/journal_path(), never by constructing DecisionJournal
+    directly or hand-building a ``<state_dir>/x.jsonl`` path — the drift
+    this PR collapsed (five subsystems each spelling their own
+    bounded-JSONL append) stays collapsed."""
+    journal_path = PKG_ROOT / "observability" / "journal.py"
+    offenders = []
+    for path in sorted(PKG_ROOT.rglob("*.py")):
+        if path == journal_path:
+            continue
+        src = path.read_text()
+        if re.search(r"\bDecisionJournal\s*\(", src):
+            offenders.append(str(path.relative_to(PKG_ROOT)))
+    assert not offenders, (
+        "DecisionJournal constructed outside observability/journal.py "
+        f"(use named_journal): {offenders}"
+    )
+    # the JOURNALS table must cover every journal the package writes: a
+    # new `<state_dir>/*.jsonl` literal outside the table is drift
+    from modal_examples_tpu.observability.journal import JOURNALS
+
+    table_files = set(JOURNALS.values())
+    for path in sorted(PKG_ROOT.rglob("*.py")):
+        if path == journal_path:
+            continue
+        for name in re.findall(r"state_dir\(\)\s*/\s*\"(\w+\.jsonl)\"",
+                               path.read_text()):
+            assert name in table_files, (
+                f"{path.relative_to(PKG_ROOT)} hand-builds journal path "
+                f"{name!r} outside the JOURNALS table"
+            )
