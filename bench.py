@@ -822,6 +822,116 @@ def _measure_fleet(engine, spec: dict, make_engine) -> dict:
     return section
 
 
+def _measure_shared_prefix(engine, spec: dict, make_engine) -> dict:
+    """Shared prefix-store A/B (docs/prefix_store.md): the same
+    two-replica fleet serving the same shared-prefix tenant traffic,
+    once with per-replica PRIVATE volume tiers (the pre-store world:
+    every replica recomputes or respills its own copy) and once with the
+    fleet-wide SHARED store. Replicas A and B both serve and spill the
+    corpus — between them every chain's rendezvous owner spills (the
+    non-owner's puts defer), and the overlap is the dedup measurement:
+    shared-arm puts dedup/defer down to ONE fleet-wide copy (ratio >
+    1.0) while private-arm replicas each write their own. Then a COLD
+    third replica (the scale-out case) serves the corpus — in the
+    shared arm it promotes the fleet's spills (all peer hits), in the
+    private arm its root is empty and every prefill recomputes. The
+    cold replica's shared-arm TTFT p95 is the benchdiff-gated scalar
+    (``fleet.shared_prefix_ttft_p95``)."""
+    import time as _time
+
+    from modal_examples_tpu.serving import SamplingParams
+    from modal_examples_tpu.storage.volume import Volume
+
+    # one multi-page shared prefix (byte tokenizer: characters ARE
+    # tokens), fanned into per-tenant requests — the workload the
+    # cross-replica store exists for
+    prefix = (
+        "shared system prompt: you are the fleet's serving benchmark; "
+        "answer tersely and deterministically. " * 3
+    )
+    prompts = [f"{prefix}tenant request {i}" for i in range(4)]
+
+    def _spill(eng) -> None:
+        # evict the trie into the host tier, then demote every host
+        # block — organic LRU overflow, forced so the A/B is
+        # deterministic at bench scale (chaos uses the same lever)
+        t = eng.tiered
+        eng.prefix_cache.evict(10_000)
+        with t._lock:
+            items = list(t._host.items())
+        for h, data in items:
+            t._demote_to_volume(h, data)
+            with t._lock:
+                t._host.pop(h, None)
+                t._host_used -= len(data)
+
+    def _arm(shared: bool) -> dict:
+        with Volume.ephemeral() as vol:
+            def _mk(name: str):
+                tp = {
+                    "host_bytes": 1 << 20, "volume": vol,
+                    "shared": shared, "replica": name,
+                }
+                if not shared:
+                    # the pre-store world: one private root per replica
+                    tp["volume_prefix"] = f"kv-tier-{name}"
+                eng = make_engine(params=engine.params, tiered_prefix=tp)
+                eng.warmup()
+                eng.start()
+                # jit the short-prompt path outside the measurement
+                eng.generate("warm " * 8, SamplingParams(max_tokens=2))
+                return eng
+
+            engines = []
+            try:
+                eng_a = _mk("rep-a")
+                eng_b = _mk("rep-b")
+                engines += [eng_a, eng_b]
+                for eng in (eng_a, eng_b):
+                    for p in prompts:
+                        eng.generate(p, SamplingParams(max_tokens=4))
+                # both replicas spill: every chain's rendezvous owner is
+                # one of the two, so one fleet-wide copy of every block
+                # lands (the non-owner's puts defer/dedup against it)
+                _spill(eng_a)
+                _spill(eng_b)
+                # the scale-out case: a COLD replica serves the corpus
+                eng_c = _mk("rep-c")
+                engines.append(eng_c)
+                ttfts = []
+                for p in prompts:
+                    t0 = _time.perf_counter()
+                    eng_c.generate(p, SamplingParams(max_tokens=1))
+                    ttfts.append(_time.perf_counter() - t0)
+                stats = [e.tiered.store.stats() for e in engines]
+                puts = sum(s["puts"] for s in stats)
+                writes = sum(s["writes"] for s in stats)
+                c_s = stats[-1]
+                return {
+                    "ttft_p50": _pct(ttfts, 50),
+                    "ttft_p95": _pct(ttfts, 95),
+                    "cold_volume_hits": eng_c.tiered.tier_hits["volume"],
+                    "peer_hits": c_s["hits"].get("peer", 0),
+                    "puts": puts,
+                    "writes": writes,
+                    "dedup_ratio": round(puts / max(1, writes), 4),
+                    "store_bytes": max(s["bytes"] for s in stats),
+                }
+            finally:
+                for eng in engines:
+                    eng.stop()
+
+    private = _arm(shared=False)
+    shared = _arm(shared=True)
+    return {
+        "private": private,
+        "shared": shared,
+        "ttft_p95_vs_private": round(
+            shared["ttft_p95"] / max(private["ttft_p95"], 1e-9), 4
+        ),
+    }
+
+
 def _child(model: str) -> None:
     spec = CONFIGS[model]
     # measured runs keep the distributed request tracer sampled OUT
@@ -1108,7 +1218,7 @@ def _child(model: str) -> None:
     # quantized; re-quantizing it would corrupt the weights)
     fleet_info = None
     if spec.get("fleet"):
-        def _mk_fleet_engine(params=None):
+        def _mk_fleet_engine(params=None, tiered_prefix=None):
             return LLMEngine(
                 cfg,
                 params=params,
@@ -1122,9 +1232,16 @@ def _child(model: str) -> None:
                 paged_impl="pallas",
                 mesh=mesh,
                 max_prefill_tokens_per_tick=spec.get("budget", 0),
+                tiered_prefix=tiered_prefix,
             )
 
         fleet_info = _measure_fleet(engine, spec, _mk_fleet_engine)
+        # shared prefix-store A/B (docs/prefix_store.md): private vs
+        # fleet-wide volume tiers on a two-replica fleet; the shared
+        # arm's cold-replica TTFT is the benchdiff-gated scalar
+        sp = _measure_shared_prefix(engine, spec, _mk_fleet_engine)
+        fleet_info["shared_prefix"] = sp
+        fleet_info["shared_prefix_ttft_p95"] = sp["shared"]["ttft_p95"]
 
     # in-flight failover A/B (failover configs, docs/failover.md): streams
     # killed mid-decode on one replica, checkpoint-resumed on another —

@@ -97,7 +97,23 @@ fi
 #     `fleet` section (goodput, p99 TTFT/TPOT vs offered load, shed rate,
 #     scale events, A/B at the knee) is what bench_diff's fleet.* metrics
 #     gate from the next round on
-timeout 1500 env BENCH_MODEL=llama2-7b-fleet-sweep BENCH_NO_SECONDARY=1 python bench.py || fail 21
+timeout 1500 env BENCH_MODEL=llama2-7b-fleet-sweep BENCH_NO_SECONDARY=1 python bench.py | tee benchmarks/BENCH_fleet.json || fail 21
+# 14b. shared prefix-store A/B (docs/prefix_store.md), inside stage 14's
+#      `fleet` section: two replicas over private vs fleet-wide volume
+#      tiers — the shared arm must actually dedup (ratio > 1.0: replica
+#      B's spill skipped what replica A already wrote) and serve the cold
+#      replica from peer spills; fleet.shared_prefix_ttft_p95 gates via
+#      benchdiff from the next round on
+timeout 120 python - <<'PYEOF' || fail 26
+from modal_examples_tpu.utils.bench_diff import load_bench
+sp = load_bench("benchmarks/BENCH_fleet.json")["fleet"]["shared_prefix"]
+assert sp["shared"]["dedup_ratio"] > 1.0, sp
+assert sp["shared"]["peer_hits"] > 0, sp
+assert sp["shared"]["ttft_p95"] > 0, sp
+print(f"stage 14b: shared prefix store OK — dedup={sp['shared']['dedup_ratio']}"
+      f" peer_hits={sp['shared']['peer_hits']}"
+      f" ttft_p95_vs_private={sp['ttft_p95_vs_private']}")
+PYEOF
 # 15. in-flight failover at the int8 headline shape (docs/failover.md),
 #     behind the regression gate: streams killed mid-decode and
 #     checkpoint-resumed on a second replica (weights aliased) — the

@@ -1488,6 +1488,76 @@ def cmd_chaos(argv: list[str]) -> int:
     return 0
 
 
+def cmd_prefixstore(argv: list[str]) -> int:
+    """Shared prefix-store view: fleet-wide dedup ratio, hit origins
+    (self vs peer — peer hits are the cross-replica wins the store
+    exists for), resident bytes, and the lease-takeover journal tail —
+    from the pushed metrics files plus ``<state_dir>/prefix_store.jsonl``
+    (the shared-KV companion of ``tpurun disagg``; docs/prefix_store.md).
+
+    ``--last N`` shows the newest N journal records (default 10);
+    ``--dir PATH`` overrides the state dir root.
+    """
+    from pathlib import Path
+
+    from ..observability import catalog as C
+    from ..observability.export import pushed_jobs
+    from ..observability.journal import named_journal
+    from ..utils.prometheus import merge_expositions, parse_exposition
+
+    usage = "usage: tpurun prefixstore [--last N] [--dir PATH]"
+    argv, root = _pop_dir_flag(argv, usage)
+    argv, last_s = _pop_flag(argv, "--last", usage)
+    last = int(last_s) if last_s is not None else 10
+
+    jobs = pushed_jobs(Path(root) / "metrics" if root else None)
+    records = named_journal("prefix_store", root).tail(last)
+    if not jobs and not records:
+        print(
+            "no shared prefix-store activity yet "
+            "(serve with tiered_prefix shared=True, or run the fleet "
+            "bench config first)"
+        )
+        return 0
+
+    if jobs:
+        merged = parse_exposition(merge_expositions(jobs))
+        hits = {
+            lbls.get("origin", "?"): v
+            for lbls, v in merged.series(C.PREFIX_STORE_HITS_TOTAL)
+        }
+        total_hits = sum(hits.values())
+        misses = merged.total(C.PREFIX_STORE_MISSES_TOTAL)
+        looked = total_hits + misses
+        print(f"jobs: {len(jobs)} ({', '.join(sorted(jobs)) or 'none'})")
+        print(
+            f"hits: {int(total_hits)} "
+            f"(self={int(hits.get('self', 0))} "
+            f"peer={int(hits.get('peer', 0))})   "
+            f"misses {int(misses)}   "
+            f"hit rate {total_hits / looked if looked else 0.0:.2f}"
+        )
+        print(
+            f"dedup ratio {merged.total(C.PREFIX_STORE_DEDUP_RATIO):.2f}   "
+            f"resident bytes "
+            f"{int(merged.total(C.PREFIX_STORE_BYTES))}   "
+            f"owner takeovers "
+            f"{int(merged.total(C.PREFIX_STORE_OWNER_TAKEOVERS_TOTAL))}"
+        )
+    if records:
+        print()
+        print(f"{'ACTION':<16} {'CHAIN':<14} {'FROM':<12} {'TO':<12} REASON")
+        for rec in records:
+            print(
+                f"{rec.get('action', '?'):<16} "
+                f"{str(rec.get('chain', '?'))[:12]:<14} "
+                f"{str(rec.get('from', '-')):<12} "
+                f"{str(rec.get('to', '-')):<12} "
+                f"{rec.get('reason', '-')}"
+            )
+    return 0
+
+
 def cmd_health(argv: list[str]) -> int:
     """Gray-failure watchdog view: per-replica progress classification,
     watermark ages, ladder counters, and the last N watchdog decisions from
@@ -1693,6 +1763,7 @@ COMMANDS = {
     "scaler": cmd_scaler,
     "sched": cmd_sched,
     "disagg": cmd_disagg,
+    "prefixstore": cmd_prefixstore,
     "chaos": cmd_chaos,
     "fleet": cmd_fleet,
     "health": cmd_health,

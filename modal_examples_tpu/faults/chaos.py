@@ -219,11 +219,24 @@ class _Fleet:
 
         self.volume_cm = Volume.ephemeral()
         vol = self.volume_cm.__enter__()
+        # pre and uni share ONE fleet-wide prefix store over the same
+        # volume (docs/prefix_store.md): rendezvous spill ownership and
+        # cross-replica promotion are live in every episode, and the
+        # prefix-store-owner-death episode kills whichever of the two the
+        # rendezvous made the warm chain's owner
         self.pre = engine(
-            tiered_prefix={"host_bytes": 1 << 20, "volume": vol}
+            tiered_prefix={
+                "host_bytes": 1 << 20, "volume": vol,
+                "shared": True, "replica": "pre-0",
+            }
         )
         self.dec = engine()
-        self.uni = engine()
+        self.uni = engine(
+            tiered_prefix={
+                "host_bytes": 1 << 20, "volume": vol,
+                "shared": True, "replica": "uni-0",
+            }
+        )
         self.engines = {"pre-0": self.pre, "dec-0": self.dec,
                         "uni-0": self.uni}
         self.coord = DisaggCoordinator(
@@ -301,6 +314,78 @@ def _traffic(fleet: _Fleet, *, n: int, via: str = "coord",
     return results, shed, attempted
 
 
+def _force_spill(engine, *, rewrite: bool = False, only_chain=None) -> None:
+    """Evict an idle engine's whole trie (spills into the host tier) and
+    demote host blocks to the shared volume store — the chaos lever that
+    makes spill ownership (and the armed owner-death fault) fire
+    deterministically instead of waiting for host-LRU overflow.
+    ``rewrite=True`` first invalidates the blocks from the store, so the
+    demotes are real writes even when earlier episodes already spilled
+    the same chains (a dedup skip never reaches the fault point).
+    ``only_chain`` restricts the demotes to one chain's blocks — the
+    owner-death episode needs the lease to land on a chain BOTH replicas
+    hold, not whatever an earlier episode left oldest in the host LRU."""
+    t = engine.tiered
+    engine.prefix_cache.evict(10_000)
+    with t._lock:
+        items = [
+            (h, d) for h, d in t._host.items()
+            if only_chain is None or t._chain_of.get(h) == only_chain
+        ]
+    if rewrite:
+        for h, _ in items:
+            t.store.invalidate(h)
+    for h, data in items:
+        t._demote_to_volume(h, data)
+        with t._lock:
+            t._host.pop(h, None)
+            t._host_used -= len(data)
+
+
+def _owner_death_spill(fleet: _Fleet):
+    """The controlled middle of the ``prefix-store-owner-death`` episode
+    (both replicas already warm on the shared chain, the fault armed):
+    force-spill the chain's rendezvous OWNER first — the injected crash
+    fires mid-put, after the spill lease is taken but before the write
+    lands, and deregisters the owner from the membership — then
+    force-spill the survivor, whose put takes the dead owner's lease over
+    (journaled ``owner_takeover``) and lands the block. Returns the
+    surviving engine plus any episode-specific violations."""
+    violations: list[str] = []
+    pre_s, uni_s = fleet.pre.tiered.store, fleet.uni.tiered.store
+    # earlier episodes may have outlived the membership TTL: refresh both
+    # heartbeats so ownership math sees two live candidates
+    pre_s.heartbeat()
+    uni_s.heartbeat()
+    # the episode must exercise ONE chain both replicas hold (the freshly
+    # warmed shared prompt), or the dead owner's lease lands on a stale
+    # chain the survivor never spills and no takeover can be observed
+    with fleet.uni.tiered._lock:
+        uni_heads = list(dict.fromkeys(fleet.uni.tiered._chain_of.values()))
+    with fleet.pre.tiered._lock:
+        pre_heads = set(fleet.pre.tiered._chain_of.values())
+    shared_heads = [h for h in uni_heads if h in pre_heads]
+    head = (shared_heads or uni_heads or [None])[-1]
+    owner = pre_s.owner_for(head) if head is not None else None
+    dead, survivor = (
+        (fleet.uni, fleet.pre) if owner == "uni-0" else (fleet.pre, fleet.uni)
+    )
+    base_takeovers = survivor.tiered.store.board.takeovers
+    # armed fault: the owner dies mid-spill of the shared chain
+    _force_spill(dead, rewrite=True, only_chain=head)
+    # lease takeover + the write that lands
+    _force_spill(survivor, only_chain=head)
+    if survivor.tiered.store.board.takeovers <= base_takeovers:
+        violations.append(
+            "owner died mid-spill but the survivor recorded no lease "
+            "takeover"
+        )
+    # the dead replica's ENGINE kept serving (only its store membership
+    # died): rejoin so later traffic sees a full membership again
+    dead.tiered.store.register_replica()
+    return survivor, violations
+
+
 #: the fixed episode schedule: (name, fault spec, traffic kwargs). One
 #: small plan per episode keeps every injection deterministic — the nth
 #: hit of a point is the nth time THIS episode's traffic reaches it —
@@ -347,13 +432,67 @@ EPISODES: list[tuple[str, dict, dict]] = [
     # it into a TransportError and the coordinator's PR-6 unified fallback
     # completes the request token-identically on the decode side
     ("transfer-stall", {"disagg.transfer_stall": {"on_hit": 1}}, {"n": 2}),
+    # the shared prefix store's chain OWNER dies mid-spill
+    # (docs/prefix_store.md): membership drops, the write never lands
+    # (atomic temp+rename: no torn block), and the survivor's next spill
+    # of the chain takes the lease over — journaled owner_takeover — then
+    # re-promotes the churned chain warm from the store. The post-traffic
+    # leg lives in :func:`_owner_death_leg`.
+    ("prefix-store-owner-death",
+     {"prefix_store.owner_death": {"on_hit": 1}},
+     {"n": 2}),
 ]
 
 
 def _run_episode(fleet: _Fleet, name: str, spec: dict, seed: int,
                  traffic_kw: dict) -> dict:
     plan = FaultPlan(spec, seed=seed)
+    extra_violations: list[str] = []
+    pre_results: list = []
+    pre_shed = pre_attempted = 0
+    survivor = None
+    base_vol_hits = 0
+    if name == "prefix-store-owner-death":
+        # pre-condition: the silent-freeze episode can leave a loop
+        # frozen-but-IDLE (healthy() true, zero outstanding — the
+        # watchdog ladder only fires once the engine holds work,
+        # docs/health.md), and this episode direct-submits to uni-0,
+        # bypassing the router probes that would otherwise revive it.
+        # The harness plays the operator: restart a loop that stopped
+        # ticking before building on it.
+        from ..serving.health import replica_snapshot
+
+        uni_rep = next(
+            r for r in fleet.coord.replicas if r.name == "uni-0"
+        )
+
+        def _uni_tick_seq():
+            return replica_snapshot(uni_rep).get("tick_seq")
+
+        seq0 = _uni_tick_seq()
+        deadline = time.monotonic() + 1.0
+        while _uni_tick_seq() == seq0 and time.monotonic() < deadline:
+            time.sleep(0.02)
+        if _uni_tick_seq() == seq0:
+            fleet.uni.stop()
+            fleet.uni.start()
+        # warm BOTH store members on the shared chain OUTSIDE the armed
+        # plan: an organic host-overflow demote must not consume the
+        # single owner-death charge before the controlled owner spill
+        pre_results, pre_shed, pre_attempted = _traffic(fleet, **traffic_kw)
+        more, more_shed, more_att = _traffic(fleet, n=2, via="uni")
+        pre_results += more
+        pre_shed += more_shed
+        pre_attempted += more_att
     with active(plan):
+        if name == "prefix-store-owner-death":
+            survivor, extra_violations = _owner_death_spill(fleet)
+            # re-drive the shared prefix at the SURVIVOR: its churned
+            # fast tiers must promote the chain warm from the store
+            base_vol_hits = survivor.tiered.tier_hits["volume"]
+            traffic_kw = {
+                "n": 2, "via": "uni" if survivor is fleet.uni else "coord"
+            }
         if name == "tiered-corrupt":
             # chaos pressure: evict the prefill trie and demote the host
             # tier so the NEXT shared-prefix prompt promotes from the
@@ -361,11 +500,23 @@ def _run_episode(fleet: _Fleet, name: str, spec: dict, seed: int,
             tiered = fleet.pre.tiered
             fleet.pre.prefix_cache.evict(10_000)
             for h, data in list(tiered._host.items()):
-                tiered._demote_to_volume(h, data)
+                # chain=None: chaos applies pressure as a driver — the
+                # block must LAND for the promote-path corruption to fire,
+                # so rendezvous spill ownership is deliberately bypassed
+                tiered.store.put(h, data)
                 with tiered._lock:
                     tiered._host.pop(h, None)
                     tiered._host_used -= len(data)
         results, shed, attempted = _traffic(fleet, **traffic_kw)
+        if name == "prefix-store-owner-death":
+            results = pre_results + results
+            shed += pre_shed
+            attempted += pre_attempted
+            if survivor.tiered.tier_hits["volume"] <= base_vol_hits:
+                extra_violations.append(
+                    "churned chain did not re-promote from the shared "
+                    "store on the surviving replica"
+                )
         if name in ("router-flap", "silent-freeze"):
             # let the down timer lapse, then place again: the re-probe
             # re-admission path (mtpu_router_readmissions_total). For the
@@ -391,6 +542,7 @@ def _run_episode(fleet: _Fleet, name: str, spec: dict, seed: int,
         + check_drained(fleet.engines)
         + check_router_recovered(fleet.coord.router)
         + check_token_identity(results, fleet.reference)
+        + extra_violations
     )
     reasons: dict[str, int] = {}
     for r in results:

@@ -115,6 +115,14 @@ POINTS: dict[str, dict] = {
         "effect": "bytes read from the Volume tier are corrupted",
         "recovery": "corrupt block dropped; prefix KV recomputed",
     },
+    "prefix_store.owner_death": {
+        "component": "serving/prefix_store/store.py",
+        "effect": "the chain's owner replica dies mid-spill: it drops out "
+                  "of the store membership and the write never lands",
+        "recovery": "atomic temp+rename leaves no torn block; rendezvous "
+                    "remaps the chain and the survivor's next spill takes "
+                    "the lease over (journaled owner_takeover)",
+    },
     "executor.container_death": {
         "component": "core/executor.py",
         "effect": "the dispatched container dies while processing",

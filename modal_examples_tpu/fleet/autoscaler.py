@@ -66,6 +66,34 @@ def _role_group(replica) -> str:
     return "prefill" if getattr(replica, "role", "unified") == "prefill" else "decode"
 
 
+def shared_prefix_store(replica):
+    """The replica engine's SHARED prefix store handle, or None. A private
+    (``shared=False``) tier has no fleet membership to manage, so the
+    autoscaler leaves it alone (docs/prefix_store.md)."""
+    tiered = getattr(getattr(replica, "engine", None), "tiered", None)
+    store = getattr(tiered, "store", None)
+    if store is not None and getattr(store, "shared", False):
+        return store
+    return None
+
+
+def _deregister_prefix_store(replica) -> None:
+    """Drop a stopped replica out of the store's membership so its leases
+    expire as dead (survivors take chains over) and its pins stop holding
+    blocks against GC. Best-effort: a failed deregister just means the
+    membership TTL does the same thing later."""
+    store = shared_prefix_store(replica)
+    if store is None:
+        return
+    try:
+        store.deregister_replica()
+    except Exception:
+        logger.warning(
+            "fleet: prefix-store deregister failed for %s",
+            getattr(replica, "name", "?"),
+        )
+
+
 class SnapshotWarmFactory:
     """Replica factory with snapshot-restored warm boots.
 
@@ -144,6 +172,17 @@ class SnapshotWarmFactory:
             with self._lock:
                 if not self.store.has(self.snapshot_key):
                     self._capture(replica.engine.params)
+        # a scale-out joins the SHARED prefix store at boot: membership
+        # makes it a rendezvous owner candidate immediately, and the tier
+        # it promotes from is the fleet's — so a warm-weights boot also
+        # serves its first traffic with a warm prefix hit rate instead of
+        # recomputing prefixes the fleet already paid for
+        pstore = shared_prefix_store(replica)
+        if pstore is not None:
+            try:
+                pstore.register_replica(boot=boot)
+            except Exception:
+                logger.warning("fleet: prefix-store register failed for %s", name)
         return replica, boot
 
 
@@ -203,6 +242,10 @@ class FleetAutoscaler:
         #: stuck 60 s drain window would spam ~120 journal records,
         #: fallback metrics, and failover spans per request
         self._drain_attempts: dict[str, tuple[float, int]] = {}
+        #: last shared-prefix-store heartbeat round (the controller is the
+        #: fleet's one periodic loop, so it keeps every replica's store
+        #: membership alive; throttled — TTL is tens of seconds)
+        self._last_store_heartbeat = 0.0
         self.journal = named_journal("fleet", path=journal_path)
         self._registry = registry if registry is not None else default_registry
         self._slos = (
@@ -371,6 +414,15 @@ class FleetAutoscaler:
         actions: list[dict] = []
         deferred: list[tuple] = []  # (group, trigger, sig) builds to run
         self._reap_drained(now)
+        if now - self._last_store_heartbeat >= 15.0:
+            self._last_store_heartbeat = now
+            for r in self.router.replicas:
+                store = shared_prefix_store(r)
+                if store is not None:
+                    try:
+                        store.heartbeat()
+                    except Exception:
+                        pass
         fleet = self.signals()
         for group in ("decode", "prefill"):
             sig = fleet.get(group)
@@ -459,6 +511,7 @@ class FleetAutoscaler:
                 replica.engine.stop()
             except Exception:
                 logger.warning("fleet: engine stop failed for %s", name)
+            _deregister_prefix_store(replica)
             rec = {
                 "at": time.time(), "action": "scale_up", "trigger": trigger,
                 "role": group, "replica": name, "boot": boot,
@@ -478,6 +531,7 @@ class FleetAutoscaler:
                 replica.engine.stop()
             except Exception:
                 logger.warning("fleet: engine stop failed for %s", name)
+            _deregister_prefix_store(replica)
             raise
         boot_s = time.perf_counter() - t0
         with self._lock:
@@ -634,6 +688,7 @@ class FleetAutoscaler:
                     logger.warning(
                         "fleet: engine stop failed for %s", replica.name
                     )
+                _deregister_prefix_store(replica)
                 if timed_out:
                     _obs.record_fleet_decision(
                         "scale_down", "drain_timeout",

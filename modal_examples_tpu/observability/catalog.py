@@ -195,6 +195,23 @@ PREFIX_TIER_PAGES = "mtpu_prefix_tier_pages"
 #: gauge {tier}: serialized bytes resident per spill tier (host | volume)
 PREFIX_TIER_BYTES = "mtpu_prefix_tier_bytes"
 
+# -- shared prefix store (serving/prefix_store/, docs/prefix_store.md) ------
+
+#: counter {origin}: blocks served by the fleet-shared store; origin =
+#: self (this replica wrote it) | peer (another replica's spill — the
+#: cross-replica warmth the store exists for)
+PREFIX_STORE_HITS_TOTAL = "mtpu_prefix_store_hits_total"
+#: counter: store lookups that found nothing (or a torn block, dropped)
+PREFIX_STORE_MISSES_TOTAL = "mtpu_prefix_store_misses_total"
+#: gauge: logical spill attempts per physical write (> 1.0 = the fleet
+#: stopped paying N copies of shared chains)
+PREFIX_STORE_DEDUP_RATIO = "mtpu_prefix_store_dedup_ratio"
+#: gauge: serialized bytes resident in the shared store
+PREFIX_STORE_BYTES = "mtpu_prefix_store_bytes"
+#: counter: spill leases taken over from a dead/expired owner replica
+#: (journaled in prefix_store.jsonl; the chaos owner-death episode's proof)
+PREFIX_STORE_OWNER_TAKEOVERS_TOTAL = "mtpu_prefix_store_owner_takeovers_total"
+
 # -- fleet autoscaler (modal_examples_tpu/fleet, docs/fleet.md) -------------
 
 #: gauge {role}: replicas currently registered in the fleet, by serving
@@ -569,6 +586,29 @@ CATALOG: dict[str, dict] = {
     PREFIX_TIER_BYTES: {
         "type": "gauge", "labels": ["tier"],
         "help": "serialized bytes resident per spill tier",
+    },
+    PREFIX_STORE_HITS_TOTAL: {
+        "type": "counter", "labels": ["origin"],
+        "help": "shared prefix-store blocks served (origin=self|peer; "
+                "peer = another replica's spill promoted here)",
+    },
+    PREFIX_STORE_MISSES_TOTAL: {
+        "type": "counter", "labels": [],
+        "help": "shared prefix-store lookups that found nothing "
+                "(torn blocks dropped count here too)",
+    },
+    PREFIX_STORE_DEDUP_RATIO: {
+        "type": "gauge", "labels": [],
+        "help": "logical spill attempts per physical store write "
+                "(> 1.0 = cross-replica dedup is paying)",
+    },
+    PREFIX_STORE_BYTES: {
+        "type": "gauge", "labels": [],
+        "help": "serialized bytes resident in the shared prefix store",
+    },
+    PREFIX_STORE_OWNER_TAKEOVERS_TOTAL: {
+        "type": "counter", "labels": [],
+        "help": "spill leases taken over from dead/expired owner replicas",
     },
     FLEET_REPLICAS: {
         "type": "gauge", "labels": ["role"],

@@ -44,6 +44,8 @@ JOURNALS: dict[str, str] = {
     "chaos": "chaos.jsonl",        # chaos episodes (faults/chaos.py)
     "compiles": "compiles.jsonl",  # compile ledger (observability/profiler.py)
     "alerts": "alerts.jsonl",      # alert fire/clear (observability/alerts.py)
+    # shared prefix store: lease takeovers + GC sweeps (serving/prefix_store/)
+    "prefix_store": "prefix_store.jsonl",
 }
 
 

@@ -485,6 +485,55 @@ def set_tier_occupancy(
     )
 
 
+# -- shared prefix store (serving/prefix_store/, docs/prefix_store.md) --------
+
+
+def record_prefix_store_hit(
+    origin: str, *, n: int = 1, registry: Registry | None = None
+) -> None:
+    """``n`` blocks served by the fleet-shared store; ``origin`` is
+    ``"self"`` (this replica's own spill) or ``"peer"`` (another
+    replica's — the cross-replica warmth the store exists for)."""
+    _reg(registry).counter_inc(
+        C.PREFIX_STORE_HITS_TOTAL, float(n),
+        labels={"origin": origin},
+        help=C.CATALOG[C.PREFIX_STORE_HITS_TOTAL]["help"],
+    )
+
+
+def record_prefix_store_miss(
+    *, n: int = 1, registry: Registry | None = None
+) -> None:
+    _reg(registry).counter_inc(
+        C.PREFIX_STORE_MISSES_TOTAL, float(n),
+        help=C.CATALOG[C.PREFIX_STORE_MISSES_TOTAL]["help"],
+    )
+
+
+def set_prefix_store_occupancy(
+    *, total_bytes: int, dedup_ratio: float,
+    registry: Registry | None = None,
+) -> None:
+    reg = _reg(registry)
+    reg.gauge_set(
+        C.PREFIX_STORE_BYTES, float(total_bytes),
+        help=C.CATALOG[C.PREFIX_STORE_BYTES]["help"],
+    )
+    reg.gauge_set(
+        C.PREFIX_STORE_DEDUP_RATIO, float(dedup_ratio),
+        help=C.CATALOG[C.PREFIX_STORE_DEDUP_RATIO]["help"],
+    )
+
+
+def record_prefix_store_takeover(
+    *, registry: Registry | None = None
+) -> None:
+    _reg(registry).counter_inc(
+        C.PREFIX_STORE_OWNER_TAKEOVERS_TOTAL, 1.0,
+        help=C.CATALOG[C.PREFIX_STORE_OWNER_TAKEOVERS_TOTAL]["help"],
+    )
+
+
 # -- hot-path profiler (observability/profiler.py) ----------------------------
 
 

@@ -57,6 +57,16 @@ from ..observability import reqtrace as _rt
 ROLES = ("prefill", "decode", "unified")
 
 
+def rendezvous_score(key: bytes, name: str) -> bytes:
+    """THE fleet's rendezvous (highest-random-weight) score: ``max`` of
+    this over member names picks the owner of ``key``. One function on
+    purpose — request placement (:meth:`PrefixAffinityRouter._preferred`)
+    and prefix-chain spill ownership (:mod:`..serving.prefix_store`) must
+    agree on the hash, so the replica a shared prefix routes to is also
+    the replica that owns spilling it."""
+    return hashlib.sha1(key + name.encode()).digest()
+
+
 class EngineReplica:
     """Adapter: one in-process ``LLMEngine`` as a routable replica."""
 
@@ -289,7 +299,7 @@ class PrefixAffinityRouter:
         """Rendezvous (highest-random-weight) hashing: stable per key, and
         removing a replica only remaps that replica's keys."""
         def score(replica) -> bytes:
-            return hashlib.sha1(key + replica.name.encode()).digest()
+            return rendezvous_score(key, replica.name)
 
         return max(
             candidates if candidates is not None else self.replicas, key=score

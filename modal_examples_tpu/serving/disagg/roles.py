@@ -448,4 +448,15 @@ class DisaggCoordinator:
                 "pages": self.pages_migrated,
                 "bytes": self.bytes_migrated,
             }
-        return {"migrations": mig, "router": self.router.stats()}
+        out = {"migrations": mig, "router": self.router.stats()}
+        # fleet view of the shared prefix store (docs/prefix_store.md):
+        # one row per replica running a tier, so an operator sees dedup
+        # and cross-replica hit attribution side by side
+        stores = {}
+        for r in self.replicas:
+            tiered = getattr(r.engine, "tiered", None)
+            if tiered is not None and getattr(tiered, "store", None) is not None:
+                stores[r.name] = tiered.store.stats()
+        if stores:
+            out["prefix_store"] = stores
+        return out

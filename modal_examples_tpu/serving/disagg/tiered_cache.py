@@ -11,10 +11,14 @@ instead:
   :func:`~.transport.extract_pages` + :func:`~.transport.serialize_block`,
   checksums included) into a bounded host-RAM LRU. Quantized (int8) pages
   spill at ~half the bf16 bytes, so the same budget holds ~2x the blocks.
-- **volume tier** — host-LRU overflow demotes to a
-  :class:`~...storage.volume.Volume` (one file per block, named by content
-  hash), so warm prefixes survive replica churn: a fresh replica promotes
-  yesterday's system prompt from the Volume instead of recomputing it.
+- **volume tier** — host-LRU overflow demotes to the fleet-wide
+  :class:`~..prefix_store.SharedPrefixStore` (content-addressed block
+  files on a :class:`~...storage.volume.Volume`), so warm prefixes survive
+  replica churn AND cross replicas: a fresh replica promotes a system
+  prompt some OTHER replica computed instead of recomputing it. With
+  ``shared=True`` the store adds rendezvous spill ownership + dedup
+  (docs/prefix_store.md); without it, the store runs as this replica's
+  private single-writer tier — same layout, same atomic write discipline.
 
 Keys are CHAINED content hashes (:func:`~.transport.chain_hashes`): block i
 hashes its page's tokens together with block i-1's hash, so a page's
@@ -75,6 +79,9 @@ class TieredPrefixCache:
         host_bytes: int | None = None,
         volume=None,
         volume_prefix: str = "kv-tier",
+        store=None,
+        replica: str | None = None,
+        shared: bool = False,
     ):
         self.cache = cache
         self.prefix_cache = prefix_cache
@@ -87,62 +94,73 @@ class TieredPrefixCache:
             except ValueError:
                 host_bytes = DEFAULT_HOST_BYTES
         self.host_bytes_budget = int(host_bytes)
-        self.volume = volume
-        self.volume_prefix = volume_prefix.strip("/")
+        # the volume tier IS a prefix store (docs/prefix_store.md): pass a
+        # SharedPrefixStore directly (the fleet-shared tier), or a Volume
+        # (a store is built over it — shared=True joins the fleet-wide
+        # store, default is this replica's private single-writer tier)
+        if store is not None:
+            self.store = store
+        elif volume is not None:
+            from ..prefix_store import SharedPrefixStore
+
+            self.store = SharedPrefixStore(
+                volume,
+                replica=replica or f"replica-{os.getpid()}",
+                root=volume_prefix,
+                shared=shared,
+            )
+        else:
+            self.store = None
+        self.volume = self.store.volume if self.store is not None else None
         self._lock = threading.Lock()
         #: trie-resident page id -> chained block hash (spill key material)
         self._by_page: dict[int, str] = {}
+        #: block hash -> its chain's HEAD hash (spill-ownership key: the
+        #: store assigns whole chains, not single blocks, to owners).
+        #: Bounded LRU — demotes can happen long after register
+        self._chain_of: OrderedDict[str, str] = OrderedDict()
         #: host tier: hash -> serialized single-block bytes, LRU order
         self._host: OrderedDict[str, bytes] = OrderedDict()
         self._host_used = 0
-        #: hashes known to exist in the volume tier (process-local view:
-        #: seeded from the volume's directory at init, grown on demote)
-        self._volume_index: dict[str, int] = {}
-        if self.volume is not None:
-            self._seed_volume_index()
         self.tier_hits = {"host": 0, "volume": 0}
         self.spilled = 0
         self.promoted = 0
 
+    #: bound on the block -> chain-head map (LRU; ~64 bytes/entry of hex)
+    CHAIN_MAP_CAP = 65536
+
     # -- bookkeeping ---------------------------------------------------------
-
-    def _seed_volume_index(self) -> None:
-        """Discover blocks a previous replica left behind (the churn-survival
-        path): every ``block-<hash>.kv`` under the prefix is promotable.
-        Sizes start at 0 and fill in lazily on first touch — reading every
-        block at init just for a byte gauge would make engine construction
-        proportional to the tier's size."""
-        try:
-            entries = list(self.volume.listdir(self.volume_prefix))
-        except Exception:
-            return  # prefix directory doesn't exist yet: empty tier
-        for name in entries:
-            base = str(name).rsplit("/", 1)[-1]
-            if base.startswith("block-") and base.endswith(".kv"):
-                self._volume_index[base[len("block-"):-len(".kv")]] = 0
-
-    def _volume_path(self, block_hash: str) -> str:
-        return f"{self.volume_prefix}/block-{block_hash}.kv"
 
     def _emit_gauges_locked(self) -> None:
         _obs.set_tier_occupancy(
             "host", pages=len(self._host), total_bytes=self._host_used
         )
-        if self.volume is not None:
+        if self.store is not None:
             _obs.set_tier_occupancy(
                 "volume",
-                pages=len(self._volume_index),
-                total_bytes=sum(self._volume_index.values()),
+                pages=self.store.n_blocks,
+                total_bytes=self.store.total_bytes,
             )
 
     def register(self, key_tokens: list, trie_pages: list) -> None:
         """Record the chained hash of every trie-resident full-prompt page
         (called after ``PrefixCache.insert``), so a later eviction knows
-        what content each physical page holds."""
+        what content each physical page holds — and pin the chain in the
+        shared store (this replica's refcount: GC keeps blocks any live
+        replica may still promote)."""
         hashes = chain_hashes(key_tokens, self.cache.page_size)
+        if not hashes:
+            return
         with self._lock:
             for pid, h in zip(trie_pages, hashes):
                 self._by_page[pid] = h
+            for h in hashes:
+                self._chain_of.pop(h, None)
+                self._chain_of[h] = hashes[0]
+            while len(self._chain_of) > self.CHAIN_MAP_CAP:
+                self._chain_of.popitem(last=False)
+        if self.store is not None:
+            self.store.pin(hashes)
 
     # -- spill (HBM -> host -> volume) ---------------------------------------
 
@@ -197,15 +215,17 @@ class TieredPrefixCache:
             self._demote_to_volume(old_hash, old_data)
 
     def _demote_to_volume(self, block_hash: str, data: bytes) -> None:
-        if self.volume is None:
-            return
-        try:
-            self.volume.write_file(self._volume_path(block_hash), data)
-        except Exception as e:
-            _log.warning("volume demote of %s failed: %s", block_hash, e)
+        if self.store is None:
             return
         with self._lock:
-            self._volume_index[block_hash] = len(data)
+            chain = self._chain_of.get(block_hash)
+        try:
+            self.store.put(block_hash, data, chain=chain)
+        except Exception as e:
+            # includes the injected owner-death crash: the spill is simply
+            # lost here (atomic writes: no torn block lands), and either a
+            # surviving replica's spill or a later recompute rewrites it
+            _log.warning("volume demote of %s failed: %s", block_hash, e)
 
     # -- promote (volume -> host -> HBM) -------------------------------------
 
@@ -217,20 +237,16 @@ class TieredPrefixCache:
             return data
 
     def _lookup_volume(self, block_hash: str):
-        if self.volume is None:
+        if self.store is None:
             return None
-        try:
-            data = self.volume.read_file(self._volume_path(block_hash))
-        except Exception:
+        data = self.store.get(block_hash)
+        if data is None:
             return None
-        # fault point (docs/faults.md): the volume's bytes rot — promote's
-        # crc check drops the block and prefill recomputes it; the stored
-        # file is untouched, so a later promote can still succeed
-        data = _inject.corrupt("tiered.volume_corrupt", data)
-        with self._lock:
-            # lazily fill the size the seeding pass skipped (byte gauge)
-            self._volume_index[block_hash] = len(data)
-        return data
+        # fault point (docs/faults.md): the volume's bytes rot IN FLIGHT —
+        # promote's crc check drops the block and prefill recomputes it;
+        # the stored file is untouched (store.drop_if_corrupt proves that
+        # before ever deleting), so a later promote can still succeed
+        return _inject.corrupt("tiered.volume_corrupt", data)
 
     def promote(self, key_tokens: list, *, n_have: int) -> list:
         """Restore consecutive full-prompt pages past the trie's
@@ -260,18 +276,26 @@ class TieredPrefixCache:
                     stale = self._host.pop(block_hash, None)
                     if stale is not None:
                         self._host_used -= len(stale)
+                if tier == "volume" and self.store is not None:
+                    # torn/rotten ON DISK -> removed so the recompute's
+                    # spill rewrites it; corrupted in flight -> kept
+                    self.store.drop_if_corrupt(block_hash)
                 break
             if block.kv_dtype != self.cache.kv_dtype:
                 break  # cache was rebuilt at a different dtype: stale tier
+            n_pages = block.n_pages
             try:
-                page = self.cache.allocator.alloc(1)
+                pages = self.cache.allocator.alloc(n_pages)
             except Exception:
                 break  # no room to promote into; callers alloc what's left
-            adopt_pages(self.cache, block, page)
-            out.append(page[0])
-            self.tier_hits[tier] += 1
-            by_tier[tier] += 1
-            _obs.record_tier_hit(tier)
+            adopt_pages(self.cache, block, pages)
+            out.extend(pages)
+            # PAGE units, like every other tier counter (a block is one
+            # page today, but hit-rate/dedup math must not silently break
+            # the day multi-page blocks ship over the same codec)
+            self.tier_hits[tier] += n_pages
+            by_tier[tier] += n_pages
+            _obs.record_tier_hit(tier, n=n_pages)
             if tier == "volume":
                 # promote the bytes up a tier too: next hit is RAM-speed
                 self._host_put(block_hash, data)
@@ -290,19 +314,27 @@ class TieredPrefixCache:
 
     def stats(self) -> dict:
         with self._lock:
-            return {
+            out = {
                 "host": {
                     "blocks": len(self._host),
                     "bytes": self._host_used,
                     "budget_bytes": self.host_bytes_budget,
                 },
                 "volume": {
-                    "blocks": len(self._volume_index),
-                    "bytes": sum(self._volume_index.values()),
-                    "enabled": self.volume is not None,
+                    "blocks": (
+                        self.store.n_blocks if self.store is not None else 0
+                    ),
+                    "bytes": (
+                        self.store.total_bytes
+                        if self.store is not None else 0
+                    ),
+                    "enabled": self.store is not None,
                 },
                 "hits": dict(self.tier_hits),
                 "spilled": self.spilled,
                 "promoted": self.promoted,
                 "registered_pages": len(self._by_page),
             }
+        if self.store is not None:
+            out["store"] = self.store.stats()
+        return out

@@ -110,15 +110,18 @@ class Volume:
     # -- convenience API (modeled on modal's volume file API) ----------------
 
     def listdir(self, path: str = "/", recursive: bool = False):
-        base = self._resolve(path)
+        # dotfiles are volume internals (.version, in-flight .tmp-* atomic
+        # writes) — listing them would hand readers a torn file
         if recursive:
-            for root, _dirs, files in os.walk(base):
-                for f in files:
+            for root, _dirs, files in os.walk(self._resolve(path)):
+                for f in sorted(files):
+                    if f.startswith("."):
+                        continue
                     full = Path(root) / f
                     yield str(full.relative_to(self._path))
         else:
-            for entry in sorted(base.iterdir()):
-                if entry.name.startswith(".version"):
+            for entry in sorted(self._resolve(path).iterdir()):
+                if entry.name.startswith("."):
                     continue
                 yield str(entry.relative_to(self._path))
 
@@ -126,9 +129,25 @@ class Volume:
         return self._resolve(path).read_bytes()
 
     def write_file(self, path: str, data: bytes) -> None:
+        """Atomic durable write: uuid temp file, fsync, rename. A crash at
+        ANY point leaves either the old content or the new — never a torn
+        file that passes a size check (the KV spill tier and the shared
+        prefix store both lean on this; a torn block would otherwise only
+        be caught at crc time, after a wasted read)."""
+        import uuid
+
         p = self._resolve(path)
         p.parent.mkdir(parents=True, exist_ok=True)
-        p.write_bytes(data)
+        tmp = p.parent / f".tmp-{uuid.uuid4().hex}-{p.name}"
+        try:
+            with open(tmp, "wb") as f:
+                f.write(data)
+                f.flush()
+                os.fsync(f.fileno())
+            os.replace(tmp, p)
+        except BaseException:
+            tmp.unlink(missing_ok=True)
+            raise
 
     def remove_file(self, path: str, recursive: bool = False) -> None:
         import shutil

@@ -51,6 +51,11 @@ METRICS: list[tuple[str, bool, str]] = [
     # single replica cannot serve
     ("fleet.goodput", False, "ratio"),
     ("fleet.p99_tpot_at_knee", True, "ratio"),
+    # fleet-wide shared prefix store (docs/prefix_store.md): a COLD
+    # replica's TTFT tail over a shared-prefix corpus another replica
+    # already spilled — a regression means cross-replica promotion
+    # stopped paying and cold replicas recompute prefills again
+    ("fleet.shared_prefix_ttft_p95", True, "ratio"),
     # in-flight failover (docs/failover.md): the client-observed takeover
     # tail — how long a stream stalls when its replica dies before a
     # healthy peer resumes it token-identically

@@ -169,6 +169,31 @@ def _chaos_snapshot(last: int = 10) -> dict:
     }
 
 
+def _prefixstore_snapshot(last: int = 10) -> dict:
+    """Shared prefix-store snapshot: fleet-wide dedup/hit/takeover
+    counters (live registry) plus the newest ownership records from the
+    ``prefix_store`` journal — the ``/prefixstore`` route's payload
+    (``tpurun prefixstore`` renders the same data from pushed metrics +
+    the journal; docs/prefix_store.md)."""
+    from ..observability import catalog as C
+    from ..observability.journal import named_journal
+    from ..utils.prometheus import default_registry as reg
+
+    hits = {
+        labels.get("origin", "?"): v
+        for labels, v in reg.series(C.PREFIX_STORE_HITS_TOTAL)
+    }
+    return {
+        "hits": hits,
+        "hits_total": sum(hits.values()),
+        "misses": reg.total(C.PREFIX_STORE_MISSES_TOTAL),
+        "dedup_ratio": reg.total(C.PREFIX_STORE_DEDUP_RATIO),
+        "bytes": reg.total(C.PREFIX_STORE_BYTES),
+        "owner_takeovers": reg.total(C.PREFIX_STORE_OWNER_TAKEOVERS_TOTAL),
+        "journal": named_journal("prefix_store").tail(last),
+    }
+
+
 def _alerts_snapshot(last: int = 20) -> dict:
     """Alert-rule snapshot: per-rule firing state — from the live
     evaluator when this process runs the tsdb sampler, else a one-shot
@@ -372,7 +397,9 @@ class _Handler(BaseHTTPRequestHandler):
         (the autoscaler decision journal), ``/disagg`` (replica roles,
         migration counters, prefix-tier occupancy — docs/disagg.md),
         ``/chaos`` (injected-fault counters + episode journal —
-        docs/faults.md), ``/fleet`` (fleet-autoscaler replica counts,
+        docs/faults.md), ``/prefixstore`` (shared prefix-store dedup,
+        hit-origin, takeover counters + ownership journal —
+        docs/prefix_store.md), ``/fleet`` (fleet-autoscaler replica counts,
         decisions, boot latencies + journal — docs/fleet.md), and
         ``/health`` (gray-failure watchdog: per-replica progress
         classification, watermark ages, ladder decisions —
@@ -389,7 +416,8 @@ class _Handler(BaseHTTPRequestHandler):
         label = parts[0] if parts else ""
         if method != "GET" or label not in (
             "metrics", "traces", "healthz", "autoscaler", "disagg", "chaos",
-            "fleet", "health", "profile", "alerts", "incidents",
+            "prefixstore", "fleet", "health", "profile", "alerts",
+            "incidents",
         ):
             return False
         if label == "alerts":
@@ -486,6 +514,17 @@ class _Handler(BaseHTTPRequestHandler):
             except ValueError:
                 n = 10
             self._respond_json(200, _chaos_snapshot(last=n))
+            return True
+        if label == "prefixstore":
+            q = {
+                k: v[-1]
+                for k, v in urllib.parse.parse_qs(parsed.query).items()
+            }
+            try:
+                n = int(q.get("n", 10))
+            except ValueError:
+                n = 10
+            self._respond_json(200, _prefixstore_snapshot(last=n))
             return True
         if label == "healthz":
             from ..observability.slo import healthz

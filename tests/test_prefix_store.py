@@ -1,0 +1,516 @@
+"""Fleet-wide shared prefix store (docs/prefix_store.md).
+
+Unit layers (no jax: blocks are numpy-built MTKV1 envelopes): content-
+addressed dedup, torn/corrupt handling, legacy-layout adoption, rendezvous
+ownership + lease takeover, bounded refcounted GC, and the satellite-3
+concurrent-writer contract (two replicas spill the same chain, ONE copy
+survives, promotes bit-identical for bf16 and int8's 4-leaf form).
+
+E2E layer (tiny engines): a cold replica serves another replica's spilled
+corpus token-identically (greedy AND seeded, bf16 and int8), and
+``SnapshotWarmFactory`` scale-outs register with the store and boot with a
+non-zero store hit rate.
+"""
+
+import json
+import os
+
+import numpy as np
+import pytest
+
+from modal_examples_tpu.serving.disagg.transport import (
+    PageBlock,
+    chain_hashes,
+    deserialize_block,
+    serialize_block,
+)
+from modal_examples_tpu.serving.prefix_store import SharedPrefixStore
+from modal_examples_tpu.serving.prefix_store.ownership import (
+    LeaseBoard,
+    rendezvous_owner,
+)
+from modal_examples_tpu.serving.prefix_store.store import block_file
+from modal_examples_tpu.storage.volume import Volume
+
+
+@pytest.fixture(scope="module")
+def jax(jax_cpu):
+    return jax_cpu
+
+
+# -- fixtures: numpy MTKV1 blocks (what a replica's spill serializes) --------
+
+
+def _np_block(seed: int, kv_dtype: str = "bf16") -> PageBlock:
+    """One page's worth of leaves. ``int8`` uses the quantized cache's
+    4-leaf form (k/v int8 + per-row scales) — the codec must carry all
+    four bit-exactly."""
+    rng = np.random.default_rng(seed)
+    if kv_dtype == "int8":
+        leaves = {
+            "k_pages": rng.integers(-128, 127, (2, 1, 8, 2, 4), np.int8),
+            "v_pages": rng.integers(-128, 127, (2, 1, 8, 2, 4), np.int8),
+            "k_scale": rng.random((2, 1, 8, 2), np.float32),
+            "v_scale": rng.random((2, 1, 8, 2), np.float32),
+        }
+    else:
+        leaves = {
+            "k_pages": rng.random((2, 1, 8, 2, 4), np.float32),
+            "v_pages": rng.random((2, 1, 8, 2, 4), np.float32),
+        }
+    return PageBlock(leaves=leaves, page_size=8, kv_dtype=kv_dtype)
+
+
+def _chain(n_pages: int, page_size: int = 8, salt: int = 0) -> list:
+    tokens = [(salt * 7 + i) % 251 for i in range(n_pages * page_size)]
+    return chain_hashes(tokens, page_size)
+
+
+@pytest.fixture()
+def vol():
+    with Volume.ephemeral() as v:
+        yield v
+
+
+class TestStoreCore:
+    def test_put_get_roundtrip_and_self_origin(self, vol):
+        s = SharedPrefixStore(vol, replica="a", shared=False)
+        data = serialize_block(_np_block(0))
+        assert s.put("h0", data) == "written"
+        assert s.get("h0") == data
+        assert s.hits == {"self": 1, "peer": 0}
+        # the read deserializes clean: crc-checked leaves, same arrays
+        block = deserialize_block(data)
+        np.testing.assert_array_equal(
+            block.leaves["k_pages"], _np_block(0).leaves["k_pages"]
+        )
+
+    def test_second_put_dedups(self, vol):
+        s = SharedPrefixStore(vol, replica="a", shared=False)
+        data = serialize_block(_np_block(1))
+        assert s.put("h1", data) == "written"
+        assert s.put("h1", data) == "dedup"
+        assert s.writes == 1 and s.dedup_skips == 1
+        assert s.dedup_ratio() == 2.0
+
+    def test_peer_origin_and_cross_instance_dedup(self, vol):
+        a = SharedPrefixStore(vol, replica="a", shared=False)
+        b = SharedPrefixStore(vol, replica="b", shared=False)
+        data = serialize_block(_np_block(2))
+        assert a.put("h2", data) == "written"
+        # b never wrote it, but the fleet has it: dedup + peer-origin hit
+        assert b.put("h2", data) == "dedup"
+        assert b.get("h2") == data
+        assert b.hits == {"self": 0, "peer": 1}
+        assert a.get("h2") is not None
+        assert a.hits["self"] == 1
+
+    def test_torn_block_dropped_not_served(self, vol):
+        s = SharedPrefixStore(vol, replica="a", shared=False)
+        data = serialize_block(_np_block(3))
+        s.put("h3", data)
+        # tear the stored file (a non-atomic writer's crash artifact)
+        path = vol.local_path / s.root / block_file("h3")
+        path.write_bytes(data[: len(data) // 2])
+        assert s.get("h3") is None
+        assert s.misses == 1 and s.invalidated == 1
+        assert not path.exists(), "torn block must be removed, not retried"
+
+    def test_corrupt_on_disk_dropped_inflight_kept(self, vol):
+        s = SharedPrefixStore(vol, replica="a", shared=False)
+        data = serialize_block(_np_block(4))
+        s.put("h4", data)
+        # intact stored bytes: drop_if_corrupt must NOT throw them away
+        assert s.drop_if_corrupt("h4") is False
+        assert s.get("h4") == data
+        # rot the payload on disk (structurally sound, crc fails)
+        path = vol.local_path / s.root / block_file("h4")
+        raw = bytearray(data)
+        raw[-1] ^= 0xFF
+        path.write_bytes(bytes(raw))
+        assert s.drop_if_corrupt("h4") is True
+        assert not path.exists()
+
+    def test_legacy_flat_layout_adopted_read_only(self, vol):
+        # a pre-store private tier left flat <root>/block-<h>.kv files
+        data = serialize_block(_np_block(5))
+        vol.write_file("kv-tier/block-legacy0.kv", data)
+        s = SharedPrefixStore(vol, replica="a", root="kv-tier", shared=False)
+        assert s.exists("legacy0")
+        assert s.get("legacy0") == data
+        # new writes land in the content-addressed layout, never flat
+        s.put("h5", serialize_block(_np_block(6)))
+        assert (vol.local_path / "kv-tier" / block_file("h5")).exists()
+
+    def test_peer_invalidation_is_observed(self, vol):
+        """A peer's invalidate (torn/corrupt drop) must not leave stale
+        presence in another replica's index — a stale dedup-skip would
+        mean the block is never respilled fleet-wide."""
+        a = SharedPrefixStore(vol, replica="a", shared=False)
+        b = SharedPrefixStore(vol, replica="b", shared=False)
+        data = serialize_block(_np_block(7))
+        a.put("h7", data)
+        assert b.exists("h7")
+        a.invalidate("h7")
+        assert not b.exists("h7")
+        assert b.put("h7", data) == "written", (
+            "the respill must write, not dedup against a ghost"
+        )
+
+    def test_atomic_writes_leave_no_temp_files(self, vol):
+        s = SharedPrefixStore(vol, replica="a", shared=False)
+        for i in range(4):
+            s.put(f"h8-{i}", serialize_block(_np_block(10 + i)))
+        blocks_dir = vol.local_path / s.root / "blocks"
+        stray = [p for p in blocks_dir.iterdir() if p.name.startswith(".")]
+        assert stray == [], f"dot-temp files survived the rename: {stray}"
+
+
+class TestOwnership:
+    def test_rendezvous_owner_is_deterministic_and_spreads(self, vol):
+        names = ["rep-a", "rep-b", "rep-c"]
+        chains = [_chain(1, salt=i)[0] for i in range(32)]
+        owners = [rendezvous_owner(c, names) for c in chains]
+        assert owners == [rendezvous_owner(c, names) for c in chains]
+        assert len(set(owners)) > 1, "32 chains all mapped to one owner"
+        assert rendezvous_owner(chains[0], []) is None
+
+    def test_membership_ttl(self, vol):
+        now = [100.0]
+        a = LeaseBoard(vol, "ps", "a", clock=lambda: now[0])
+        b = LeaseBoard(vol, "ps", "b", clock=lambda: now[0])
+        a.register()
+        b.register()
+        assert a.alive_replicas() == ["a", "b"]
+        now[0] += 61.0  # past DEFAULT_REPLICA_TTL_S
+        a.register()  # only a refreshes
+        assert a.alive_replicas() == ["a"]
+        a.deregister()
+        assert b.alive_replicas() == []
+
+    def test_lease_refused_while_live_owner_holds(self, vol):
+        now = [100.0]
+        a = LeaseBoard(vol, "ps", "a", clock=lambda: now[0])
+        b = LeaseBoard(vol, "ps", "b", clock=lambda: now[0])
+        a.register()
+        b.register()
+        chain = _chain(1)[0]
+        assert a.acquire(chain) is True
+        assert b.acquire(chain) is False
+        assert b.takeovers == 0
+        # the holder re-acquiring refreshes, never counts as takeover
+        assert a.acquire(chain) is True
+        assert a.takeovers == 0
+
+    def test_takeover_on_dead_owner_is_counted_and_journaled(
+        self, vol, state_dir
+    ):
+        now = [100.0]
+        a = LeaseBoard(vol, "ps", "a", clock=lambda: now[0])
+        b = LeaseBoard(vol, "ps", "b", clock=lambda: now[0])
+        a.register()
+        b.register()
+        chain = _chain(1, salt=1)[0]
+        assert a.acquire(chain)
+        a.deregister()  # the owner-death path deregisters before dying
+        assert b.acquire(chain) is True
+        assert b.takeovers == 1
+        assert b.lease_of(chain)["owner"] == "b"
+        recs = [
+            json.loads(line)
+            for line in (state_dir / "prefix_store.jsonl")
+            .read_text().splitlines()
+        ]
+        mine = [
+            r for r in recs
+            if r.get("action") == "owner_takeover" and r.get("chain") == chain
+        ]
+        assert mine and mine[-1]["from"] == "a" and mine[-1]["to"] == "b"
+        assert mine[-1]["reason"] == "owner_dead"
+
+    def test_takeover_on_expired_lease(self, vol):
+        now = [100.0]
+        a = LeaseBoard(vol, "ps", "a", clock=lambda: now[0],
+                       lease_ttl_s=5.0, replica_ttl_s=1000.0)
+        b = LeaseBoard(vol, "ps", "b", clock=lambda: now[0],
+                       lease_ttl_s=5.0, replica_ttl_s=1000.0)
+        a.register()
+        b.register()
+        chain = _chain(1, salt=2)[0]
+        assert a.acquire(chain)
+        now[0] += 6.0  # owner alive but wedged past its lease
+        assert b.acquire(chain) is True
+        assert b.takeovers == 1
+
+    def test_release_never_steals(self, vol):
+        now = [100.0]
+        a = LeaseBoard(vol, "ps", "a", clock=lambda: now[0])
+        b = LeaseBoard(vol, "ps", "b", clock=lambda: now[0])
+        a.register()
+        b.register()
+        chain = _chain(1, salt=3)[0]
+        a.acquire(chain)
+        b.release(chain)  # not b's lease: must be a no-op
+        assert a.lease_of(chain)["owner"] == "a"
+        a.release(chain)
+        assert a.lease_of(chain) is None
+
+
+class TestGC:
+    def _store(self, vol, name="a", **kw):
+        return SharedPrefixStore(vol, replica=name, shared=False, **kw)
+
+    def test_lru_sweep_is_bounded_and_skips_pins(self, vol):
+        s = self._store(vol)
+        data = serialize_block(_np_block(20))
+        for i in range(6):
+            s.put(f"g{i}", data)
+            # stamp strictly increasing mtimes: g0 oldest
+            path = vol.local_path / s.root / block_file(f"g{i}")
+            os.utime(path, (1000.0 + i, 1000.0 + i))
+        s.unpin([f"g{i}" for i in range(6)])
+        s.pin(["g0", "g1"])  # oldest two are referenced
+        out = s.gc(max_blocks=2, max_remove=2)
+        # bounded: 2 removals max, oldest UNPINNED first (g2, g3)
+        assert out["removed"] == 2
+        assert not s.exists("g2") and not s.exists("g3")
+        assert s.exists("g0") and s.exists("g1")
+        out = s.gc(max_blocks=2, max_remove=64)
+        assert s.exists("g0") and s.exists("g1"), "pins survive every sweep"
+        assert out["blocks"] == 2
+
+    def test_hit_refreshes_lru_age(self, vol):
+        s = self._store(vol)
+        data = serialize_block(_np_block(21))
+        for i in range(3):
+            s.put(f"t{i}", data)
+            path = vol.local_path / s.root / block_file(f"t{i}")
+            os.utime(path, (2000.0 + i, 2000.0 + i))
+        s.unpin(["t0", "t1", "t2"])
+        assert s.get("t0") is not None  # touch: t0 becomes newest
+        out = s.gc(max_blocks=2, max_remove=64)
+        assert out["removed"] == 1
+        assert s.exists("t0") and not s.exists("t1")
+
+    def test_live_peer_pins_protect_cross_replica(self, vol):
+        a = SharedPrefixStore(vol, replica="a", shared=True)
+        b = SharedPrefixStore(vol, replica="b", shared=True)
+        data = serialize_block(_np_block(22))
+        a.put("p0", data, chain=None)
+        a.pin(["p0"])
+        b.unpin(["p0"])
+        out = b.gc(max_blocks=0, max_remove=64)
+        assert out["removed"] == 0 and b.exists("p0"), (
+            "a LIVE peer's refs manifest must protect its blocks"
+        )
+        a.deregister_replica()  # scale-in: a's pins no longer count
+        out = b.gc(max_blocks=0, max_remove=64)
+        assert out["removed"] == 1 and not b.exists("p0")
+
+
+class TestConcurrentWriters:
+    """Satellite 3: two replicas spill the SAME chain concurrently."""
+
+    @pytest.mark.parametrize("kv_dtype", ["bf16", "int8"])
+    def test_one_copy_survives_bit_identical(self, vol, kv_dtype):
+        a = SharedPrefixStore(vol, replica="rep-a", shared=True)
+        b = SharedPrefixStore(vol, replica="rep-b", shared=True)
+        hashes = _chain(4, salt=5)
+        chain = hashes[0]
+        payloads = {
+            h: serialize_block(_np_block(30 + i, kv_dtype))
+            for i, h in enumerate(hashes)
+        }
+        owner = a.owner_for(chain)
+        first, second = (a, b) if owner == "rep-a" else (b, a)
+        for h in hashes:
+            assert first.put(h, payloads[h], chain=chain) == "written"
+        # the concurrent non-owner's spill of the same chain: every put
+        # skips — the fleet already has the copy
+        for h in hashes:
+            assert second.put(h, payloads[h], chain=chain) == "dedup"
+        blocks_dir = vol.local_path / a.root / "blocks"
+        files = sorted(p.name for p in blocks_dir.iterdir())
+        assert files == sorted(
+            block_file(h).split("/")[-1] for h in hashes
+        ), "exactly one physical copy per block"
+        # BOTH replicas promote the stored bytes bit-identically
+        for reader in (a, b):
+            for h in hashes:
+                got = reader.get(h)
+                assert got == payloads[h]
+                blk = deserialize_block(got)
+                ref = deserialize_block(payloads[h])
+                for name in ref.leaves:
+                    np.testing.assert_array_equal(
+                        blk.leaves[name], ref.leaves[name]
+                    )
+
+    def test_non_owner_defers_fresh_chains(self, vol):
+        a = SharedPrefixStore(vol, replica="rep-a", shared=True)
+        b = SharedPrefixStore(vol, replica="rep-b", shared=True)
+        hashes = _chain(2, salt=6)
+        chain = hashes[0]
+        owner = a.owner_for(chain)
+        non_owner = b if owner == "rep-a" else a
+        data = serialize_block(_np_block(40))
+        # nothing stored yet: the non-owner DEFERS (the owner will spill
+        # its own copy) instead of racing the write
+        assert non_owner.put(hashes[0], data, chain=chain) == "deferred"
+        assert non_owner.writes == 0
+
+    def test_gc_keeps_chain_while_either_replica_pins(self, vol):
+        a = SharedPrefixStore(vol, replica="rep-a", shared=True)
+        b = SharedPrefixStore(vol, replica="rep-b", shared=True)
+        hashes = _chain(3, salt=7)
+        for i, h in enumerate(hashes):
+            a.put(h, serialize_block(_np_block(50 + i)), chain=None)
+        a.pin(hashes)
+        b.unpin(hashes)
+        assert b.gc(max_blocks=0, max_remove=64)["removed"] == 0
+        a.unpin(hashes)
+        b.pin(hashes)
+        assert a.gc(max_blocks=0, max_remove=64)["removed"] == 0
+        a.unpin(hashes)
+        b.unpin(hashes)
+        assert a.gc(max_blocks=0, max_remove=64)["removed"] == 3
+
+
+# -- E2E: engines over one shared store --------------------------------------
+
+
+PROMPT = "the shared system prompt every fleet tenant reuses verbatim!"
+
+
+def _tiny_engine(jax, tiered_prefix, seed=0, **kw):
+    from modal_examples_tpu.models import llama
+    from modal_examples_tpu.serving import LLMEngine
+
+    kw.setdefault("max_slots", 2)
+    kw.setdefault("max_model_len", 96)
+    kw.setdefault("page_size", 8)
+    kw.setdefault("prefill_buckets", (32, 64))
+    return LLMEngine(
+        llama.LlamaConfig.tiny(), seed=seed, tiered_prefix=tiered_prefix,
+        **kw,
+    )
+
+
+def _spill_all(engine) -> None:
+    """Evict the trie and demote every host block to the store (the same
+    lever chaos + bench use to make spills deterministic)."""
+    t = engine.tiered
+    engine.prefix_cache.evict(10_000)
+    with t._lock:
+        items = list(t._host.items())
+    for h, data in items:
+        t._demote_to_volume(h, data)
+        with t._lock:
+            t._host.pop(h, None)
+            t._host_used -= len(data)
+
+
+class TestPrefixStoreE2E:
+    @pytest.mark.parametrize(
+        "kv_dtype,params_kw",
+        [
+            ("bf16", {"temperature": 0.0}),
+            ("bf16", {"temperature": 0.8, "seed": 7}),
+            ("int8", {"temperature": 0.0}),
+            ("int8", {"temperature": 0.8, "seed": 7}),
+        ],
+    )
+    def test_cold_replica_serves_peer_spills_token_identical(
+        self, jax, vol, kv_dtype, params_kw
+    ):
+        from modal_examples_tpu.serving import SamplingParams
+
+        params = SamplingParams(max_tokens=6, **params_kw)
+        tp = {"host_bytes": 1 << 20, "volume": vol, "shared": True}
+        a = _tiny_engine(
+            jax, dict(tp, replica="rep-a"), kv_dtype=kv_dtype
+        )
+        try:
+            ref = a.generate(PROMPT, params)
+            # sole member: rep-a owns every chain, the spill all lands
+            _spill_all(a)
+            assert a.tiered.store.writes > 0
+        finally:
+            a.stop()
+        b = _tiny_engine(
+            jax, dict(tp, replica="rep-b"), kv_dtype=kv_dtype
+        )
+        try:
+            out = b.generate(PROMPT, params)
+        finally:
+            b.stop()
+        assert out == ref, "promoted pages must be token-identical"
+        st = b.tiered.store.stats()
+        assert b.tiered.tier_hits["volume"] > 0
+        assert st["hits"]["peer"] > 0, (
+            "the cold replica must hit blocks ANOTHER replica wrote"
+        )
+
+    def test_tier_hit_metric_counts_pages_not_blocks(self, jax, vol):
+        """Satellite 2: promote's tier-hit counters are PAGE units —
+        comparable with the hbm counter — not block counts."""
+        from modal_examples_tpu.serving import SamplingParams
+
+        tp = {"host_bytes": 1 << 20, "volume": vol, "shared": True}
+        a = _tiny_engine(jax, dict(tp, replica="rep-a"))
+        try:
+            a.generate(PROMPT, SamplingParams(max_tokens=4, temperature=0.0))
+            _spill_all(a)
+        finally:
+            a.stop()
+        b = _tiny_engine(jax, dict(tp, replica="rep-b"))
+        try:
+            b.generate(PROMPT, SamplingParams(max_tokens=4, temperature=0.0))
+            assert b.tiered.tier_hits["volume"] > 0
+            assert b.tiered.tier_hits["volume"] == b.tiered.promoted, (
+                "volume tier hits must count promoted PAGES (the unit "
+                "promoted counts), not lookup calls or blocks"
+            )
+        finally:
+            b.stop()
+
+    def test_scale_out_registers_and_boots_with_store_hits(self, jax, vol):
+        """A SnapshotWarmFactory scale-out joins the store membership at
+        boot and serves the fleet's warm corpus from the store."""
+        from modal_examples_tpu.fleet import SnapshotWarmFactory
+        from modal_examples_tpu.scheduling import EngineReplica
+        from modal_examples_tpu.serving import SamplingParams
+
+        params = SamplingParams(max_tokens=4, temperature=0.0)
+        tp = {"host_bytes": 1 << 20, "volume": vol, "shared": True}
+        primary = _tiny_engine(jax, dict(tp, replica="primary"))
+        try:
+            ref = primary.generate(PROMPT, params)
+            _spill_all(primary)
+
+            def build(name, role, params=None):
+                eng = _tiny_engine(jax, dict(tp, replica=name))
+                return EngineReplica(eng, name, role=role)
+
+            factory = SnapshotWarmFactory(
+                build, snapshot_key="test-prefix-store-scaleout"
+            )
+            factory.prime(primary)
+            replica, _boot = factory("scale-1", "decode")
+            try:
+                store = replica.engine.tiered.store
+                assert "scale-1" in store.alive_replicas(), (
+                    "the factory must register scale-outs with the store"
+                )
+                out = replica.engine.generate(PROMPT, params)
+                assert out == ref
+                st = store.stats()
+                assert (
+                    st["hits"]["peer"] > 0
+                    or replica.engine.tiered.tier_hits["volume"] > 0
+                ), "a scale-out must boot with a non-zero store hit rate"
+            finally:
+                replica.engine.stop()
+                factory.store.delete("test-prefix-store-scaleout")
+        finally:
+            primary.stop()

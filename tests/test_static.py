@@ -1429,3 +1429,93 @@ def test_journals_resolve_only_through_named_journal():
                 f"{path.relative_to(PKG_ROOT)} hand-builds journal path "
                 f"{name!r} outside the JOURNALS table"
             )
+
+
+def test_prefix_store_series_declared_and_emitted():
+    """Closure for the ``mtpu_prefix_store_*`` series, both directions
+    (the fleet-series guard's pattern): the package-wide name guard
+    already rejects an UNDECLARED series; this adds the reverse — every
+    declared prefix-store catalog constant must be referenced by a live
+    emitter/reader, AND every prefix-store recorder in
+    observability/metrics.py must have a call site outside metrics.py
+    (a recorder nothing calls means a series that silently stopped
+    flowing to the CLI, gateway, and docs table)."""
+    from modal_examples_tpu.observability import catalog
+
+    consts = {
+        attr: val
+        for attr, val in vars(catalog).items()
+        if isinstance(val, str) and val.startswith("mtpu_prefix_store_")
+    }
+    assert len(consts) >= 5, consts
+    catalog_path = PKG_ROOT / "observability" / "catalog.py"
+    package_src = {
+        path: path.read_text()
+        for path in sorted(PKG_ROOT.rglob("*.py"))
+        if path != catalog_path
+    }
+    unused = [
+        attr for attr in consts
+        if not any(
+            re.search(rf"\b{attr}\b", src) for src in package_src.values()
+        )
+    ]
+    assert not unused, (
+        "prefix-store series declared in the catalog but never referenced "
+        f"by an emitter/reader in the package: {unused}"
+    )
+    metrics_path = PKG_ROOT / "observability" / "metrics.py"
+    recorders = (
+        "record_prefix_store_hit", "record_prefix_store_miss",
+        "set_prefix_store_occupancy", "record_prefix_store_takeover",
+    )
+    orphans = [
+        fn for fn in recorders
+        if not any(
+            re.search(rf"\b{fn}\(", src)
+            for path, src in package_src.items()
+            if path != metrics_path
+        )
+    ]
+    assert not orphans, (
+        f"prefix-store recorders with no call site outside metrics.py: "
+        f"{orphans}"
+    )
+
+
+def test_prefix_store_is_sole_writer_of_block_layout():
+    """LAYERING (docs/prefix_store.md): ``serving/prefix_store/`` is the
+    ONLY package code that spells the store's on-volume block layout
+    (``block-<hash>.kv``). Everything else — tiered cache, chaos, fleet,
+    benches — goes through :class:`SharedPrefixStore`'s API, so the
+    layout (sharding, compression, a manifest) can evolve in one place
+    without call-site archaeology. Comments/docstrings are stripped
+    before matching so prose ABOUT the layout stays legal."""
+    import io
+    import tokenize
+
+    store_pkg = PKG_ROOT / "serving" / "prefix_store"
+    offenders = []
+    for path in sorted(PKG_ROOT.rglob("*.py")):
+        if store_pkg in path.parents:
+            continue
+        code_strings = []
+        try:
+            for tok in tokenize.generate_tokens(
+                io.StringIO(path.read_text()).readline
+            ):
+                if tok.type == tokenize.STRING:
+                    code_strings.append(tok.string)
+        except tokenize.TokenizeError:
+            code_strings = [path.read_text()]
+        # docstrings are STRING tokens too: only flag strings that look
+        # like a PATH being built (contain the block- prefix AND the .kv
+        # suffix without intervening prose whitespace)
+        for s in code_strings:
+            if re.search(r"block-[^\s\"']*\.kv", s):
+                offenders.append(str(path.relative_to(PKG_ROOT)))
+                break
+    assert not offenders, (
+        "block-file paths constructed outside serving/prefix_store/ "
+        f"(use SharedPrefixStore / block_file): {offenders}"
+    )
