@@ -1306,6 +1306,14 @@ def _child(model: str) -> None:
     # ceiling says how close the whole serving stack runs to the hardware.
     stream_gbps = (tok_s / spec["slots"]) * weight_bytes / 1e9
 
+    # roofline position (docs/observability.md#roofline-and-usage-
+    # accounting): the engine's usage meter joins its analytic work model
+    # (FLOPs + dtype-aware bytes) with the device seconds it accounted —
+    # MFU/MBU against the target generation's peaks plus the compute-vs-
+    # bandwidth bound classification, gated release-to-release by
+    # bench_diff. A pure function of token counts and the engine clock.
+    utilization = engine.usage.utilization_section(tokens_per_second=tok_s)
+
     # KV-cache footprint (dtype-aware: int8 counts int8 payload + f32 scale
     # rows): the residency half of the int8-KV win. max_slots_at_hbm = how
     # many slots of THIS config's context length fit in v5e HBM after the
@@ -1415,6 +1423,7 @@ def _child(model: str) -> None:
                 "token_latency": token_latency,
                 "scheduling": scheduling,
                 "kv_cache": kv_cache_info,
+                "utilization": utilization,
                 **({"overhead": overhead} if overhead else {}),
                 "tokens_per_second": round(tok_s, 2),
                 **({"spec": spec_info} if spec_info else {}),

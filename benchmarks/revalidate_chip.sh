@@ -150,6 +150,27 @@ json.dump(ov, open("benchmarks/BENCH_overhead.json", "w"), indent=1)
 print(f"stage 17: overhead section OK — host_fraction={ov['host_fraction']}"
       f" tick_p95={ov['tick_p95']} compiles={ov['compiles_n']}")
 PYEOF
+# 17b. roofline utilization accounting (docs/observability.md#roofline-and-
+#      usage-accounting): stage 12's full run already emitted the
+#      `utilization` section — analytic-model MFU/MBU against the chip's
+#      peaks plus the compute-vs-bandwidth classification (decode serving
+#      must classify bandwidth-bound on real hardware; MBU here vs the
+#      pct_hbm_ceiling weight-streaming bound is the honest-accounting
+#      cross-check). benchdiff gates utilization.mfu / utilization.mbu /
+#      utilization.tokens_per_second_per_chip from the next round on.
+timeout 120 python - <<'PYEOF' || fail 27
+import json
+from modal_examples_tpu.utils.bench_diff import load_bench
+ut = load_bench("benchmarks/BENCH_revalidate.json")["utilization"]
+assert 0.0 < ut["mfu"] <= 1.5, ut   # >1 means the work model or clock lies
+assert 0.0 < ut["mbu"] <= 1.5, ut
+assert ut["bound"] in ("compute", "bandwidth"), ut
+assert ut["tokens_per_second_per_chip"] > 0, ut
+assert ut["per_phase"]["decode"]["device_seconds"] > 0, ut
+json.dump(ut, open("benchmarks/BENCH_utilization.json", "w"), indent=1)
+print(f"stage 17b: utilization section OK — mfu={ut['mfu']} mbu={ut['mbu']}"
+      f" bound={ut['bound']} tok/s/chip={ut['tokens_per_second_per_chip']}")
+PYEOF
 # 18. compile ledger for the >=40-slot compile-helper ceiling (ROADMAP #1,
 #     docs/observability.md#hot-path-profiling): run the s44 config with
 #     the hot-path profiler ON and a LOCAL state dir. The profiler writes

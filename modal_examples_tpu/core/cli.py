@@ -28,6 +28,7 @@ Usage:
     tpurun disagg [--watch S]          # replica roles, migrations, KV tiers
     tpurun chaos [--last N]            # fault-injection episodes + invariants
     tpurun fleet [--last N]            # fleet-autoscaler decisions + boots
+    tpurun usage [N] [--json]          # per-tenant usage meters + roofline MFU/MBU
 """
 
 from __future__ import annotations
@@ -593,7 +594,17 @@ def cmd_profile(argv: list[str]) -> int:
     phases: dict = {}
     ratio = None
     lookups: dict = {}
+    roofline: dict = {}
     if merged is not None:
+        # roofline position (docs/observability.md#roofline-and-usage-
+        # accounting): the usage meter's achieved-vs-peak gauges per phase
+        for series, key in (
+            (C.MFU, "mfu"),
+            (C.HBM_BW_UTIL, "mbu"),
+            (C.ACHIEVED_TFLOPS, "tflops"),
+        ):
+            for labels, v in merged.series(series):
+                roofline.setdefault(labels.get("phase", "?"), {})[key] = v
         for phase in C.TICK_PHASES + (C.TICK_TOTAL_PHASE,):
             q = merged.histogram_quantiles(
                 C.TICK_PHASE_SECONDS, quantiles=(0.5, 0.95),
@@ -617,6 +628,7 @@ def cmd_profile(argv: list[str]) -> int:
     if as_json:
         print(json.dumps({
             "host_overhead_ratio": ratio,
+            "roofline": roofline,
             "phases": phases,
             "compile_lookups": lookups,
             "compile_total_s": round(
@@ -630,6 +642,18 @@ def cmd_profile(argv: list[str]) -> int:
 
     if ratio is not None:
         print(f"host overhead ratio: {ratio:.3f} (1 - device-blocked/total)")
+    tot = roofline.get("total")
+    if tot is not None:
+        bound = (
+            "compute-bound"
+            if tot.get("mfu", 0.0) >= tot.get("mbu", 0.0)
+            else "bandwidth-bound"
+        )
+        print(
+            f"roofline: MFU {tot.get('mfu', 0.0):.4f}  "
+            f"MBU {tot.get('mbu', 0.0):.4f}  "
+            f"{tot.get('tflops', 0.0):.3f} TFLOP/s achieved ({bound})"
+        )
     if phases:
         print(f"{'PHASE':<18} {'P50 ms':>9} {'P95 ms':>9} {'TICKS':>7}")
         for phase in list(C.TICK_PHASES) + [C.TICK_TOTAL_PHASE]:
@@ -666,6 +690,118 @@ def cmd_profile(argv: list[str]) -> int:
             print(
                 f"  {r.get('program', '?')} {r.get('shape_key', '?')} "
                 f"on {r.get('replica', '?')}"
+            )
+    return 0
+
+
+def cmd_usage(argv: list[str]) -> int:
+    """Hardware-utilization accounting (docs/observability.md#roofline-and-
+    usage-accounting): the per-tenant/per-class usage counters (prompt +
+    generated tokens, device-seconds, KV page-seconds, sheds) from the
+    pushed metrics files, the roofline MFU/MBU gauges, and the newest
+    per-request records from ``<state_dir>/usage.jsonl``. jax-free by
+    construction.
+
+    usage [N]        — tenant table + last N journal records (default 10)
+    usage --json     — the machine-readable payload
+    ``--dir PATH`` overrides the state-dir root.
+    """
+    from pathlib import Path
+
+    from ..observability import catalog as C
+    from ..observability import usage as _usage
+    from ..observability.export import pushed_jobs
+    from ..observability.journal import named_journal
+    from ..utils.prometheus import merge_expositions, parse_exposition
+
+    argv, root = _pop_dir_flag(argv, "usage: tpurun usage [N] [--json]")
+    as_json = "--json" in argv
+    argv = [a for a in argv if a != "--json"]
+    last = int(argv[0]) if argv else 10
+
+    jobs = pushed_jobs(Path(root) / "metrics" if root else None)
+    merged = parse_exposition(merge_expositions(jobs)) if jobs else None
+
+    tenants: dict = {}
+    roofline: dict = {}
+    if merged is not None:
+        for series, field in (
+            (C.USAGE_PROMPT_TOKENS_TOTAL, "prompt_tokens"),
+            (C.USAGE_GENERATED_TOKENS_TOTAL, "generated_tokens"),
+            (C.USAGE_DEVICE_SECONDS_TOTAL, "device_seconds"),
+            (C.USAGE_KV_PAGE_SECONDS_TOTAL, "kv_page_seconds"),
+            (C.USAGE_SHEDS_TOTAL, "sheds"),
+        ):
+            for labels, v in merged.series(series):
+                key = (
+                    labels.get("tenant", "?"), labels.get("class", "?")
+                )
+                tenants.setdefault(key, {})[field] = v
+        for series, field in (
+            (C.MFU, "mfu"),
+            (C.HBM_BW_UTIL, "mbu"),
+            (C.ACHIEVED_TFLOPS, "tflops"),
+        ):
+            for labels, v in merged.series(series):
+                roofline.setdefault(
+                    labels.get("phase", "?"), {}
+                )[field] = v
+
+    records = named_journal("usage", root).tail(last)
+    journal_totals = _usage.journal_tenant_totals(records)
+
+    if as_json:
+        print(json.dumps({
+            "tenants": [
+                {"tenant": t, "class": k, **fields}
+                for (t, k), fields in sorted(tenants.items())
+            ],
+            "roofline": roofline,
+            "journal_totals": journal_totals,
+            "records": records,
+        }))
+        return 0
+
+    if tenants:
+        print(
+            f"{'TENANT':<14} {'CLASS':<13} {'PROMPT':>9} {'GEN':>8} "
+            f"{'DEV s':>9} {'PAGE s':>11} {'SHEDS':>6}"
+        )
+        for (t, k), f in sorted(tenants.items()):
+            print(
+                f"{t:<14} {k:<13} {int(f.get('prompt_tokens', 0)):>9} "
+                f"{int(f.get('generated_tokens', 0)):>8} "
+                f"{f.get('device_seconds', 0.0):>9.3f} "
+                f"{f.get('kv_page_seconds', 0.0):>11.3f} "
+                f"{int(f.get('sheds', 0)):>6}"
+            )
+    else:
+        print(
+            "no usage series in pushed metrics "
+            "(run a bench or a serving engine first)"
+        )
+    tot = roofline.get("total")
+    if tot is not None:
+        bound = (
+            "compute-bound"
+            if tot.get("mfu", 0.0) >= tot.get("mbu", 0.0)
+            else "bandwidth-bound"
+        )
+        print(
+            f"\nroofline: MFU {tot.get('mfu', 0.0):.4f}  "
+            f"MBU {tot.get('mbu', 0.0):.4f}  "
+            f"{tot.get('tflops', 0.0):.3f} TFLOP/s achieved ({bound})"
+        )
+    if records:
+        print(f"\nlast {len(records)} usage records (usage.jsonl):")
+        for r in records:
+            print(
+                f"  {r.get('request_id', '?'):<18} "
+                f"{r.get('tenant', '?'):<12} {r.get('class', '?'):<10} "
+                f"prompt={r.get('prompt_tokens', 0):<6} "
+                f"gen={r.get('generated_tokens', 0):<6} "
+                f"cached={r.get('cached_prompt_tokens', 0):<6} "
+                f"{r.get('finish_reason', '?')}"
             )
     return 0
 
@@ -1756,6 +1892,7 @@ COMMANDS = {
     "benchdiff": cmd_benchdiff,
     "metrics": cmd_metrics,
     "profile": cmd_profile,
+    "usage": cmd_usage,
     "tsdb": cmd_tsdb,
     "alerts": cmd_alerts,
     "incidents": cmd_incidents,

@@ -24,6 +24,16 @@ TPU_GENERATIONS: dict[str, tuple[int, int, float]] = {
     "v6e": (8, 32, 459.0),
 }
 
+# generation -> HBM bandwidth GB/s per chip: the MBU denominator the
+# roofline meter (observability/usage.py) normalizes decode byte traffic
+# against. v5e matches bench.py's V5E_HBM_GBPS ceiling.
+TPU_HBM_GBPS: dict[str, float] = {
+    "v4": 1228.0,
+    "v5e": 819.0,
+    "v5p": 2765.0,
+    "v6e": 1638.0,
+}
+
 _SPEC_RE = re.compile(r"^(?P<gen>v\d+[a-z]*)(?:-(?P<chips>\d+))?$", re.IGNORECASE)
 
 
@@ -59,6 +69,10 @@ class TPUSpec:
     @property
     def bf16_tflops_per_chip(self) -> float:
         return TPU_GENERATIONS[self.generation][2]
+
+    @property
+    def hbm_gbps_per_chip(self) -> float:
+        return TPU_HBM_GBPS[self.generation]
 
     @property
     def multi_host(self) -> bool:

@@ -178,6 +178,10 @@ class LoadGenerator:
             "ttft_s": None,
             "tpot_s": None,
             "completion_tokens": None,
+            # prompt tokens the server answered from its prefix cache
+            # (usage.prompt_tokens_details.cached_tokens) — the client-side
+            # check that shared-prefix traffic actually hits the trie
+            "cached_tokens": None,
             "e2e_s": 0.0,
             "finish_reason": None,
             "pieces": 0,
@@ -216,6 +220,9 @@ class LoadGenerator:
                 out["finish_reason"] = payload["choices"][0].get("finish_reason")
                 usage = payload.get("usage") or {}
                 out["completion_tokens"] = usage.get("completion_tokens")
+                out["cached_tokens"] = (
+                    usage.get("prompt_tokens_details") or {}
+                ).get("cached_tokens")
                 out["status"] = "ok" if out["finish_reason"] != "error" else "error"
                 return out
             t_last = None
@@ -235,6 +242,9 @@ class LoadGenerator:
                     usage = event.get("usage") or {}
                     if usage.get("completion_tokens") is not None:
                         out["completion_tokens"] = usage["completion_tokens"]
+                    details = usage.get("prompt_tokens_details") or {}
+                    if details.get("cached_tokens") is not None:
+                        out["cached_tokens"] = details["cached_tokens"]
                     continue
                 now = time.monotonic()
                 finish = choices[0].get("finish_reason")
@@ -361,6 +371,12 @@ class LoadGenerator:
                 "p50": round(_percentile(tpots, 0.50), 6),
                 "p99": round(_percentile(tpots, 0.99), 6),
             },
+            # prefix-cache effectiveness as the CLIENT sees it, summed over
+            # completed requests that reported usage details
+            "cached_tokens": sum(
+                r["cached_tokens"] for r in ok
+                if r.get("cached_tokens") is not None
+            ),
             "per_class": per_class,
         }
 

@@ -149,6 +149,21 @@ DEFAULT_RULES: tuple[AlertRule, ...] = (
         description="KV page pool ~exhausted sustained (sheds imminent)",
     ),
     AlertRule(
+        name="mbu_collapse",
+        series=C.HBM_BW_UTIL,
+        labels={"phase": "decode"},
+        op="<=",
+        threshold=0.01,
+        for_s=20.0,
+        clear_s=10.0,
+        guard_series=C.ACTIVE_SLOTS,
+        guard_threshold=0.0,
+        description=(
+            "decode bandwidth utilization collapsed while decodable slots "
+            "exist — the wedge precursor (work admitted, HBM idle)"
+        ),
+    ),
+    AlertRule(
         name="no_token_progress",
         series=C.GENERATED_TOKENS_TOTAL,
         kind="absence",
@@ -234,6 +249,17 @@ class AlertEvaluator:
         # fire) — requiring the data window to ALSO hold for_s would double
         # the fire latency. window_s here only bounds staleness: a series
         # that stopped reporting cannot keep deciding the condition.
+        if rule.guard_series:
+            # guarded threshold (same semantics as absence): the condition
+            # only holds while the guard's latest point shows outstanding
+            # work — a "<=" rule over a utilization gauge must not page an
+            # idle engine whose meters legitimately read zero
+            guard_pts = _ts.series_points(
+                rule.guard_series, records,
+                labels=rule.guard_labels, agg=rule.agg,
+            )
+            if not guard_pts or guard_pts[-1][1] <= rule.guard_threshold:
+                return False, None
         window = [p for p in pts if p[0] >= now - rule.window_s]
         if not window:
             return False, None

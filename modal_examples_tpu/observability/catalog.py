@@ -331,6 +331,45 @@ PREFIX_CACHE_HITS_TOTAL = "mtpu_prefix_cache_hits_total"
 PREFIX_CACHE_MISSES_TOTAL = "mtpu_prefix_cache_misses_total"
 PREFIX_CACHED_PAGES = "mtpu_prefix_cached_pages"
 
+# -- roofline / usage accounting (observability/usage.py,
+#    docs/observability.md#roofline-and-usage-accounting) --------------------
+
+#: the work-model phase vocabulary the roofline gauges label by: prefill
+#: and decode are attributed separately (their roofline positions differ —
+#: prefill is compute-rich, decode streams weights+KV), "total" is the
+#: flops/bytes-weighted combination the BENCH `utilization` headline uses
+ROOFLINE_PHASES = ("prefill", "decode", "total")
+
+#: gauge {phase}: model FLOPs utilization — analytic FLOPs accounted to
+#: the phase over (device seconds x peak TFLOP/s x chips), against the
+#: core/resources.py bf16 peak for the resolved generation (MTPU_TPU_GEN)
+MFU = "mtpu_mfu"
+#: gauge {phase}: HBM bandwidth utilization (MBU) — analytic bytes moved
+#: (weight stream + kv_dtype-aware KV reads) over (device seconds x peak
+#: HBM GB/s x chips); sustained collapse while decodable slots exist is
+#: the wedge-precursor signature the mbu_collapse alert rule watches
+HBM_BW_UTIL = "mtpu_hbm_bw_util"
+#: gauge {phase}: achieved TFLOP/s over the phase's accounted device time
+#: (the numerator MFU normalizes — kept as its own series so dashboards
+#: can plot absolute roofline position, not just the ratio)
+ACHIEVED_TFLOPS = "mtpu_achieved_tflops"
+
+#: counter {tenant, class}: prompt tokens prefilled, attributed to the
+#: submitting tenant and priority class (Σ tenants == engine totals —
+#: the conservation contract tests/test_usage.py asserts)
+USAGE_PROMPT_TOKENS_TOTAL = "mtpu_usage_prompt_tokens_total"
+#: counter {tenant, class}: generated tokens accepted per tenant/class
+USAGE_GENERATED_TOKENS_TOTAL = "mtpu_usage_generated_tokens_total"
+#: counter {tenant, class}: decode-slot occupancy seconds (install ->
+#: release on the engine clock) — the device-seconds a tenant held
+USAGE_DEVICE_SECONDS_TOTAL = "mtpu_usage_device_seconds_total"
+#: counter {tenant, class}: KV page-seconds (pages held x hold seconds)
+#: — the HBM-residency integral behind per-tenant memory billing
+USAGE_KV_PAGE_SECONDS_TOTAL = "mtpu_usage_kv_page_seconds_total"
+#: counter {tenant, class}: admission sheds charged to the tenant whose
+#: request was rejected (the per-tenant split of mtpu_sheds_total)
+USAGE_SHEDS_TOTAL = "mtpu_usage_sheds_total"
+
 
 #: machine-readable catalog: name -> {type, labels, help}. docs/observability
 #: renders this; the static guard asserts every emitted name appears here.
@@ -752,6 +791,43 @@ CATALOG: dict[str, dict] = {
     PREFIX_CACHED_PAGES: {
         "type": "gauge", "labels": [],
         "help": "pages currently held by the prefix cache",
+    },
+    MFU: {
+        "type": "gauge", "labels": ["phase"],
+        "help": "model FLOPs utilization vs the resolved generation's bf16 "
+                "peak (phase=prefill|decode|total)",
+    },
+    HBM_BW_UTIL: {
+        "type": "gauge", "labels": ["phase"],
+        "help": "HBM bandwidth utilization (MBU): analytic bytes streamed "
+                "over device-seconds x peak GB/s (phase=prefill|decode|total)",
+    },
+    ACHIEVED_TFLOPS: {
+        "type": "gauge", "labels": ["phase"],
+        "help": "achieved TFLOP/s over the phase's accounted device time",
+    },
+    USAGE_PROMPT_TOKENS_TOTAL: {
+        "type": "counter", "labels": ["tenant", "class"],
+        "help": "prompt tokens prefilled per tenant/class (conserved: "
+                "sums to the engine's prefill counter)",
+    },
+    USAGE_GENERATED_TOKENS_TOTAL: {
+        "type": "counter", "labels": ["tenant", "class"],
+        "help": "generated tokens accepted per tenant/class (conserved: "
+                "sums to the engine's decode counter)",
+    },
+    USAGE_DEVICE_SECONDS_TOTAL: {
+        "type": "counter", "labels": ["tenant", "class"],
+        "help": "decode-slot occupancy seconds per tenant/class "
+                "(install -> release on the engine clock)",
+    },
+    USAGE_KV_PAGE_SECONDS_TOTAL: {
+        "type": "counter", "labels": ["tenant", "class"],
+        "help": "KV page-seconds held per tenant/class (pages x seconds)",
+    },
+    USAGE_SHEDS_TOTAL: {
+        "type": "counter", "labels": ["tenant", "class"],
+        "help": "admission sheds charged to the rejected tenant/class",
     },
 }
 

@@ -46,6 +46,8 @@ JOURNALS: dict[str, str] = {
     "alerts": "alerts.jsonl",      # alert fire/clear (observability/alerts.py)
     # shared prefix store: lease takeovers + GC sweeps (serving/prefix_store/)
     "prefix_store": "prefix_store.jsonl",
+    # per-request usage records (observability/usage.py)
+    "usage": "usage.jsonl",
 }
 
 

@@ -842,3 +842,88 @@ def record_fleet_boot(
         labels={"boot": boot},
         help=C.CATALOG[C.FLEET_BOOT_SECONDS]["help"],
     )
+
+
+# -- roofline / usage accounting (observability/usage.py) ---------------------
+
+
+def set_roofline(
+    phase: str, *, mfu: float, mbu: float, tflops: float,
+    registry: Registry | None = None,
+) -> None:
+    """One phase's roofline position (``catalog.ROOFLINE_PHASES``): MFU and
+    MBU as 0..1 fractions of the resolved generation's peaks, plus the
+    absolute achieved TFLOP/s. Called from the usage meter's throttled
+    flush — never per token."""
+    reg = _reg(registry)
+    reg.gauge_set(
+        C.MFU, float(mfu),
+        labels={"phase": phase},
+        help=C.CATALOG[C.MFU]["help"],
+    )
+    reg.gauge_set(
+        C.HBM_BW_UTIL, float(mbu),
+        labels={"phase": phase},
+        help=C.CATALOG[C.HBM_BW_UTIL]["help"],
+    )
+    reg.gauge_set(
+        C.ACHIEVED_TFLOPS, float(tflops),
+        labels={"phase": phase},
+        help=C.CATALOG[C.ACHIEVED_TFLOPS]["help"],
+    )
+
+
+def record_usage_tokens(
+    tenant: str, klass: str, *, prompt: int = 0, generated: int = 0,
+    registry: Registry | None = None,
+) -> None:
+    """Per-tenant/class token counters (deltas, not totals — the usage
+    meter accumulates and flushes from the engine's gauge-refresh
+    throttle, the ``record_token_totals`` pattern)."""
+    reg = _reg(registry)
+    if prompt:
+        reg.counter_inc(
+            C.USAGE_PROMPT_TOKENS_TOTAL, float(prompt),
+            labels={"tenant": tenant, "class": klass},
+            help=C.CATALOG[C.USAGE_PROMPT_TOKENS_TOTAL]["help"],
+        )
+    if generated:
+        reg.counter_inc(
+            C.USAGE_GENERATED_TOKENS_TOTAL, float(generated),
+            labels={"tenant": tenant, "class": klass},
+            help=C.CATALOG[C.USAGE_GENERATED_TOKENS_TOTAL]["help"],
+        )
+
+
+def record_usage_seconds(
+    tenant: str, klass: str, *, device_seconds: float = 0.0,
+    kv_page_seconds: float = 0.0, registry: Registry | None = None,
+) -> None:
+    """Per-tenant residency deltas: slot-occupancy seconds and KV
+    page-seconds (pages held x hold time), flushed with the token deltas."""
+    reg = _reg(registry)
+    if device_seconds > 0:
+        reg.counter_inc(
+            C.USAGE_DEVICE_SECONDS_TOTAL, float(device_seconds),
+            labels={"tenant": tenant, "class": klass},
+            help=C.CATALOG[C.USAGE_DEVICE_SECONDS_TOTAL]["help"],
+        )
+    if kv_page_seconds > 0:
+        reg.counter_inc(
+            C.USAGE_KV_PAGE_SECONDS_TOTAL, float(kv_page_seconds),
+            labels={"tenant": tenant, "class": klass},
+            help=C.CATALOG[C.USAGE_KV_PAGE_SECONDS_TOTAL]["help"],
+        )
+
+
+def record_usage_shed(
+    tenant: str, klass: str, *, registry: Registry | None = None
+) -> None:
+    """One admission shed charged to the rejected tenant (the per-tenant
+    split of ``record_shed`` — sheds are rare, so this one is immediate,
+    not delta-flushed)."""
+    _reg(registry).counter_inc(
+        C.USAGE_SHEDS_TOTAL, 1.0,
+        labels={"tenant": tenant, "class": klass},
+        help=C.CATALOG[C.USAGE_SHEDS_TOTAL]["help"],
+    )

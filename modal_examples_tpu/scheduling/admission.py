@@ -122,12 +122,18 @@ class AdmissionController:
         self.reserved_pages = 0
         self.sheds = 0  # monotonic, all classes/reasons
         self.admitted = 0
+        # per-tenant usage accountant (observability/usage.py): the owning
+        # engine assigns its EngineUsage here so sheds are charged to the
+        # tenant/class that was turned away, not just a global counter
+        self.usage = None
 
     def _shed(self, entry: ScheduledRequest, reason: str, depth: int,
               message: str) -> ShedError:
         with self._lock:
             self.sheds += 1
         _obs.record_shed(entry.priority, reason)
+        if self.usage is not None:
+            self.usage.note_shed(entry.tenant, entry.priority)
         bound = max(1, self.config.max_queue.get(entry.priority, 1))
         retry = self.config.retry_after_s * (1.0 + depth / bound)
         return ShedError(reason, retry, message)

@@ -45,6 +45,7 @@ from ..observability import metrics as _obs
 from ..observability import profiler as _profiler
 from ..observability import reqtrace as _rt
 from ..observability import timeseries as _ts
+from ..observability import usage as _usage
 from ..scheduling.admission import AdmissionController, ShedError
 from ..scheduling.policy import (
     DEFAULT_CLASS,
@@ -134,6 +135,11 @@ class Request:
     tenant: str = "default"
     deadline: float | None = None
     deadline_expired: bool = False
+    # prefix-cache accounting (observability/usage.py + the OpenAI usage
+    # contract's prompt_tokens_details.cached_tokens): prompt tokens whose
+    # KV came from already-cached pages (trie hits + tier promotions)
+    # instead of being recomputed — set at page claim
+    cached_prompt_tokens: int = 0
     # distributed request tracing (observability/reqtrace.py): the
     # RequestTraceContext minted at the entry point, or None when tracing
     # is disabled/sampled out — every trace touch point is None-safe
@@ -163,6 +169,10 @@ class _Slot:
     #: failover-resumed request is the SAME object re-admitted, and a stale
     #: block from its previous tenancy must not feed the new one
     tenancy: int = 0
+    #: engine-clock timestamp of this tenancy's install — the usage meter
+    #: charges the occupancy interval (device-seconds, KV page-seconds) to
+    #: the tenant when the slot's pages release (observability/usage.py)
+    claimed_at: float = 0.0
 
     @property
     def free(self) -> bool:
@@ -664,6 +674,27 @@ class LLMEngine:
         if trace_store is not None:
             _rt.register_store(self._trace_store)
         self.stats = EngineStats()
+        # hardware-utilization accounting (observability/usage.py,
+        # docs/observability.md#roofline-and-usage-accounting): the
+        # analytic work model is frozen HERE — parameter count from the
+        # config, true weight HBM bytes from the loaded tree, dtype-aware
+        # KV bytes/token from the cache's own accounting — and the meter
+        # shares the engine's injectable clock, so fake-clock runs meter
+        # bit-reproducible MFU/MBU. Always on: the per-token cost is a few
+        # integer adds (no extra timestamps), unlike the profiler.
+        from ..models.quantize import param_bytes
+
+        self.usage = _usage.EngineUsage(
+            _usage.WorkModel.from_engine(
+                cfg, cache=self.cache,
+                weight_bytes=param_bytes(self.params),
+            ),
+            clock=self._clock,
+            name=lambda: self.trace_name,
+            chips=int(self.impl_plan.get("tp", 1) or 1),
+        )
+        # admission sheds are charged to the shedding tenant/class
+        self.admission.usage = self.usage
         self.error_log: list[str] = []  # recent scheduler tracebacks
         self.error_count = 0  # monotonic (error_log is capped at 20)
         # MTPU_ENGINE_STRICT=1 (the test suite's default, conftest.py): a
@@ -1175,9 +1206,11 @@ class LLMEngine:
             jnp.asarray(self._temps.copy()),
         )
         _tm(tick, "decode_dispatch")
+        u_start = self._clock()  # usage meter: engine-clock domain
         out_np = np.asarray(out_tokens)
         n_np = np.asarray(n_emit)
         _tm_device(tick, "harvest")
+        self.usage.note_phase_seconds("decode", self._clock() - u_start)
         self.stats.steps += 1
         for i in active_idx:
             s = self.slots[i]
@@ -1556,6 +1589,10 @@ class LLMEngine:
         dangling span) and only then release the caller's stream. Every
         ``_Finish`` put in this engine goes through here."""
         _rt.finish_request(req, marker.reason, store=self._trace_store)
+        # per-request usage record (usage.jsonl): journaled at the SAME
+        # terminal point that releases the stream, with the ACCOUNTED
+        # token counts — Σ journal == the engine's counters by structure
+        self.usage.note_finish(req, marker.reason)
         req.out_queue.put(marker)
 
     def _close_queue_span(self, req: Request) -> None:
@@ -1632,6 +1669,7 @@ class LLMEngine:
                 )
             t_start = time.monotonic()
             t_wall = time.time()
+            u_start = self._clock()  # usage meter: engine-clock domain
             try:
                 first = self._prefill_pages(req, claim)
             except Exception:
@@ -1640,6 +1678,8 @@ class LLMEngine:
                 self.release_claim(claim, valid=False)
                 raise
             self.stats.prompt_tokens += claim["n_prompt"]
+            self.usage.note_prompt(req, claim["n_prompt"])
+            self.usage.note_phase_seconds("prefill", self._clock() - u_start)
             _obs.record_engine_phase("prefill", time.monotonic() - t_start)
             _rt.record_span(
                 req.trace, "prefill", start=t_wall,
@@ -1973,6 +2013,7 @@ class LLMEngine:
             self._thread.join(timeout=10)
         self._release_all(_FINISH if reason == "stop" else _Finish(reason))
         self._flush_token_counters()
+        self.usage.flush()  # unthrottled: the final window reaches pushes
         if self.profiler is not None:
             self.profiler.flush()
 
@@ -2231,6 +2272,9 @@ class LLMEngine:
                 )
         _obs.set_prefill_backlog(backlog)
         self._flush_token_counters()
+        # per-tenant usage deltas + roofline MFU/MBU gauges ride the same
+        # throttle (the flight recorder's tsdb sampler sees them for free)
+        self.usage.flush()
 
     def _flush_token_counters(self) -> None:
         """Push the stats deltas accumulated since the last flush into the
@@ -2455,6 +2499,7 @@ class LLMEngine:
         slot.request = req
         self._tenancy_seq += 1
         slot.tenancy = self._tenancy_seq
+        slot.claimed_at = self._clock()
         # adopted pages are all privately owned: this replica's prefix trie
         # never saw them (tier/trie integration is the PREFILL side's job)
         slot.pages = list(pages)
@@ -2568,6 +2613,13 @@ class LLMEngine:
             else:
                 return None
         pages = shared + promoted + fresh
+        # prefix-cache usage accounting (the OpenAI contract's
+        # prompt_tokens_details.cached_tokens): prompt tokens served from
+        # already-cached KV — trie hits + tier promotions — clamped to the
+        # prompt (the last shared page may cover growth positions too)
+        req.cached_prompt_tokens = min(
+            n_prompt, (len(shared) + len(promoted)) * self.cache.page_size
+        )
         trie_pages, private_pages = list(shared), list(promoted) + list(fresh)
         if pc is not None:
             pc.hits += bool(shared)
@@ -2589,7 +2641,21 @@ class LLMEngine:
             "n_prompt": n_prompt,
         }
 
+    def _charge_slot_usage(self, slot: _Slot) -> None:
+        """Charge the ending tenancy's occupancy interval to its tenant
+        (device-seconds + KV page-seconds) — from BOTH release paths, with
+        ``claimed_at`` zeroed so no path can double-charge."""
+        req = slot.request
+        if req is not None and slot.claimed_at > 0:
+            self.usage.note_slot_release(
+                req,
+                pages=len(slot.pages),
+                held_s=self._clock() - slot.claimed_at,
+            )
+        slot.claimed_at = 0.0
+
     def _release_slot_pages(self, slot: _Slot) -> None:
+        self._charge_slot_usage(slot)
         if self.prefix_cache is not None:
             self.prefix_cache.release(slot.trie_pages)
             self.cache.allocator.free(slot.private_pages)
@@ -2741,6 +2807,7 @@ class LLMEngine:
         slot.request = req
         self._tenancy_seq += 1
         slot.tenancy = self._tenancy_seq
+        slot.claimed_at = self._clock()
         slot.pages = pages
         slot.trie_pages = claim["trie_pages"]
         slot.private_pages = claim["private_pages"]
@@ -2850,6 +2917,7 @@ class LLMEngine:
         worked = False
         while self._pending_harvest:
             next_tok, rows, meta = self._pending_harvest.popleft()
+            u_start = self._clock()  # usage meter: engine-clock domain
             try:
                 next_np = np.asarray(next_tok)
                 _tm_device(tick, "harvest")
@@ -2868,6 +2936,11 @@ class LLMEngine:
             _obs.record_engine_phase(
                 meta["phase"], time.monotonic() - meta["t_start"]
             )
+            # roofline prefill seconds: the blocking-read interval on the
+            # injectable clock (the dispatch itself is async; this is
+            # where the host actually waits on prefill device work)
+            self.usage.note_phase_seconds("prefill", self._clock() - u_start)
+            u_calls = 1  # one dispatched program per harvest entry
             for slot_idx, req, row, n_prompt, tenancy in rows:
                 s = self.slots[slot_idx]
                 if s.request is not req or s.tenancy != tenancy or req.aborted:
@@ -2877,6 +2950,10 @@ class LLMEngine:
                     continue
                 s.pending_first = False
                 self.stats.prompt_tokens += n_prompt
+                # batched admissions share ONE weight stream: the first
+                # accounted row carries the program's weight-read bytes
+                self.usage.note_prompt(req, n_prompt, calls=u_calls)
+                u_calls = 0
                 s.position = n_prompt
                 # failover resume (docs/failover.md): replay the accepted
                 # generated prefix through the decode block program
@@ -3006,6 +3083,7 @@ class LLMEngine:
         rule, reconstructed from the slot's own page lists — trie pages
         invalidated so no later request can share never-/partially-written
         KV, exclusively-owned pages freed."""
+        self._charge_slot_usage(slot)
         self._unwind_claim({
             "pages": slot.pages,
             "trie_pages": slot.trie_pages,
@@ -3040,6 +3118,7 @@ class LLMEngine:
             slot.request = req
             self._tenancy_seq += 1
             slot.tenancy = self._tenancy_seq
+            slot.claimed_at = self._clock()
             slot.pages = pages
             slot.trie_pages = claim["trie_pages"]
             slot.private_pages = claim["private_pages"]
@@ -3296,8 +3375,10 @@ class LLMEngine:
         tick = self._tick
         toks, snapshot = self._inflight.popleft()
         t_wait = time.monotonic()
+        u_start = self._clock()  # usage meter: engine-clock domain
         toks_np = np.asarray(toks)  # [K, B] — the ONE blocking read per block
         _obs.record_engine_phase("decode_wait", time.monotonic() - t_wait)
+        self.usage.note_phase_seconds("decode", self._clock() - u_start)
         _tm_device(tick, "harvest")
         self.stats.steps += self.decode_block
         worked = False
@@ -3346,9 +3427,11 @@ class LLMEngine:
             jnp.asarray(self._seeds.copy()),
         )
         _tm(tick, "decode_dispatch")
+        u_start = self._clock()  # usage meter: engine-clock domain
         out_np = np.asarray(out_tokens)
         n_np = np.asarray(n_emit)
         _tm_device(tick, "harvest")
+        self.usage.note_phase_seconds("decode", self._clock() - u_start)
         self.stats.steps += 1
         for i in active_idx:
             s = self.slots[i]
@@ -3374,6 +3457,9 @@ class LLMEngine:
         slot = self.slots[slot_idx]
         req = slot.request
         self.stats.generated_tokens += 1
+        # usage meter: same site as the stats counter (conservation is
+        # structural); slot.position is the context the decode attended over
+        self.usage.note_token(req, slot.position)
         self.watermarks.note_accept()
         # token-level latency: TTFT on the request's first token, the
         # inter-token gap (TPOT) on every later one. Honest wall-clock from

@@ -344,6 +344,15 @@ class _Handler(BaseHTTPRequestHandler):
                         "prompt_tokens": n_prompt,
                         "completion_tokens": n_out,
                         "total_tokens": n_prompt + n_out,
+                        # real OpenAI field: prompt tokens served from the
+                        # prefix cache (engine page claim) instead of
+                        # recomputed — n>1 rows share one prompt, like
+                        # prompt_tokens above
+                        "prompt_tokens_details": {
+                            "cached_tokens": int(
+                                getattr(reqs[0], "cached_prompt_tokens", 0)
+                            ),
+                        },
                     },
                 },
             )
@@ -386,6 +395,12 @@ class _Handler(BaseHTTPRequestHandler):
                     "prompt_tokens": n_prompt,
                     "completion_tokens": req.n_generated,
                     "total_tokens": n_prompt + req.n_generated,
+                    # real OpenAI field: prefix-cache hits at page claim
+                    "prompt_tokens_details": {
+                        "cached_tokens": int(
+                            getattr(req, "cached_prompt_tokens", 0)
+                        ),
+                    },
                 })
 
             try:
@@ -466,6 +481,12 @@ class _Handler(BaseHTTPRequestHandler):
                     "prompt_tokens": n_prompt,
                     "completion_tokens": n_out,
                     "total_tokens": n_prompt + n_out,
+                    # real OpenAI field: prefix-cache hits at page claim
+                    "prompt_tokens_details": {
+                        "cached_tokens": int(
+                            getattr(req, "cached_prompt_tokens", 0)
+                        ),
+                    },
                 },
             },
             extra_headers={"x-mtpu-request-id": req.request_id},

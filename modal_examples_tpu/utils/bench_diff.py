@@ -73,7 +73,32 @@ METRICS: list[tuple[str, bool, str]] = [
     # decode loop exists to shrink, so it must fail the gate loudly.
     ("overhead.host_fraction", True, "abs"),
     ("overhead.tick_p95", True, "ratio"),
+    # roofline utilization (docs/observability.md#roofline-and-usage-
+    # accounting): achieved-vs-peak fractions are 0..1 rates (abs, like
+    # shed_rate); per-chip tok/s is the TP-normalized headline — a drop
+    # means the mesh stopped paying for itself
+    ("utilization.mfu", False, "abs"),
+    ("utilization.mbu", False, "abs"),
+    ("utilization.tokens_per_second_per_chip", False, "ratio"),
 ]
+
+#: identity keys that make two bench jsons comparable AT ALL: a CPU run
+#: diffed against a TPU run (or two different chips) produces nonsense
+#: verdicts for every hardware-relative metric, so the diff refuses
+#: instead of printing a table that looks authoritative.
+IDENTITY_KEYS = ("backend", "chip_note")
+
+
+def identity_mismatches(old: dict, new: dict) -> list[str]:
+    """Human-readable identity disagreements between two bench jsons.
+    Keys absent from either side are not mismatches (older files predate
+    ``chip_note``); only a present-and-different value disqualifies."""
+    out = []
+    for key in IDENTITY_KEYS:
+        ov, nv = old.get(key), new.get(key)
+        if ov is not None and nv is not None and ov != nv:
+            out.append(f"{key}: {ov!r} != {nv!r}")
+    return out
 
 
 def load_bench(path: str | Path) -> dict:
@@ -171,10 +196,14 @@ def run_diff(argv: list[str]) -> int:
     usage/read error."""
     usage = (
         "usage: tpurun benchdiff OLD.json NEW.json "
-        f"[--threshold PCT (default {DEFAULT_THRESHOLD * 100:.0f})]"
+        f"[--threshold PCT (default {DEFAULT_THRESHOLD * 100:.0f})] "
+        "[--allow-backend-mismatch]"
     )
     threshold = DEFAULT_THRESHOLD
     args = list(argv)
+    allow_mismatch = "--allow-backend-mismatch" in args
+    if allow_mismatch:
+        args.remove("--allow-backend-mismatch")
     if "--threshold" in args:
         i = args.index("--threshold")
         if i + 1 >= len(args):
@@ -194,6 +223,21 @@ def run_diff(argv: list[str]) -> int:
     except (OSError, ValueError, json.JSONDecodeError) as e:
         print(f"benchdiff: {e}")
         return 2
+    mismatches = identity_mismatches(old, new)
+    if mismatches:
+        for m in mismatches:
+            print(f"benchdiff: HARDWARE MISMATCH — {m}")
+        if not allow_mismatch:
+            print(
+                "benchdiff: refusing to compare runs from different "
+                "hardware (every hardware-relative verdict would be "
+                "nonsense); pass --allow-backend-mismatch to override"
+            )
+            return 2
+        print(
+            "benchdiff: --allow-backend-mismatch set — verdicts below "
+            "compare DIFFERENT hardware and are not regressions"
+        )
     rows = compare(old, new, threshold)
     if not rows:
         print("benchdiff: no comparable metrics between the two files")

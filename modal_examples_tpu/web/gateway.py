@@ -260,6 +260,30 @@ def _profile_snapshot(last: int = 20) -> dict:
     }
 
 
+def _usage_snapshot(last: int = 10) -> dict:
+    """Usage-accounting snapshot: every live engine's per-tenant meters +
+    roofline position, plus the newest per-request records from the
+    ``usage`` journal — the ``/usage`` route's payload (``tpurun usage``
+    renders the same data from pushed metrics + the journal;
+    docs/observability.md#roofline-and-usage-accounting)."""
+    from ..observability import incident as _incident
+    from ..observability import usage as _usage
+    from ..observability.journal import named_journal
+
+    engines = {}
+    for eng in _incident.live_engines():
+        u = getattr(eng, "usage", None)
+        if u is None:
+            continue
+        engines[u.replica] = {"roofline": u.summary(), **u.tenants()}
+    records = named_journal("usage").tail(last)
+    return {
+        "engines": engines,
+        "journal_totals": _usage.journal_tenant_totals(records),
+        "records": records,
+    }
+
+
 class _Handler(BaseHTTPRequestHandler):
     gateway: "Gateway"
 
@@ -409,7 +433,9 @@ class _Handler(BaseHTTPRequestHandler):
         (alert-rule firing state + fire/clear history —
         docs/observability.md#alert-rules), and
         ``/incidents[/<id>[?file=NAME]]`` (incident-bundle index /
-        manifest / bundled file — docs/observability.md#incident-bundles).
+        manifest / bundled file — docs/observability.md#incident-bundles),
+        and ``/usage[?n=N]`` (per-tenant usage meters + roofline MFU/MBU —
+        docs/observability.md#roofline-and-usage-accounting).
         User endpoints with the same label win — these only answer when no
         route claimed the path."""
         parts = parsed.path.strip("/").split("/")
@@ -417,9 +443,20 @@ class _Handler(BaseHTTPRequestHandler):
         if method != "GET" or label not in (
             "metrics", "traces", "healthz", "autoscaler", "disagg", "chaos",
             "prefixstore", "fleet", "health", "profile", "alerts",
-            "incidents",
+            "incidents", "usage",
         ):
             return False
+        if label == "usage":
+            q = {
+                k: v[-1]
+                for k, v in urllib.parse.parse_qs(parsed.query).items()
+            }
+            try:
+                n = int(q.get("n", 10))
+            except ValueError:
+                n = 10
+            self._respond_json(200, _usage_snapshot(last=n))
+            return True
         if label == "alerts":
             q = {
                 k: v[-1]

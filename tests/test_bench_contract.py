@@ -98,6 +98,28 @@ def test_bench_emits_schema_json():
     assert ts["series"] >= 1
     if ts["scrape_p95"] is not None:
         assert 0.0 <= ts["scrape_p50"] <= ts["scrape_p95"]
+    # roofline utilization accounting (docs/observability.md#roofline-and-
+    # usage-accounting): EVERY bench json carries a deterministic
+    # `utilization` section — the work model is analytic, so it exists even
+    # on CPU (the achieved fractions are tiny there, but the SHAPE and the
+    # work-model constants are the contract benchdiff gates against)
+    util = payload.get("utilization")
+    assert util, payload
+    assert {"mfu", "mbu", "bound", "tokens_per_second_per_chip",
+            "generation", "chips", "per_phase", "work_model"} <= set(util)
+    assert 0.0 <= util["mfu"] <= 1.5, util  # sanity roof, not a target
+    assert 0.0 <= util["mbu"] <= 1.5, util
+    assert util["bound"] in ("compute", "bandwidth")
+    assert util["tokens_per_second_per_chip"] > 0
+    assert util["chips"] >= 1
+    for phase in ("prefill", "decode"):
+        p = util["per_phase"][phase]
+        assert {"flops", "bytes", "device_seconds", "mfu", "mbu"} <= set(p)
+        assert p["flops"] > 0 and p["bytes"] > 0
+        assert p["device_seconds"] > 0  # the clock brackets really ran
+    wm = util["work_model"]
+    assert wm["n_params"] > 0 and wm["weight_bytes"] > 0
+    assert wm["kv_bytes_per_token"] > 0
 
 
 @pytest.mark.slow

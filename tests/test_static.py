@@ -1483,6 +1483,82 @@ def test_prefix_store_series_declared_and_emitted():
     )
 
 
+def test_usage_series_declared_and_emitted():
+    """Closure for the usage/roofline series (``mtpu_usage_*``,
+    ``mtpu_mfu``, ``mtpu_hbm_bw_util``, ``mtpu_achieved_tflops``), both
+    directions (the fleet/failover/watchdog-series guard pattern): every
+    declared catalog constant must be referenced by a live emitter/reader,
+    AND every usage recorder in observability/metrics.py must have a call
+    site outside metrics.py (a recorder nothing calls means per-tenant
+    billing or the roofline position silently stopped flowing to `tpurun
+    usage`, the gateway `/usage` view, and the bench `utilization`
+    section)."""
+    from modal_examples_tpu.observability import catalog
+
+    roofline = {"mtpu_mfu", "mtpu_hbm_bw_util", "mtpu_achieved_tflops"}
+    consts = {
+        attr: val
+        for attr, val in vars(catalog).items()
+        if isinstance(val, str)
+        and (val.startswith("mtpu_usage_") or val in roofline)
+    }
+    assert len(consts) >= 8, consts
+    catalog_path = PKG_ROOT / "observability" / "catalog.py"
+    package_src = {
+        path: path.read_text()
+        for path in sorted(PKG_ROOT.rglob("*.py"))
+        if path != catalog_path
+    }
+    unused = [
+        attr for attr in consts
+        if not any(
+            re.search(rf"\b{attr}\b", src) for src in package_src.values()
+        )
+    ]
+    assert not unused, (
+        "usage/roofline series declared in the catalog but never "
+        f"referenced by an emitter/reader in the package: {unused}"
+    )
+    metrics_path = PKG_ROOT / "observability" / "metrics.py"
+    recorders = (
+        "set_roofline", "record_usage_tokens", "record_usage_seconds",
+        "record_usage_shed",
+    )
+    orphans = [
+        fn for fn in recorders
+        if not any(
+            re.search(rf"\b{fn}\(", src)
+            for path, src in package_src.items()
+            if path != metrics_path
+        )
+    ]
+    assert not orphans, (
+        f"usage recorders with no call site outside metrics.py: {orphans}"
+    )
+
+
+def test_every_catalog_series_has_a_docs_table_row():
+    """The docs half of the catalog closure: every series declared in
+    ``catalog.CATALOG`` must appear as a ``| `name` |`` table row somewhere
+    under ``docs/`` (observability.md holds most of them). The catalog is
+    the machine-readable half of the metrics reference; a series missing
+    from the docs table is invisible to anyone deciding what to dashboard
+    — exactly the drift this repo's declare⇔emit guards exist to stop,
+    applied to the human-readable half."""
+    from modal_examples_tpu.observability import catalog
+
+    rows = set()
+    for path in sorted((REPO_ROOT / "docs").glob("*.md")):
+        rows |= set(
+            re.findall(r"^\|\s*`([a-z0-9_]+)`", path.read_text(), re.M)
+        )
+    missing = [name for name in catalog.CATALOG if name not in rows]
+    assert not missing, (
+        "catalog series with no `| `name` |` table row in docs/*.md "
+        f"(add one to docs/observability.md): {missing}"
+    )
+
+
 def test_prefix_store_is_sole_writer_of_block_layout():
     """LAYERING (docs/prefix_store.md): ``serving/prefix_store/`` is the
     ONLY package code that spells the store's on-volume block layout
