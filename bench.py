@@ -245,6 +245,61 @@ CONFIGS = {
 }
 
 
+def _measure_canary(engine) -> dict:
+    """Golden-set canary rounds on the measured engine
+    (docs/observability.md#correctness-canary): first contact with this
+    (model, fingerprint) identity records the golden, then a compare round
+    gates bit-exact — pass rate, probe latency quantiles, and a drift
+    count (expected: 0) ride in every BENCH json, so a numerically
+    drifting build fails loudly at bench time instead of in serving. A
+    cross-identity golden raises CanaryIdentityError (the loud banner) —
+    never a false drift verdict."""
+    from modal_examples_tpu.observability import canary as _canary
+
+    store = _canary.GoldenStore()
+    model = _canary.model_id(engine.cfg)
+    fp = _canary.fingerprint(engine)
+    golden = store.load(model, fp)  # CanaryIdentityError propagates, loudly
+    recorded_now = golden is None
+    if recorded_now:
+        rec = _canary.probe_engine(engine, replica="bench", golden=None)
+        probes = {
+            r["probe"]: {"tokens": r["tokens"]}
+            for r in rec
+            if r["result"] == "recorded"
+        }
+        if len(probes) == len(_canary.GOLDEN_SET):
+            store.record(model, fp, probes)
+            golden = store.load(model, fp)
+    results = _canary.probe_engine(engine, replica="bench", golden=golden)
+
+    def _q(vals: list, frac: float):
+        vals = sorted(v for v in vals if v is not None)
+        if not vals:
+            return None
+        return round(vals[min(len(vals) - 1, round(frac * (len(vals) - 1)))], 6)
+
+    compared = [r for r in results if r["result"] in ("pass", "drift")]
+    drifts = sum(1 for r in results if r["result"] == "drift")
+    out = {
+        "probes": len(results),
+        "pass_rate": (
+            round(sum(1 for r in compared if r["result"] == "pass")
+                  / len(compared), 4)
+            if compared else None
+        ),
+        "drift_count": drifts,
+        "errors": sum(1 for r in results if r["result"] == "error"),
+        "fingerprint": _canary.fingerprint_hash(fp),
+        "recorded": recorded_now,
+    }
+    for key in ("ttft", "tpot", "e2e"):
+        vals = [r.get(key) for r in results]
+        out[f"{key}_p50"] = _q(vals, 0.5)
+        out[f"{key}_p95"] = _q(vals, 0.95)
+    return out
+
+
 def _measure_interference(engine, spec: dict) -> dict:
     """Stall-free admission A/B (docs/scheduling.md): while one interactive
     stream decodes, long-prompt arrivals force chunked prefills; the gaps
@@ -1211,6 +1266,12 @@ def _child(model: str) -> None:
     if spec.get("mixed"):
         interference = _measure_interference(engine, spec)
 
+    # correctness canary (docs/observability.md#correctness-canary): a
+    # record-then-compare golden-set round on the same warm engine, BEFORE
+    # the fleet/failover/recovery arms stop it — drift_count must be 0 on
+    # a healthy build, and an identity-mismatched golden refuses loudly
+    canary_info = _measure_canary(engine)
+
     # closed-loop fleet A/B (fleet configs, docs/fleet.md): saturating
     # open-loop sweep against an OpenAI front, pinned vs autoscaled —
     # scale-out replicas are built by this factory with snapshot-restored
@@ -1430,6 +1491,7 @@ def _child(model: str) -> None:
                 **({"disagg": disagg_info} if disagg_info else {}),
                 **({"faults": faults_info} if faults_info else {}),
                 **({"interference": interference} if interference else {}),
+                **({"canary": canary_info} if canary_info else {}),
                 **({"fleet": fleet_info} if fleet_info else {}),
                 **({"failover": failover_info} if failover_info else {}),
                 **({"recovery": recovery_info} if recovery_info else {}),

@@ -13,6 +13,13 @@ exec > >(tee "$LOG") 2>&1
 # flight recorder (docs/observability.md): every stage's engines run under
 # the tsdb sampler, so the minutes before a wedge survive on disk ...
 export MTPU_TSDB=1
+# correctness canary armed for the WHOLE run
+# (docs/observability.md#correctness-canary): every stage's serving fleet
+# probes its golden set at this cadence, so numeric drift anywhere in the
+# revalidation fires canary_drift + an incident bundle instead of
+# shipping a wrong-answer chip report; bench.py additionally emits its
+# own record-then-compare `canary` section per config (stage 17c)
+export MTPU_CANARY_INTERVAL=15
 # ... and any stage failure ships an incident bundle (tsdb window, journal
 # tails, compile ledger, env fingerprint) instead of a shrug: `fail CODE
 # "STAGE"` captures, prints the bundle path in the stage summary, exits.
@@ -170,6 +177,23 @@ assert ut["per_phase"]["decode"]["device_seconds"] > 0, ut
 json.dump(ut, open("benchmarks/BENCH_utilization.json", "w"), indent=1)
 print(f"stage 17b: utilization section OK — mfu={ut['mfu']} mbu={ut['mbu']}"
       f" bound={ut['bound']} tok/s/chip={ut['tokens_per_second_per_chip']}")
+PYEOF
+# 17c. correctness canary (docs/observability.md#correctness-canary):
+#      stage 12's full run recorded-then-compared the golden set on the
+#      headline config's warm engine — the `canary` section must show
+#      zero drift and zero probe errors on a healthy chip, and the
+#      fingerprint proves the golden was recorded on THIS numeric
+#      identity (a CPU-recorded golden can never gate this run)
+timeout 120 python - <<'PYEOF' || fail 28
+from modal_examples_tpu.utils.bench_diff import load_bench
+cn = load_bench("benchmarks/BENCH_revalidate.json")["canary"]
+assert cn["drift_count"] == 0, cn
+assert cn["errors"] == 0, cn
+assert cn["pass_rate"] == 1.0, cn
+assert cn["probes"] > 0 and cn["fingerprint"], cn
+print(f"stage 17c: canary section OK — probes={cn['probes']}"
+      f" pass_rate={cn['pass_rate']} drift={cn['drift_count']}"
+      f" ttft_p95={cn['ttft_p95']} fp={cn['fingerprint']}")
 PYEOF
 # 18. compile ledger for the >=40-slot compile-helper ceiling (ROADMAP #1,
 #     docs/observability.md#hot-path-profiling): run the s44 config with

@@ -29,6 +29,7 @@ Usage:
     tpurun chaos [--last N]            # fault-injection episodes + invariants
     tpurun fleet [--last N]            # fleet-autoscaler decisions + boots
     tpurun usage [N] [--json]          # per-tenant usage meters + roofline MFU/MBU
+    tpurun canary [N] [--json]         # golden-set probe results + drift streaks
 """
 
 from __future__ import annotations
@@ -803,6 +804,96 @@ def cmd_usage(argv: list[str]) -> int:
                 f"cached={r.get('cached_prompt_tokens', 0):<6} "
                 f"{r.get('finish_reason', '?')}"
             )
+    return 0
+
+
+def cmd_canary(argv: list[str]) -> int:
+    """Correctness-canary status
+    (docs/observability.md#correctness-canary): per-replica golden-set
+    probe counts from the pushed metrics files plus the newest probe
+    rounds from ``<state_dir>/canary.jsonl``. jax-free by construction.
+
+    canary [N]        — replica table + last N journal records (default 10)
+    canary --json     — the machine-readable payload
+    ``--dir PATH`` overrides the state-dir root.
+    """
+    from pathlib import Path
+
+    from ..observability import catalog as C
+    from ..observability.export import pushed_jobs
+    from ..observability.journal import named_journal
+    from ..utils.prometheus import merge_expositions, parse_exposition
+
+    argv, root = _pop_dir_flag(argv, "usage: tpurun canary [N] [--json]")
+    as_json = "--json" in argv
+    argv = [a for a in argv if a != "--json"]
+    last = int(argv[0]) if argv else 10
+
+    jobs = pushed_jobs(Path(root) / "metrics" if root else None)
+    merged = parse_exposition(merge_expositions(jobs)) if jobs else None
+
+    replicas: dict = {}
+    if merged is not None:
+        for labels, v in merged.series(C.CANARY_PROBES_TOTAL):
+            rep = replicas.setdefault(labels.get("replica", "?"), {})
+            rep[labels.get("result", "?")] = rep.get(
+                labels.get("result", "?"), 0.0
+            ) + v
+        for labels, v in merged.series(C.CANARY_DRIFT_TOTAL):
+            replicas.setdefault(
+                labels.get("replica", "?"), {}
+            )["drift_total"] = v
+        for labels, v in merged.series(C.CANARY_FAILING):
+            replicas.setdefault(
+                labels.get("replica", "?"), {}
+            )["failing_streak"] = v
+
+    records = named_journal("canary", root).tail(last)
+
+    if as_json:
+        print(json.dumps({
+            "replicas": [
+                {"replica": name, **fields}
+                for name, fields in sorted(replicas.items())
+            ],
+            "records": records,
+        }))
+        return 0
+
+    if replicas:
+        print(
+            f"{'REPLICA':<18} {'PASS':>6} {'DRIFT':>6} {'ERROR':>6} "
+            f"{'RECORDED':>9} {'STREAK':>7}"
+        )
+        for name, f in sorted(replicas.items()):
+            print(
+                f"{name:<18} {int(f.get('pass', 0)):>6} "
+                f"{int(f.get('drift', 0)):>6} {int(f.get('error', 0)):>6} "
+                f"{int(f.get('recorded', 0)):>9} "
+                f"{int(f.get('failing_streak', 0)):>7}"
+            )
+    else:
+        print(
+            "no canary series in pushed metrics "
+            "(arm the prober: MTPU_CANARY_INTERVAL, or run a bench)"
+        )
+    if records:
+        print(f"\nlast {len(records)} canary records (canary.jsonl):")
+        for r in records:
+            action = r.get("action", "?")
+            if action == "round":
+                results = r.get("results", {})
+                summary = " ".join(
+                    f"{k}={v}" for k, v in sorted(results.items())
+                )
+                print(
+                    f"  round      {r.get('replica', '?'):<16} {summary}"
+                )
+            else:
+                print(
+                    f"  {action:<10} {r.get('replica', '?'):<16} "
+                    f"{r.get('reason', r.get('weight', ''))}"
+                )
     return 0
 
 
@@ -1893,6 +1984,7 @@ COMMANDS = {
     "metrics": cmd_metrics,
     "profile": cmd_profile,
     "usage": cmd_usage,
+    "canary": cmd_canary,
     "tsdb": cmd_tsdb,
     "alerts": cmd_alerts,
     "incidents": cmd_incidents,

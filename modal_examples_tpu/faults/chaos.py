@@ -441,6 +441,16 @@ EPISODES: list[tuple[str, dict, dict]] = [
     ("prefix-store-owner-death",
      {"prefix_store.owner_death": {"on_hit": 1}},
      {"n": 2}),
+    # numeric drift (docs/observability.md#correctness-canary): ONE
+    # accepted decode token flipped on a canary probe — tenant-gated, so
+    # the concurrent user traffic (and its token-identity invariant) is
+    # untouched. The golden is recorded OUTSIDE the armed plan; the
+    # corrupted round must be detected as drift, capture a canary_drift
+    # incident, and down-weight the drifting replica while the other
+    # serving replica's probes keep passing.
+    ("canary-numeric-drift",
+     {"engine.canary_token_corrupt": {"on_hit": 1}},
+     {"n": 2}),
 ]
 
 
@@ -452,6 +462,40 @@ def _run_episode(fleet: _Fleet, name: str, spec: dict, seed: int,
     pre_shed = pre_attempted = 0
     survivor = None
     base_vol_hits = 0
+    prober = None
+    if name == "canary-numeric-drift":
+        # pre-condition (the prefix-store-owner-death hazard, below): the
+        # silent-freeze episode can leave a loop frozen-but-IDLE, and the
+        # canary is the first thing since to hand dec-0 work directly —
+        # probing a frozen loop wedges the probe requests and drags the
+        # watchdog into the episode. Play the operator: restart any
+        # serving loop that stopped ticking before probing it.
+        from ..serving.health import replica_snapshot
+
+        for eng in (fleet.dec, fleet.uni):
+            rep = next(
+                r for r in fleet.coord.replicas if r.engine is eng
+            )
+            seq0 = replica_snapshot(rep).get("tick_seq")
+            deadline = time.monotonic() + 1.0
+            while (
+                replica_snapshot(rep).get("tick_seq") == seq0
+                and time.monotonic() < deadline
+            ):
+                time.sleep(0.02)
+            if replica_snapshot(rep).get("tick_seq") == seq0:
+                eng.stop()
+                eng.start()
+        # the clean round runs OUTSIDE the armed plan: the first serving
+        # replica records the golden, the second compares against it —
+        # the store must hold uncorrupted transcripts before the armed
+        # round can be judged as drift rather than a fresh recording
+        from ..observability.canary import CanaryProber
+
+        prober = CanaryProber(
+            fleet.coord.router, fail_threshold=1, interval_s=3600.0
+        )
+        prober.probe_once()
     if name == "prefix-store-owner-death":
         # pre-condition: the silent-freeze episode can leave a loop
         # frozen-but-IDLE (healthy() true, zero outstanding — the
@@ -517,6 +561,41 @@ def _run_episode(fleet: _Fleet, name: str, spec: dict, seed: int,
                     "churned chain did not re-promote from the shared "
                     "store on the surviving replica"
                 )
+        if name == "canary-numeric-drift":
+            # armed round: the first canary token accepted fleet-wide is
+            # flipped (+1 mod vocab) — the prober must see bit-exact drift
+            # on that replica, down-weight it (fail_threshold=1 here; the
+            # production default demands consecutive failing rounds), and
+            # keep passing on the other serving replica. Probe requests
+            # never enter ``results``: the token-identity invariant is
+            # about user traffic, and the probe's whole job is to diverge.
+            round2 = prober.probe_once()
+            snap = prober.snapshot()
+            if snap["drifts"] < 1:
+                extra_violations.append(
+                    "injected canary token corruption was never detected "
+                    "as drift"
+                )
+            drifted = [
+                rep for rep, probes in round2.items()
+                if any(p["result"] == "drift" for p in probes)
+            ]
+            if drifted and sorted(drifted) != snap["downweighted"]:
+                extra_violations.append(
+                    f"drifting replica(s) {drifted} were not down-weighted "
+                    f"(downweighted={snap['downweighted']})"
+                )
+            healthy = [rep for rep in round2 if rep not in drifted]
+            for rep in healthy:
+                if not all(p["result"] == "pass" for p in round2[rep]):
+                    extra_violations.append(
+                        f"non-drifting replica {rep} stopped passing its "
+                        "canaries during the drift episode"
+                    )
+            # hand traffic back at full weight: the canary proved its
+            # point; later invariants expect an evenly-weighted fleet
+            for rep in snap["downweighted"]:
+                fleet.coord.router.set_health_weight(rep, 1.0)
         if name in ("router-flap", "silent-freeze"):
             # let the down timer lapse, then place again: the re-probe
             # re-admission path (mtpu_router_readmissions_total). For the

@@ -105,6 +105,15 @@ POINTS: dict[str, dict] = {
         "effect": "one decode tick stalls (~50 ms)",
         "recovery": "latency only; requests still terminate",
     },
+    "engine.canary_token_corrupt": {
+        "component": "serving/engine.py",
+        "effect": "one accepted decode token is deterministically flipped "
+                  "(+1 mod vocab) — ONLY on __canary__ probe requests, so "
+                  "user-visible streams are never corrupted",
+        "recovery": "canary prober detects bit-exact drift vs the golden "
+                    "store -> canary_drift alert + incident + router "
+                    "down-weight (observability/canary.py)",
+    },
     "router.health_flap": {
         "component": "scheduling/router.py",
         "effect": "a replica's health probe reports unhealthy once",

@@ -302,7 +302,12 @@ class FleetAutoscaler:
         reads the shed delta without resetting the tick baseline — the
         read-only path for :meth:`stats`, so an observer polling ``/fleet``
         cannot eat the controller's shed-pressure signal."""
-        sheds = self._registry.total(C.SHEDS_TOTAL)
+        # canary probes (observability/canary.py) are synthetic: a shed or
+        # queued probe is the canary observing pressure, not pressure worth
+        # buying a replica for — subtract the canary class from both signals
+        sheds = self._registry.total(C.SHEDS_TOTAL) - self._registry.total(
+            C.SHEDS_TOTAL, {"class": "canary"}
+        )
         shed_delta = sheds - self._last_sheds
         if consume_sheds:
             self._last_sheds = sheds
@@ -323,7 +328,14 @@ class FleetAutoscaler:
             if not replicas:
                 out[group] = None
                 continue
-            queued = sum(r.engine.policy.total_depth() for r in replicas)
+            # synthetic canary probes (observability/canary.py) are not
+            # demand: a queued probe must never scale the fleet. depths()
+            # is guarded — test fakes stub only total_depth()
+            queued = sum(
+                r.engine.policy.total_depth()
+                - getattr(r.engine.policy, "depths", dict)().get("canary", 0)
+                for r in replicas
+            )
             outstanding = sum(r.outstanding() for r in replicas)
             capacity = sum(max(1, r.capacity()) for r in replicas)
             kv = max(self._kv_pressure(r.engine) for r in replicas)

@@ -175,6 +175,36 @@ DEFAULT_RULES: tuple[AlertRule, ...] = (
         capture=True,
         description="active slots but zero tokens generated over the window",
     ),
+    AlertRule(
+        name="canary_drift",
+        series=C.CANARY_DRIFT_TOTAL,
+        kind="rate",
+        agg="sum",
+        threshold=0.001,
+        window_s=60.0,
+        clear_s=30.0,
+        # the prober already captures a canary_drift incident per drifted
+        # probe (with the mismatching request id in the reason); capturing
+        # here too would duplicate the bundle
+        description=(
+            "a replica's golden-set probe diverged bit-exact from its "
+            "golden transcript (numeric drift sentinel)"
+        ),
+    ),
+    AlertRule(
+        name="canary_latency_burn",
+        series=C.CANARY_E2E_SECONDS,
+        kind="rate",
+        field="sum",
+        agg="sum",
+        threshold=2.0,
+        window_s=60.0,
+        clear_s=30.0,
+        description=(
+            "canary probes burning >2 probe-seconds/s — the fleet is slow "
+            "from the client's seat even if no tenant is complaining yet"
+        ),
+    ),
 )
 
 

@@ -309,7 +309,7 @@ ALERTS_ACTIVE = "mtpu_alerts_active"
 ALERTS_FIRED_TOTAL = "mtpu_alerts_fired_total"
 #: counter {trigger}: incident bundles captured; trigger = watchdog_wedge |
 #: watchdog_quarantine | scheduler_crash | chaos_invariant | alert |
-#: stage_failure | manual
+#: canary_drift | stage_failure | manual
 INCIDENTS_CAPTURED_TOTAL = "mtpu_incidents_captured_total"
 
 # -- SLO engine (observability/slo.py) --------------------------------------
@@ -369,6 +369,36 @@ USAGE_KV_PAGE_SECONDS_TOTAL = "mtpu_usage_kv_page_seconds_total"
 #: counter {tenant, class}: admission sheds charged to the tenant whose
 #: request was rejected (the per-tenant split of mtpu_sheds_total)
 USAGE_SHEDS_TOTAL = "mtpu_usage_sheds_total"
+
+# -- correctness canary (observability/canary.py,
+#    docs/observability.md#correctness-canary) -------------------------------
+
+#: counter {replica, result}: golden-set probes completed per replica;
+#: result = pass (bit-exact vs golden) | drift (token mismatch) | error
+#: (probe died before finishing) | recorded (golden captured on first
+#: contact with this model+fingerprint — never compared, never gated)
+CANARY_PROBES_TOTAL = "mtpu_canary_probes_total"
+#: counter {replica}: probes whose generated tokens diverged bit-exact
+#: from the pinned golden transcript — the numeric-drift sentinel the
+#: canary_drift alert rule and the router down-weight key on
+CANARY_DRIFT_TOTAL = "mtpu_canary_drift_total"
+#: histogram {replica}: client-observed TTFT of canary probes (submit ->
+#: first streamed piece) — active latency probing on the real serving path
+CANARY_TTFT_SECONDS = "mtpu_canary_ttft_seconds"
+#: histogram {replica}: client-observed inter-piece latency of canary
+#: probes (the probe-side TPOT proxy)
+CANARY_TPOT_SECONDS = "mtpu_canary_tpot_seconds"
+#: histogram {replica}: end-to-end canary probe latency (submit -> stream
+#: drained) — the canary_latency_burn alert rule's input
+CANARY_E2E_SECONDS = "mtpu_canary_e2e_seconds"
+#: counter {replica, kind}: synthetic canary tokens (kind=prompt|generated)
+#: — excluded from per-tenant usage billing and the usage journal, counted
+#: here instead so conservation stays closed: Σ usage tenants + canary ==
+#: engine totals
+CANARY_TOKENS_TOTAL = "mtpu_canary_tokens_total"
+#: gauge {replica}: consecutive failing canary rounds (0 = passing);
+#: reaching the prober's fail threshold drives router.set_health_weight
+CANARY_FAILING = "mtpu_canary_failing"
 
 
 #: machine-readable catalog: name -> {type, labels, help}. docs/observability
@@ -737,7 +767,7 @@ CATALOG: dict[str, dict] = {
         "type": "counter", "labels": ["trigger"],
         "help": "incident bundles captured (trigger=watchdog_wedge|"
                 "watchdog_quarantine|scheduler_crash|chaos_invariant|"
-                "alert|stage_failure|manual)",
+                "alert|canary_drift|stage_failure|manual)",
     },
     SLO_BURN_RATE: {
         "type": "gauge", "labels": ["slo"],
@@ -828,6 +858,37 @@ CATALOG: dict[str, dict] = {
     USAGE_SHEDS_TOTAL: {
         "type": "counter", "labels": ["tenant", "class"],
         "help": "admission sheds charged to the rejected tenant/class",
+    },
+    CANARY_PROBES_TOTAL: {
+        "type": "counter", "labels": ["replica", "result"],
+        "help": "golden-set canary probes per replica "
+                "(result=pass|drift|error|recorded)",
+    },
+    CANARY_DRIFT_TOTAL: {
+        "type": "counter", "labels": ["replica"],
+        "help": "canary probes whose generated tokens diverged from the "
+                "pinned golden transcript",
+    },
+    CANARY_TTFT_SECONDS: {
+        "type": "histogram", "labels": ["replica"],
+        "help": "client-observed TTFT of canary probes",
+    },
+    CANARY_TPOT_SECONDS: {
+        "type": "histogram", "labels": ["replica"],
+        "help": "client-observed inter-piece latency of canary probes",
+    },
+    CANARY_E2E_SECONDS: {
+        "type": "histogram", "labels": ["replica"],
+        "help": "end-to-end canary probe latency (submit -> stream drained)",
+    },
+    CANARY_TOKENS_TOTAL: {
+        "type": "counter", "labels": ["replica", "kind"],
+        "help": "synthetic canary tokens, excluded from tenant billing "
+                "(kind=prompt|generated; closes usage conservation)",
+    },
+    CANARY_FAILING: {
+        "type": "gauge", "labels": ["replica"],
+        "help": "consecutive failing canary rounds per replica (0=passing)",
     },
 }
 

@@ -22,6 +22,10 @@ Triggers (the ``mtpu_incidents_captured_total{trigger}`` label set):
 - ``chaos_invariant`` — a failed fleet invariant (faults/chaos.py).
 - ``alert`` — an :class:`~.alerts.AlertRule` with ``capture=True`` at its
   fire transition.
+- ``canary_drift`` — the correctness canary (observability/canary.py)
+  caught a replica generating tokens that diverge bit-exact from its
+  golden transcript; the bundle's reason names the mismatching probe
+  request so its trace is findable in the open-trace section.
 - ``stage_failure`` — ``benchmarks/revalidate_chip.sh``'s stage wrapper on
   any nonzero exit (the next chip wedge ships a bundle, not a shrug).
 - ``manual`` — ``tpurun incidents capture``.
@@ -61,7 +65,7 @@ DIR_NAME = "incidents"
 #: ``mtpu_incidents_captured_total{trigger}`` labels enumerate it)
 TRIGGERS = (
     "watchdog_wedge", "watchdog_quarantine", "scheduler_crash",
-    "chaos_invariant", "alert", "stage_failure", "manual",
+    "chaos_invariant", "alert", "canary_drift", "stage_failure", "manual",
 )
 
 #: tsdb window a bundle snapshots (the last N minutes before the event)

@@ -48,6 +48,8 @@ JOURNALS: dict[str, str] = {
     "prefix_store": "prefix_store.jsonl",
     # per-request usage records (observability/usage.py)
     "usage": "usage.jsonl",
+    # golden-set probe results (observability/canary.py)
+    "canary": "canary.jsonl",
 }
 
 

@@ -927,3 +927,86 @@ def record_usage_shed(
         labels={"tenant": tenant, "class": klass},
         help=C.CATALOG[C.USAGE_SHEDS_TOTAL]["help"],
     )
+
+
+def record_canary_probe(
+    replica: str, result: str, *, registry: Registry | None = None
+) -> None:
+    """One completed golden-set probe (result=pass|drift|error|recorded)."""
+    _reg(registry).counter_inc(
+        C.CANARY_PROBES_TOTAL, 1.0,
+        labels={"replica": replica, "result": result},
+        help=C.CATALOG[C.CANARY_PROBES_TOTAL]["help"],
+    )
+
+
+def record_canary_drift(
+    replica: str, *, registry: Registry | None = None
+) -> None:
+    """One probe whose tokens diverged from the golden transcript."""
+    _reg(registry).counter_inc(
+        C.CANARY_DRIFT_TOTAL, 1.0,
+        labels={"replica": replica},
+        help=C.CATALOG[C.CANARY_DRIFT_TOTAL]["help"],
+    )
+
+
+def record_canary_latency(
+    replica: str, *, ttft: float | None = None, tpot: float | None = None,
+    e2e: float | None = None, registry: Registry | None = None,
+) -> None:
+    """Client-observed probe latencies — measured from the canary's side
+    of the stream, so they price the full router/engine path, not just the
+    decode tick."""
+    reg = _reg(registry)
+    labels = {"replica": replica}
+    if ttft is not None:
+        reg.histogram_observe(
+            C.CANARY_TTFT_SECONDS, float(ttft), labels=labels,
+            buckets=C.TOKEN_TIME_BUCKETS,
+            help=C.CATALOG[C.CANARY_TTFT_SECONDS]["help"],
+        )
+    if tpot is not None:
+        reg.histogram_observe(
+            C.CANARY_TPOT_SECONDS, float(tpot), labels=labels,
+            buckets=C.TOKEN_TIME_BUCKETS,
+            help=C.CATALOG[C.CANARY_TPOT_SECONDS]["help"],
+        )
+    if e2e is not None:
+        reg.histogram_observe(
+            C.CANARY_E2E_SECONDS, float(e2e), labels=labels,
+            buckets=C.TOKEN_TIME_BUCKETS,
+            help=C.CATALOG[C.CANARY_E2E_SECONDS]["help"],
+        )
+
+
+def record_canary_tokens(
+    replica: str, *, prompt: int = 0, generated: int = 0,
+    registry: Registry | None = None,
+) -> None:
+    """Synthetic canary token deltas — the conservation-closing partner of
+    the per-tenant usage counters the canary tenant is excluded from."""
+    reg = _reg(registry)
+    if prompt:
+        reg.counter_inc(
+            C.CANARY_TOKENS_TOTAL, float(prompt),
+            labels={"replica": replica, "kind": "prompt"},
+            help=C.CATALOG[C.CANARY_TOKENS_TOTAL]["help"],
+        )
+    if generated:
+        reg.counter_inc(
+            C.CANARY_TOKENS_TOTAL, float(generated),
+            labels={"replica": replica, "kind": "generated"},
+            help=C.CATALOG[C.CANARY_TOKENS_TOTAL]["help"],
+        )
+
+
+def set_canary_failing(
+    replica: str, streak: int, *, registry: Registry | None = None
+) -> None:
+    """Consecutive failing canary rounds (0 clears)."""
+    _reg(registry).gauge_set(
+        C.CANARY_FAILING, float(streak),
+        labels={"replica": replica},
+        help=C.CATALOG[C.CANARY_FAILING]["help"],
+    )

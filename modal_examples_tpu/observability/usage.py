@@ -44,6 +44,7 @@ import time
 
 from . import catalog as C
 from . import metrics as _obs
+from .canary import CANARY_TENANT
 from .journal import JOURNALS, DecisionJournal, named_journal
 
 #: generation override for peak resolution (one env, read once per engine
@@ -220,6 +221,14 @@ class EngineUsage:
         # take deltas; the buckets hold the running totals)
         self._buckets: dict[tuple[str, str], dict] = {}
         self._flushed: dict[tuple[str, str], dict] = {}
+        # synthetic canary probes (observability/canary.py): excluded from
+        # the tenant buckets and the usage journal — nobody is billed for
+        # the fleet probing itself — but the tokens are REAL device work, so
+        # they keep feeding the roofline accumulators and land in their own
+        # mtpu_canary_tokens_total series; conservation stays closed as
+        # Σ tenant buckets + canary == the engine's stats counters
+        self._canary = {"prompt_tokens": 0, "generated_tokens": 0}
+        self._canary_flushed = {"prompt_tokens": 0, "generated_tokens": 0}
 
     @property
     def replica(self) -> str:
@@ -240,9 +249,12 @@ class EngineUsage:
         disagg prefill), so Σ tenants == the engine counter."""
         n = int(n_tokens)
         with self._lock:
-            b = self._b(req.tenant, req.priority)
-            b["prompt_tokens"] += n
-            b["requests"] += 1
+            if req.tenant == CANARY_TENANT:
+                self._canary["prompt_tokens"] += n
+            else:
+                b = self._b(req.tenant, req.priority)
+                b["prompt_tokens"] += n
+                b["requests"] += 1
             self._prefill_tokens += n
             self._prefill_sq_tokens += n * n
             self._prefill_calls += int(calls)
@@ -255,7 +267,10 @@ class EngineUsage:
         """One generated token accepted at context length ``ctx`` — called
         from the ONE site that bumps ``stats.generated_tokens``."""
         with self._lock:
-            self._b(req.tenant, req.priority)["generated_tokens"] += 1
+            if req.tenant == CANARY_TENANT:
+                self._canary["generated_tokens"] += 1
+            else:
+                self._b(req.tenant, req.priority)["generated_tokens"] += 1
             self._decode_tokens += 1
             self._decode_ctx_sum += int(ctx)
 
@@ -272,6 +287,8 @@ class EngineUsage:
     def note_slot_release(self, req, *, pages: int, held_s: float) -> None:
         """A decode slot released its pages: charge the occupancy interval
         (device-seconds) and its KV-residency integral (page-seconds)."""
+        if req.tenant == CANARY_TENANT:
+            return  # probe residency bills nobody
         held = max(0.0, float(held_s))
         with self._lock:
             b = self._b(req.tenant, req.priority)
@@ -292,6 +309,8 @@ class EngineUsage:
         if getattr(req, "_usage_journaled", False):
             return
         req._usage_journaled = True
+        if req.tenant == CANARY_TENANT:
+            return  # probes never land a billing line; see canary.jsonl
         self._journal_record({
             "at": time.time(),
             "replica": self.replica,
@@ -419,7 +438,9 @@ class EngineUsage:
             f: (round(v, 6) if isinstance(v, float) else v)
             for f, v in totals.items()
         }
-        return {"tenants": rows, "totals": totals}
+        with self._lock:
+            canary = dict(self._canary)
+        return {"tenants": rows, "totals": totals, "canary": canary}
 
     def flush(self, registry=None) -> None:
         """Push accumulated deltas into the cataloged per-tenant counters
@@ -439,6 +460,18 @@ class EngineUsage:
                 )):
                     deltas.append((key, d))
                 self._flushed[key] = dict(b)
+            canary_d = {
+                f: self._canary[f] - self._canary_flushed[f]
+                for f in self._canary
+            }
+            self._canary_flushed = dict(self._canary)
+        if any(canary_d.values()):
+            _obs.record_canary_tokens(
+                self.replica,
+                prompt=canary_d["prompt_tokens"],
+                generated=canary_d["generated_tokens"],
+                registry=reg,
+            )
         for (tenant, klass), d in deltas:
             _obs.record_usage_tokens(
                 tenant, klass,

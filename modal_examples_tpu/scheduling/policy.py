@@ -32,8 +32,11 @@ import time
 from collections import OrderedDict, deque
 from typing import Callable
 
-#: priority classes, highest first — the order IS the strict service order
-PRIORITY_CLASSES = ("interactive", "default", "batch")
+#: priority classes, highest first — the order IS the strict service order.
+#: "canary" is the synthetic golden-set probe class (observability/canary.py):
+#: lowest rank so probes never starve real traffic, excluded from autoscaler
+#: signals and per-tenant usage billing.
+PRIORITY_CLASSES = ("interactive", "default", "batch", "canary")
 DEFAULT_CLASS = "default"
 #: class -> rank (lower serves first); shared by the executor's pool
 CLASS_RANK = {c: i for i, c in enumerate(PRIORITY_CLASSES)}

@@ -56,6 +56,7 @@ from ..scheduling.policy import (
 )
 from ..faults import inject as _inject
 from ..faults.inject import FaultError as _FaultError
+from ..observability.canary import CANARY_TENANT as _CANARY_TENANT
 from ..utils.log import get_logger
 from .health import EngineWatermarks
 from .kv_cache import OutOfPages, PagedKVCache
@@ -3456,6 +3457,14 @@ class LLMEngine:
     def _accept_token(self, slot_idx: int, token: int) -> None:
         slot = self.slots[slot_idx]
         req = slot.request
+        # canary drift injection: deterministically flip ONE accepted token,
+        # gated on the synthetic probe tenant so user-visible streams (and
+        # the chaos harness's token-identity invariant) are never corrupted —
+        # only the golden-set comparison sees the flip
+        if req.tenant == _CANARY_TENANT and _inject.fire(
+            "engine.canary_token_corrupt"
+        ):
+            token = (token + 1) % self.cfg.vocab_size
         self.stats.generated_tokens += 1
         # usage meter: same site as the stats counter (conservation is
         # structural); slot.position is the context the decode attended over
@@ -3466,12 +3475,18 @@ class LLMEngine:
         # the client's seat: pipelined blocks emit in bursts, and the
         # histogram shows exactly that.
         now = time.monotonic()
+        # canary probes keep their first/last-token bookkeeping (the prober
+        # measures client-side) but must NOT feed the unlabeled TTFT/TPOT
+        # histograms: those drive the SLO burn gauges and the autoscaler,
+        # and synthetic probes would pollute both. Canary latency lands in
+        # the dedicated canary histograms instead.
         if req.first_token_at is None:
             req.first_token_at = now
-            _obs.record_ttft(now - req.created)
+            if req.tenant != _CANARY_TENANT:
+                _obs.record_ttft(now - req.created)
             if req.trace is not None:
                 req.trace.root.attrs["ttft_s"] = round(now - req.created, 6)
-        else:
+        elif req.tenant != _CANARY_TENANT:
             _obs.record_tpot(now - req.last_token_at)
         req.last_token_at = now
         req.n_generated += 1

@@ -524,6 +524,25 @@ class OpenAIServer:
         self.httpd = ThreadingHTTPServer((host, port), handler)
         self.host, self.port = self.httpd.server_address[:2]
         self._thread: threading.Thread | None = None
+        self._canary = None
+
+    def _maybe_start_canary(self) -> None:
+        """Env-gated like the MTPU_TSDB sampler: exporting
+        ``MTPU_CANARY_INTERVAL`` arms always-on golden-set probing for the
+        fleet this server fronts, with zero further wiring
+        (docs/observability.md#correctness-canary). Router fronts only —
+        the prober walks ``router.replicas`` and down-weights via
+        ``set_health_weight``."""
+        import os
+
+        from ..observability.canary import INTERVAL_ENV, CanaryProber
+
+        if self.router is None or not os.environ.get(INTERVAL_ENV):
+            return
+        # a DisaggCoordinator front exposes the weight-bearing router
+        # underneath it; a bare PrefixAffinityRouter is its own
+        target = getattr(self.router, "router", self.router)
+        self._canary = CanaryProber(target).start()
 
     def submit(self, prompt, params, image=None, **sched):
         """Place one request; returns (request, owning engine). Raises
@@ -581,6 +600,7 @@ class OpenAIServer:
     def start(self) -> "OpenAIServer":
         for eng in self._engines():
             eng.start()
+        self._maybe_start_canary()
         self._thread = threading.Thread(target=self.httpd.serve_forever, daemon=True)
         self._thread.start()
         return self
@@ -588,9 +608,13 @@ class OpenAIServer:
     def serve_forever(self) -> None:
         for eng in self._engines():
             eng.start()
+        self._maybe_start_canary()
         self.httpd.serve_forever()
 
     def stop(self) -> None:
+        if self._canary is not None:
+            self._canary.stop()
+            self._canary = None
         self.httpd.shutdown()
         self.httpd.server_close()
         for eng in self._engines():

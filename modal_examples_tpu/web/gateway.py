@@ -25,6 +25,29 @@ from ..utils.log import get_logger
 
 _log = get_logger("gateway")
 
+#: every built-in observability surface the gateway serves: route label ->
+#: one-line description. ONE table shared by the dispatch check, the ``/``
+#: root index payload, and the endpoint smoke-matrix test — so a new
+#: surface cannot land without being discoverable (and a dropped one
+#: cannot linger in the index). ``/metrics`` is prometheus text; every
+#: other route answers JSON.
+BUILTIN_ROUTES: dict[str, str] = {
+    "healthz": "SLO pass/fail gate + burn rates",
+    "health": "gray-failure watchdog: per-replica progress classification",
+    "metrics": "prometheus exposition (live registry + pushed jobs)",
+    "alerts": "alert-rule firing state + fire/clear history",
+    "incidents": "incident-bundle index (/incidents/<id>[?file=NAME])",
+    "usage": "per-tenant usage meters + roofline MFU/MBU",
+    "prefixstore": "shared prefix-store dedup/hit-origin/takeover counters",
+    "profile": "hot-path profiler: tick phases, host fraction, compiles",
+    "traces": "request/call trace index (/traces/<id>[?explain=1])",
+    "fleet": "fleet autoscaler: replicas, decisions, boot latencies",
+    "disagg": "disaggregated serving: roles, migrations, prefix tiers",
+    "chaos": "injected-fault counters + chaos episode journal",
+    "canary": "correctness canary: golden-set probe results + drift",
+    "autoscaler": "executor autoscaler decision journal",
+}
+
 
 def _coerce_kwargs(fn, raw: dict) -> dict:
     """Coerce string query params to the entrypoint's annotated types."""
@@ -284,6 +307,48 @@ def _usage_snapshot(last: int = 10) -> dict:
     }
 
 
+def _canary_snapshot(last: int = 20) -> dict:
+    """Correctness-canary snapshot: the live prober's state (when this
+    process runs one), per-replica probe/drift counters from the registry,
+    and the newest probe-round records from the ``canary`` journal — the
+    ``/canary`` route's payload (``tpurun canary`` renders the same data
+    from pushed metrics; docs/observability.md#correctness-canary)."""
+    from ..observability import canary as _canary
+    from ..observability import catalog as C
+    from ..observability.journal import named_journal
+    from ..utils.prometheus import default_registry as reg
+
+    probes: dict = {}
+    for labels, v in reg.series(C.CANARY_PROBES_TOTAL):
+        rep = labels.get("replica", "?")
+        probes.setdefault(rep, {})[labels.get("result", "?")] = int(v)
+    drift = {
+        labels.get("replica", "?"): int(v)
+        for labels, v in reg.series(C.CANARY_DRIFT_TOTAL)
+    }
+    failing = {
+        labels.get("replica", "?"): int(v)
+        for labels, v in reg.series(C.CANARY_FAILING)
+    }
+    prober = _canary.live_prober()
+    return {
+        "probes": probes,
+        "drift": drift,
+        "failing": failing,
+        "prober": prober.snapshot() if prober is not None else None,
+        "journal": named_journal("canary").tail(last),
+    }
+
+
+def _root_index() -> dict:
+    """The ``/`` discovery payload: every built-in observability surface,
+    straight from :data:`BUILTIN_ROUTES` so index and dispatch can't drift."""
+    return {
+        "service": "modal_examples_tpu gateway",
+        "routes": {f"/{label}": desc for label, desc in BUILTIN_ROUTES.items()},
+    }
+
+
 class _Handler(BaseHTTPRequestHandler):
     gateway: "Gateway"
 
@@ -435,17 +500,32 @@ class _Handler(BaseHTTPRequestHandler):
         ``/incidents[/<id>[?file=NAME]]`` (incident-bundle index /
         manifest / bundled file — docs/observability.md#incident-bundles),
         and ``/usage[?n=N]`` (per-tenant usage meters + roofline MFU/MBU —
-        docs/observability.md#roofline-and-usage-accounting).
+        docs/observability.md#roofline-and-usage-accounting), and
+        ``/canary[?n=N]`` (correctness-canary probe results, drift counters,
+        prober state — docs/observability.md#correctness-canary). ``/``
+        serves the :data:`BUILTIN_ROUTES` discovery index.
         User endpoints with the same label win — these only answer when no
         route claimed the path."""
         parts = parsed.path.strip("/").split("/")
         label = parts[0] if parts else ""
-        if method != "GET" or label not in (
-            "metrics", "traces", "healthz", "autoscaler", "disagg", "chaos",
-            "prefixstore", "fleet", "health", "profile", "alerts",
-            "incidents", "usage",
-        ):
+        if method != "GET" or (label and label not in BUILTIN_ROUTES):
             return False
+        if not label:
+            # `/` — the discovery index (ISSUE: operators should not need
+            # the docs open to find a surface)
+            self._respond_json(200, _root_index())
+            return True
+        if label == "canary":
+            q = {
+                k: v[-1]
+                for k, v in urllib.parse.parse_qs(parsed.query).items()
+            }
+            try:
+                n = int(q.get("n", 20))
+            except ValueError:
+                n = 20
+            self._respond_json(200, _canary_snapshot(last=n))
+            return True
         if label == "usage":
             q = {
                 k: v[-1]

@@ -1537,6 +1537,78 @@ def test_usage_series_declared_and_emitted():
     )
 
 
+def test_canary_series_declared_and_emitted():
+    """Closure for the correctness-canary series (``mtpu_canary_*``),
+    both directions (the usage-series guard pattern): every declared
+    catalog constant must be referenced by a live emitter/reader, AND
+    every canary recorder in observability/metrics.py must have a call
+    site outside metrics.py — a recorder nothing calls means the drift
+    sentinel silently stopped flowing to `tpurun canary`, the gateway
+    `/canary` view, and the `canary_drift` alert rule."""
+    from modal_examples_tpu.observability import catalog
+
+    consts = {
+        attr: val
+        for attr, val in vars(catalog).items()
+        if isinstance(val, str) and val.startswith("mtpu_canary_")
+    }
+    assert len(consts) >= 7, consts
+    catalog_path = PKG_ROOT / "observability" / "catalog.py"
+    package_src = {
+        path: path.read_text()
+        for path in sorted(PKG_ROOT.rglob("*.py"))
+        if path != catalog_path
+    }
+    unused = [
+        attr for attr in consts
+        if not any(
+            re.search(rf"\b{attr}\b", src) for src in package_src.values()
+        )
+    ]
+    assert not unused, (
+        "canary series declared in the catalog but never referenced by "
+        f"an emitter/reader in the package: {unused}"
+    )
+    metrics_path = PKG_ROOT / "observability" / "metrics.py"
+    recorders = (
+        "record_canary_probe", "record_canary_drift",
+        "record_canary_latency", "record_canary_tokens",
+        "set_canary_failing",
+    )
+    orphans = [
+        fn for fn in recorders
+        if not any(
+            re.search(rf"\b{fn}\(", src)
+            for path, src in package_src.items()
+            if path != metrics_path
+        )
+    ]
+    assert not orphans, (
+        f"canary recorders with no call site outside metrics.py: {orphans}"
+    )
+
+
+def test_every_journal_has_a_docs_table_row():
+    """The docs half of the JOURNALS closure (the catalog-series guard
+    applied to the journal table): every named journal in
+    ``journal.JOURNALS`` must appear as a ``| `name` |`` table row
+    somewhere under ``docs/`` — a journal missing from the docs table is
+    a decision record nobody knows to read back after an incident."""
+    from modal_examples_tpu.observability.journal import JOURNALS
+
+    rows = set()
+    for path in sorted((REPO_ROOT / "docs").glob("*.md")):
+        rows |= set(
+            re.findall(r"^\|\s*`([a-z0-9_]+)`", path.read_text(), re.M)
+        )
+    missing = [name for name in JOURNALS if name not in rows]
+    assert not missing, (
+        "JOURNALS entries with no `| `name` |` table row in docs/*.md "
+        "(add one to docs/observability.md#decision-journals): "
+        f"{missing}"
+    )
+
+
 def test_every_catalog_series_has_a_docs_table_row():
     """The docs half of the catalog closure: every series declared in
     ``catalog.CATALOG`` must appear as a ``| `name` |`` table row somewhere
