@@ -97,6 +97,15 @@ if [ -n "$PREV" ]; then
 else
   echo "stage 13 SKIPPED: no BENCH_r*.json to diff against"
 fi
+# 13b. macro-step decode A/B at the int8 headline shape (docs/multistep.md),
+#      behind the regression gate: N=1 vs N=8 on the same warm engine via
+#      the runtime-mutable decode_steps knob — the json's `multistep`
+#      section carries per-arm host_fraction/tick_p95/tokens-per-dispatch
+#      and the deltas; bench_diff's multistep.tokens_per_dispatch gates it
+#      from the next round on. On chip the N=8 arm's host_fraction must
+#      drop outright (each dispatch carries ~8x device work for the same
+#      host bookkeeping)
+timeout 1500 env BENCH_MODEL=llama2-7b-int8-multistep BENCH_NO_SECONDARY=1 python bench.py || fail 29
 # 14. closed-loop fleet sweep (docs/fleet.md), behind the regression gate:
 #     the int8 headline shape under production-shaped open-loop traffic —
 #     calibrated saturating sweep, pinned single replica vs FleetAutoscaler

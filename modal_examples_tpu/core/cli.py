@@ -594,6 +594,8 @@ def cmd_profile(argv: list[str]) -> int:
 
     phases: dict = {}
     ratio = None
+    decode_steps = None
+    tokens_per_dispatch = None
     lookups: dict = {}
     roofline: dict = {}
     if merged is not None:
@@ -617,6 +619,12 @@ def cmd_profile(argv: list[str]) -> int:
                 }
         # a 0..1 fraction must never sum across jobs: show the worst
         ratio = merged.peak(C.HOST_OVERHEAD_RATIO) or None
+        # macro-step decode (docs/multistep.md): configured N + the
+        # harvested tokens-per-dispatch — gauges, so peak, never sum
+        decode_steps = merged.peak(C.MULTISTEP_DECODE_STEPS) or None
+        tokens_per_dispatch = (
+            merged.peak(C.MULTISTEP_TOKENS_PER_DISPATCH) or None
+        )
         for labels, v in merged.series(C.COMPILES_TOTAL):
             entry = lookups.setdefault(
                 labels.get("program", "?"), {"hit": 0, "miss": 0}
@@ -629,6 +637,8 @@ def cmd_profile(argv: list[str]) -> int:
     if as_json:
         print(json.dumps({
             "host_overhead_ratio": ratio,
+            "decode_steps": decode_steps,
+            "tokens_per_dispatch": tokens_per_dispatch,
             "roofline": roofline,
             "phases": phases,
             "compile_lookups": lookups,
@@ -643,6 +653,15 @@ def cmd_profile(argv: list[str]) -> int:
 
     if ratio is not None:
         print(f"host overhead ratio: {ratio:.3f} (1 - device-blocked/total)")
+    if decode_steps is not None:
+        tpd = (
+            f"{tokens_per_dispatch:.1f}"
+            if tokens_per_dispatch is not None else "-"
+        )
+        print(
+            f"macro-step decode: N={decode_steps:.0f} configured, "
+            f"{tpd} tokens/dispatch"
+        )
     tot = roofline.get("total")
     if tot is not None:
         bound = (
@@ -1374,6 +1393,15 @@ def cmd_top(argv: list[str]) -> int:
             f"ttft p50/p95 ms {fmt_q(C.TTFT_SECONDS)}   "
             f"tpot p50/p95 ms {fmt_q(C.TPOT_SECONDS)}"
         )
+        # macro-step decode (docs/multistep.md): configured N + harvested
+        # tokens-per-dispatch, when a multistep engine has pushed (gauges:
+        # peak, never sum across jobs)
+        ms_n = merged.peak(C.MULTISTEP_DECODE_STEPS)
+        if ms_n:
+            print(
+                f"macro-step decode: N={ms_n:.0f}   tokens/dispatch "
+                f"{merged.peak(C.MULTISTEP_TOKENS_PER_DISPATCH):.1f}"
+            )
         # the resolved decode plan, incl. the tensor-parallel degree and the
         # PER-SHARD ragged variant (paged_impl_plan(mesh=...)) — so a TP
         # deployment's dashboard shows the sharded plan actually running

@@ -119,6 +119,24 @@ def check_drained(engines: dict) -> list[str]:
     return out
 
 
+def settle_drained(engines: dict, timeout: float = 10.0,
+                   poll_s: float = 0.02) -> list[str]:
+    """Poll :func:`check_drained` until clean or ``timeout``; returns the
+    final violation list (empty on success). The finish marker is
+    delivered to the client queue BEFORE the scheduler thread frees the
+    slot and releases its pages, so an *instant* drain check right after
+    the last stream joins is racy by construction — and on a starved CI
+    box the scheduler thread may lag the client by whole ticks. Settling
+    is the honest way to assert drain; a genuinely leaked slot or page
+    still fails, just ``timeout`` seconds later."""
+    deadline = time.monotonic() + timeout
+    while True:
+        violations = check_drained(engines)
+        if not violations or time.monotonic() >= deadline:
+            return violations
+        time.sleep(poll_s)
+
+
 def check_router_recovered(router) -> list[str]:
     """No replica stuck on the down list, and every replica healthy."""
     out = []
@@ -607,13 +625,25 @@ def _run_episode(fleet: _Fleet, name: str, spec: dict, seed: int,
             results += more
             shed += more_shed
             attempted += more_attempted
-    # settle: a crash-released engine may need a tick to drain gauges; the
-    # decode/unified loops run continuously so this is bounded and short
-    deadline = time.monotonic() + 10.0
-    while time.monotonic() < deadline:
-        if not check_drained(fleet.engines):
+    # recovery drive: the watchdog may have error-stopped a replica the
+    # episode never scripted a recovery for (on a starved CI box a slow
+    # tick can read as a wedge — a false positive the ladder still
+    # handles). Re-probe + readmission only complete when a placement
+    # actually lands on the revived replica, so play the operator for ANY
+    # episode that ends with a replica down, not just router-flap /
+    # silent-freeze: wait out the down timer and place fresh traffic.
+    for _ in range(2):
+        if not check_router_recovered(fleet.coord.router):
             break
-        time.sleep(0.02)
+        time.sleep(fleet.coord.router.reprobe_s + 0.3)
+        more, more_shed, more_attempted = _traffic(fleet, n=2)
+        results += more
+        shed += more_shed
+        attempted += more_attempted
+    # settle: the finish marker reaches the client BEFORE the scheduler
+    # frees the slot; the decode/unified loops run continuously so this
+    # is bounded and short
+    settle_drained(fleet.engines)
 
     violations = (
         check_terminal(results)

@@ -150,6 +150,9 @@ class HotPathProfiler:
         self._seen: set[tuple[str, str]] = set()
         self._compiles = 0
         self._compile_s = 0.0
+        self._dispatches = 0
+        self._dispatch_tokens = 0
+        self._decode_steps = 1
         self._compile_log: deque[dict] = deque(maxlen=COMPILE_LOG_KEEP)
         self._ledger_path = ledger_path
         self._ledger: DecisionJournal | None = None
@@ -189,6 +192,18 @@ class HotPathProfiler:
         )
         if refresh:
             self._refresh_ratio()
+
+    def note_dispatch_tokens(self, n: int, steps: int | None = None) -> None:
+        """One harvested decode dispatch accepted ``n`` tokens (both the
+        classic block path and the macro-step path report here, so the
+        BENCH ``multistep`` section's tokens-per-dispatch compares across
+        arms); ``steps`` is the configured ``decode_steps`` at dispatch
+        time."""
+        with self._lock:
+            self._dispatches += 1
+            self._dispatch_tokens += int(n)
+            if steps is not None:
+                self._decode_steps = max(1, int(steps))
 
     def flush(self) -> None:
         """Force the host-overhead gauge current (engine stop / push time:
@@ -290,6 +305,12 @@ class HotPathProfiler:
         with self._lock:
             ring = list(self._ring)
             compiles_n, compile_s = self._compiles, self._compile_s
+            dispatches = self._dispatches
+            dispatch_tokens = self._dispatch_tokens
+            decode_steps = self._decode_steps
+        tokens_per_dispatch = (
+            round(dispatch_tokens / dispatches, 3) if dispatches else None
+        )
         if not ring:
             return {
                 "ticks": 0,
@@ -301,6 +322,9 @@ class HotPathProfiler:
                 "phases": {},
                 "compile_total_s": round(compile_s, 3),
                 "compiles_n": compiles_n,
+                "decode_steps": decode_steps,
+                "dispatches": dispatches,
+                "tokens_per_dispatch": tokens_per_dispatch,
             }
         totals = sorted(e["total"] for e in ring)
         sum_total = sum(totals)
@@ -332,6 +356,9 @@ class HotPathProfiler:
             "phases": phases,
             "compile_total_s": round(compile_s, 3),
             "compiles_n": compiles_n,
+            "decode_steps": decode_steps,
+            "dispatches": dispatches,
+            "tokens_per_dispatch": tokens_per_dispatch,
         }
 
     def perfetto_snapshot(self) -> dict:

@@ -27,6 +27,7 @@ from .paged_attention import (
     scatter_kv_pages,
 )
 from .quantized_matmul import dequantize_int8, quantize_int8, quantized_matmul
+from .scan_loop import masked_scan
 from .sharded import (
     mesh_tp_degree,
     shard_cache_pages,
@@ -58,6 +59,7 @@ __all__ = [
     "kv_empty",
     "kv_gather",
     "kv_scatter",
+    "masked_scan",
     "mesh_tp_degree",
     "scatter_kv_pages",
     "shard_cache_pages",

@@ -406,6 +406,14 @@ class LLMEngine:
         draft_params=None,
         draft_model_dir: str | None = None,
         decode_block: int = 8,  # decode steps rolled into one dispatch
+        # macro-step decode (docs/multistep.md): N decode+sample steps
+        # fused into ONE jitted program per dispatch, with device-side
+        # stop-token/length early exit and per-slot validity masks. None
+        # resolves MTPU_DECODE_STEPS once (the knob rule); 1 = the classic
+        # pipelined block path, byte-identical fall-through. Runtime-
+        # mutable like prefill_budget (read once per dispatch), so benches
+        # A/B it on a live engine.
+        decode_steps: int | None = None,
         # stall-free admission (docs/scheduling.md): max prompt tokens the
         # scheduler may convert into prefill work per tick. None resolves
         # through MTPU_PREFILL_BUDGET (empty env = unlimited); an explicit
@@ -763,6 +771,18 @@ class LLMEngine:
         self._last_dispatch_at: float | None = None
 
         self._block_jit = jax.jit(self._decode_block_fn, donate_argnums=(1, 2))
+        # macro-step decode runtime (serving/multistep, docs/multistep.md)
+        from .multistep.runtime import resolve_decode_steps
+
+        self.decode_steps = resolve_decode_steps(decode_steps)
+        self._multistep_jits: dict[int, object] = {}  # keyed by N
+        self._detok = None  # lazy DetokWorker (first routed token)
+        # tokens-per-dispatch accounting (harvest-side; feeds the
+        # catalog MULTISTEP_* gauges through _refresh_gauges' throttle)
+        self._ms_dispatches = 0
+        self._ms_tokens = 0
+        self._ms_flush = {"dispatches": 0, "tokens": 0}
+        self._ms_tpd = 0.0
         self._prefill_jits: dict[int, object] = {}
         self._chunk_jits: dict[int, object] = {}  # keyed by chunk q_offset
 
@@ -927,6 +947,45 @@ class LLMEngine:
             jax.random.split(key, self.decode_block),
         )
         return toks, last, k_pages, v_pages
+
+    def _multistep_jit(self, n: int):
+        """The N-step macro decode program (serving/multistep/runtime.py),
+        built lazily per N — the knob is runtime-mutable, and each value
+        is its own compiled program (shape key ``s{slots}n{N}``)."""
+        jit = self._multistep_jits.get(n)
+        if jit is None:
+            from .multistep.runtime import build_multistep_fn
+
+            fn = build_multistep_fn(
+                self.cfg,
+                paged_impl=self.paged_impl,
+                scatter_impl=self.scatter_impl,
+                mesh=self.mesh,
+                eos_id=self.tokenizer.eos_id,
+                n_steps=n,
+            )
+            jit = self._multistep_jits[n] = jax.jit(
+                fn, donate_argnums=(1, 2)
+            )
+        return jit
+
+    def _ensure_detok(self):
+        """The lazy detokenization worker (serving/multistep/detok.py). A
+        dead worker is replaced — owned streams re-register from their
+        ``req.emitted_len`` cursor on the next accepted token."""
+        w = self._detok
+        if w is None or not w.alive:
+            from .multistep.detok import DetokWorker
+
+            w = DetokWorker(
+                tokenizer=self.tokenizer,
+                deliver=self._deliver_finish,
+                safe_len=_stop_safe_len,
+                unstable_tail=_unstable_tail,
+                name=self.trace_name,
+            )
+            self._detok = w
+        return w
 
     def _prefill_and_sample(
         self, params, k_pages, v_pages, tokens, page_tables, seq_lens, key,
@@ -1518,6 +1577,34 @@ class LLMEngine:
                 jnp.zeros((B,), jnp.int32),
                 jnp.full((B,), -1, jnp.int32),
             )
+        n_ms = max(1, int(self.decode_steps))
+        if not self.spec_gamma and n_ms > 1:
+            # macro-step program (docs/multistep.md): warmed at the
+            # configured N; other N values compile on first dispatch
+            # (runtime knob flips are a bench/test affair)
+            (
+                _toks, _valid, _last,
+                self.cache.k_pages, self.cache.v_pages,
+            ) = self._profiled(
+                "multistep", f"s{self.max_slots}n{n_ms}",
+                self._multistep_jit(n_ms),
+            )(
+                self.params,
+                self.cache.k_pages,
+                self.cache.v_pages,
+                jnp.zeros((B,), jnp.int32),
+                jnp.zeros((B,), jnp.int32),
+                jnp.zeros((B,), bool),
+                jnp.zeros((B,), jnp.int32),
+                jnp.zeros((B, self.pages_per_slot), jnp.int32),
+                jnp.zeros((B,), bool),
+                self._next_key(),
+                jnp.ones((B,), jnp.float32),
+                jnp.ones((B,), jnp.float32),
+                jnp.zeros((B,), jnp.int32),
+                jnp.full((B,), -1, jnp.int32),
+                jnp.ones((B,), jnp.int32),
+            )
         if self.spec_mode == "ngram":
             B = self.max_slots
             (
@@ -1585,10 +1672,21 @@ class LLMEngine:
         return time.monotonic() - t0
 
     def _finish_stream(self, req: Request, marker: "_Finish") -> None:
+        """THE terminal routing point: every ``_Finish`` put in this
+        engine goes through here. Streams the detok worker owns get their
+        marker enqueued BEHIND any pending text (the FIFO ordering
+        contract, docs/multistep.md) — the worker then runs
+        :meth:`_deliver_finish`; everything else delivers directly."""
+        w = self._detok
+        if w is not None and w.alive and w.owns(req):
+            w.finish(req, marker)
+            return
+        self._deliver_finish(req, marker)
+
+    def _deliver_finish(self, req: Request, marker: "_Finish") -> None:
         """THE terminal delivery: close the request's trace (sweeping any
         still-open spans — queue, decode — so no failure path can leak a
-        dangling span) and only then release the caller's stream. Every
-        ``_Finish`` put in this engine goes through here."""
+        dangling span) and only then release the caller's stream."""
         _rt.finish_request(req, marker.reason, store=self._trace_store)
         # per-request usage record (usage.jsonl): journaled at the SAME
         # terminal point that releases the stream, with the ACCOUNTED
@@ -1925,7 +2023,17 @@ class LLMEngine:
             # mid-decode: KV for [0, position) is complete (every accepted
             # token's predecessor was fed through a finished block); later
             # positions an in-flight block may have written are masked by
-            # position-bounded attention and overwritten on resume
+            # position-bounded attention and overwritten on resume. The
+            # same harvest-boundary argument covers mid-MACRO-step
+            # migration (docs/multistep.md): un-harvested device tokens
+            # are simply never accepted — the checkpoint carries only
+            # committed state, and the peer regenerates the rest
+            # token-identically from the (seed, position) keying.
+            if self._detok is not None and self._detok.owns(req):
+                # drain pending text first: req.emitted_len below must be
+                # the FINAL emitted cursor or the resumed stream would
+                # duplicate/lose chars
+                self._detok.flush(timeout=5.0)
             n_kv = self.cache.pages_for(s.position)
             # the ORIGINAL prompt (explicit on resumed requests); the
             # pages hold KV for base + generated[:-1], which keys their
@@ -2012,6 +2120,10 @@ class LLMEngine:
         self._running = False
         if self._thread and self._thread is not threading.current_thread():
             self._thread.join(timeout=10)
+        if self._detok is not None:
+            # drain held text BEFORE the release sweep: its direct markers
+            # must land behind every chunk the worker still owes
+            self._detok.stop()
         self._release_all(_FINISH if reason == "stop" else _Finish(reason))
         self._flush_token_counters()
         self.usage.flush()  # unthrottled: the final window reaches pushes
@@ -2142,6 +2254,14 @@ class LLMEngine:
         self._pending_harvest.clear()
         self._device_tokens = None
         self._last_dispatch_at = None
+        # queue BEFORE slots: delivering an in-flight marker wakes that
+        # caller, and a caller that immediately resubmits must not have
+        # its fresh request reaped by the tail of this same sweep (the
+        # surviving-loop crash path keeps serving — a post-release
+        # submission stays queued for the next tick instead)
+        for entry in self.policy.drain():
+            self.admission.release(entry)
+            self._finish_stream(entry.payload, marker)
         for slot in self.slots:
             if not slot.free:
                 self._finish_stream(slot.request, marker)
@@ -2153,9 +2273,6 @@ class LLMEngine:
                 else:
                     self._release_slot_pages(slot)
                 slot.request = None
-        for entry in self.policy.drain():
-            self.admission.release(entry)
-            self._finish_stream(entry.payload, marker)
 
     def step(self) -> bool:
         """One scheduler tick: expire deadlines -> admit -> decode -> emit.
@@ -2272,6 +2389,25 @@ class LLMEngine:
                     0, len(s.request.prompt_tokens) - s.prefill.offset
                 )
         _obs.set_prefill_backlog(backlog)
+        # macro-step decode gauges (docs/multistep.md): configured N, the
+        # harvested tokens-per-dispatch over the window since the last
+        # refresh (held when idle), and the detok worker's queue depth
+        d = self._ms_dispatches - self._ms_flush["dispatches"]
+        if d > 0:
+            self._ms_tpd = (
+                self._ms_tokens - self._ms_flush["tokens"]
+            ) / d
+            self._ms_flush = {
+                "dispatches": self._ms_dispatches,
+                "tokens": self._ms_tokens,
+            }
+        _obs.set_multistep_gauges(
+            decode_steps=max(1, int(self.decode_steps)),
+            tokens_per_dispatch=self._ms_tpd,
+            detok_queue_depth=(
+                self._detok.queue_depth() if self._detok is not None else 0
+            ),
+        )
         self._flush_token_counters()
         # per-tenant usage deltas + roofline MFU/MBU gauges ride the same
         # throttle (the flight recorder's tsdb sampler sees them for free)
@@ -3339,61 +3475,146 @@ class LLMEngine:
         prev = self._device_tokens
         if prev is None:
             prev = jnp.zeros((self.max_slots,), jnp.int32)
-        toks, last, self.cache.k_pages, self.cache.v_pages = self._profiled(
-            "block", f"s{self.max_slots}k{self.decode_block}", self._block_jit
-        )(
-            self.params,
-            self.cache.k_pages,
-            self.cache.v_pages,
-            prev,
-            jnp.asarray(self._override.copy()),
-            jnp.asarray(self._override_mask.copy()),
-            jnp.asarray(self._positions.copy()),
-            jnp.asarray(self._page_tables.copy()),
-            jnp.asarray(self._active.copy()),
-            self._next_key(),
-            jnp.asarray(self._temps.copy()),
-            jnp.asarray(self._top_ps.copy()),
-            jnp.asarray(self._top_ks.copy()),
-            jnp.asarray(self._seeds.copy()),
-        )
+        n = max(1, int(self.decode_steps))  # runtime-mutable: read ONCE
+        if n <= 1:
+            # classic pipelined block: byte-identical fall-through
+            toks, last, self.cache.k_pages, self.cache.v_pages = self._profiled(
+                "block", f"s{self.max_slots}k{self.decode_block}",
+                self._block_jit,
+            )(
+                self.params,
+                self.cache.k_pages,
+                self.cache.v_pages,
+                prev,
+                jnp.asarray(self._override.copy()),
+                jnp.asarray(self._override_mask.copy()),
+                jnp.asarray(self._positions.copy()),
+                jnp.asarray(self._page_tables.copy()),
+                jnp.asarray(self._active.copy()),
+                self._next_key(),
+                jnp.asarray(self._temps.copy()),
+                jnp.asarray(self._top_ps.copy()),
+                jnp.asarray(self._top_ks.copy()),
+                jnp.asarray(self._seeds.copy()),
+            )
+            valid = None
+            n = self.decode_block
+        else:
+            # macro-step program (docs/multistep.md): per-slot budgets let
+            # the device die at exactly the token the host would finish on
+            # — remaining max_tokens (counting in-flight un-harvested
+            # tokens) and remaining context, whichever is tighter
+            budgets = np.ones((self.max_slots,), np.int32)
+            for i in live:
+                s = self.slots[i]
+                p = s.request.params
+                g_opt = len(s.generated) + (
+                    int(self._opt_positions[i]) - s.position
+                )
+                budgets[i] = max(1, min(
+                    p.max_tokens - g_opt,
+                    (self.max_model_len - 1) - int(self._opt_positions[i]),
+                ))
+            (
+                toks, valid, last, self.cache.k_pages, self.cache.v_pages,
+            ) = self._profiled(
+                "multistep", f"s{self.max_slots}n{n}", self._multistep_jit(n)
+            )(
+                self.params,
+                self.cache.k_pages,
+                self.cache.v_pages,
+                prev,
+                jnp.asarray(self._override.copy()),
+                jnp.asarray(self._override_mask.copy()),
+                jnp.asarray(self._positions.copy()),
+                jnp.asarray(self._page_tables.copy()),
+                jnp.asarray(self._active.copy()),
+                self._next_key(),
+                jnp.asarray(self._temps.copy()),
+                jnp.asarray(self._top_ps.copy()),
+                jnp.asarray(self._top_ks.copy()),
+                jnp.asarray(self._seeds.copy()),
+                jnp.asarray(budgets),
+            )
         self._device_tokens = last
         # snapshot pins (slot, request, tenancy): request identity alone is
         # not enough — a failover-resumed request is the same object back
         # in a NEW tenancy, and this block belongs to its old one
         self._inflight.append((
             toks,
+            valid,
             [
                 (i, self.slots[i].request, self.slots[i].tenancy)
                 for i in live
             ],
         ))
         for i in live:
-            self._opt_positions[i] += self.decode_block
+            self._opt_positions[i] += n
         _tm(tick, "decode_dispatch")
 
     def _process_block(self) -> bool:
         tick = self._tick
-        toks, snapshot = self._inflight.popleft()
+        toks, valid, snapshot = self._inflight.popleft()
         t_wait = time.monotonic()
         u_start = self._clock()  # usage meter: engine-clock domain
         toks_np = np.asarray(toks)  # [K, B] — the ONE blocking read per block
+        # the macro-step harvest plane (docs/multistep.md): the validity
+        # mask rides the SAME round trip as the tokens — per-slot accept
+        # stops at the first invalid row (the lane died at its stop token
+        # or length budget on-device)
+        valid_np = None if valid is None else np.asarray(valid)
         _obs.record_engine_phase("decode_wait", time.monotonic() - t_wait)
         self.usage.note_phase_seconds("decode", self._clock() - u_start)
         _tm_device(tick, "harvest")
-        self.stats.steps += self.decode_block
+        n_steps = int(toks_np.shape[0])
+        # only steps with a live lane executed (masked_scan's cond skips
+        # the rest once every lane died): count the truth, not the
+        # program length
+        executed = (
+            n_steps if valid_np is None
+            else int(valid_np.any(axis=1).sum())
+        )
+        self.stats.steps += executed
         worked = False
+        accepted = 0
         for i, req, tenancy in snapshot:
             s = self.slots[i]
             if s.request is not req or s.tenancy != tenancy or req.aborted:
                 continue  # slot finished/recycled while the block was in flight
-            for k in range(self.decode_block):
+            taken = 0
+            for k in range(n_steps):
                 if s.request is not req or s.tenancy != tenancy:
                     break  # finished mid-block
+                if valid_np is not None and not valid_np[k, i]:
+                    break  # lane died on-device: the tail rows are holds
                 s.position += 1
                 s.last_token = int(toks_np[k, i])
                 self._accept_token(i, s.last_token)
+                taken += 1
                 worked = True
+            accepted += taken
+            if (
+                valid_np is not None
+                and taken < n_steps
+                and s.request is req
+                and s.tenancy == tenancy
+            ):
+                # the device retired this lane early but the host did NOT
+                # finish the request (a budget/position desync — should
+                # not happen; self-heal rather than diverge): resync the
+                # slot through the fresh-slot override lane, which re-feeds
+                # the last ACCEPTED token at the host-known position
+                s.fresh = True
+        # tokens-per-dispatch accounting covers BOTH paths (N=1 classic
+        # included): the A/B lever the bench reads is the same series
+        self._ms_dispatches += 1
+        self._ms_tokens += accepted
+        _obs.record_multistep_dispatch(
+            tokens=accepted, steps_saved=n_steps - executed
+        )
+        prof = self.profiler
+        if prof is not None:
+            prof.note_dispatch_tokens(accepted, steps=int(self.decode_steps))
         _tm(tick, "accept")
         return worked
 
@@ -3492,7 +3713,8 @@ class LLMEngine:
         req.n_generated += 1
         finished = False
         reason = None
-        if token == self.tokenizer.eos_id:
+        appended = token != self.tokenizer.eos_id
+        if not appended:
             finished, reason = True, "stop"
         else:
             slot.generated.append(token)
@@ -3502,6 +3724,43 @@ class LLMEngine:
                 finished, reason = True, "length"
             elif slot.position + 1 >= self.max_model_len:
                 finished, reason = True, "length"
+
+        # macro-step path (docs/multistep.md): token-level bookkeeping
+        # above stays on the scheduler thread — the harvest boundary — but
+        # detokenization, stop-string scanning, and emission move to the
+        # DetokWorker. Streams the worker already owns keep routing even
+        # after the knob drops back to 1 (ordering), and a dead worker
+        # falls through to the inline path below.
+        w = self._detok
+        if self.decode_steps > 1 or (w is not None and w.owns(req)):
+            if w is None or not w.alive:
+                w = self._ensure_detok()
+            if w.alive:
+                tick = self._tick
+                _tm(tick, "accept")
+                if not w.owns(req):
+                    prior = (
+                        slot.generated[:-1] if appended
+                        else list(slot.generated)
+                    )
+                    w.register(
+                        req, prior,
+                        max(slot.emitted_text_len, req.emitted_len),
+                    )
+                if appended:
+                    w.feed(req, token)
+                # enqueue cost only: the decode itself runs off-thread
+                _tm(tick, "detokenize")
+                if finished:
+                    # release BEFORE the finish marker is enqueued: the
+                    # worker thread can deliver it (and wake the client)
+                    # ahead of the scheduler's next bytecode, and a
+                    # client-visible finish must imply pages/slot freed
+                    self._release_slot_pages(slot)
+                    slot.request = None
+                    self._active[slot_idx] = False
+                    self._finish_stream(req, _Finish(reason))
+                return
 
         # incremental detokenization: emit the stable new suffix. Profiled
         # as its own phase (the ROADMAP #3 "move detokenization off the
@@ -3535,10 +3794,13 @@ class LLMEngine:
             # this replica dies resumes emission from exactly this cursor
             req.emitted_len = slot.emitted_text_len
         if finished:
-            self._finish_stream(req, _Finish(reason))
+            # same release-before-finish ordering as the worker branch
+            # above: a client that wakes on the marker must observe the
+            # slot and its pages already freed
             self._release_slot_pages(slot)
             slot.request = None
             self._active[slot_idx] = False
+            self._finish_stream(req, _Finish(reason))
 
 
 def build_engine(

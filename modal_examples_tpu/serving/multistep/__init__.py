@@ -1,0 +1,30 @@
+"""Macro-step decode runtime (docs/multistep.md).
+
+One jitted program runs N decode+sample steps per dispatch — ``lax.scan``
+over the engine's decode step with the KV scatter fused between steps,
+on-device (seed, position)-keyed sampling, and stop-token/length-budget
+early-exit via ``lax.cond`` (ops.scan_loop.masked_scan) — so the host
+pays ONE dispatch and ONE blocking read per N tokens instead of per
+``decode_block``. The harvest plane returns per-slot validity masks; the
+scheduler accepts only valid tokens, keeping the PR-12 checkpoint /
+live-migration boundary exact while a slot holds un-harvested tokens.
+Detokenization moves off the scheduler thread onto :class:`DetokWorker`.
+
+The knob is ``LLMEngine(decode_steps=...)`` / ``MTPU_DECODE_STEPS``,
+runtime-mutable like ``prefill_budget``; 1 (the default) is the classic
+one-block-per-dispatch path, byte-identical fall-through.
+"""
+
+from .detok import DetokWorker
+from .runtime import (
+    DECODE_STEPS_ENV,
+    build_multistep_fn,
+    resolve_decode_steps,
+)
+
+__all__ = [
+    "DECODE_STEPS_ENV",
+    "DetokWorker",
+    "build_multistep_fn",
+    "resolve_decode_steps",
+]

@@ -26,6 +26,35 @@ class SamplingParams:
     deadline_s: float | None = None
 
 
+def seeded_row_keys(
+    key: jax.Array,
+    seeds: jax.Array,  # [B] int32; >=0 selects the seeded derivation
+    step_ids: jax.Array,  # [B] int32 per-slot decode position
+) -> jax.Array:  # [B, 2] PRNG keys
+    """Per-row sampling keys, (seed, position)-derived for seeded rows.
+
+    A row with ``seeds[i] >= 0`` gets ``fold_in(fold_in(PRNGKey(0),
+    seed), step_id)`` — a function of the REQUEST's seed and its absolute
+    decode position only. This is the exactness anchor the multi-step
+    decode runtime relies on (docs/multistep.md): classic one-block-
+    per-dispatch and N-step macro dispatch burn the engine key
+    differently, but every real request carries a seed (submit() assigns
+    ``auto_seed`` when the caller passes none), so its sampled tokens
+    depend on nothing the dispatch shape changes. Unseeded rows fall back
+    to splits of the per-dispatch engine ``key`` and make no cross-shape
+    promise."""
+    B = seeds.shape[0]
+    base_keys = jax.random.split(key, B)
+
+    def row_key(i):
+        seeded = jax.random.fold_in(
+            jax.random.fold_in(jax.random.PRNGKey(0), seeds[i]), step_ids[i]
+        )
+        return jnp.where(seeds[i] >= 0, seeded, base_keys[i])
+
+    return jax.vmap(row_key)(jnp.arange(B))
+
+
 def sample(
     logits: jax.Array,  # [B, V] f32
     key: jax.Array,
@@ -77,15 +106,7 @@ def sample(
         B = logits.shape[0]
         if step_ids is None:
             step_ids = jnp.zeros((B,), jnp.int32)
-        base_keys = jax.random.split(key, B)
-
-        def row_key(i):
-            seeded = jax.random.fold_in(
-                jax.random.fold_in(jax.random.PRNGKey(0), seeds[i]), step_ids[i]
-            )
-            return jnp.where(seeds[i] >= 0, seeded, base_keys[i])
-
-        keys = jax.vmap(row_key)(jnp.arange(B))
+        keys = seeded_row_keys(key, seeds, step_ids)
         sampled = jax.vmap(
             lambda k, row: jax.random.categorical(k, row)
         )(keys, scaled)

@@ -120,8 +120,8 @@ class TestChaosUnderLoad:
     ):
         monkeypatch.setenv("MTPU_TRACE_SAMPLE", "0")
         from modal_examples_tpu.faults.chaos import (
-            check_drained,
             check_router_recovered,
+            settle_drained,
         )
         from modal_examples_tpu.faults.inject import FaultPlan, active
         from modal_examples_tpu.fleet.loadgen import LoadGenerator, RequestClass
@@ -190,7 +190,7 @@ class TestChaosUnderLoad:
                 assert step["wedged"] == 0, step
                 assert step["errors"] == 0, step
             # fleet invariants (PR 8) after the fault window drained
-            assert check_drained({"uni-a": eng_a, "uni-b": eng_b}) == []
+            assert settle_drained({"uni-a": eng_a, "uni-b": eng_b}) == []
             assert check_router_recovered(router) == []
             # the measured self-healing clause: the fault window still
             # delivered a bounded fraction of fault-free goodput
@@ -212,8 +212,8 @@ class TestDecodeReplicaDeathMidStream:
         import threading
 
         from modal_examples_tpu.faults.chaos import (
-            check_drained,
             check_router_recovered,
+            settle_drained,
         )
         from modal_examples_tpu.faults.inject import FaultPlan, active
         from modal_examples_tpu.models import llama
@@ -287,7 +287,7 @@ class TestDecodeReplicaDeathMidStream:
                 assert req.finish_reason in ("stop", "length"), req.request_id
                 assert "".join(outs[req.request_id]) == reference[req.prompt]
             # PR-8 fleet invariants after the episode
-            assert check_drained({"death-a": eng_a, "death-b": eng_b}) == []
+            assert settle_drained({"death-a": eng_a, "death-b": eng_b}) == []
             assert check_router_recovered(router) == []
         finally:
             eng_a.stop()
@@ -302,8 +302,8 @@ class TestDecodeReplicaDeathMidStream:
         not just asserted on a quiet fleet."""
         monkeypatch.setenv("MTPU_TRACE_SAMPLE", "0")
         from modal_examples_tpu.faults.chaos import (
-            check_drained,
             check_router_recovered,
+            settle_drained,
         )
         from modal_examples_tpu.faults.inject import FaultPlan, active
         from modal_examples_tpu.fleet.loadgen import LoadGenerator, RequestClass
@@ -357,7 +357,7 @@ class TestDecodeReplicaDeathMidStream:
             assert faulted["wedged"] == 0, faulted
             assert faulted["errors"] == 0, faulted
             assert faulted["goodput_rps"] > 0
-            assert check_drained({"dload-a": eng_a, "dload-b": eng_b}) == []
+            assert settle_drained({"dload-a": eng_a, "dload-b": eng_b}) == []
             assert check_router_recovered(router) == []
         finally:
             server.stop()
@@ -376,8 +376,8 @@ class TestSilentHangUnderLoad:
     def test_freeze_under_load_recovers(self, jax_cpu, state_dir, monkeypatch):
         monkeypatch.setenv("MTPU_TRACE_SAMPLE", "0")
         from modal_examples_tpu.faults.chaos import (
-            check_drained,
             check_router_recovered,
+            settle_drained,
         )
         from modal_examples_tpu.faults.inject import FaultPlan, active
         from modal_examples_tpu.fleet.loadgen import LoadGenerator, RequestClass
@@ -459,7 +459,7 @@ class TestSilentHangUnderLoad:
             # the ladder actually ran: a wedge transition + an error-stop
             acted = {e["action"] for e in watchdog.events}
             assert "stop_revive" in acted, watchdog.events
-            assert check_drained({"hang-a": eng_a, "hang-b": eng_b}) == []
+            assert settle_drained({"hang-a": eng_a, "hang-b": eng_b}) == []
             assert check_router_recovered(router) == []
         finally:
             if watchdog is not None:

@@ -1588,6 +1588,140 @@ def test_canary_series_declared_and_emitted():
     )
 
 
+def test_multistep_series_declared_and_emitted():
+    """Closure for the macro-step decode series (``mtpu_multistep_*``),
+    both directions (the canary-series guard pattern): every declared
+    catalog constant must be referenced by a live emitter/reader, AND
+    every multistep recorder in observability/metrics.py must have a call
+    site outside metrics.py — a recorder nothing calls means the
+    tokens-per-dispatch A/B the bench gates on silently reads zeros."""
+    from modal_examples_tpu.observability import catalog
+
+    consts = {
+        attr: val
+        for attr, val in vars(catalog).items()
+        if isinstance(val, str) and val.startswith("mtpu_multistep_")
+    }
+    assert len(consts) >= 6, consts
+    catalog_path = PKG_ROOT / "observability" / "catalog.py"
+    package_src = {
+        path: path.read_text()
+        for path in sorted(PKG_ROOT.rglob("*.py"))
+        if path != catalog_path
+    }
+    unused = [
+        attr for attr in consts
+        if not any(
+            re.search(rf"\b{attr}\b", src) for src in package_src.values()
+        )
+    ]
+    assert not unused, (
+        "multistep series declared in the catalog but never referenced by "
+        f"an emitter/reader in the package: {unused}"
+    )
+    metrics_path = PKG_ROOT / "observability" / "metrics.py"
+    recorders = ("record_multistep_dispatch", "set_multistep_gauges")
+    orphans = [
+        fn for fn in recorders
+        if not any(
+            re.search(rf"\b{fn}\(", src)
+            for path, src in package_src.items()
+            if path != metrics_path
+        )
+    ]
+    assert not orphans, (
+        f"multistep recorders with no call site outside metrics.py: {orphans}"
+    )
+
+
+#: the decode harvest/accept path (docs/multistep.md#harvest-boundary):
+#: these engine functions sit between a harvested token matrix and the
+#: client stream, and the multistep plane's whole point is ONE blocking
+#: device read per dispatch — so blocking host<-device materialization
+#: (np.asarray / np.array / .item()) is banned here outside the blessed
+#: harvest reads in ``_process_block``
+_HARVEST_PATH_FUNCS = {
+    "_process_block", "_accept_token", "_finish_stream", "_deliver_finish",
+}
+#: the blessed sites: the block-level token + validity reads — exactly the
+#: multistep harvest plane, one (rel_path, dotted.func) entry
+_HARVEST_READ_ALLOWLIST = {
+    ("serving/engine.py", "LLMEngine._process_block"),
+}
+
+
+def test_harvest_path_has_no_per_token_device_reads():
+    """AST guard for the macro-step harvest boundary (docs/multistep.md):
+    in the engine's decode harvest/accept functions and everywhere in
+    serving/multistep/, the only blocking device materialization
+    (``np.asarray`` / ``np.array`` / ``.item()``) allowed is the
+    block-level harvest in ``_process_block`` — and that function performs
+    exactly two (the token matrix and the validity mask). A read anywhere
+    else on this path is a per-token host round-trip, the exact overhead
+    the N-step dispatch exists to amortize (frozen allowlist, exact match
+    both ways — a removed site prunes its entry)."""
+    targets = [
+        (PKG_ROOT / "serving" / "engine.py", _HARVEST_PATH_FUNCS),
+    ] + [
+        (path, None)
+        for path in sorted((PKG_ROOT / "serving" / "multistep").glob("*.py"))
+    ]
+    found = set()
+    blessed_reads = 0
+
+    def is_blocking_read(call: ast.Call) -> bool:
+        f = call.func
+        if (
+            isinstance(f, ast.Attribute)
+            and f.attr in ("asarray", "array")
+            and isinstance(f.value, ast.Name)
+            and f.value.id == "np"
+        ):
+            return True
+        return isinstance(f, ast.Attribute) and f.attr == "item"
+
+    for path, only_funcs in targets:
+        tree = ast.parse(path.read_text())
+        rel = str(path.relative_to(PKG_ROOT.parent / "modal_examples_tpu"))
+
+        def walk(node, stack):
+            nonlocal blessed_reads
+            for child in ast.iter_child_nodes(node):
+                nstack = stack
+                if isinstance(
+                    child,
+                    (ast.FunctionDef, ast.AsyncFunctionDef, ast.ClassDef),
+                ):
+                    nstack = stack + [child.name]
+                if isinstance(child, ast.Call) and is_blocking_read(child):
+                    in_scope = only_funcs is None or any(
+                        name in only_funcs for name in stack
+                    )
+                    if in_scope:
+                        site = (rel, ".".join(stack) or "<module>")
+                        found.add(site)
+                        if site in _HARVEST_READ_ALLOWLIST:
+                            blessed_reads += 1
+                walk(child, nstack)
+
+        walk(tree, [])
+
+    new_sites = found - _HARVEST_READ_ALLOWLIST
+    assert not new_sites, (
+        "blocking device reads on the decode harvest path outside the "
+        "multistep harvest plane — accept/detokenize must work from the "
+        f"already-harvested block: {sorted(new_sites)}"
+    )
+    stale = _HARVEST_READ_ALLOWLIST - found
+    assert not stale, (
+        f"stale allowlist entries (site removed — prune them): {sorted(stale)}"
+    )
+    assert blessed_reads == 2, (
+        "_process_block must perform exactly TWO blocking reads (token "
+        f"matrix + validity mask), found {blessed_reads}"
+    )
+
+
 def test_every_journal_has_a_docs_table_row():
     """The docs half of the JOURNALS closure (the catalog-series guard
     applied to the journal table): every named journal in
