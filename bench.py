@@ -161,6 +161,23 @@ CONFIGS = {
     "tiny-spec-ngram": dict(
         slots=4, max_len=128, max_tokens=16, timeout=420, spec=("ngram", 2),
     ),
+    # CPU path-proof of fused adaptive speculation (test_bench_contract,
+    # docs/speculative.md#gamma-schedule): spec-off vs fixed-γ vs adaptive
+    # on the same warm engine over a MIXED acceptance population
+    # (repetitive prompts the n-gram proposer nails + prose it can't) —
+    # the json's `spec` section carries gamma_p50 / acceptance_rate /
+    # tokens_per_dispatch / fallback_rounds and the per-arm TPOT tails
+    # benchdiff gates on (speculation pays where acceptance is high,
+    # and the controller's retreat must keep the adaptive arm no slower
+    # than spec-off where it isn't)
+    # decode_block=1 isolates speculation from macro-step amortization
+    # (same rationale as tiny-multistep): the spec-off arm pays one host
+    # round-trip per token, so the A/B measures what the γ-deep verify
+    # round buys, not what block fusion buys
+    "tiny-spec-adaptive": dict(
+        slots=4, max_len=128, max_tokens=16, timeout=420,
+        spec=("ngram", 4), spec_ab=True, decode_block=1,
+    ),
     # CPU path-proof of stall-free admission (test_bench_contract): the
     # same mixed-traffic interference A/B the 7B config above runs on chip
     # — an interactive stream's TPOT while long prompts chunk-prefill,
@@ -187,6 +204,14 @@ CONFIGS = {
     "llama2-7b-int8-multistep": dict(
         slots=16, max_len=256, max_tokens=128, timeout=1500, quant="int8",
         kv_dtype="int8", multistep=8,
+    ),
+    # the on-chip adaptive-speculation A/B at the int8 headline shape
+    # (revalidate_chip.sh, behind the benchdiff gate): prompt-lookup
+    # proposals against real llama2-7b weights, spec-off vs fixed-γ vs
+    # the acceptance-driven controller on the same warm engine
+    "llama2-7b-int8-spec-adaptive": dict(
+        slots=16, max_len=256, max_tokens=128, timeout=1500, quant="int8",
+        kv_dtype="int8", spec=("ngram", 4), spec_ab=True, decode_block=1,
     ),
     # CPU path-proof of the chaos harness (test_bench_contract): after the
     # measured run, the seeded fault-injection episode schedule drives a
@@ -543,6 +568,156 @@ def _measure_multistep(engine, spec: dict) -> dict:
         section["host_ms_per_token_delta"] = round(
             classic["host_ms_per_token"] - multi["host_ms_per_token"], 4
         )
+    return section
+
+
+def _measure_spec_adaptive(engine, spec: dict) -> dict:
+    """Fused-speculation A/B (docs/speculative.md#gamma-schedule): the same
+    warm engine runs an identical MIXED-acceptance population three times
+    via the runtime-mutable spec knobs — spec off (depth 0), fixed full γ,
+    and the adaptive controller — so both halves of the contract land in
+    one json section: speculation pays where acceptance is high
+    (``tokens_per_dispatch`` > 1 on the arms that speculate), and the
+    controller's retreat means adaptivity can never cost latency (the
+    adaptive arm's TPOT p95 vs the spec-off arm's is the benchdiff gate).
+    Greedy traffic throughout — only greedy lanes speculate (the fused
+    program's exactness contract, docs/speculative.md#exactness)."""
+    import threading
+
+    import numpy as _np
+
+    from modal_examples_tpu.serving import SamplingParams
+
+    sp = SamplingParams(max_tokens=spec["max_tokens"], temperature=0.0)
+    # two acceptance regimes, measured separately because they gate two
+    # DIFFERENT contracts: "accept" (looping text the n-gram proposer
+    # nails → speculation must pay: tokens_per_dispatch > 1) and
+    # "hostile" (the same bigram followed by a different token every
+    # occurrence → proposals fire and miss, so the controller must
+    # shrink γ and the adaptive arm must cost no more than spec-off)
+    n = spec["slots"] * 2
+    populations = {
+        "accept": ["one two three " * 6 for _ in range(n)],
+        "hostile": [
+            "one two three one two four one two five one two six one two"
+            for _ in range(n)
+        ],
+    }
+    # bounded concurrency (slots-1 outstanding): a SATURATED batch is the
+    # controller's global-pressure regime (it rightly speculates for no
+    # one — verify flops scale with γ+1 per lane and a full batch is
+    # already amortized), which would make every arm identical; the A/B
+    # exists to expose the PER-REQUEST acceptance policy, so the traffic
+    # keeps one slot of headroom like latency-bound serving does
+    conc = threading.Semaphore(max(1, spec["slots"] - 1))
+
+    def run_arm(depth: int, adaptive: bool, prompts: list) -> dict:
+        engine.spec_depth = depth
+        engine.spec_adaptive = adaptive
+        for _ in engine.stream(engine.submit("spec arm warm " * 3, sp)):
+            pass
+        # freeze the gauge sweep so it can't drain the γ window mid-arm;
+        # the arm computes its own p50 from the full window
+        saved_wall = engine._metrics_wall
+        engine._metrics_wall = time.monotonic() + 3600.0
+        del engine._spec_gamma_window[:]
+        r0 = engine._spec_rounds
+        k0 = engine._spec_round_tokens
+        f0 = engine._spec_fallbacks
+        p0 = engine.stats.spec_proposed
+        a0 = engine.stats.spec_accepted
+        # per-REQUEST TPOT ((t_last - t_first) / (n - 1)), quantiles
+        # across requests: spec rounds deliver tokens in bursts, so raw
+        # inter-arrival gap quantiles would structurally punish any
+        # multi-token dispatch (most gaps ~0, the tail = one whole round)
+        # — the same reason _measure_multistep normalizes tick_p95 by N
+        tpots: list[float] = []
+        t0 = time.time()
+
+        def drain(prompt):
+            with conc:
+                r = engine.submit(prompt, sp)
+                first = last = None
+                pieces = 0
+                for _ in engine.stream(r):
+                    last = time.monotonic()
+                    if first is None:
+                        first = last
+                    pieces += 1
+                n = max(r.n_generated, pieces)
+                if first is not None and n > 1:
+                    tpots.append((last - first) / (n - 1))
+
+        threads = [
+            threading.Thread(target=drain, args=(p,)) for p in prompts
+        ]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        elapsed = time.time() - t0
+        rounds = engine._spec_rounds - r0
+        tokens = engine._spec_round_tokens - k0
+        proposed = engine.stats.spec_proposed - p0
+        accepted = engine.stats.spec_accepted - a0
+        window = list(engine._spec_gamma_window)
+        engine._metrics_wall = saved_wall
+        tpots.sort()
+
+        def q(p: float) -> float:
+            if not tpots:
+                return 0.0
+            return tpots[min(len(tpots) - 1, int(p * len(tpots)))]
+
+        return {
+            "spec_rounds": int(rounds),
+            "fallback_rounds": int(engine._spec_fallbacks - f0),
+            "tokens_per_dispatch": (
+                round(tokens / rounds, 3) if rounds else None
+            ),
+            "gamma_p50": (
+                float(_np.median(window)) if window else 0.0
+            ),
+            "proposed": int(proposed),
+            "accepted": int(accepted),
+            "acceptance_rate": (
+                round(accepted / proposed, 4) if proposed else 0.0
+            ),
+            "tpot_p50": round(q(0.50), 6),
+            "tpot_p95": round(q(0.95), 6),
+            "elapsed_s": round(elapsed, 3),
+        }
+
+    saved_depth, saved_adaptive = engine.spec_depth, engine.spec_adaptive
+    section: dict = {}
+    try:
+        for name, prompts in populations.items():
+            section[name] = {
+                "off": run_arm(0, False, prompts),
+                "fixed": run_arm(engine.spec_gamma, False, prompts),
+                "adaptive": run_arm(engine.spec_gamma, True, prompts),
+            }
+    finally:
+        engine.spec_depth = saved_depth
+        engine.spec_adaptive = saved_adaptive
+    accept, hostile = section["accept"], section["hostile"]
+    section.update({
+        # the benchdiff-gated scalars (utils/bench_diff.py METRICS): the
+        # production mode is adaptive, so its numbers are the headline.
+        # tokens_per_dispatch/gamma_p50 come from the regime speculation
+        # exists for; fallback_rounds + the TPOT ratio from the regime
+        # the controller exists for
+        "gamma_p50": accept["adaptive"]["gamma_p50"],
+        "tokens_per_dispatch": accept["adaptive"]["tokens_per_dispatch"],
+        "fallback_rounds": hostile["adaptive"]["fallback_rounds"],
+        # >= ~1 means the controller kept the hostile traffic free:
+        # adaptive TPOT tail no worse than never speculating at all
+        "adaptive_vs_off_tpot_p95": round(
+            hostile["off"]["tpot_p95"]
+            / max(hostile["adaptive"]["tpot_p95"], 1e-9),
+            3,
+        ),
+    })
     return section
 
 
@@ -1397,6 +1572,14 @@ def _child(model: str) -> None:
     if spec.get("multistep"):
         multistep_info = _measure_multistep(engine, spec)
 
+    # fused adaptive speculation A/B (spec_ab configs,
+    # docs/speculative.md#gamma-schedule): spec-off vs fixed-γ vs the
+    # acceptance-driven controller on the same warm engine via the
+    # runtime-mutable knobs — merged into the `spec` json section below
+    spec_ab_info = None
+    if spec.get("spec") and spec.get("spec_ab"):
+        spec_ab_info = _measure_spec_adaptive(engine, spec)
+
     # correctness canary (docs/observability.md#correctness-canary): a
     # record-then-compare golden-set round on the same warm engine, BEFORE
     # the fleet/failover/recovery arms stop it — drift_count must be 0 on
@@ -1530,9 +1713,14 @@ def _child(model: str) -> None:
         spec_info = {
             "mode": engine.spec_mode,
             "gamma": engine.spec_gamma,
+            "adaptive": bool(engine.spec_adaptive),
             "proposed": int(engine.stats.spec_proposed),
             "accepted": int(engine.stats.spec_accepted),
             "acceptance_rate": round(engine.stats.acceptance_rate(), 4),
+            # spec_ab configs: the off/fixed/adaptive A/B arms + the
+            # benchdiff-gated scalars (gamma_p50, tokens_per_dispatch,
+            # fallback_rounds, adaptive_vs_off_tpot_p95)
+            **(spec_ab_info or {}),
         }
     # disaggregated serving (docs/disagg.md): migration volume + latency and
     # the tiered prefix cache's per-tier hit mix, only for disagg configs

@@ -78,6 +78,16 @@ fi
 #     ngram config (acceptance-driven win) vs its no-spec A/B partner
 #     llama2-7b-int8-kv8-s36 from the full bench below
 timeout 1500 env BENCH_MODEL=llama2-7b-int8-spec-ngram BENCH_NO_SECONDARY=1 python bench.py || fail 17
+# 10b. fused ADAPTIVE speculation A/B at the int8 headline shape
+#      (docs/speculative.md#gamma-schedule), behind the regression gate:
+#      spec-off vs fixed-γ vs the acceptance-driven controller on the same
+#      warm engine via the runtime-mutable spec_depth/spec_adaptive knobs —
+#      the json's `spec` section carries per-arm TPOT tails plus
+#      gamma_p50/tokens_per_dispatch/fallback_rounds; bench_diff's
+#      spec.tokens_per_dispatch and spec.adaptive_vs_off_tpot_p95 gate it
+#      from the next round on (the latter must hold ~>=1: adaptivity may
+#      never be slower than not speculating)
+timeout 1500 env BENCH_MODEL=llama2-7b-int8-spec-adaptive BENCH_NO_SECONDARY=1 python bench.py || fail 30
 # 11. stall-free admission under mixed traffic (round 10, docs/scheduling.md):
 #     the ctx-1024 int8 shape with an interactive stream decoding while
 #     ~1k-token prompts chunk-prefill — budgeted (256 tok/tick = one chunk)

@@ -596,6 +596,9 @@ def cmd_profile(argv: list[str]) -> int:
     ratio = None
     decode_steps = None
     tokens_per_dispatch = None
+    spec_gamma = None
+    spec_accept = None
+    spec_tpd = None
     lookups: dict = {}
     roofline: dict = {}
     if merged is not None:
@@ -625,6 +628,11 @@ def cmd_profile(argv: list[str]) -> int:
         tokens_per_dispatch = (
             merged.peak(C.MULTISTEP_TOKENS_PER_DISPATCH) or None
         )
+        # fused speculative rounds (docs/speculative.md#series): dispatched
+        # γ p50 + acceptance — gauges, so peak, never sum
+        spec_gamma = merged.peak(C.SPEC_GAMMA) or None
+        spec_accept = merged.peak(C.SPEC_ACCEPTANCE_RATE) or None
+        spec_tpd = merged.peak(C.SPEC_TOKENS_PER_DISPATCH) or None
         for labels, v in merged.series(C.COMPILES_TOTAL):
             entry = lookups.setdefault(
                 labels.get("program", "?"), {"hit": 0, "miss": 0}
@@ -639,6 +647,9 @@ def cmd_profile(argv: list[str]) -> int:
             "host_overhead_ratio": ratio,
             "decode_steps": decode_steps,
             "tokens_per_dispatch": tokens_per_dispatch,
+            "spec_gamma": spec_gamma,
+            "spec_acceptance_rate": spec_accept,
+            "spec_tokens_per_dispatch": spec_tpd,
             "roofline": roofline,
             "phases": phases,
             "compile_lookups": lookups,
@@ -661,6 +672,13 @@ def cmd_profile(argv: list[str]) -> int:
         print(
             f"macro-step decode: N={decode_steps:.0f} configured, "
             f"{tpd} tokens/dispatch"
+        )
+    if spec_gamma is not None or spec_accept:
+        acc = f"{spec_accept:.2f}" if spec_accept is not None else "-"
+        stpd = f"{spec_tpd:.1f}" if spec_tpd is not None else "-"
+        print(
+            f"speculative decode: gamma p50 {spec_gamma or 0:.0f}, "
+            f"acceptance {acc}, {stpd} tokens/round"
         )
     tot = roofline.get("total")
     if tot is not None:
@@ -1401,6 +1419,16 @@ def cmd_top(argv: list[str]) -> int:
             print(
                 f"macro-step decode: N={ms_n:.0f}   tokens/dispatch "
                 f"{merged.peak(C.MULTISTEP_TOKENS_PER_DISPATCH):.1f}"
+            )
+        # fused speculative decode (docs/speculative.md#series): dispatched
+        # γ p50 + acceptance, when a spec engine has pushed (gauges: peak)
+        sp_acc = merged.peak(C.SPEC_ACCEPTANCE_RATE)
+        if merged.peak(C.SPEC_GAMMA) or sp_acc:
+            print(
+                f"speculative decode: gamma p50 "
+                f"{merged.peak(C.SPEC_GAMMA):.0f}   acceptance "
+                f"{sp_acc:.2f}   tokens/round "
+                f"{merged.peak(C.SPEC_TOKENS_PER_DISPATCH):.1f}"
             )
         # the resolved decode plan, incl. the tensor-parallel degree and the
         # PER-SHARD ragged variant (paged_impl_plan(mesh=...)) — so a TP

@@ -149,6 +149,28 @@ def check_router_recovered(router) -> list[str]:
     return out
 
 
+def settle_recovered(router, timeout: float = 10.0,
+                     poll_s: float = 0.05) -> list[str]:
+    """Poll :func:`check_router_recovered` until clean or ``timeout``,
+    driving the router's re-probe pass (``router.reprobe()``) each round;
+    returns the final violation list (empty on success). Re-admission —
+    and the revival probe that restarts a stopped-on-error engine — only
+    advances on a placement walk, so a replica that died near the END of
+    a load window stays marked down once traffic stops, and an instant
+    recovery check is racy by construction: the :func:`settle_drained`
+    lesson applied to router health. A genuinely unrecoverable replica
+    still fails, just ``timeout`` seconds later."""
+    deadline = time.monotonic() + timeout
+    while True:
+        reprobe = getattr(router, "reprobe", None)
+        if reprobe is not None:
+            reprobe()
+        violations = check_router_recovered(router)
+        if not violations or time.monotonic() >= deadline:
+            return violations
+        time.sleep(poll_s)
+
+
 def check_token_identity(results: list, reference: dict) -> list[str]:
     """Requests that finished normally must match the fault-free reference
     byte for byte — faults may kill requests, never corrupt survivors."""

@@ -164,6 +164,25 @@ DEFAULT_RULES: tuple[AlertRule, ...] = (
         ),
     ),
     AlertRule(
+        name="spec_acceptance_collapse",
+        series=C.SPEC_ACCEPTANCE_RATE,
+        op="<=",
+        threshold=0.3,
+        for_s=20.0,
+        clear_s=10.0,
+        # guard on dispatched depth: acceptance is only meaningful while
+        # the engine is actually speculating — once the adaptive controller
+        # drives gamma to 0 the rate freezes and must not keep paging
+        guard_series=C.SPEC_GAMMA,
+        guard_threshold=0.0,
+        description=(
+            "draft acceptance collapsed while speculation is still being "
+            "dispatched — the draft stopped predicting the target "
+            "(docs/speculative.md#gamma-schedule); expect the adaptive "
+            "controller to drive gamma down, else spec is a latency tax"
+        ),
+    ),
+    AlertRule(
         name="no_token_progress",
         series=C.GENERATED_TOKENS_TOTAL,
         kind="absence",

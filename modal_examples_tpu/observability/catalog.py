@@ -308,6 +308,23 @@ MULTISTEP_EARLY_EXIT_STEPS_TOTAL = "mtpu_multistep_early_exit_steps_total"
 #: depth means text emission is falling behind the scheduler)
 MULTISTEP_DETOK_QUEUE_DEPTH = "mtpu_multistep_detok_queue_depth"
 
+# -- fused speculative decoding (serving/spec_runtime/, docs/speculative.md) --
+
+#: gauge: dispatched per-slot speculation depth, p50 over the last gauge
+#: window (the adaptive controller's OUTPUT — 0 means lanes are riding the
+#: classic γ=0 path inside the fused round)
+SPEC_GAMMA = "mtpu_spec_gamma"
+#: gauge: harvested tokens per speculative round over the last gauge window
+#: (>1 is the whole point; held when idle)
+SPEC_TOKENS_PER_DISPATCH = "mtpu_spec_tokens_per_dispatch"
+#: gauge: lifetime draft-token acceptance rate (accepted / proposed) — the
+#: ``spec_acceptance_collapse`` alert's series, guarded on SPEC_GAMMA > 0
+SPEC_ACCEPTANCE_RATE = "mtpu_spec_acceptance_rate"
+#: counter: whole decode rounds where NO slot speculated (pressure or
+#: acceptance collapse) and the engine fell through to the classic block
+#: program — the "spec never costs latency" escape hatch firing
+SPEC_FALLBACK_TOTAL = "mtpu_spec_fallback_total"
+
 # -- flight recorder (observability/timeseries.py / alerts.py / incident.py,
 #    docs/observability.md#metrics-history) ----------------------------------
 
@@ -347,7 +364,8 @@ KV_PAGES_FREE = "mtpu_kv_pages_free"
 DECODE_IMPL = "mtpu_decode_impl"
 SPEC_PROPOSED_TOTAL = "mtpu_spec_proposed_total"
 SPEC_ACCEPTED_TOTAL = "mtpu_spec_accepted_total"
-SPEC_ACCEPTANCE_RATE = "mtpu_spec_acceptance_rate"
+# (SPEC_ACCEPTANCE_RATE lives in the fused-speculative section above — the
+# /metrics hand-built exposition and the gauge sweep share one name)
 PREFIX_CACHE_HITS_TOTAL = "mtpu_prefix_cache_hits_total"
 PREFIX_CACHE_MISSES_TOTAL = "mtpu_prefix_cache_misses_total"
 PREFIX_CACHED_PAGES = "mtpu_prefix_cached_pages"
@@ -827,10 +845,6 @@ CATALOG: dict[str, dict] = {
         "type": "counter", "labels": [],
         "help": "draft tokens accepted by the target",
     },
-    SPEC_ACCEPTANCE_RATE: {
-        "type": "gauge", "labels": [],
-        "help": "speculative acceptance rate",
-    },
     PREFIX_CACHE_HITS_TOTAL: {
         "type": "counter", "labels": [],
         "help": "prefix-cache admission hits",
@@ -939,6 +953,25 @@ CATALOG: dict[str, dict] = {
         "type": "gauge", "labels": [],
         "help": "events pending on the detokenization worker queue",
     },
+    SPEC_GAMMA: {
+        "type": "gauge", "labels": [],
+        "help": "dispatched per-slot speculation depth, p50 over the last "
+                "gauge window (adaptive controller output; 0=classic lane)",
+    },
+    SPEC_TOKENS_PER_DISPATCH: {
+        "type": "gauge", "labels": [],
+        "help": "harvested tokens per speculative round over the last "
+                "gauge window",
+    },
+    SPEC_ACCEPTANCE_RATE: {
+        "type": "gauge", "labels": [],
+        "help": "lifetime draft-token acceptance rate (accepted/proposed)",
+    },
+    SPEC_FALLBACK_TOTAL: {
+        "type": "counter", "labels": [],
+        "help": "whole rounds where no slot speculated and the engine fell "
+                "through to the classic block program",
+    },
 }
 
 #: every declared metric name (the static guard's allowlist)
@@ -1018,9 +1051,10 @@ SPAN_CATALOG: dict[str, dict] = {
                 "one timeline",
     },
     "spec_verify": {
-        "attrs": ["replica", "proposed", "accepted"],
-        "help": "one speculative verify tick's outcome for this request "
-                "(event)",
+        "attrs": ["replica", "proposed", "accepted", "gamma"],
+        "help": "one fused speculative round's outcome for this request "
+                "(event; gamma = the depth the adaptive controller "
+                "dispatched, docs/speculative.md#gamma-schedule)",
     },
     "fault": {
         "attrs": ["replica", "point"],

@@ -1054,3 +1054,39 @@ def set_multistep_gauges(
         C.MULTISTEP_DETOK_QUEUE_DEPTH, float(detok_queue_depth),
         help=C.CATALOG[C.MULTISTEP_DETOK_QUEUE_DEPTH]["help"],
     )
+
+
+def set_spec_gauges(
+    *, gamma: float, tokens_per_dispatch: float, acceptance_rate: float,
+    registry: Registry | None = None,
+) -> None:
+    """Fused speculative-round gauges (docs/speculative.md#series),
+    refreshed with the engine's gauge sweep. ``gamma`` is the p50 of the
+    per-slot depths actually dispatched over the window — the adaptive
+    controller's output, not the configured cap."""
+    reg = _reg(registry)
+    reg.gauge_set(
+        C.SPEC_GAMMA, float(gamma),
+        help=C.CATALOG[C.SPEC_GAMMA]["help"],
+    )
+    reg.gauge_set(
+        C.SPEC_TOKENS_PER_DISPATCH, float(tokens_per_dispatch),
+        help=C.CATALOG[C.SPEC_TOKENS_PER_DISPATCH]["help"],
+    )
+    reg.gauge_set(
+        C.SPEC_ACCEPTANCE_RATE, float(acceptance_rate),
+        help=C.CATALOG[C.SPEC_ACCEPTANCE_RATE]["help"],
+    )
+
+
+def record_spec_fallback(
+    n: int = 1, *, registry: Registry | None = None
+) -> None:
+    """Whole spec rounds that fell through to the classic block program
+    (every live lane at γ=0 — collapse, pressure, or temp>0 lanes)."""
+    if n <= 0:
+        return
+    _reg(registry).counter_inc(
+        C.SPEC_FALLBACK_TOTAL, float(n),
+        help=C.CATALOG[C.SPEC_FALLBACK_TOTAL]["help"],
+    )

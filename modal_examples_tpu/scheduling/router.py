@@ -363,6 +363,17 @@ class PrefixAffinityRouter:
         with self._lock:
             return self._weights.get(name, 1.0)
 
+    def reprobe(self) -> list:
+        """One down-tracking/probe pass with no placement: the same
+        ``_candidates`` walk a submit runs, minus the request. Returns
+        the currently healthy replicas. Re-admission (and the revival
+        probe of a stopped-on-error engine) otherwise only advances when
+        a placement lands — with traffic stopped, a replica that died at
+        the end of a load window would stay down forever. Operators and
+        the chaos invariants (``faults.chaos.settle_recovered``) call
+        this to settle recovery without synthesizing traffic."""
+        return self._candidates(self.replicas)
+
     def _effective_load(self, replica) -> float:
         """Outstanding work scaled by the inverse health weight: a
         degraded replica at weight 0.25 competes as if 4x busier, plus a

@@ -61,6 +61,7 @@ from ..utils.log import get_logger
 from .health import EngineWatermarks
 from .kv_cache import OutOfPages, PagedKVCache
 from .sampling import SamplingParams, sample
+from . import spec_runtime as _spec_rt
 from ..utils.tokenizer import load_tokenizer
 
 _log = get_logger("engine")
@@ -115,10 +116,9 @@ class Request:
     # engine-assigned when params.seed is None: sampling is derived from
     # (auto_seed, position) so outputs never depend on scheduler timing —
     # how many blocks/keys the engine happened to burn before this request.
-    # SPECULATIVE-MODE EXCEPTION: the spec accept/reject kernel samples
-    # unseeded temperature>0 rows from the engine key, so those outputs DO
-    # depend on scheduler timing (explicit seed= there is rejected up front
-    # by validate_params; see _spec_propose_verify's docstring).
+    # Speculative mode included: temperature>0 lanes never speculate (the
+    # fused round's γ=0 classic lane samples them with this very key;
+    # docs/speculative.md#exactness).
     auto_seed: int | None = None
     # multimodal: preprocessed [S, S, 3] float image (models.vlm); its
     # n_image_tokens placeholder ids lead prompt_tokens
@@ -159,6 +159,12 @@ class _Slot:
     generated: list[int] = dataclasses.field(default_factory=list)
     emitted_text_len: int = 0
     ngram: "_NgramIndex | None" = None  # prompt-lookup spec mode only
+    #: pin this tenancy's speculation depth to 0 (draft mode only): set for
+    #: failover-resumed/adopted installs whose draft cache has a
+    #: generated-prefix KV hole — proposing against it would collapse
+    #: acceptance. The lane rides the fused round's classic γ=0 path, so
+    #: the stream stays token-identical either way (docs/speculative.md).
+    spec_hold: bool = False
     #: resumable chunked-prefill state (stall-free admission): set while the
     #: slot's prompt KV is still being filled chunk-by-chunk across ticks
     prefill: "_PendingPrefill | None" = None
@@ -405,6 +411,13 @@ class LLMEngine:
         speculative: tuple | None = None,  # (draft preset|LlamaConfig, gamma)
         draft_params=None,
         draft_model_dir: str | None = None,
+        # adaptive speculation depth (docs/speculative.md#gamma-schedule):
+        # the per-request EWMA/pressure controller that shrinks γ toward 0
+        # when acceptance drops or the batch fills. None resolves
+        # MTPU_SPEC_ADAPTIVE once (the knob rule); True/False override.
+        # Runtime-mutable (self.spec_adaptive, like self.spec_depth), so
+        # benches A/B fixed-vs-adaptive on a live engine.
+        spec_adaptive: bool | None = None,
         decode_block: int = 8,  # decode steps rolled into one dispatch
         # macro-step decode (docs/multistep.md): N decode+sample steps
         # fused into ONE jitted program per dispatch, with device-side
@@ -787,12 +800,14 @@ class LLMEngine:
         self._chunk_jits: dict[int, object] = {}  # keyed by chunk q_offset
 
         # speculative decoding (the engine-side flag the reference exposes:
-        # vllm_inference.py:196-205): a small draft model proposes gamma
-        # tokens per tick, the target verifies them in one teacher-forced
-        # pass, and accept/reject runs in-graph. The draft keeps its own
-        # paged KV cache ADDRESSED BY THE SAME page ids/tables as the
-        # target's, so allocation, prefix sharing, and slot recycling are
-        # managed once.
+        # vllm_inference.py:196-205), as a first-class scheduler decode
+        # mode (docs/speculative.md): one fused round program per dispatch
+        # — draft-propose(γ) on masked_scan + one ragged target verify +
+        # accept in-graph (serving/spec_runtime/runtime.py) — emitting the
+        # multistep harvest plane, so spec rounds and macro-step blocks
+        # share ONE harvest site. The draft keeps its own paged KV cache
+        # ADDRESSED BY THE SAME page ids/tables as the target's, so
+        # allocation, prefix sharing, and slot recycling are managed once.
         self.spec_gamma = 0
         self.spec_mode: str | None = None  # "draft" | "ngram"
         self.draft_cfg = None
@@ -817,7 +832,8 @@ class LLMEngine:
                 self.spec_mode = "ngram"
                 self.ngram_n = 2  # trailing-bigram lookup (prompt-lookup)
                 self._ngram_jit = jax.jit(
-                    self._ngram_verify, donate_argnums=(1, 2)
+                    _spec_rt.build_ngram_round_fn(cfg, gamma=self.spec_gamma),
+                    donate_argnums=(1, 2),
                 )
             else:
                 if isinstance(draft, str):
@@ -867,9 +883,38 @@ class LLMEngine:
                 if mesh is not None:
                     self._shard_cache(self.draft_cache)
                 self._spec_jit = jax.jit(
-                    self._spec_propose_verify, donate_argnums=(2, 3, 4, 5)
+                    _spec_rt.build_spec_round_fn(
+                        cfg,
+                        draft,
+                        paged_impl=self.paged_impl,
+                        scatter_impl=self.scatter_impl,
+                        mesh=mesh,
+                        gamma=self.spec_gamma,
+                    ),
+                    donate_argnums=(2, 3, 4, 5),
                 )
                 self._draft_prefill_jits: dict[object, object] = {}
+        # adaptive γ (docs/speculative.md#gamma-schedule): both knobs are
+        # runtime-mutable — spec_depth caps per-round proposal budgets
+        # (0 = spec fully off, every round falls through to the classic
+        # block program), spec_adaptive switches the per-request controller
+        # on/off — so benches A/B off/fixed/adaptive on one live engine.
+        self.spec_depth = self.spec_gamma
+        self.spec_adaptive = _spec_rt.resolve_spec_adaptive(spec_adaptive)
+        self._spec_ctrl = (
+            _spec_rt.AdaptiveGammaController(self.spec_gamma)
+            if self.spec_gamma
+            else None
+        )
+        # spec round accounting (harvest-side; feeds the SPEC_* gauges
+        # through _refresh_gauges' throttle — the _ms_* delta pattern)
+        self._spec_rounds = 0
+        self._spec_round_tokens = 0
+        self._spec_fallbacks = 0
+        self._spec_flush = {"rounds": 0, "tokens": 0, "fallbacks": 0}
+        self._spec_tpd = 0.0
+        self._spec_gamma_window: list[int] = []  # dispatched per-slot γs
+        self._spec_gamma_p50 = 0.0
 
     def _shard_cache(self, cache) -> None:
         """Shard page arrays [L, P, ps, Hkv, D] by kv head over ``tensor`` —
@@ -1048,249 +1093,37 @@ class LLMEngine:
             self._draft_prefill_jits[key] = fn
         return fn
 
-    def _spec_propose_verify(
-        self, params, d_params, tk, tv, dk, dv, tokens, positions,
-        page_tables, active, key, temps, seeds,
-    ):
-        """One speculative tick, fully in-graph: draft chain -> target verify
-        -> accept/reject. Returns (out_tokens [B, gamma+1], n_emit [B], and
-        the four updated cache arrays).
-
-        Greedy slots (temperature 0) accept while draft argmax == target
-        argmax — reproducing the target's greedy decode token-for-token.
-        Sampling slots use standard speculative sampling: accept draft token
-        x with prob min(1, p_t(x)/p_d(x)); on rejection resample from the
-        residual max(p_t - p_d, 0) — the output distribution equals the
-        target's. Rejected tokens' KV entries are left in place and
-        overwritten as positions advance (never attended past the accept
-        point). ``seeds`` is accepted for signature parity but per-request
-        seeded determinism is not batch-invariant in speculative mode.
-        """
-        del seeds
-        gamma = self.spec_gamma
-        cfg, dcfg = self.cfg, self.draft_cfg
-        B = tokens.shape[0]
-        cap = self.pages_per_slot * self.cache.page_size
-        keys = jax.random.split(key, gamma + 2)
-
-        def draft_step(carry, k_i):
-            tok, pos, dk, dv = carry
-            step_active = active & (pos < cap)
-            logits, dk, dv = llama.decode_step(
-                d_params, tok, pos, dk, dv, page_tables, step_active, dcfg,
-                impl=self.paged_impl, scatter_impl=self.scatter_impl,
-                mesh=self.mesh,
-            )
-            scaled = logits / jnp.maximum(temps, 1e-6)[:, None]
-            proposed = jnp.where(
-                temps <= 0.0,
-                jnp.argmax(logits, axis=-1),
-                jax.vmap(jax.random.categorical)(
-                    jax.random.split(k_i, B), scaled
-                ),
-            ).astype(jnp.int32)
-            logp = jax.nn.log_softmax(scaled, axis=-1)
-            return (proposed, pos + 1, dk, dv), (proposed, logp)
-
-        (last_d, last_pos, dk, dv), (draft_toks, draft_logps) = jax.lax.scan(
-            draft_step, (tokens, positions, dk, dv), keys[:gamma]
-        )
-        # complete the draft cache: the scan proposed d_gamma but never wrote
-        # its KV — without this, a fully-accepted round leaves a hole at
-        # position+gamma and the next round's draft attends to stale state,
-        # collapsing the acceptance rate (logits discarded; draft is small)
-        _, dk, dv = llama.decode_step(
-            d_params, last_d, last_pos, dk, dv, page_tables,
-            active & (last_pos < cap), dcfg, impl=self.paged_impl,
-            scatter_impl=self.scatter_impl, mesh=self.mesh,
-        )
-        draft_toks = draft_toks.T  # [B, gamma]
-        draft_logps = draft_logps.transpose(1, 0, 2)  # [B, gamma, V]
-
-        # target scores the whole chain in ONE pass against the paged cache
-        chain = jnp.concatenate([tokens[:, None], draft_toks], axis=1)
-        t_logits, tk, tv = llama.verify_step(
-            params, chain, positions, tk, tv, page_tables, active, cfg
-        )  # [B, gamma+1, V]
-        out, n_emit = self._accept_reject(
-            t_logits, draft_toks, temps, (keys[gamma], keys[gamma + 1]),
-            active, proposal_logps=draft_logps,
-        )
-        return out, n_emit, tk, tv, dk, dv
-
-    def _accept_reject(
-        self, t_logits, proposals, temps, keys2, active, *,
-        proposal_logps=None, n_prop=None,
-    ):
-        """Shared speculative accept/reject (both spec modes route here so
-        the math can never drift). ``proposal_logps`` is the draft model's
-        log-probs; ``None`` means a degenerate (delta) proposal
-        distribution — prompt-lookup mode — where acceptance is
-        min(1, p_t(x)) and the rejection residual is p_t with x zeroed.
-        ``n_prop`` (ngram mode) marks how many proposal slots are real;
-        slots beyond it are never accepted.
-
-        Greedy slots (temperature 0) accept while proposal == target
-        argmax — reproducing the target's greedy decode token-for-token.
-        Sampling slots use standard speculative sampling, so the output
-        distribution equals the target's. Returns (out [B, gamma+1],
-        n_emit [B])."""
-        gamma = self.spec_gamma
-        B = proposals.shape[0]
-        t_scaled = t_logits / jnp.maximum(temps, 1e-6)[:, None, None]
-        t_logp = jax.nn.log_softmax(t_scaled, axis=-1)
-        greedy_choice = jnp.argmax(t_logits, axis=-1).astype(jnp.int32)
-
-        rows = jnp.arange(B)
-        valid = (
-            jnp.ones((B, gamma), bool)
-            if n_prop is None
-            else jnp.arange(gamma)[None, :] < n_prop[:, None]
-        )
-        match = (proposals == greedy_choice[:, :gamma]) & valid
-        lp_t = jnp.take_along_axis(
-            t_logp[:, :gamma], proposals[..., None], axis=-1
-        )[..., 0]
-        if proposal_logps is None:
-            accept_prob = jnp.exp(lp_t)  # min(1, p_t / 1)
-        else:
-            lp_d = jnp.take_along_axis(
-                proposal_logps, proposals[..., None], axis=-1
-            )[..., 0]
-            accept_prob = jnp.exp(jnp.minimum(0.0, lp_t - lp_d))
-        u = jax.random.uniform(keys2[0], (B, gamma))
-        accept = jnp.where(
-            (temps <= 0.0)[:, None], match, (u < accept_prob) & valid
-        )
-        n_acc = jnp.argmin(
-            jnp.concatenate(
-                [accept.astype(jnp.int32), jnp.zeros((B, 1), jnp.int32)],
-                axis=1,
-            ),
-            axis=1,
-        )  # first rejection; == gamma when all accepted
-
-        # token at the cut: target's fix on rejection, fresh bonus sample
-        # when every proposal was accepted
-        j = n_acc
-        p_t_row = jnp.exp(t_logp[rows, j])  # [B, V]
-        if proposal_logps is None:
-            prop_at_j = proposals[rows, jnp.minimum(j, gamma - 1)]
-            residual = p_t_row.at[rows, prop_at_j].set(0.0)
-            rejected = j < (gamma if n_prop is None else n_prop)
-        else:
-            p_d_row = jnp.exp(
-                proposal_logps[rows, jnp.minimum(j, gamma - 1)]
-            )
-            residual = jnp.maximum(p_t_row - p_d_row, 0.0)
-            rejected = j < gamma
-        has_res = residual.sum(-1, keepdims=True) > 0
-        residual = jnp.where(rejected[:, None] & has_res, residual, p_t_row)
-        sampled_fix = jax.vmap(jax.random.categorical)(
-            jax.random.split(keys2[1], B), jnp.log(residual + 1e-20)
-        ).astype(jnp.int32)
-        fix = jnp.where(temps <= 0.0, greedy_choice[rows, j], sampled_fix)
-        out = jnp.concatenate(
-            [proposals, jnp.zeros((B, 1), jnp.int32)], axis=1
-        )
-        out = out.at[rows, j].set(fix)
-        n_emit = jnp.where(active, n_acc + 1, 0)
-        return out, n_emit
-
-    def _ngram_verify(
-        self, params, tk, tv, proposals, n_prop, tokens, positions,
-        page_tables, active, key, temps,
-    ):
-        """One prompt-lookup tick: target-verify host-proposed tokens.
-
-        Same accept/reject math as draft-model mode with the proposal
-        distribution degenerate (a delta at the proposed token): greedy
-        slots accept while proposal == target argmax; sampling slots accept
-        token x with prob min(1, p_t(x)/1) = p_t(x) and resample rejections
-        from p_t with x zeroed (the residual max(p_t - delta_x, 0)) — the
-        output distribution equals the target's. Proposal slots beyond
-        ``n_prop`` are never accepted, so empty-proposal slots degrade to
-        exactly one plain target step.
-        """
-        k1, k2 = jax.random.split(key)
-        chain = jnp.concatenate([tokens[:, None], proposals], axis=1)
-        t_logits, tk, tv = llama.verify_step(
-            params, chain, positions, tk, tv, page_tables, active, self.cfg
-        )  # [B, gamma+1, V]
-        out, n_emit = self._accept_reject(
-            t_logits, proposals, temps, (k1, k2), active, n_prop=n_prop,
-        )
-        return out, n_emit, tk, tv
+    # the fused speculative round programs (propose+verify+accept and the
+    # shared accept/reject math) live in serving/spec_runtime/runtime.py —
+    # built per-config in __init__ and dispatched from _spec_round
 
     #: host-side lookup window per tick (prompt_lookup_max analog)
     NGRAM_LOOKBACK = 1024
 
-    def _ngram_proposals(self):
+    def _ngram_proposals(self, gammas):
         """Host-side prompt lookup: match each slot's trailing n-gram
         against its own prompt+generation history; propose the tokens that
         followed the MOST RECENT earlier occurrence. Each slot's
         ``_NgramIndex`` (built at prefill, pushed per accepted token) makes
         this O(gamma) per slot per tick — the old full-history rescan was
-        O(window x n) on the host critical path every tick."""
+        O(window x n) on the host critical path every tick. ``gammas``
+        carries the per-slot proposal budgets (the adaptive controller's
+        output): a 0-budget lane proposes nothing and takes the classic
+        lane inside the fused round."""
         gamma = self.spec_gamma
         props = np.zeros((self.max_slots, gamma), np.int32)
         n_prop = np.zeros((self.max_slots,), np.int32)
         for i, s in enumerate(self.slots):
             if s.free or s.ngram is None:
                 continue
-            cont = s.ngram.propose(gamma)
+            budget = min(int(gammas[i]), gamma)
+            if budget <= 0:
+                continue
+            cont = s.ngram.propose(budget)
             if cont:
                 props[i, : len(cont)] = cont
                 n_prop[i] = len(cont)
         return props, n_prop
-
-    def _ngram_tick(self, active_idx: list[int]) -> bool:
-        tick = self._tick
-        props, n_prop = self._ngram_proposals()
-        (
-            out_tokens, n_emit, self.cache.k_pages, self.cache.v_pages,
-        ) = self._profiled(
-            "ngram_verify", f"s{self.max_slots}g{self.spec_gamma}",
-            self._ngram_jit,
-        )(
-            self.params,
-            self.cache.k_pages,
-            self.cache.v_pages,
-            jnp.asarray(props),
-            jnp.asarray(n_prop),
-            jnp.asarray(self._tokens.copy()),
-            jnp.asarray(self._positions.copy()),
-            jnp.asarray(self._page_tables.copy()),
-            jnp.asarray(self._active.copy()),
-            self._next_key(),
-            jnp.asarray(self._temps.copy()),
-        )
-        _tm(tick, "decode_dispatch")
-        u_start = self._clock()  # usage meter: engine-clock domain
-        out_np = np.asarray(out_tokens)
-        n_np = np.asarray(n_emit)
-        _tm_device(tick, "harvest")
-        self.usage.note_phase_seconds("decode", self._clock() - u_start)
-        self.stats.steps += 1
-        for i in active_idx:
-            s = self.slots[i]
-            take = int(n_np[i])
-            self.stats.spec_proposed += int(n_prop[i])
-            self.stats.spec_accepted += max(0, take - 1)
-            if s.request is not None and s.request.trace is not None:
-                _rt.event(
-                    s.request.trace, "spec_verify",
-                    store=self._trace_store, replica=self.trace_name,
-                    proposed=int(n_prop[i]), accepted=max(0, take - 1),
-                )
-            for t in range(take):
-                if s.request is None:
-                    break  # finished mid-chain (eos/stop/length)
-                s.position += 1
-                s.last_token = int(out_np[i, t])
-                self._accept_token(i, s.last_token)
-        _tm(tick, "accept")
-        return True
 
     def _bucket_for(self, n: int) -> int:
         for b in self.prefill_buckets:
@@ -1307,21 +1140,13 @@ class LLMEngine:
     def validate_params(self, params: SamplingParams) -> None:
         """Raise ValueError for parameter combinations this engine rejects —
         servers call this up front so a bad request becomes a 400, not a
-        dropped connection."""
-        if self.spec_gamma and (params.top_p < 1.0 or params.top_k > 0):
-            raise ValueError(
-                "speculative decoding supports greedy (temperature=0) and "
-                "plain temperature sampling; top_p/top_k are unsupported"
-            )
-        if self.spec_gamma and params.seed is not None and params.temperature > 0:
-            # the spec accept/reject kernel samples from the engine key
-            # (_spec_propose_verify ignores per-request seeds); accepting
-            # seed= would silently break the seeded-determinism contract
-            raise ValueError(
-                "speculative decoding does not support seed= with "
-                "temperature > 0 (per-request seeded sampling is not "
-                "implemented in the spec accept/reject kernel)"
-            )
+        dropped connection. Speculative engines now accept the FULL
+        sampling surface (docs/speculative.md#exactness): temperature>0 /
+        top_p / top_k / seed= lanes never speculate — they ride the fused
+        round's γ=0 classic lane, whose token is drawn by the very same
+        (seed, position)-keyed ``sample`` call the block program makes —
+        so nothing is rejected engine-wide today."""
+        del params
 
     def make_request(
         self,
@@ -1555,30 +1380,30 @@ class LLMEngine:
                 jnp.full((B,), -1, jnp.int32),
             )
         B = self.max_slots
-        if not self.spec_gamma:
-            # spec mode never runs the block program — compiling the 8-step
-            # scan there would be pure cold-start cost for a dead path
-            _toks, _last, self.cache.k_pages, self.cache.v_pages = self._profiled(
-                "block", f"s{self.max_slots}k{self.decode_block}",
-                self._block_jit,
-            )(
-                self.params,
-                self.cache.k_pages,
-                self.cache.v_pages,
-                jnp.zeros((B,), jnp.int32),
-                jnp.zeros((B,), jnp.int32),
-                jnp.zeros((B,), bool),
-                jnp.zeros((B,), jnp.int32),
-                jnp.zeros((B, self.pages_per_slot), jnp.int32),
-                jnp.zeros((B,), bool),
-                self._next_key(),
-                jnp.ones((B,), jnp.float32),
-                jnp.ones((B,), jnp.float32),
-                jnp.zeros((B,), jnp.int32),
-                jnp.full((B,), -1, jnp.int32),
-            )
+        # the block program warms for EVERY engine: spec engines run it
+        # too — whole-round γ=0 fallbacks (pressure/collapse) and the
+        # failover replay path both dispatch it
+        _toks, _last, self.cache.k_pages, self.cache.v_pages = self._profiled(
+            "block", f"s{self.max_slots}k{self.decode_block}",
+            self._block_jit,
+        )(
+            self.params,
+            self.cache.k_pages,
+            self.cache.v_pages,
+            jnp.zeros((B,), jnp.int32),
+            jnp.zeros((B,), jnp.int32),
+            jnp.zeros((B,), bool),
+            jnp.zeros((B,), jnp.int32),
+            jnp.zeros((B, self.pages_per_slot), jnp.int32),
+            jnp.zeros((B,), bool),
+            self._next_key(),
+            jnp.ones((B,), jnp.float32),
+            jnp.ones((B,), jnp.float32),
+            jnp.zeros((B,), jnp.int32),
+            jnp.full((B,), -1, jnp.int32),
+        )
         n_ms = max(1, int(self.decode_steps))
-        if not self.spec_gamma and n_ms > 1:
+        if n_ms > 1:
             # macro-step program (docs/multistep.md): warmed at the
             # configured N; other N values compile on first dispatch
             # (runtime knob flips are a bench/test affair)
@@ -1608,7 +1433,7 @@ class LLMEngine:
         if self.spec_mode == "ngram":
             B = self.max_slots
             (
-                _, _, self.cache.k_pages, self.cache.v_pages,
+                _, _, _, self.cache.k_pages, self.cache.v_pages,
             ) = self._profiled(
                 "ngram_verify", f"s{self.max_slots}g{self.spec_gamma}",
                 self._ngram_jit,
@@ -1620,10 +1445,14 @@ class LLMEngine:
                 jnp.zeros((B,), jnp.int32),
                 jnp.zeros((B,), jnp.int32),
                 jnp.zeros((B,), jnp.int32),
+                jnp.zeros((B,), jnp.int32),
                 jnp.zeros((B, self.pages_per_slot), jnp.int32),
                 jnp.zeros((B,), bool),
                 self._next_key(),
                 jnp.ones((B,), jnp.float32),
+                jnp.ones((B,), jnp.float32),
+                jnp.zeros((B,), jnp.int32),
+                jnp.full((B,), -1, jnp.int32),
             )
         if self.spec_mode == "draft":
             for bucket in buckets or self.prefill_buckets:
@@ -1641,7 +1470,9 @@ class LLMEngine:
                         jnp.ones((B,), jnp.int32),
                     )
                 )
+            B = self.max_slots
             (
+                _,
                 _,
                 _,
                 self.cache.k_pages,
@@ -1658,13 +1489,16 @@ class LLMEngine:
                 self.cache.v_pages,
                 self.draft_cache.k_pages,
                 self.draft_cache.v_pages,
-                jnp.zeros((self.max_slots,), jnp.int32),
-                jnp.zeros((self.max_slots,), jnp.int32),
-                jnp.zeros((self.max_slots, self.pages_per_slot), jnp.int32),
-                jnp.zeros((self.max_slots,), bool),
+                jnp.zeros((B,), jnp.int32),
+                jnp.zeros((B,), jnp.int32),
+                jnp.zeros((B, self.pages_per_slot), jnp.int32),
+                jnp.zeros((B,), bool),
+                jnp.zeros((B,), jnp.int32),
                 self._next_key(),
-                jnp.ones((self.max_slots,), jnp.float32),
-                jnp.full((self.max_slots,), -1, jnp.int32),
+                jnp.ones((B,), jnp.float32),
+                jnp.ones((B,), jnp.float32),
+                jnp.zeros((B,), jnp.int32),
+                jnp.full((B,), -1, jnp.int32),
             )
         from ..utils.sync import force
 
@@ -1738,11 +1572,6 @@ class LLMEngine:
         racing that donation would pass deleted arrays. Prefill-role
         replicas never ``start()`` their engine; concurrent server threads
         serialize on an internal lock."""
-        if self.spec_gamma:
-            raise ValueError(
-                "disaggregated prefill is incompatible with speculative=: "
-                "the draft model's KV is not on the wire"
-            )
         if req.image is not None:
             raise ValueError(
                 "multimodal requests do not take the disagg prefill path "
@@ -1868,11 +1697,6 @@ class LLMEngine:
         first sample. ``entry`` is the migration's admission reservation,
         taken by the coordinator BEFORE any byte moved so decode-side KV
         headroom was guaranteed while the transfer was in flight."""
-        if self.spec_gamma:
-            raise ValueError(
-                "adopting migrated pages into a speculative engine is "
-                "unsupported: the draft cache's KV is not on the wire"
-            )
         if block.kv_dtype != self.cache.kv_dtype:
             raise ValueError(
                 f"migrated block is {block.kv_dtype}, this cache is "
@@ -1923,11 +1747,6 @@ class LLMEngine:
         ``generated`` degrades to a plain resubmission. The same ``req``
         object (same id, same out_queue, same trace id) rides through, so
         a blocked ``stream()`` consumer continues without reconnecting."""
-        if self.spec_gamma:
-            raise ValueError(
-                "resuming into a speculative engine is unsupported: spec "
-                "sampling is not keyed (seed, position)"
-            )
         if req.image is not None:
             raise ValueError(
                 "multimodal requests do not take the failover resume path"
@@ -1988,11 +1807,6 @@ class LLMEngine:
 
         Raises when the scheduler loop is stopped or unresponsive — the
         caller falls back to the reactive (checkpoint-only) resume."""
-        if self.spec_gamma:
-            raise ValueError(
-                "live migration out of a speculative engine is unsupported: "
-                "the draft cache's KV is not on the wire"
-            )
         return self._run_on_scheduler(
             lambda: self._migrate_out_on_sched(req), timeout
         )
@@ -2408,6 +2222,34 @@ class LLMEngine:
                 self._detok.queue_depth() if self._detok is not None else 0
             ),
         )
+        # speculative gauges (docs/speculative.md#series): dispatched-γ
+        # p50 over the window since the last refresh, harvested tokens per
+        # spec round (held when idle), lifetime acceptance, and the
+        # fallback-round counter delta
+        if self.spec_gamma:
+            d = self._spec_rounds - self._spec_flush["rounds"]
+            if d > 0:
+                self._spec_tpd = (
+                    self._spec_round_tokens - self._spec_flush["tokens"]
+                ) / d
+            fb = self._spec_fallbacks - self._spec_flush["fallbacks"]
+            if d > 0 or fb > 0:
+                self._spec_flush = {
+                    "rounds": self._spec_rounds,
+                    "tokens": self._spec_round_tokens,
+                    "fallbacks": self._spec_fallbacks,
+                }
+            gw = self._spec_gamma_window
+            if gw:
+                self._spec_gamma_p50 = float(np.median(gw))
+                del gw[:]
+            _obs.set_spec_gauges(
+                gamma=self._spec_gamma_p50,
+                tokens_per_dispatch=self._spec_tpd,
+                acceptance_rate=self.stats.acceptance_rate(),
+            )
+            if fb > 0:
+                _obs.record_spec_fallback(fb)
         self._flush_token_counters()
         # per-tenant usage deltas + roofline MFU/MBU gauges ride the same
         # throttle (the flight recorder's tsdb sampler sees them for free)
@@ -2663,6 +2505,20 @@ class LLMEngine:
         slot.position = state["position"]
         slot.last_token = state["first_token"]
         slot.fresh = True  # first token rides the override lane, like prefill
+        # speculative engines adopt migrated work too
+        # (docs/speculative.md#failure-boundaries): ngram mode rebuilds its
+        # prompt-lookup index from the history that rode the wire; draft
+        # mode pins γ=0 for this tenancy (spec_hold) — the draft cache's KV
+        # never crossed the wire, and the classic lane inside the fused
+        # round keeps the stream token-identical regardless
+        slot.spec_hold = self.spec_mode == "draft"
+        if self.spec_mode == "ngram":
+            slot.ngram = _NgramIndex(
+                self.ngram_n,
+                list(req.prompt_tokens or [])
+                + [int(t) for t in req.generated_tokens],
+                self.NGRAM_LOOKBACK,
+            )
         _obs.record_sched_queue_wait(
             entry.priority, max(0.0, now - entry.enqueued_at)
         )
@@ -2802,6 +2658,12 @@ class LLMEngine:
         slot.ngram = None
         slot.prefill = None
         slot.pending_first = False
+        slot.spec_hold = False
+        if self._spec_ctrl is not None and slot.request is not None:
+            # the controller's acceptance EWMA is per-request state: drop
+            # it with the tenancy (both release paths call here or
+            # _unwind_slot, so nothing leaks)
+            self._spec_ctrl.forget(slot.request.request_id)
 
     def _dispatch_prefill_chunk(
         self, prompt_tokens: list, table, offset: int
@@ -3106,6 +2968,12 @@ class LLMEngine:
                     self._replay_decode_prefix(slot_idx, replay)
                     s.position = n_prompt + len(replay) - 1
                     s.last_token = int(replay[-1])
+                    if self.spec_mode == "draft":
+                        # the replay rebuilt TARGET KV only: the draft
+                        # cache has a generated-prefix hole, so this
+                        # tenancy never proposes (γ pinned 0 — the fused
+                        # round's classic lane; token-identical either way)
+                        s.spec_hold = True
                 else:
                     s.last_token = int(next_np[row])
                 s.fresh = True
@@ -3230,6 +3098,9 @@ class LLMEngine:
         slot.prefill = None
         slot.pending_first = False
         slot.ngram = None
+        slot.spec_hold = False
+        if self._spec_ctrl is not None and slot.request is not None:
+            self._spec_ctrl.forget(slot.request.request_id)
 
     def _prefill_group(self, bucket: int, group: list, is_mm: bool = False) -> None:
         t_start = time.monotonic()
@@ -3262,10 +3133,16 @@ class LLMEngine:
             slot.generated = req.generated_tokens  # request-owned history
             slot.emitted_text_len = req.emitted_len
             slot.prefill = None
+            slot.spec_hold = False
             if self.spec_mode == "ngram":
                 slot.ngram = _NgramIndex(
                     self.ngram_n, req.prompt_tokens or [], self.NGRAM_LOOKBACK
                 )
+                for t in req.generated_tokens:
+                    # failover-resumed requests arrive with accepted
+                    # history (replayed at harvest): the lookup index must
+                    # match an uninterrupted run's
+                    slot.ngram.push(int(t))
             table = np.zeros((self.pages_per_slot,), np.int32)
             table[: len(pages)] = pages
             self._page_tables[slot_idx] = table
@@ -3397,16 +3274,65 @@ class LLMEngine:
             if not live:
                 return worked
             self._active[:] = False
+            # reset dead-slot sampling params (same rationale as
+            # _dispatch_block: stale top_p/top_k keeps sample()'s runtime
+            # lax.cond on the expensive sort path)
+            self._temps[:] = 1.0
+            self._top_ps[:] = 1.0
+            self._top_ks[:] = 0
+            self._seeds[:] = -1
+            gammas = np.zeros((self.max_slots,), np.int32)
+            batch_fill = len(live) / max(1, self.max_slots)
+            # prefill-budget contention (docs/scheduling.md): chunked
+            # prefills mid-slice or first tokens parked unharvested mean
+            # admission cadence is live — long speculative rounds would
+            # stretch the tick it rides on
+            prefill_pressure = bool(self._pending_harvest) or any(
+                s.prefill is not None for s in self.slots
+            )
             for i in live:
                 s = self.slots[i]
                 self._active[i] = True
                 self._tokens[i] = s.last_token
                 self._positions[i] = s.position
+                s.fresh = False  # spec rounds feed host tokens directly
                 p = s.request.params
                 self._temps[i] = p.temperature
+                self._top_ps[i] = p.top_p
+                self._top_ks[i] = p.top_k
                 self._seeds[i] = _req_seed(s.request)
+                gammas[i] = self._slot_gamma(s, batch_fill, prefill_pressure)
+            ngram_props = None
+            if self.spec_mode == "ngram":
+                # proposal availability is host-known BEFORE dispatch: a
+                # lane whose trailing-ngram lookup comes up empty has
+                # nothing to verify, so its γ drops to 0 (the fused
+                # program's classic lane) — and an all-empty round falls
+                # through to the strictly-cheaper block program below
+                # instead of paying a 1-token spec round. No controller
+                # involvement: an empty lookup is absence of evidence,
+                # not rejection evidence (docs/speculative.md#gamma-
+                # schedule).
+                ngram_props = self._ngram_proposals(gammas)
+                gammas = np.minimum(
+                    gammas, ngram_props[1].astype(np.int32)
+                )
             _tm(tick, "admit")  # spec batch staging: slot-state bookkeeping
-            return self._spec_tick(live) or worked
+            if not any(gammas[i] for i in live):
+                # whole-round fallback: nobody speculates this round
+                # (pressure, collapse, or sampling lanes only) — the
+                # classic block program is strictly cheaper than a
+                # γ-shaped verify pass, so spec can never COST latency
+                self._spec_fallbacks += 1
+                for i in live:
+                    # re-enter the block program through the override lane
+                    # (spec rounds end on host-known tokens, not
+                    # device-resident ones)
+                    self.slots[i].fresh = True
+                self._dispatch_block(live)
+                worked = self._harvest_prefills() or True
+                return self._process_block() or worked
+            return self._spec_round(live, gammas, ngram_props) or worked
 
         # pipelined path: keep one decode block in flight ahead of the one
         # being read, so the device never waits on the host round trip
@@ -3547,6 +3473,7 @@ class LLMEngine:
                 (i, self.slots[i].request, self.slots[i].tenancy)
                 for i in live
             ],
+            None,  # spec_meta: classic/macro-step blocks carry none
         ))
         for i in live:
             self._opt_positions[i] += n
@@ -3554,14 +3481,14 @@ class LLMEngine:
 
     def _process_block(self) -> bool:
         tick = self._tick
-        toks, valid, snapshot = self._inflight.popleft()
+        toks, valid, snapshot, spec_meta = self._inflight.popleft()
         t_wait = time.monotonic()
         u_start = self._clock()  # usage meter: engine-clock domain
         toks_np = np.asarray(toks)  # [K, B] — the ONE blocking read per block
         # the macro-step harvest plane (docs/multistep.md): the validity
         # mask rides the SAME round trip as the tokens — per-slot accept
         # stops at the first invalid row (the lane died at its stop token
-        # or length budget on-device)
+        # or length budget on-device; in a spec round, at its accept cut)
         valid_np = None if valid is None else np.asarray(valid)
         _obs.record_engine_phase("decode_wait", time.monotonic() - t_wait)
         self.usage.note_phase_seconds("decode", self._clock() - u_start)
@@ -3569,12 +3496,13 @@ class LLMEngine:
         n_steps = int(toks_np.shape[0])
         # only steps with a live lane executed (masked_scan's cond skips
         # the rest once every lane died): count the truth, not the
-        # program length
+        # program length. A spec round is ONE verify pass regardless of
+        # how many chain rows it emitted.
         executed = (
             n_steps if valid_np is None
             else int(valid_np.any(axis=1).sum())
         )
-        self.stats.steps += executed
+        self.stats.steps += 1 if spec_meta is not None else executed
         worked = False
         accepted = 0
         for i, req, tenancy in snapshot:
@@ -3593,7 +3521,29 @@ class LLMEngine:
                 taken += 1
                 worked = True
             accepted += taken
-            if (
+            if spec_meta is not None:
+                n_p = int(spec_meta["proposed"][i])
+                acc = max(0, taken - 1)
+                self.stats.spec_proposed += n_p
+                self.stats.spec_accepted += acc
+                if req.trace is not None:
+                    _rt.event(
+                        req.trace, "spec_verify",
+                        store=self._trace_store, replica=self.trace_name,
+                        proposed=n_p, accepted=acc,
+                        gamma=int(spec_meta["gammas"][i]),
+                    )
+                if s.request is req and s.tenancy == tenancy:
+                    if self._spec_ctrl is not None and n_p > 0:
+                        # the controller sees exactly what the host
+                        # accepted (stop/length cuts included): its EWMA
+                        # tracks USEFUL acceptance, not device acceptance
+                        self._spec_ctrl.observe(req.request_id, n_p, acc)
+                    # the round ended on a host-known token: the next
+                    # dispatch (spec or classic fallback) re-feeds it
+                    # through the fresh-slot override lane
+                    s.fresh = True
+            elif (
                 valid_np is not None
                 and taken < n_steps
                 and s.request is req
@@ -3605,75 +3555,156 @@ class LLMEngine:
                 # slot through the fresh-slot override lane, which re-feeds
                 # the last ACCEPTED token at the host-known position
                 s.fresh = True
-        # tokens-per-dispatch accounting covers BOTH paths (N=1 classic
-        # included): the A/B lever the bench reads is the same series
-        self._ms_dispatches += 1
-        self._ms_tokens += accepted
-        _obs.record_multistep_dispatch(
-            tokens=accepted, steps_saved=n_steps - executed
-        )
-        prof = self.profiler
-        if prof is not None:
-            prof.note_dispatch_tokens(accepted, steps=int(self.decode_steps))
+        if spec_meta is None:
+            # tokens-per-dispatch accounting covers classic AND macro-step
+            # (N=1 included): the A/B lever the bench reads is one series
+            self._ms_dispatches += 1
+            self._ms_tokens += accepted
+            _obs.record_multistep_dispatch(
+                tokens=accepted, steps_saved=n_steps - executed
+            )
+            prof = self.profiler
+            if prof is not None:
+                prof.note_dispatch_tokens(
+                    accepted, steps=int(self.decode_steps)
+                )
+        else:
+            # spec rounds keep their own tokens-per-dispatch plane
+            # (docs/speculative.md#series): γ=0 fallback ROUNDS are counted
+            # in _decode_tick, not here — this is a dispatched spec round
+            self._spec_rounds += 1
+            self._spec_round_tokens += accepted
+            gw = self._spec_gamma_window
+            for i, _req, _tenancy in snapshot:
+                gw.append(int(spec_meta["gammas"][i]))
+            if len(gw) > 4096:
+                del gw[: len(gw) - 4096]
+            prof = self.profiler
+            if prof is not None:
+                prof.note_dispatch_tokens(accepted, steps=1)
         _tm(tick, "accept")
         return worked
 
-    def _spec_tick(self, active_idx: list[int]) -> bool:
-        """Speculative decode tick: up to gamma+1 tokens per slot per step."""
-        if self.spec_mode == "ngram":
-            return self._ngram_tick(active_idx)
-        tick = self._tick
-        (
-            out_tokens,
-            n_emit,
-            self.cache.k_pages,
-            self.cache.v_pages,
-            self.draft_cache.k_pages,
-            self.draft_cache.v_pages,
-        ) = self._profiled(
-            "spec_verify", f"s{self.max_slots}g{self.spec_gamma}",
-            self._spec_jit,
-        )(
-            self.params,
-            self.draft_params,
-            self.cache.k_pages,
-            self.cache.v_pages,
-            self.draft_cache.k_pages,
-            self.draft_cache.v_pages,
-            jnp.asarray(self._tokens.copy()),
-            jnp.asarray(self._positions.copy()),
-            jnp.asarray(self._page_tables.copy()),
-            jnp.asarray(self._active.copy()),
-            self._next_key(),
-            jnp.asarray(self._temps.copy()),
-            jnp.asarray(self._seeds.copy()),
+    def _slot_gamma(
+        self, s: _Slot, batch_fill: float, prefill_pressure: bool
+    ) -> int:
+        """Per-slot proposal budget for the next fused round
+        (docs/speculative.md#gamma-schedule). 0 = the classic lane inside
+        the same program. Sampling lanes (temperature > 0) never
+        speculate — the spec accept path is not (seed, position)-keyed,
+        and the classic lane keeps them token-identical to a non-spec
+        engine; ``spec_hold`` pins resumed/adopted draft-mode tenancies
+        whose draft cache has a KV hole."""
+        p = s.request.params
+        if p.temperature > 0 or s.spec_hold:
+            return 0
+        cap = max(0, min(int(self.spec_depth), self.spec_gamma))
+        if self.spec_adaptive and self._spec_ctrl is not None:
+            g = self._spec_ctrl.gamma_for(
+                s.request.request_id,
+                gamma_cap=cap,
+                batch_fill=batch_fill,
+                prefill_pressure=prefill_pressure,
+            )
+        else:
+            g = cap
+        # never propose past the request's own stopping point: tokens
+        # beyond max_tokens / context length would be verified, then
+        # discarded by the host accept loop — pure wasted verify flops
+        room = min(
+            p.max_tokens - len(s.generated) - 1,
+            (self.max_model_len - 1) - s.position - 1,
         )
+        return max(0, min(g, room))
+
+    def _spec_round(self, live: list[int], gammas, ngram_props=None) -> bool:
+        """One fused speculative round (docs/speculative.md#program-shape):
+        propose(γ) + verify + accept in ONE dispatch, harvested through
+        the SAME ``_process_block`` site as macro-step blocks (the [N, B]
+        validity plane). Spec rounds never pipeline — the next round's
+        positions depend on this round's acceptance — so the block is
+        processed immediately after dispatch."""
+        tick = self._tick
+        now = time.monotonic()
+        if self._last_dispatch_at is not None:
+            _obs.record_decode_stall(now - self._last_dispatch_at)
+        self._last_dispatch_at = now
+        self.watermarks.note_dispatch()
+        _obs.record_engine_batch(len(live))
+        gam = jnp.asarray(gammas)
+        if self.spec_mode == "ngram":
+            # _decode_tick already ran the lookup to γ-clamp empty lanes
+            props, n_prop = (
+                ngram_props
+                if ngram_props is not None
+                else self._ngram_proposals(gammas)
+            )
+            (
+                toks, valid, last, self.cache.k_pages, self.cache.v_pages,
+            ) = self._profiled(
+                "ngram_verify", f"s{self.max_slots}g{self.spec_gamma}",
+                self._ngram_jit,
+            )(
+                self.params,
+                self.cache.k_pages,
+                self.cache.v_pages,
+                jnp.asarray(props),
+                jnp.asarray(n_prop),
+                gam,
+                jnp.asarray(self._tokens.copy()),
+                jnp.asarray(self._positions.copy()),
+                jnp.asarray(self._page_tables.copy()),
+                jnp.asarray(self._active.copy()),
+                self._next_key(),
+                jnp.asarray(self._temps.copy()),
+                jnp.asarray(self._top_ps.copy()),
+                jnp.asarray(self._top_ks.copy()),
+                jnp.asarray(self._seeds.copy()),
+            )
+            proposed = n_prop
+        else:
+            (
+                toks, valid, last,
+                self.cache.k_pages, self.cache.v_pages,
+                self.draft_cache.k_pages, self.draft_cache.v_pages,
+            ) = self._profiled(
+                "spec_verify", f"s{self.max_slots}g{self.spec_gamma}",
+                self._spec_jit,
+            )(
+                self.params,
+                self.draft_params,
+                self.cache.k_pages,
+                self.cache.v_pages,
+                self.draft_cache.k_pages,
+                self.draft_cache.v_pages,
+                jnp.asarray(self._tokens.copy()),
+                jnp.asarray(self._positions.copy()),
+                jnp.asarray(self._page_tables.copy()),
+                jnp.asarray(self._active.copy()),
+                gam,
+                self._next_key(),
+                jnp.asarray(self._temps.copy()),
+                jnp.asarray(self._top_ps.copy()),
+                jnp.asarray(self._top_ks.copy()),
+                jnp.asarray(self._seeds.copy()),
+            )
+            # the draft proposes its full budget in-graph (capacity-died
+            # lanes are masked by prop_valid and never accepted, but they
+            # were still paid for — count them as proposed)
+            proposed = gammas
+        del last  # spec rounds end on host-known tokens (fresh resync)
+        self._device_tokens = None
+        self._inflight.append((
+            toks,
+            valid,
+            [
+                (i, self.slots[i].request, self.slots[i].tenancy)
+                for i in live
+            ],
+            {"gammas": gammas, "proposed": proposed},
+        ))
         _tm(tick, "decode_dispatch")
-        u_start = self._clock()  # usage meter: engine-clock domain
-        out_np = np.asarray(out_tokens)
-        n_np = np.asarray(n_emit)
-        _tm_device(tick, "harvest")
-        self.usage.note_phase_seconds("decode", self._clock() - u_start)
-        self.stats.steps += 1
-        for i in active_idx:
-            s = self.slots[i]
-            take = int(n_np[i])
-            self.stats.spec_proposed += self.spec_gamma
-            self.stats.spec_accepted += max(0, take - 1)
-            if s.request is not None and s.request.trace is not None:
-                _rt.event(
-                    s.request.trace, "spec_verify",
-                    store=self._trace_store, replica=self.trace_name,
-                    proposed=self.spec_gamma, accepted=max(0, take - 1),
-                )
-            for t in range(take):
-                if s.request is None:
-                    break  # finished mid-chain (eos/stop/length)
-                s.position += 1
-                s.last_token = int(out_np[i, t])
-                self._accept_token(i, s.last_token)
-        _tm(tick, "accept")
-        return True
+        return self._process_block()
 
     def _accept_token(self, slot_idx: int, token: int) -> None:
         slot = self.slots[slot_idx]
@@ -3732,7 +3763,11 @@ class LLMEngine:
         # after the knob drops back to 1 (ordering), and a dead worker
         # falls through to the inline path below.
         w = self._detok
-        if self.decode_steps > 1 or (w is not None and w.owns(req)):
+        if (
+            self.decode_steps > 1
+            or self.spec_gamma > 0
+            or (w is not None and w.owns(req))
+        ):
             if w is None or not w.alive:
                 w = self._ensure_detok()
             if w.alive:

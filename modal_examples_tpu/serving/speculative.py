@@ -1,9 +1,16 @@
-"""Speculative decoding: draft-model propose, target-model verify.
+"""Speculative decoding REFERENCE ORACLE: single-sequence propose/verify.
 
-Reference parity (SURVEY.md §2.3): the served engines enable spec decode via
-flags — vllm_inference.py:115-116,196-205 (MTP draft), deepseek EAGLE
-(config_deepseek_v4.yaml:25-27), sglang_low_latency.py:194. Here the
-algorithm itself is implemented: a small draft llama proposes gamma tokens
+This module is NOT the serving path. The engine's production speculation is
+the fused, batched, paged-KV round in :mod:`serving.spec_runtime`
+(docs/speculative.md) — scheduler-integrated, adaptive-depth, harvested
+through the multistep plane. What lives here is the textbook algorithm in
+its simplest possible form, kept as the correctness yardstick the fused
+runtime is tested against (tests/test_speculative.py; the quarantine is
+enforced by tests/test_static.py — nothing in the package may import this
+module outside spec-parity tests).
+
+The algorithm (SURVEY.md §2.3; vllm_inference.py:115-116,196-205 enables
+the same idea via flags): a small draft llama proposes gamma tokens
 autoregressively, the target scores all of them in ONE teacher-forced
 forward, and standard speculative sampling accepts a prefix (greedy mode:
 accept while draft == target argmax; stochastic mode: accept token x with
@@ -12,9 +19,9 @@ rejection) — guaranteeing the output distribution equals the target
 model's.
 
 Static-shape jit: fixed token buffer, ``lax.while_loop`` over rounds,
-``lax.scan`` for the draft chain. v1 scores by recompute over the fixed
-window (the tiny-draft regime); wiring the paged KV cache into verification
-is the planned optimization for the serving engine's decode loop.
+``lax.scan`` for the draft chain. Scoring recomputes over the fixed window
+(no KV cache) — fine for an oracle, exactly the cost the fused runtime's
+paged ``verify_step`` removes.
 """
 
 from __future__ import annotations

@@ -41,6 +41,15 @@ METRICS: list[tuple[str, bool, str]] = [
     ("disagg.migration_latency.p50", True, "ratio"),
     ("disagg.migration_latency.p95", True, "ratio"),
     ("spec.acceptance_rate", False, "abs"),
+    # fused adaptive speculation (docs/speculative.md#series): harvested
+    # tokens per fused round on the adaptive arm — the amortization
+    # speculation buys; a drop means the controller stopped finding
+    # profitable depth (or the fused round silently stopped accepting)
+    ("spec.tokens_per_dispatch", False, "ratio"),
+    # the "spec can never cost latency" escape hatch: spec-off TPOT p95
+    # over adaptive TPOT p95 on the mixed-acceptance A/B — falling below
+    # ~1 means adaptivity started taxing the hostile half of the traffic
+    ("spec.adaptive_vs_off_tpot_p95", False, "ratio"),
     ("kv_cache.bytes_per_slot", True, "ratio"),
     # stall-free admission (docs/scheduling.md): the budgeted arm's
     # interactive-stream tail latency under long-prompt interference

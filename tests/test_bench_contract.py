@@ -511,6 +511,68 @@ def test_bench_spec_config_emits_spec_section():
 
 
 @pytest.mark.slow
+def test_bench_spec_adaptive_config_emits_ab_section():
+    """tiny-spec-adaptive is the A/B the fused adaptive runtime is gated
+    on (docs/speculative.md): two populations (high-acceptance /
+    hostile) x three arms (off / fixed-gamma / adaptive) plus the
+    benchdiff scalars utils/bench_diff.py tracks. The amortization claim
+    — tokens_per_dispatch > 1 at high acceptance — is asserted here;
+    the latency claim (adaptive_vs_off_tpot_p95) is asserted present and
+    positive but not >= 1, because sub-10ms CPU tails are too noisy for
+    a hard absolute gate — benchdiff gates it round-over-round."""
+    out = subprocess.run(
+        [sys.executable, str(REPO / "bench.py")],
+        capture_output=True,
+        text=True,
+        timeout=500,
+        env={
+            **os.environ,
+            "BENCH_CPU": "1",
+            "BENCH_MODEL": "tiny-spec-adaptive",
+            "BENCH_NO_SECONDARY": "1",
+        },
+        cwd=str(REPO),
+    )
+    assert out.returncode == 0, out.stderr[-500:]
+    lines = [l for l in out.stdout.strip().splitlines() if l.strip()]
+    assert len(lines) == 1, lines
+    payload = json.loads(lines[0])
+    assert payload["value"] > 0 and payload["unit"] == "tok/s"
+    spec = payload.get("spec")
+    assert spec, payload
+    assert spec["mode"] == "ngram" and spec["gamma"] == 4
+    # benchdiff-gated scalars (utils/bench_diff.py METRICS)
+    assert {"gamma_p50", "tokens_per_dispatch", "fallback_rounds",
+            "adaptive_vs_off_tpot_p95"} <= set(spec), spec
+    assert spec["adaptive_vs_off_tpot_p95"] > 0
+    # the A/B grid itself
+    for pop in ("accept", "hostile"):
+        arms = spec.get(pop)
+        assert arms and {"off", "fixed", "adaptive"} <= set(arms), spec
+        for arm, stats in arms.items():
+            assert {"spec_rounds", "fallback_rounds", "gamma_p50",
+                    "acceptance_rate", "tpot_p95"} <= set(stats), stats
+        # the off arm never dispatches a fused round
+        assert arms["off"]["spec_rounds"] == 0
+        assert arms["off"]["proposed"] == 0
+    accept_ad = spec["accept"]["adaptive"]
+    # acceptance gate: on the self-similar population the fused round
+    # harvests strictly more than one token per dispatch, at depth > 0
+    assert accept_ad["spec_rounds"] > 0, spec
+    assert accept_ad["tokens_per_dispatch"] > 1, spec
+    assert accept_ad["gamma_p50"] > 0, spec
+    assert spec["tokens_per_dispatch"] == accept_ad["tokens_per_dispatch"]
+    # the hostile population must actually be hostile (low acceptance on
+    # the fixed arm) and the controller must shrink depth relative to it
+    hostile = spec["hostile"]
+    assert hostile["fixed"]["acceptance_rate"] < 0.6, spec
+    assert (
+        hostile["adaptive"]["gamma_p50"] <= hostile["fixed"]["gamma_p50"]
+    ), spec
+    assert payload["engine_errors"] == 0
+
+
+@pytest.mark.slow
 def test_image_child_emits_schema_json():
     """The images/sec secondary metric (BASELINE.json: 'SDXL images/sec'):
     the txt2img pipeline child must print one JSON line; the tiny CPU

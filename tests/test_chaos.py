@@ -120,8 +120,8 @@ class TestChaosUnderLoad:
     ):
         monkeypatch.setenv("MTPU_TRACE_SAMPLE", "0")
         from modal_examples_tpu.faults.chaos import (
-            check_router_recovered,
             settle_drained,
+            settle_recovered,
         )
         from modal_examples_tpu.faults.inject import FaultPlan, active
         from modal_examples_tpu.fleet.loadgen import LoadGenerator, RequestClass
@@ -191,7 +191,7 @@ class TestChaosUnderLoad:
                 assert step["errors"] == 0, step
             # fleet invariants (PR 8) after the fault window drained
             assert settle_drained({"uni-a": eng_a, "uni-b": eng_b}) == []
-            assert check_router_recovered(router) == []
+            assert settle_recovered(router) == []
             # the measured self-healing clause: the fault window still
             # delivered a bounded fraction of fault-free goodput
             assert baseline["goodput_rps"] > 0
@@ -212,8 +212,8 @@ class TestDecodeReplicaDeathMidStream:
         import threading
 
         from modal_examples_tpu.faults.chaos import (
-            check_router_recovered,
             settle_drained,
+            settle_recovered,
         )
         from modal_examples_tpu.faults.inject import FaultPlan, active
         from modal_examples_tpu.models import llama
@@ -288,7 +288,7 @@ class TestDecodeReplicaDeathMidStream:
                 assert "".join(outs[req.request_id]) == reference[req.prompt]
             # PR-8 fleet invariants after the episode
             assert settle_drained({"death-a": eng_a, "death-b": eng_b}) == []
-            assert check_router_recovered(router) == []
+            assert settle_recovered(router) == []
         finally:
             eng_a.stop()
             eng_b.stop()
@@ -302,8 +302,8 @@ class TestDecodeReplicaDeathMidStream:
         not just asserted on a quiet fleet."""
         monkeypatch.setenv("MTPU_TRACE_SAMPLE", "0")
         from modal_examples_tpu.faults.chaos import (
-            check_router_recovered,
             settle_drained,
+            settle_recovered,
         )
         from modal_examples_tpu.faults.inject import FaultPlan, active
         from modal_examples_tpu.fleet.loadgen import LoadGenerator, RequestClass
@@ -358,7 +358,7 @@ class TestDecodeReplicaDeathMidStream:
             assert faulted["errors"] == 0, faulted
             assert faulted["goodput_rps"] > 0
             assert settle_drained({"dload-a": eng_a, "dload-b": eng_b}) == []
-            assert check_router_recovered(router) == []
+            assert settle_recovered(router) == []
         finally:
             server.stop()
 
@@ -376,8 +376,8 @@ class TestSilentHangUnderLoad:
     def test_freeze_under_load_recovers(self, jax_cpu, state_dir, monkeypatch):
         monkeypatch.setenv("MTPU_TRACE_SAMPLE", "0")
         from modal_examples_tpu.faults.chaos import (
-            check_router_recovered,
             settle_drained,
+            settle_recovered,
         )
         from modal_examples_tpu.faults.inject import FaultPlan, active
         from modal_examples_tpu.fleet.loadgen import LoadGenerator, RequestClass
@@ -460,7 +460,7 @@ class TestSilentHangUnderLoad:
             acted = {e["action"] for e in watchdog.events}
             assert "stop_revive" in acted, watchdog.events
             assert settle_drained({"hang-a": eng_a, "hang-b": eng_b}) == []
-            assert check_router_recovered(router) == []
+            assert settle_recovered(router) == []
         finally:
             if watchdog is not None:
                 watchdog.stop()

@@ -159,47 +159,26 @@ class TestEngineSpeculative:
         finally:
             eng.stop()
 
-    def test_top_p_rejected_in_spec_mode(self, jax):
+    def test_top_p_accepted_in_spec_mode(self, jax):
+        """The fused runtime routes temp>0 lanes through the in-program
+        classic sample() call (docs/speculative.md#program-shape), so every
+        sampling knob the plain engine takes is legal in spec mode — and
+        must match the plain engine token-for-token (same seed, same
+        (seed,position)-keyed sampling contract)."""
         from modal_examples_tpu.models import llama
         from modal_examples_tpu.serving import SamplingParams
 
+        plain = self._mk_engine(jax)
         eng = self._mk_engine(jax, speculative=(llama.LlamaConfig.tiny(), 2))
         try:
-            with pytest.raises(ValueError, match="top_p"):
-                eng.submit("x", SamplingParams(top_p=0.5))
-        finally:
-            eng.stop()
-
-    def test_top_p_is_http_400_in_spec_mode(self, jax):
-        """An unsupported-but-valid OpenAI field must come back as a JSON 400
-        (invalid_request_error), not a dropped connection."""
-        import json
-        import urllib.error
-        import urllib.request
-
-        from modal_examples_tpu.models import llama
-        from modal_examples_tpu.serving import OpenAIServer
-
-        eng = self._mk_engine(jax, speculative=(llama.LlamaConfig.tiny(), 2))
-        srv = OpenAIServer(eng, model_name="spec", host="127.0.0.1", port=0)
-        srv.start()
-        try:
-            body = json.dumps(
-                {"prompt": "x", "max_tokens": 4, "top_p": 0.9}
-            ).encode()
-            req = urllib.request.Request(
-                f"http://127.0.0.1:{srv.port}/v1/completions",
-                data=body,
-                headers={"content-type": "application/json"},
+            params = SamplingParams(
+                max_tokens=12, temperature=0.8, top_p=0.5, seed=7
             )
-            with pytest.raises(urllib.error.HTTPError) as exc:
-                urllib.request.urlopen(req)
-            assert exc.value.code == 400
-            err = json.load(exc.value)
-            assert err["error"]["type"] == "invalid_request_error"
-            assert "top_p" in err["error"]["message"]
+            want = plain.generate("x y z", params)
+            got = eng.generate("x y z", params)
+            assert got == want
         finally:
-            srv.httpd.shutdown()
+            plain.stop()
             eng.stop()
 
 

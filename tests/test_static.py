@@ -886,6 +886,9 @@ _SERVING_MONOTONIC_ALLOWLIST = frozenset({
     ("serving/engine.py", "LLMEngine._prefill_sync_locked"),
     ("serving/engine.py", "LLMEngine._process_block"),
     ("serving/engine.py", "LLMEngine._refresh_gauges"),
+    # the fused speculative round is a dispatch site like _dispatch_block:
+    # same decode-stall watermark accounting, same raw-clock rationale
+    ("serving/engine.py", "LLMEngine._spec_round"),
     ("serving/engine.py", "LLMEngine.submit_resumed"),
     ("serving/engine.py", "LLMEngine.warmup"),
     ("serving/failover.py", "migrate_request"),
@@ -1631,6 +1634,96 @@ def test_multistep_series_declared_and_emitted():
     ]
     assert not orphans, (
         f"multistep recorders with no call site outside metrics.py: {orphans}"
+    )
+
+
+def test_spec_series_declared_and_emitted():
+    """Closure for the fused-speculative series (``mtpu_spec_*``,
+    docs/speculative.md#series), both directions: every declared catalog
+    constant must be referenced by a live emitter/reader outside the
+    catalog, AND every spec recorder in observability/metrics.py must have
+    a call site outside metrics.py — otherwise the γ/acceptance meters the
+    adaptive controller is judged by silently read zeros."""
+    from modal_examples_tpu.observability import catalog
+
+    consts = {
+        attr: val
+        for attr, val in vars(catalog).items()
+        if isinstance(val, str) and val.startswith("mtpu_spec_")
+    }
+    # proposed/accepted/acceptance (PR-5 server exposition) + the fused
+    # gamma/tokens-per-dispatch/fallback series (PR-20)
+    assert len(consts) >= 6, consts
+    catalog_path = PKG_ROOT / "observability" / "catalog.py"
+    package_src = {
+        path: path.read_text()
+        for path in sorted(PKG_ROOT.rglob("*.py"))
+        if path != catalog_path
+    }
+    unused = [
+        attr for attr in consts
+        if not any(
+            re.search(rf"\b{attr}\b", src) for src in package_src.values()
+        )
+    ]
+    assert not unused, (
+        "spec series declared in the catalog but never referenced by an "
+        f"emitter/reader in the package: {unused}"
+    )
+    metrics_path = PKG_ROOT / "observability" / "metrics.py"
+    recorders = ("set_spec_gauges", "record_spec_fallback")
+    orphans = [
+        fn for fn in recorders
+        if not any(
+            re.search(rf"\b{fn}\(", src)
+            for path, src in package_src.items()
+            if path != metrics_path
+        )
+    ]
+    assert not orphans, (
+        f"spec recorders with no call site outside metrics.py: {orphans}"
+    )
+
+
+def test_speculative_bypass_quarantined_to_oracle_duty():
+    """The standalone ``speculative_generate`` loop is RETIRED from the
+    serving path (docs/speculative.md): the engine's fused round in
+    serving/spec_runtime/ is the only production speculation. The module
+    survives solely as the reference oracle for parity tests, so nothing
+    under the package may import it except spec_runtime itself (which
+    shares ``serving.speculative``'s n-gram index) — a new import is
+    someone re-growing the bypass."""
+    offenders = []
+    for path in sorted(PKG_ROOT.rglob("*.py")):
+        rel = path.relative_to(PKG_ROOT).as_posix()
+        if rel.startswith("serving/spec_runtime/"):
+            continue  # shares the oracle's n-gram index by design
+        if rel == "serving/speculative.py":
+            continue  # the oracle itself
+        src = path.read_text()
+        for node in ast.walk(ast.parse(src, filename=str(path))):
+            # `from X.speculative import ...` pulls symbols out of the
+            # oracle; `import X.speculative` binds it for use. The one
+            # legal form is `from . import speculative` in
+            # serving/__init__.py, which only RE-EXPORTS the module so the
+            # parity tests can import the oracle.
+            if isinstance(node, ast.ImportFrom):
+                if (node.module or "").split(".")[-1] == "speculative":
+                    offenders.append((rel, f"line {node.lineno}"))
+                elif any(a.name == "speculative" for a in node.names):
+                    if rel != "serving/__init__.py":
+                        offenders.append((rel, f"line {node.lineno}"))
+            elif isinstance(node, ast.Import):
+                if any(
+                    a.name.split(".")[-1] == "speculative"
+                    for a in node.names
+                ):
+                    offenders.append((rel, f"line {node.lineno}"))
+        if "speculative_generate" in src:
+            offenders.append((rel, "references speculative_generate"))
+    assert not offenders, (
+        "serving.speculative is the parity oracle, not a serving-path "
+        f"dependency — re-route through serving/spec_runtime/: {offenders}"
     )
 
 
